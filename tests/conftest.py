@@ -1,0 +1,43 @@
+"""Test fixtures: force an 8-device virtual CPU platform BEFORE jax import.
+
+This is the capability the reference never had (SURVEY.md §4): Theano-MPI
+could only be tested on a real multi-GPU MPI cluster. Here every
+collective/exchanger/sync-rule test runs on a real 8-way mesh emulated
+on host CPU, so distributed semantics are unit-testable in CI.
+"""
+
+import os
+
+# The container's axon site hook re-exports JAX_PLATFORMS=axon at interpreter
+# start, so plain env vars are not enough: set XLA_FLAGS (read at backend
+# init), then override the platform through the config API post-import.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh8(devices):
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(devices), ("data",))
+
+
+@pytest.fixture
+def rng():
+    return jax.random.PRNGKey(0)

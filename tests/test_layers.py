@@ -1,0 +1,138 @@
+"""Layer-library unit tests vs numpy oracles (SURVEY.md §4 item (a))."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from theanompi_tpu import nn
+from theanompi_tpu.nn import init as initializers
+
+
+def test_conv_shape_and_oracle():
+    key = jax.random.PRNGKey(0)
+    conv = nn.Conv(8, kernel=3, stride=1, padding="VALID", w_init=initializers.gaussian(0.1))
+    x = jax.random.normal(key, (2, 8, 8, 4))
+    params, state = conv.init(key, x.shape)
+    y, _ = conv.apply(params, state, x)
+    assert y.shape == conv.out_shape(x.shape) == (2, 6, 6, 8)
+    # oracle: direct correlation at one output location
+    w = np.asarray(params["w"])
+    xn = np.asarray(x)
+    expect = np.einsum("hwc,hwco->o", xn[0, 0:3, 0:3, :], w) + np.asarray(params["b"])
+    np.testing.assert_allclose(np.asarray(y)[0, 0, 0], expect, rtol=1e-4)
+
+
+def test_grouped_conv_matches_split_concat():
+    """groups=2 (AlexNet) == two independent convs on channel halves."""
+    key = jax.random.PRNGKey(1)
+    x = jax.random.normal(key, (2, 6, 6, 8))
+    g = nn.Conv(16, kernel=3, padding="SAME", groups=2, use_bias=False)
+    params, state = g.init(key, x.shape)
+    y, _ = g.apply(params, state, x)
+
+    w = params["w"]  # (3,3,4,16)
+    lo = nn.Conv(8, kernel=3, padding="SAME", groups=1, use_bias=False)
+    y_lo, _ = lo.apply({"w": w[..., :8]}, {}, x[..., :4])
+    y_hi, _ = lo.apply({"w": w[..., 8:]}, {}, x[..., 4:])
+    np.testing.assert_allclose(np.asarray(y), np.concatenate([y_lo, y_hi], axis=-1), rtol=1e-4)
+
+
+def test_maxpool_oracle():
+    x = jnp.arange(16, dtype=jnp.float32).reshape(1, 4, 4, 1)
+    pool = nn.Pool(window=2, stride=2, mode="max")
+    y, _ = pool.apply({}, {}, x)
+    np.testing.assert_allclose(np.asarray(y)[0, :, :, 0], [[5, 7], [13, 15]])
+
+
+def test_avgpool_oracle():
+    x = jnp.arange(16, dtype=jnp.float32).reshape(1, 4, 4, 1)
+    pool = nn.Pool(window=2, stride=2, mode="avg")
+    y, _ = pool.apply({}, {}, x)
+    np.testing.assert_allclose(np.asarray(y)[0, :, :, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_lrn_oracle():
+    """pylearn2-convention LRN: y = x / (k + (alpha/n) * window_sum(x^2))^beta."""
+    n, alpha, beta, k = 5, 1e-4, 0.75, 2.0
+    lrn = nn.LRN(n=n, alpha=alpha, beta=beta, k=k)
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 3, 3, 7)) * 3.0
+    y, _ = lrn.apply({}, {}, x)
+    xn = np.asarray(x)
+    sq = xn**2
+    half = n // 2
+    padded = np.pad(sq, [(0, 0)] * 3 + [(half, half)])
+    wsum = np.stack([padded[..., i : i + n].sum(-1) for i in range(7)], axis=-1)
+    expect = xn / (k + (alpha / n) * wsum) ** beta
+    np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-5)
+
+
+def test_dense_oracle():
+    key = jax.random.PRNGKey(3)
+    fc = nn.Dense(5)
+    x = jax.random.normal(key, (4, 7))
+    params, state = fc.init(key, x.shape)
+    y, _ = fc.apply(params, state, x)
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(x) @ np.asarray(params["w"]) + np.asarray(params["b"]), rtol=1e-5
+    )
+
+
+def test_dropout_train_and_eval():
+    d = nn.Dropout(0.5)
+    x = jnp.ones((100, 100))
+    y_eval, _ = d.apply({}, {}, x, train=False)
+    np.testing.assert_array_equal(np.asarray(y_eval), np.asarray(x))
+    y_train, _ = d.apply({}, {}, x, train=True, rng=jax.random.PRNGKey(0))
+    kept = np.asarray(y_train) > 0
+    assert 0.4 < kept.mean() < 0.6
+    np.testing.assert_allclose(np.asarray(y_train)[kept], 2.0)  # inverted scaling
+
+
+def test_batchnorm_train_normalizes_and_updates_state():
+    bn = nn.BatchNorm(momentum=0.9)
+    key = jax.random.PRNGKey(4)
+    x = 3.0 + 2.0 * jax.random.normal(key, (64, 4, 4, 3))
+    params, state = bn.init(key, x.shape)
+    y, new_state = bn.apply(params, state, x, train=True)
+    yn = np.asarray(y)
+    np.testing.assert_allclose(yn.mean(axis=(0, 1, 2)), 0.0, atol=1e-4)
+    np.testing.assert_allclose(yn.std(axis=(0, 1, 2)), 1.0, atol=1e-2)
+    assert np.all(np.asarray(new_state["mean"]) != np.asarray(state["mean"]))
+    # eval path uses running stats
+    y2, s2 = bn.apply(params, new_state, x, train=False)
+    assert s2 is new_state
+
+
+def test_sequential_composes_and_infers_shapes():
+    key = jax.random.PRNGKey(5)
+    net = nn.Sequential(
+        [
+            nn.Conv(8, 3, padding="SAME"),
+            nn.BatchNorm(),
+            nn.Activation("relu"),
+            nn.Pool(2),
+            nn.Flatten(),
+            nn.Dense(10),
+        ]
+    )
+    x = jax.random.normal(key, (2, 8, 8, 3))
+    params, state = net.init(key, x.shape)
+    assert net.out_shape(x.shape) == (2, 10)
+    y, new_state = net.apply(params, state, x, train=True, rng=key)
+    assert y.shape == (2, 10)
+    assert any("bn" in k for k in state)
+
+
+def test_sequential_jit_grad():
+    key = jax.random.PRNGKey(6)
+    net = nn.Sequential([nn.Conv(4, 3, padding="SAME"), nn.Activation("relu"), nn.Flatten(), nn.Dense(2)])
+    x = jax.random.normal(key, (2, 4, 4, 3))
+    params, state = net.init(key, x.shape)
+
+    @jax.jit
+    def loss_fn(p):
+        y, _ = net.apply(p, state, x)
+        return jnp.sum(y**2)
+
+    g = jax.grad(loss_fn)(params)
+    assert jax.tree_util.tree_structure(g) == jax.tree_util.tree_structure(params)

@@ -1,0 +1,50 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from theanompi_tpu.ops import lr_schedules as lrs
+
+
+def test_constant():
+    s = lrs.constant(0.1)
+    assert float(s(0)) == pytest.approx(0.1)
+    assert float(s(1000)) == pytest.approx(0.1)
+
+
+def test_step_decay_boundaries():
+    s = lrs.step_decay(0.1, boundaries=[10, 20], factor=0.1)
+    assert float(s(0)) == pytest.approx(0.1)
+    assert float(s(9)) == pytest.approx(0.1)
+    assert float(s(10)) == pytest.approx(0.01)
+    assert float(s(19)) == pytest.approx(0.01)
+    assert float(s(25)) == pytest.approx(0.001, rel=1e-5)
+
+
+def test_step_decay_traced():
+    import jax
+
+    s = lrs.step_decay(0.1, boundaries=[5], factor=0.5)
+    vals = jax.jit(jax.vmap(s))(jnp.arange(10))
+    np.testing.assert_allclose(np.asarray(vals[:5]), 0.1, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(vals[5:]), 0.05, rtol=1e-6)
+
+
+def test_exponential_decay():
+    s = lrs.exponential_decay(1.0, 0.5, every=2)
+    assert float(s(0)) == pytest.approx(1.0)
+    assert float(s(2)) == pytest.approx(0.5)
+    assert float(s(4)) == pytest.approx(0.25)
+
+
+def test_warmup_cosine_endpoints():
+    s = lrs.linear_warmup_cosine(1.0, warmup_steps=10, total_steps=110)
+    assert float(s(0)) == pytest.approx(0.0)
+    assert float(s(5)) == pytest.approx(0.5)
+    assert float(s(10)) == pytest.approx(1.0, rel=1e-3)
+    assert float(s(110)) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_registry():
+    assert float(lrs.get_schedule("constant", lr=0.2)(3)) == pytest.approx(0.2)
+    with pytest.raises(ValueError):
+        lrs.get_schedule("nope")

@@ -1,0 +1,121 @@
+"""Optimizer unit tests against numpy oracles (SURVEY.md §4 item (a))."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from theanompi_tpu.ops import optimizers as opt
+
+
+def _tree(seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "w": jnp.asarray(rng.randn(4, 3), jnp.float32),
+        "b": jnp.asarray(rng.randn(3), jnp.float32),
+    }
+
+
+def _grads(seed=1):
+    return _tree(seed)
+
+
+def test_sgd_matches_oracle():
+    params, grads = _tree(), _grads()
+    o = opt.sgd(weight_decay=0.0)
+    state = o.init(params)
+    updates, state = o.update(grads, state, params, jnp.float32(0.1))
+    new = opt.apply_updates(params, updates)
+    np.testing.assert_allclose(new["w"], np.asarray(params["w"]) - 0.1 * np.asarray(grads["w"]), rtol=1e-6)
+
+
+def test_sgd_weight_decay():
+    params, grads = _tree(), _grads()
+    o = opt.sgd(weight_decay=0.01)
+    updates, _ = o.update(grads, o.init(params), params, jnp.float32(0.1))
+    new = opt.apply_updates(params, updates)
+    expect = np.asarray(params["w"]) - 0.1 * (np.asarray(grads["w"]) + 0.01 * np.asarray(params["w"]))
+    np.testing.assert_allclose(new["w"], expect, rtol=1e-6)
+
+
+def test_momentum_two_steps_matches_oracle():
+    """v = mu*v - lr*g; p += v — the reference's lib/opt.py momentum form."""
+    params, grads = _tree(), _grads()
+    mu, lr = 0.9, 0.05
+    o = opt.momentum_sgd(momentum=mu)
+    state = o.init(params)
+    p_np, v_np = np.asarray(params["w"]), np.zeros((4, 3), np.float32)
+    g_np = np.asarray(grads["w"])
+    p = params
+    for _ in range(3):
+        updates, state = o.update(grads, state, p, jnp.float32(lr))
+        p = opt.apply_updates(p, updates)
+        v_np = mu * v_np - lr * g_np
+        p_np = p_np + v_np
+    np.testing.assert_allclose(p["w"], p_np, rtol=1e-5)
+
+
+def test_nesterov_matches_oracle():
+    params, grads = _tree(), _grads()
+    mu, lr = 0.9, 0.05
+    o = opt.nesterov_sgd(momentum=mu)
+    state = o.init(params)
+    p_np, v_np = np.asarray(params["w"]), np.zeros((4, 3), np.float32)
+    g_np = np.asarray(grads["w"])
+    p = params
+    for _ in range(2):
+        updates, state = o.update(grads, state, p, jnp.float32(lr))
+        p = opt.apply_updates(p, updates)
+        v_np = mu * v_np - lr * g_np
+        p_np = p_np + mu * v_np - lr * g_np
+    np.testing.assert_allclose(p["w"], p_np, rtol=1e-5)
+
+
+def test_adam_matches_oracle():
+    params, grads = _tree(), _grads()
+    b1, b2, eps, lr = 0.9, 0.999, 1e-8, 0.001
+    o = opt.adam(b1=b1, b2=b2, eps=eps)
+    state = o.init(params)
+    m = np.zeros((4, 3), np.float32)
+    v = np.zeros((4, 3), np.float32)
+    g = np.asarray(grads["w"])
+    p_np = np.asarray(params["w"])
+    p = params
+    for t in range(1, 4):
+        updates, state = o.update(grads, state, p, jnp.float32(lr))
+        p = opt.apply_updates(p, updates)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        scale = lr * np.sqrt(1 - b2**t) / (1 - b1**t)
+        p_np = p_np - scale * m / (np.sqrt(v) + eps)
+    np.testing.assert_allclose(p["w"], p_np, rtol=1e-5)
+
+
+def test_rmsprop_decreases_quadratic():
+    o = opt.rmsprop()
+    params = {"x": jnp.asarray([3.0, -2.0])}
+    state = o.init(params)
+
+    def loss(p):
+        return jnp.sum(p["x"] ** 2)
+
+    for _ in range(200):
+        g = jax.grad(loss)(params)
+        updates, state = o.update(g, state, params, jnp.float32(0.05))
+        params = opt.apply_updates(params, updates)
+    assert loss(params) < 1e-2
+
+
+def test_registry_and_unknown():
+    assert opt.get_optimizer("momentum", momentum=0.8).name == "momentum"
+    with pytest.raises(ValueError):
+        opt.get_optimizer("nope")
+
+
+def test_update_is_jittable():
+    params, grads = _tree(), _grads()
+    o = opt.momentum_sgd()
+    state = o.init(params)
+    step = jax.jit(lambda g, s, p, lr: o.update(g, s, p, lr))
+    updates, state2 = step(grads, state, params, jnp.float32(0.1))
+    assert updates["w"].shape == (4, 3)

@@ -1,0 +1,38 @@
+"""theanompi_tpu — a TPU-native rebuild of Theano-MPI.
+
+Theano-MPI (reference: bobquest33/Theano-MPI, arXiv:1605.08325) is a
+data-parallel distributed training framework for convolutional networks:
+a model zoo (AlexNet, GoogLeNet, VGG16, ResNet-50, Wide-ResNet), pluggable
+synchronization rules (BSP / EASGD / GoSGD), pluggable gradient-exchange
+strategies, an asynchronous input pipeline, and a recorder/checkpoint layer,
+all glued together with CUDA-aware MPI + NCCL.
+
+This package provides the same behavioral contract, redesigned TPU-first:
+
+- one SPMD program under ``jax.jit`` over a named ``jax.sharding.Mesh``
+  replaces the reference's process-per-GPU ``mpirun`` model
+  (reference: ``lib/base.py`` — ``MPI_GPU_Process``; empty mount, see SURVEY.md);
+- gradient allreduce lowers to ``lax.psum`` over ICI instead of
+  MPI/NCCL calls between steps (reference: ``lib/exchanger.py`` — ``BSP_Exchanger``);
+- EASGD's center<->worker elastic averaging and GoSGD's randomized gossip
+  become ``lax.ppermute`` / ``lax.psum`` collectives inside the compiled step
+  (reference: ``lib/exchanger.py`` — ``EASGD_Exchanger``, ``GOSGD_Exchanger``);
+- the exchanger-strategy concept survives as a swappable gradient-sync
+  function (reference: ``lib/exchanger_strategy.py`` — ``Exch_allreduce``,
+  ``Exch_asa32``, ``Exch_asa16``, ``Exch_nccl32``);
+- Theano shared GPU params + ``lib/opt.py`` updates compile as a single
+  pjit'd train step over HBM-resident ``jax.Array``s.
+
+Session API (reference: ``launch_session.py`` / ``tmpi``)::
+
+    from theanompi_tpu import BSP
+    rule = BSP()
+    rule.init(devices=8, modelfile='theanompi_tpu.models.wrn', modelclass='WRN')
+    rule.wait()
+"""
+
+__version__ = "0.1.0"
+
+from theanompi_tpu.launch.session import BSP, EASGD, GOSGD, SyncRule  # noqa: F401
+
+__all__ = ["BSP", "EASGD", "GOSGD", "SyncRule", "__version__"]

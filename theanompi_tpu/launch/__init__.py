@@ -1,0 +1,5 @@
+"""Launch layer: session API + worker training drivers.
+
+TPU-native replacement for the reference's ``tmpi`` CLI and
+``launch_session.py`` session scripts (SURVEY.md §1 L7).
+"""

@@ -1,0 +1,115 @@
+"""Session API: sync-rule classes mirroring the reference's launcher.
+
+Reference (``tmpi`` / ``launch_session.py``, SURVEY.md §1 L7, §3.1): the
+user constructs a rule object and calls
+``rule.init(devices, modelfile, modelclass)`` then ``rule.wait()``; the
+reference built an ``mpirun`` command line spawning one OS process per
+GPU. On TPU there is no mpirun and no process-per-device: ``init``
+resolves the model class, builds a ``jax.sharding.Mesh`` over the
+requested devices, and starts ONE SPMD training driver (in-process, or
+in a background thread so ``wait()`` keeps the reference's semantics).
+"""
+
+from __future__ import annotations
+
+import importlib
+import threading
+from typing import Any, Optional, Sequence, Union
+
+
+def resolve_model(modelfile: str, modelclass: str):
+    """Import ``modelclass`` from module path ``modelfile``.
+
+    The reference passed a python file path + class name over argv to the
+    workers (reference: ``launch_session.py``); here modelfile is a module
+    path (e.g. ``theanompi_tpu.models.wrn``) or a filesystem path ending
+    in ``.py``.
+    """
+    if modelfile.endswith(".py"):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("_tmpi_model", modelfile)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    else:
+        mod = importlib.import_module(modelfile)
+    return getattr(mod, modelclass)
+
+
+class SyncRule:
+    """Base rule: subclasses set ``rule_name`` and default driver kwargs."""
+
+    rule_name: str = "base"
+
+    def __init__(self, **rule_kwargs):
+        self.rule_kwargs = rule_kwargs
+        self._thread: Optional[threading.Thread] = None
+        self._result: Any = None
+        self._error: Optional[BaseException] = None
+
+    def init(
+        self,
+        devices: Union[int, Sequence, None] = None,
+        modelfile: str = "theanompi_tpu.models.wrn",
+        modelclass: str = "WRN",
+        blocking: bool = False,
+        **overrides,
+    ):
+        """Start training. ``devices``: device count (first N), an explicit
+        device list, or None for all. With ``blocking=False`` (reference
+        semantics) training runs in a background thread and ``wait()``
+        joins it."""
+        from theanompi_tpu.launch.worker import run_training
+
+        self._thread = None
+        self._result = None
+        self._error = None
+        model_cls = resolve_model(modelfile, modelclass)
+        kwargs = {**self.rule_kwargs, **overrides}
+
+        def _run():
+            try:
+                self._result = run_training(
+                    rule=self.rule_name, model_cls=model_cls, devices=devices, **kwargs
+                )
+            except BaseException as e:  # surfaced in wait()
+                self._error = e
+
+        if blocking:
+            _run()
+            if self._error is not None:
+                raise self._error
+            return self._result
+        self._thread = threading.Thread(target=_run, name=f"tmpi-{self.rule_name}", daemon=True)
+        self._thread.start()
+        return self
+
+    def wait(self):
+        """Block until training finishes (reference: ``rule.wait()`` blocked
+        on the mpirun child)."""
+        if self._thread is not None:
+            self._thread.join()
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class BSP(SyncRule):
+    """Bulk-synchronous data parallelism: per-step gradient allreduce
+    (reference: ``lib/exchanger.py`` — ``BSP_Exchanger``)."""
+
+    rule_name = "bsp"
+
+
+class EASGD(SyncRule):
+    """Elastic-averaging SGD: workers + center replica, periodic elastic
+    exchange (reference: ``lib/exchanger.py`` — ``EASGD_Exchanger``)."""
+
+    rule_name = "easgd"
+
+
+class GOSGD(SyncRule):
+    """Gossip SGD: randomized peer-to-peer weighted averaging
+    (reference: ``lib/exchanger.py`` — ``GOSGD_Exchanger``)."""
+
+    rule_name = "gosgd"

@@ -1,0 +1,24 @@
+"""Functional NN layer library.
+
+TPU-native replacement for the reference's hand-rolled Theano layer
+classes (reference: ``models/layers2.py`` — ``Conv``, ``Pool``, ``FC``,
+``Dropout``, ``Softmax``, ``LRN``; reference mount empty at build time,
+anchors per SURVEY.md §2.1). Idiomatic JAX modules: every layer is a
+lightweight object with pure ``init``/``apply`` functions over explicit
+parameter and state pytrees — no framework magic, everything jit-safe.
+"""
+
+from theanompi_tpu.nn import init  # noqa: F401
+from theanompi_tpu.nn.layers import (  # noqa: F401
+    Activation,
+    BatchNorm,
+    Conv,
+    Dropout,
+    Flatten,
+    GlobalAvgPool,
+    Layer,
+    Dense,
+    LRN,
+    Pool,
+    Sequential,
+)

@@ -1,0 +1,23 @@
+"""Pure-function compute ops: optimizers, LR schedules, numerics.
+
+TPU-native replacement for the reference's ``lib/opt.py`` update-rule
+builders (reference mount empty at build time — anchors per SURVEY.md §2.1).
+"""
+
+from theanompi_tpu.ops.optimizers import (  # noqa: F401
+    Optimizer,
+    adam,
+    get_optimizer,
+    momentum_sgd,
+    nesterov_sgd,
+    rmsprop,
+    sgd,
+)
+from theanompi_tpu.ops.lr_schedules import (  # noqa: F401
+    constant,
+    exponential_decay,
+    get_schedule,
+    linear_warmup_cosine,
+    polynomial_decay,
+    step_decay,
+)

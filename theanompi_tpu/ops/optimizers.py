@@ -1,0 +1,202 @@
+"""Optimizer update rules as pure pytree transforms.
+
+TPU-native equivalent of the reference's ``lib/opt.py`` (grep anchors:
+``MSGD``-style builders, ``vels``, ``updates_v``/``updates_w``; reference
+mount empty at build time — see SURVEY.md §2.1).
+
+The reference built Theano update dicts in a **two-phase** scheme: the
+train function wrote raw gradients into persistent velocity shared vars
+("separate" mode), the exchanger allreduced those buffers between Theano
+calls, and a second compiled function applied them to the weights. That
+split existed only because communication happened *between* compiled
+functions. Under XLA the whole step — forward, backward, collective,
+update — is one compiled program, so here an optimizer is simply a pair
+of pure functions over parameter pytrees:
+
+    opt = momentum_sgd(momentum=0.9, weight_decay=5e-4)
+    state = opt.init(params)
+    updates, state = opt.update(grads, state, params, lr)
+    params = apply_updates(params, updates)
+
+Gradient synchronization (the exchanger) transforms ``grads`` *before*
+``opt.update`` — exactly the reference's ordering, where comm saw raw
+gradients and the weight update ran post-exchange.
+
+Semantics match the reference recipes (2016 AlexNet-era conventions):
+
+- weight decay is folded into the gradient: ``g += wd * p``;
+- classical momentum:  ``v = mu*v - lr*g``; ``p += v``;
+- Nesterov momentum:   ``v = mu*v - lr*g``; ``p += mu*v - lr*g``.
+
+All arithmetic runs in the dtype of the optimizer state (fp32 by
+default even when params are bf16) so that long momentum accumulations
+do not lose precision on TPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+class Optimizer(NamedTuple):
+    """A pure optimizer: ``init(params) -> state``, ``update(grads, state, params, lr) -> (updates, state)``."""
+
+    name: str
+    init: Callable[[PyTree], PyTree]
+    update: Callable[[PyTree, PyTree, PyTree, jax.Array], tuple[PyTree, PyTree]]
+
+
+def apply_updates(params: PyTree, updates: PyTree) -> PyTree:
+    """``p += u`` leafwise, preserving the parameter dtype."""
+    return jax.tree_util.tree_map(
+        lambda p, u: (p + u.astype(p.dtype)) if u is not None else p, params, updates
+    )
+
+
+def _acc_like(params: PyTree, dtype=jnp.float32) -> PyTree:
+    """Zero accumulator pytree in the accumulation dtype."""
+    return jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, dtype or p.dtype), params)
+
+
+def _decayed(grads: PyTree, params: PyTree, weight_decay: float, dtype=jnp.float32) -> PyTree:
+    """Fold L2 weight decay into the gradient (reference: ``lib/opt.py`` adds
+    ``weight_decay * p`` to the cost gradient)."""
+    if weight_decay:
+        return jax.tree_util.tree_map(
+            lambda g, p: g.astype(dtype) + weight_decay * p.astype(dtype), grads, params
+        )
+    return jax.tree_util.tree_map(lambda g: g.astype(dtype), grads)
+
+
+def sgd(weight_decay: float = 0.0) -> Optimizer:
+    """Vanilla SGD: ``p -= lr * (g + wd*p)``. Stateless."""
+
+    def init(params):
+        return ()
+
+    def update(grads, state, params, lr):
+        g = _decayed(grads, params, weight_decay)
+        updates = jax.tree_util.tree_map(lambda gi: -lr * gi, g)
+        return updates, state
+
+    return Optimizer("sgd", init, update)
+
+
+def momentum_sgd(momentum: float = 0.9, weight_decay: float = 0.0) -> Optimizer:
+    """Classical momentum SGD, the reference's default training rule
+    (reference: ``lib/opt.py`` — momentum variant).
+
+    ``v = mu*v - lr*(g + wd*p)``; ``p += v``.
+    """
+
+    def init(params):
+        return {"vel": _acc_like(params)}
+
+    def update(grads, state, params, lr):
+        g = _decayed(grads, params, weight_decay)
+        vel = jax.tree_util.tree_map(
+            lambda v, gi: momentum * v - lr * gi, state["vel"], g
+        )
+        return vel, {"vel": vel}
+
+    return Optimizer("momentum", init, update)
+
+
+def nesterov_sgd(momentum: float = 0.9, weight_decay: float = 0.0) -> Optimizer:
+    """Nesterov momentum in the same formulation the reference used
+    (reference: ``lib/opt.py`` — Nesterov variant):
+
+    ``v = mu*v - lr*g``; ``p += mu*v - lr*g``.
+    """
+
+    def init(params):
+        return {"vel": _acc_like(params)}
+
+    def update(grads, state, params, lr):
+        g = _decayed(grads, params, weight_decay)
+        vel = jax.tree_util.tree_map(
+            lambda v, gi: momentum * v - lr * gi, state["vel"], g
+        )
+        updates = jax.tree_util.tree_map(
+            lambda v, gi: momentum * v - lr * gi, vel, g
+        )
+        return updates, {"vel": vel}
+
+    return Optimizer("nesterov", init, update)
+
+
+def rmsprop(decay: float = 0.9, eps: float = 1e-8, weight_decay: float = 0.0) -> Optimizer:
+    """RMSProp: ``s = rho*s + (1-rho)*g^2``; ``p -= lr * g / (sqrt(s) + eps)``."""
+
+    def init(params):
+        return {"sq": _acc_like(params)}
+
+    def update(grads, state, params, lr):
+        g = _decayed(grads, params, weight_decay)
+        sq = jax.tree_util.tree_map(
+            lambda s, gi: decay * s + (1.0 - decay) * jnp.square(gi), state["sq"], g
+        )
+        updates = jax.tree_util.tree_map(
+            lambda gi, s: -lr * gi / (jnp.sqrt(s) + eps), g, sq
+        )
+        return updates, {"sq": sq}
+
+    return Optimizer("rmsprop", init, update)
+
+
+def adam(
+    b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8, weight_decay: float = 0.0
+) -> Optimizer:
+    """Adam (Kingma & Ba 2015) with bias correction; named in the north-star
+    contract alongside SGD (reference: ``lib/opt.py`` — "SGD/Adam updates")."""
+
+    def init(params):
+        return {
+            "m": _acc_like(params),
+            "v": _acc_like(params),
+            "t": jnp.zeros((), jnp.int32),
+        }
+
+    def update(grads, state, params, lr):
+        g = _decayed(grads, params, weight_decay)
+        t = state["t"] + 1
+        m = jax.tree_util.tree_map(
+            lambda mi, gi: b1 * mi + (1.0 - b1) * gi, state["m"], g
+        )
+        v = jax.tree_util.tree_map(
+            lambda vi, gi: b2 * vi + (1.0 - b2) * jnp.square(gi), state["v"], g
+        )
+        tf = t.astype(jnp.float32)
+        scale = lr * jnp.sqrt(1.0 - b2**tf) / (1.0 - b1**tf)
+        updates = jax.tree_util.tree_map(
+            lambda mi, vi: -scale * mi / (jnp.sqrt(vi) + eps), m, v
+        )
+        return updates, {"m": m, "v": v, "t": t}
+
+    return Optimizer("adam", init, update)
+
+
+_REGISTRY: dict[str, Callable[..., Optimizer]] = {
+    "sgd": sgd,
+    "momentum": momentum_sgd,
+    "nesterov": nesterov_sgd,
+    "rmsprop": rmsprop,
+    "adam": adam,
+}
+
+
+def get_optimizer(name: str, **kwargs) -> Optimizer:
+    """Look up an optimizer builder by name (model recipes name their rule
+    as a string, mirroring the reference's model-owned hyperparams)."""
+    try:
+        return _REGISTRY[name](**kwargs)
+    except KeyError:
+        raise ValueError(
+            f"unknown optimizer {name!r}; available: {sorted(_REGISTRY)}"
+        ) from None
