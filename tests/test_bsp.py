@@ -1,0 +1,130 @@
+"""BSP data-parallel step tests on the 8-way CPU mesh.
+
+The key invariants (SURVEY.md §4): (1) BSP-8 == single-device training
+on the same global batch (lockstep semantics of the reference's
+allreduce BSP), (2) strategies are interchangeable, (3) state stays
+replicated.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from theanompi_tpu.data import get_dataset
+from theanompi_tpu.models.model_zoo.wrn import WRN_16_4
+from theanompi_tpu.parallel import make_bsp_eval_step, make_bsp_train_step
+from theanompi_tpu.parallel.mesh import put_global_batch
+from theanompi_tpu.train import init_train_state, make_train_step
+
+
+def _model(batch=64, bn_axis=None):
+    recipe = WRN_16_4.default_recipe().replace(
+        batch_size=batch,
+        dataset="synthetic",
+        input_shape=(16, 16, 3),
+        sched_kwargs={"lr": 0.05, "boundaries": [10**9]},
+        bn_axis_name=bn_axis,
+    )
+    return WRN_16_4(recipe)
+
+
+def _batch(model, n=64):
+    data = get_dataset("synthetic", n_train=n, n_val=n, image_shape=model.recipe.input_shape)
+    x, y = next(data.train_epoch(0, n))
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def test_bsp8_matches_single_device(mesh8):
+    """Grad-allreduce BSP over 8 shards == one device on the global batch.
+
+    WRN has no dropout, and with cross-replica BN (bn_axis='data') the
+    sharded forward is mathematically identical to the global-batch
+    forward (two-moment stats average exactly across equal shards), so
+    the first step must agree to float-reduction tolerance — the
+    lockstep-BSP semantics of the reference's allreduce.
+    """
+    model = _model()  # per-replica BN would differ; see bn model below
+    model_bsp = _model(bn_axis="data")
+    x, y = _batch(model)
+    state0 = init_train_state(model, jax.random.PRNGKey(0))
+    rng = jax.random.PRNGKey(42)
+
+    single = jax.jit(make_train_step(model, steps_per_epoch=1))
+    s_single, m_single = single(state0, x, y, rng)
+
+    bsp = make_bsp_train_step(model_bsp, mesh8, steps_per_epoch=1, strategy="psum", donate=False)
+    s_bsp, m_bsp = bsp(state0, put_global_batch(mesh8, x), put_global_batch(mesh8, y), rng)
+
+    # loss: mean of per-shard means == global mean (equal shard sizes)
+    np.testing.assert_allclose(float(m_bsp["loss"]), float(m_single["loss"]), rtol=1e-4)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s_bsp.params), jax.tree_util.tree_leaves(s_single.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5)
+
+
+def test_bsp_strategies_agree(mesh8):
+    model = _model()
+    x, y = _batch(model)
+    state0 = init_train_state(model, jax.random.PRNGKey(0))
+    rng = jax.random.PRNGKey(1)
+    results = {}
+    for strat in ("psum", "ring"):
+        step = make_bsp_train_step(model, mesh8, strategy=strat, donate=False)
+        s = state0
+        for i in range(2):
+            s, _ = step(s, put_global_batch(mesh8, x), put_global_batch(mesh8, y), jax.random.fold_in(rng, i))
+        results[strat] = s.params
+    for a, b in zip(
+        jax.tree_util.tree_leaves(results["psum"]), jax.tree_util.tree_leaves(results["ring"])
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=5e-5)
+
+
+def test_bsp_grads_match_sequential_oracle(mesh8):
+    """Per-replica-BN BSP == sequentially simulating each shard and
+    averaging grads — the ground truth for the reference's allreduce
+    semantics. Also locks in the check_vma=False AD convention: under
+    vma typing the exchanger would double-count (see train.py note)."""
+    model = _model()
+    x, y = _batch(model)
+    state0 = init_train_state(model, jax.random.PRNGKey(0))
+
+    def shard_grad(xs, ys):
+        def loss_fn(p):
+            logits, _ = model.apply(p, state0.model_state, xs, train=True)
+            return model.loss(logits, ys)
+        return jax.grad(loss_fn)(state0.params)
+
+    gs = [shard_grad(x[i * 8 : (i + 1) * 8], y[i * 8 : (i + 1) * 8]) for i in range(8)]
+    g_oracle = jax.tree_util.tree_map(lambda *a: sum(a) / 8.0, *gs)
+    # one nesterov step from zero velocity: p += mu*v - lr*g, v = -lr*g
+    lr, mu = 0.05, 0.9
+    p_oracle = jax.tree_util.tree_map(
+        lambda p, g: p - (1 + mu) * lr * g, state0.params, g_oracle
+    )
+
+    step = make_bsp_train_step(model, mesh8, strategy="psum", donate=False)
+    s, _ = step(state0, put_global_batch(mesh8, x), put_global_batch(mesh8, y), jax.random.PRNGKey(1))
+    for a, b in zip(jax.tree_util.tree_leaves(s.params), jax.tree_util.tree_leaves(p_oracle)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+
+
+def test_bsp_trains_and_state_replicated(mesh8):
+    model = _model()
+    x, y = _batch(model)
+    state = init_train_state(model, jax.random.PRNGKey(0))
+    step = make_bsp_train_step(model, mesh8, donate=False)
+    losses = []
+    for i in range(10):
+        state, m = step(state, put_global_batch(mesh8, x), put_global_batch(mesh8, y), jax.random.PRNGKey(i))
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0], losses
+    assert int(state.step) == 10
+    # replicated output state: every leaf fully replicated across the mesh
+    leaf = jax.tree_util.tree_leaves(state.params)[0]
+    assert leaf.sharding.is_fully_replicated
+
+    ev = make_bsp_eval_step(model, mesh8)
+    metrics = ev(state, put_global_batch(mesh8, x), put_global_batch(mesh8, y))
+    assert np.isfinite(float(metrics["loss"]))

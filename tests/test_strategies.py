@@ -1,0 +1,72 @@
+"""Exchanger-strategy tests on the 8-way CPU mesh vs the jnp.mean oracle
+(SURVEY.md §4 item (b))."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from theanompi_tpu.parallel.strategies import get_strategy
+
+
+def _per_device_grads(n=8, seed=0):
+    """A pytree of per-device-distinct gradients, stacked on axis 0."""
+    rng = np.random.RandomState(seed)
+    return {
+        "w": jnp.asarray(rng.randn(n, 4, 3), jnp.float32),
+        "b": jnp.asarray(rng.randn(n, 5), jnp.float32),
+        "odd": jnp.asarray(rng.randn(n, 7), jnp.float32),  # odd size: tests ring padding
+    }
+
+
+def _run_strategy(mesh8, name):
+    stacked = _per_device_grads()
+    strat = get_strategy(name, "data", 8)
+
+    def f(g):
+        # inside shard_map each device sees its (1, ...) shard; drop the axis
+        local = jax.tree_util.tree_map(lambda a: a[0], g)
+        out = strat(local)
+        return jax.tree_util.tree_map(lambda a: a[None], out)
+
+    mapped = jax.jit(
+        jax.shard_map(
+            f, mesh=mesh8, in_specs=(P("data"),), out_specs=P("data"), check_vma=False
+        )
+    )
+    return stacked, mapped(stacked)
+
+
+@pytest.mark.parametrize("name", ["psum", "ring", "psum_bf16", "ring_bf16"])
+def test_strategy_matches_mean_oracle(mesh8, name):
+    stacked, out = _run_strategy(mesh8, name)
+    tol = 1e-6 if name in ("psum", "ring") else 2e-2
+    for key in stacked:
+        oracle = np.asarray(stacked[key]).mean(axis=0)
+        got = np.asarray(out[key])
+        for d in range(8):
+            np.testing.assert_allclose(got[d], oracle, rtol=tol, atol=tol, err_msg=f"{name}/{key}/dev{d}")
+
+
+@pytest.mark.parametrize("alias,canon", [("ar", "psum"), ("asa32", "ring"), ("asa16", "ring_bf16"), ("nccl32", "psum"), ("nccl16", "psum_bf16"), ("cudaaware", "psum")])
+def test_reference_aliases_resolve(mesh8, alias, canon):
+    _, out_a = _run_strategy(mesh8, alias)
+    _, out_c = _run_strategy(mesh8, canon)
+    for key in out_a:
+        np.testing.assert_allclose(np.asarray(out_a[key]), np.asarray(out_c[key]), rtol=1e-6)
+
+
+def test_unknown_strategy():
+    with pytest.raises(ValueError):
+        get_strategy("fancy", "data", 8)
+
+
+def test_ring_exact_vs_psum(mesh8):
+    """fp32 ring must agree with psum to float addition-order tolerance."""
+    _, out_ring = _run_strategy(mesh8, "ring")
+    _, out_psum = _run_strategy(mesh8, "psum")
+    for key in out_ring:
+        np.testing.assert_allclose(
+            np.asarray(out_ring[key]), np.asarray(out_psum[key]), rtol=1e-5, atol=1e-6
+        )

@@ -1,0 +1,12 @@
+"""Data pipeline.
+
+TPU-native replacement for the reference's data layer (SURVEY.md §1 L3):
+``models/data/{imagenet.py,cifar10.py}`` dataset classes plus the
+``lib/proc_load_mpi.py`` spawned-loader subsystem. Datasets expose epoch
+iterators of host numpy batches; the prefetch loader overlaps host I/O +
+preprocessing with device compute (reference hid loading behind GPU
+compute via MPI-spawned child processes; here a thread + device prefetch
+does the same without process gymnastics).
+"""
+
+from theanompi_tpu.data.datasets import Dataset, get_dataset  # noqa: F401

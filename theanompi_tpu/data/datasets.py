@@ -1,0 +1,193 @@
+"""Dataset classes: synthetic fixture + CIFAR-10.
+
+Reference: ``models/data/cifar10.py`` — ``Cifar10_data`` with
+``n_train_batches`` and batch iterators (SURVEY.md §2.1). The synthetic
+dataset is the deterministic fake-data fixture SURVEY.md §4(d) requires
+for seeded distributed tests; it is linearly separable-ish (class means +
+noise) so overfit smoke tests can assert learning.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+class Dataset:
+    """Host-side dataset of (images NHWC float32, labels int32).
+
+    Epoch iterators yield fixed-size batches; the last partial batch is
+    dropped (the reference trained on whole file-batches the same way).
+    """
+
+    name = "dataset"
+    image_shape: tuple = (32, 32, 3)
+    n_classes: int = 10
+
+    # subclasses populate these
+    x_train: np.ndarray
+    y_train: np.ndarray
+    x_val: np.ndarray
+    y_val: np.ndarray
+
+    @property
+    def n_train(self) -> int:
+        return len(self.x_train)
+
+    @property
+    def n_val(self) -> int:
+        return len(self.x_val)
+
+    def n_train_batches(self, batch_size: int) -> int:
+        return self.n_train // batch_size
+
+    def n_val_batches(self, batch_size: int) -> int:
+        return self.n_val // batch_size
+
+    def train_epoch(
+        self, epoch: int, batch_size: int, seed: int = 0
+    ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        """Deterministically shuffled epoch (seed + epoch → permutation),
+        so every data-parallel worker computes the same global order —
+        the reference broadcast shuffled filename lists from rank 0 for
+        the same reason (reference: ``models/data/imagenet.py``)."""
+        rng = np.random.RandomState(seed * 100003 + epoch)
+        perm = rng.permutation(self.n_train)
+        for i in range(self.n_train_batches(batch_size)):
+            idx = perm[i * batch_size : (i + 1) * batch_size]
+            yield self.augment(self.x_train[idx], rng), self.y_train[idx]
+
+    def val_epoch(self, batch_size: int) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        for i in range(self.n_val_batches(batch_size)):
+            sl = slice(i * batch_size, (i + 1) * batch_size)
+            yield self.x_val[sl], self.y_val[sl]
+
+    def augment(self, x: np.ndarray, rng: np.random.RandomState) -> np.ndarray:
+        """Train-time augmentation hook; default identity."""
+        return x
+
+
+class Synthetic_data(Dataset):
+    """Deterministic fake data: x = class_mean + noise. Learnable, seeded,
+    zero I/O — the fixture for every CI/mesh test."""
+
+    name = "synthetic"
+
+    def __init__(
+        self,
+        n_train: int = 1024,
+        n_val: int = 256,
+        image_shape: tuple = (32, 32, 3),
+        n_classes: int = 10,
+        seed: int = 1234,
+        noise: float = 0.3,
+    ):
+        self.image_shape = image_shape
+        self.n_classes = n_classes
+        rng = np.random.RandomState(seed)
+        means = rng.randn(n_classes, *image_shape).astype(np.float32)
+
+        def make(n, salt):
+            r = np.random.RandomState(seed + salt)
+            y = r.randint(0, n_classes, size=n).astype(np.int32)
+            x = means[y] + noise * r.randn(n, *image_shape).astype(np.float32)
+            return x.astype(np.float32), y
+
+        self.x_train, self.y_train = make(n_train, 1)
+        self.x_val, self.y_val = make(n_val, 2)
+
+
+class Cifar10_data(Dataset):
+    """Real CIFAR-10 from the standard python-pickle batches.
+
+    Looks for ``cifar-10-batches-py`` under ``$CIFAR10_DIR`` or common
+    data roots. No network access is assumed: if the files are absent,
+    raises with instructions (the reference likewise expected
+    pre-downloaded ``.hkl``/pickle files on disk).
+
+    Preprocessing follows the reference recipe: per-channel mean/std
+    normalization; train-time augment = random crop from 4-pixel pad +
+    horizontal mirror (reference: ``models/data/utils.py`` crop/mirror).
+    """
+
+    name = "cifar10"
+
+    SEARCH = (
+        "/root/data",
+        "/data",
+        os.path.expanduser("~/.cache/theanompi_tpu"),
+    )
+
+    def __init__(self, root: Optional[str] = None):
+        base = self._find(root)
+        xs, ys = [], []
+        for i in range(1, 6):
+            with open(os.path.join(base, f"data_batch_{i}"), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            xs.append(d[b"data"])
+            ys.append(np.asarray(d[b"labels"]))
+        x_train = np.concatenate(xs)
+        y_train = np.concatenate(ys)
+        with open(os.path.join(base, "test_batch"), "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        x_val, y_val = d[b"data"], np.asarray(d[b"labels"])
+
+        def to_nhwc(x):
+            return x.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1).astype(np.float32) / 255.0
+
+        x_train, x_val = to_nhwc(x_train), to_nhwc(x_val)
+        self.mean = x_train.mean(axis=(0, 1, 2), keepdims=True)
+        self.std = x_train.std(axis=(0, 1, 2), keepdims=True) + 1e-7
+        self.x_train = (x_train - self.mean) / self.std
+        self.x_val = (x_val - self.mean) / self.std
+        self.y_train = y_train.astype(np.int32)
+        self.y_val = y_val.astype(np.int32)
+
+    @classmethod
+    def _find(cls, root: Optional[str]) -> str:
+        # $CIFAR10_DIR is read at call time, not import time
+        env = os.environ.get("CIFAR10_DIR", "")
+        candidates = [root] if root else [p for p in (env, *cls.SEARCH) if p]
+        for c in candidates:
+            for sub in ("", "cifar-10-batches-py"):
+                base = os.path.join(c, sub) if sub else c
+                if os.path.exists(os.path.join(base, "data_batch_1")):
+                    return base
+        raise FileNotFoundError(
+            "CIFAR-10 not found. Place the extracted 'cifar-10-batches-py' "
+            f"directory under one of {[c for c in candidates]} or set $CIFAR10_DIR. "
+            "(No network access is assumed; use dataset='synthetic' for smoke runs.)"
+        )
+
+    def augment(self, x: np.ndarray, rng: np.random.RandomState) -> np.ndarray:
+        n, h, w, c = x.shape
+        padded = np.pad(x, [(0, 0), (4, 4), (4, 4), (0, 0)], mode="reflect")
+        out = np.empty_like(x)
+        offs = rng.randint(0, 9, size=(n, 2))
+        flips = rng.rand(n) < 0.5
+        for i in range(n):
+            oy, ox = offs[i]
+            img = padded[i, oy : oy + h, ox : ox + w]
+            out[i] = img[:, ::-1] if flips[i] else img
+        return out
+
+
+_REGISTRY = {
+    "synthetic": Synthetic_data,
+    "cifar10": Cifar10_data,
+}
+
+
+def get_dataset(name: str, **kwargs) -> Dataset:
+    try:
+        cls = _REGISTRY[name]
+    except KeyError:
+        raise ValueError(f"unknown dataset {name!r}; available: {sorted(_REGISTRY)}") from None
+    return cls(**kwargs)
+
+
+def register_dataset(name: str, cls) -> None:
+    _REGISTRY[name] = cls

@@ -13,6 +13,7 @@ in a background thread so ``wait()`` keeps the reference's semantics).
 from __future__ import annotations
 
 import importlib
+import importlib.util
 import threading
 from typing import Any, Optional, Sequence, Union
 
@@ -26,8 +27,6 @@ def resolve_model(modelfile: str, modelclass: str):
     in ``.py``.
     """
     if modelfile.endswith(".py"):
-        import importlib.util
-
         spec = importlib.util.spec_from_file_location("_tmpi_model", modelfile)
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
@@ -50,7 +49,7 @@ class SyncRule:
     def init(
         self,
         devices: Union[int, Sequence, None] = None,
-        modelfile: str = "theanompi_tpu.models.wrn",
+        modelfile: str = "theanompi_tpu.models.model_zoo.wrn",
         modelclass: str = "WRN",
         blocking: bool = False,
         **overrides,

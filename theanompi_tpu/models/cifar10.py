@@ -1,0 +1,58 @@
+"""Small CIFAR-10 CNN — the README quick-start model.
+
+Reference: ``models/cifar10.py`` — ``Cifar10_model`` (SURVEY.md §2.1),
+an AlexNet-style small CNN: two conv+LRN+pool stages, two hidden FC
+layers with dropout, softmax output; momentum SGD with step-decayed LR.
+"""
+
+from __future__ import annotations
+
+from theanompi_tpu import nn
+from theanompi_tpu.models.contract import Model, Recipe
+from theanompi_tpu.nn import init as initializers
+
+
+class Cifar10_model(Model):
+    name = "cifar10"
+
+    @classmethod
+    def default_recipe(cls) -> Recipe:
+        return Recipe(
+            batch_size=128,
+            n_epochs=70,
+            optimizer="momentum",
+            opt_kwargs={"momentum": 0.9, "weight_decay": 1e-4},
+            schedule="step",
+            sched_kwargs={"lr": 0.01, "boundaries": [40, 60], "factor": 0.1},
+            lr_unit="epoch",
+            input_shape=(32, 32, 3),
+            num_classes=10,
+            dataset="cifar10",
+        )
+
+    def build(self):
+        # he/glorot init rather than the 2016 fixed-std gaussians: with
+        # this depth the tiny gaussians stall (vanishing grads) — verified
+        # empirically; the architecture and recipe otherwise match.
+        he = initializers.he_normal()
+        return nn.Sequential(
+            [
+                nn.Conv(64, 5, padding="SAME", w_init=he, name="conv1"),
+                nn.Activation("relu"),
+                nn.Pool(3, stride=2, mode="max"),
+                nn.LRN(),
+                nn.Conv(128, 5, padding="SAME", w_init=he, name="conv2"),
+                nn.Activation("relu"),
+                nn.Pool(3, stride=2, mode="max"),
+                nn.LRN(),
+                nn.Flatten(),
+                nn.Dense(384, name="fc3"),
+                nn.Activation("relu"),
+                nn.Dropout(0.5),
+                nn.Dense(192, name="fc4"),
+                nn.Activation("relu"),
+                nn.Dropout(0.5),
+                nn.Dense(self.recipe.num_classes, name="softmax"),
+            ],
+            name="cifar10_cnn",
+        )

@@ -1,0 +1,125 @@
+"""The model contract: what every zoo model provides to the framework.
+
+Reference contract (SURVEY.md §2.1, ``models/alex_net.py`` et al.):
+``params``, ``data``, ``compile_iter_fns()``, ``train_iter()``,
+``val_iter()``, ``adjust_hyperp(epoch)``, ``cleanup()``. That shape was
+imperative — Theano shared variables mutated by compiled functions, LR
+adjusted by host code between epochs.
+
+The TPU-native contract is functional. A model is:
+
+- a **Recipe** (declarative hyperparams the model owns — batch size,
+  optimizer, LR schedule, epochs; the framework forwards, never
+  interprets);
+- pure ``init(key) -> (params, state)`` and
+  ``apply(params, state, images, train, rng) -> (logits, state)``;
+- ``loss(logits, labels) -> scalar`` and ``metrics(logits, labels)``.
+
+``compile_iter_fns`` becomes "the framework jits a train step around
+these", ``adjust_hyperp`` becomes the recipe's schedule evaluated inside
+the step, and ``cleanup`` disappears (no process state to tear down).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class Recipe:
+    """Model-owned training recipe (reference: module-level hyperparam
+    dicts in each model file; SURVEY.md §5.6 scope 2)."""
+
+    batch_size: int = 128
+    n_epochs: int = 10
+    optimizer: str = "momentum"
+    opt_kwargs: dict = dataclasses.field(default_factory=dict)
+    schedule: str = "constant"
+    sched_kwargs: dict = dataclasses.field(default_factory=lambda: {"lr": 0.01})
+    lr_unit: str = "epoch"  # 'epoch' | 'step': unit of the schedule's input
+    input_shape: tuple = (32, 32, 3)  # (H, W, C)
+    num_classes: int = 10
+    compute_dtype: Any = jnp.float32  # bfloat16 for the big ImageNet models
+    # cross-replica BN over the data axis (None = per-replica stats)
+    bn_axis_name: Optional[str] = None
+    # dataset defaults; the launcher may override (e.g. synthetic for tests)
+    dataset: str = "synthetic"
+    val_batch_size: Optional[int] = None
+
+    def replace(self, **kw) -> "Recipe":
+        return dataclasses.replace(self, **kw)
+
+
+def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean softmax cross-entropy with integer labels, computed in fp32
+    (logits may be bf16 on TPU)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(nll)
+
+
+def classification_metrics(logits: jax.Array, labels: jax.Array) -> dict:
+    """top-1/top-5 error — the reference Recorder's val metrics
+    (reference: ``lib/recorder.py`` val cost/error/top-5)."""
+    logits = logits.astype(jnp.float32)
+    top1 = jnp.argmax(logits, axis=-1)
+    err1 = jnp.mean((top1 != labels).astype(jnp.float32))
+    k = min(5, logits.shape[-1])
+    topk = jax.lax.top_k(logits, k)[1]
+    errk = 1.0 - jnp.mean(jnp.any(topk == labels[:, None], axis=-1).astype(jnp.float32))
+    return {"error": err1, "top5_error": errk}
+
+
+class Model:
+    """Base model. Subclasses set ``recipe`` and build ``self.net`` (a
+    ``nn.Layer``) in ``__init__``; everything else is inherited."""
+
+    name = "model"
+    recipe: Recipe
+
+    def __init__(self, recipe: Optional[Recipe] = None):
+        self.recipe = recipe or self.default_recipe()
+        self.net = self.build()
+
+    # -- subclass surface ---------------------------------------------------
+    @classmethod
+    def default_recipe(cls) -> Recipe:
+        raise NotImplementedError
+
+    def build(self):
+        """Return the network as an ``nn.Layer`` (or override apply)."""
+        raise NotImplementedError
+
+    # -- framework surface --------------------------------------------------
+    @property
+    def input_shape(self) -> tuple:
+        return (self.recipe.batch_size, *self.recipe.input_shape)
+
+    def init(self, key) -> tuple[PyTree, PyTree]:
+        return self.net.init(key, self.input_shape)
+
+    def apply(self, params, state, images, *, train: bool = False, rng=None):
+        images = images.astype(self.recipe.compute_dtype)
+        return self.net.apply(params, state, images, train=train, rng=rng)
+
+    def loss(self, logits, labels):
+        return softmax_cross_entropy(logits, labels)
+
+    def metrics(self, logits, labels) -> dict:
+        return classification_metrics(logits, labels)
+
+    def optimizer(self):
+        from theanompi_tpu.ops import get_optimizer
+
+        return get_optimizer(self.recipe.optimizer, **self.recipe.opt_kwargs)
+
+    def schedule(self):
+        from theanompi_tpu.ops import get_schedule
+
+        return get_schedule(self.recipe.schedule, **self.recipe.sched_kwargs)

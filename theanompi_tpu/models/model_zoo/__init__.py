@@ -1,0 +1,7 @@
+"""The reference's lasagne model zoo, rebuilt natively.
+
+Reference: ``models/lasagne_model_zoo/{vgg.py,resnet50.py,wrn.py}``
+(SURVEY.md §2.1). Nothing lasagne remains — these are idiomatic JAX
+modules over :mod:`theanompi_tpu.nn` — but the zoo inventory and the
+training recipes match the reference model-for-model.
+"""

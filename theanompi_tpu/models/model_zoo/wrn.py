@@ -1,0 +1,143 @@
+"""Wide Residual Network (WRN-28-10) on CIFAR-10.
+
+Reference: ``models/lasagne_model_zoo/wrn.py`` — the single-worker BSP
+smoke config, BASELINE config #1 (SURVEY.md §2.1, §6). Architecture per
+Zagoruyko & Komodakis 2016: pre-activation residual blocks, 3 stages of
+``(depth-4)/6`` blocks at widths ``16k/32k/64k``, strides 1/2/2.
+
+Recipe (the standard WRN CIFAR-10 recipe the reference's lasagne port
+used): batch 128, SGD momentum 0.9 (Nesterov), weight decay 5e-4,
+LR 0.1 stepped x0.2 at epochs 60/120/160, 200 epochs, he-normal init.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from theanompi_tpu import nn
+from theanompi_tpu.models.contract import Model, Recipe
+from theanompi_tpu.nn import init as initializers
+from theanompi_tpu.nn.layers import Layer
+
+
+class PreActBlock(Layer):
+    """BN-ReLU-Conv3x3-(Dropout)-BN-ReLU-Conv3x3 + shortcut.
+
+    The projection shortcut (1x1 conv on the pre-activated input) is used
+    when shape changes, as in the WRN paper.
+    """
+
+    def __init__(self, in_c: int, out_c: int, stride: int = 1, dropout: float = 0.0,
+                 bn_axis=None, name: str = "preact"):
+        self.name = name
+        self.needs_proj = stride != 1 or in_c != out_c
+        he = initializers.he_normal()
+        self.bn1 = nn.BatchNorm(axis_name=bn_axis)
+        self.conv1 = nn.Conv(out_c, 3, stride=stride, padding="SAME", use_bias=False, w_init=he)
+        self.dropout = nn.Dropout(dropout) if dropout > 0 else None
+        self.bn2 = nn.BatchNorm(axis_name=bn_axis)
+        self.conv2 = nn.Conv(out_c, 3, stride=1, padding="SAME", use_bias=False, w_init=he)
+        self.proj = (
+            nn.Conv(out_c, 1, stride=stride, padding="VALID", use_bias=False, w_init=he)
+            if self.needs_proj
+            else None
+        )
+
+    def init(self, key, in_shape):
+        keys = jax.random.split(key, 3)
+        params, state = {}, {}
+        p, s = self.bn1.init(keys[0], in_shape)
+        params["bn1"], state["bn1"] = p, s
+        p, _ = self.conv1.init(keys[0], in_shape)
+        params["conv1"] = p
+        mid_shape = self.conv1.out_shape(in_shape)
+        p, s = self.bn2.init(keys[1], mid_shape)
+        params["bn2"], state["bn2"] = p, s
+        p, _ = self.conv2.init(keys[1], mid_shape)
+        params["conv2"] = p
+        if self.proj is not None:
+            p, _ = self.proj.init(keys[2], in_shape)
+            params["proj"] = p
+        return params, state
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        new_state = dict(state)
+        h, new_state["bn1"] = self.bn1.apply(params["bn1"], state["bn1"], x, train=train)
+        h = jax.nn.relu(h)
+        shortcut = x if self.proj is None else self.proj.apply(params["proj"], {}, h)[0]
+        h, _ = self.conv1.apply(params["conv1"], {}, h)
+        h, new_state["bn2"] = self.bn2.apply(params["bn2"], state["bn2"], h, train=train)
+        h = jax.nn.relu(h)
+        if self.dropout is not None and train:
+            h, _ = self.dropout.apply({}, {}, h, train=train, rng=rng)
+        h, _ = self.conv2.apply(params["conv2"], {}, h)
+        return h + shortcut, new_state
+
+    def out_shape(self, in_shape):
+        return self.conv1.out_shape(in_shape)
+
+
+class WRN(Model):
+    """Wide-ResNet; ``depth``/``widen`` default to the reference's 28-10."""
+
+    name = "wrn"
+    depth = 28
+    widen = 10
+    dropout = 0.0
+
+    @classmethod
+    def default_recipe(cls) -> Recipe:
+        return Recipe(
+            batch_size=128,
+            n_epochs=200,
+            optimizer="nesterov",
+            opt_kwargs={"momentum": 0.9, "weight_decay": 5e-4},
+            schedule="step",
+            sched_kwargs={"lr": 0.1, "boundaries": [60, 120, 160], "factor": 0.2},
+            lr_unit="epoch",
+            input_shape=(32, 32, 3),
+            num_classes=10,
+            dataset="cifar10",
+        )
+
+    def build(self):
+        assert (self.depth - 4) % 6 == 0, "WRN depth must be 6n+4"
+        n = (self.depth - 4) // 6
+        k = self.widen
+        bn_axis = self.recipe.bn_axis_name
+        he = initializers.he_normal()
+
+        layers: list[Layer] = [
+            nn.Conv(16, 3, padding="SAME", use_bias=False, w_init=he, name="stem")
+        ]
+        in_c = 16
+        for stage, (width, stride) in enumerate(
+            [(16 * k, 1), (32 * k, 2), (64 * k, 2)]
+        ):
+            for block in range(n):
+                layers.append(
+                    PreActBlock(
+                        in_c,
+                        width,
+                        stride=stride if block == 0 else 1,
+                        dropout=self.dropout,
+                        bn_axis=bn_axis,
+                        name=f"s{stage}b{block}",
+                    )
+                )
+                in_c = width
+        layers += [
+            nn.BatchNorm(axis_name=bn_axis, name="final_bn"),
+            nn.Activation("relu"),
+            nn.GlobalAvgPool(),
+            nn.Dense(self.recipe.num_classes, name="classifier"),
+        ]
+        return nn.Sequential(layers, name="wrn")
+
+
+class WRN_16_4(WRN):
+    """Smaller WRN for quick experiments and CI smoke tests."""
+
+    name = "wrn_16_4"
+    depth = 16
+    widen = 4

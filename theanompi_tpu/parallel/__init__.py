@@ -1,0 +1,18 @@
+"""Parallelism layer: mesh runtime, exchanger strategies, sync rules.
+
+TPU-native replacement for the reference's process/communication stack
+(SURVEY.md §1 L1-L2): ``lib/base.py`` (``MPI_GPU_Process``, MPI world +
+NCCL clique), ``lib/exchanger.py`` (``BSP_Exchanger`` / ``EASGD_Exchanger``
+/ ``GOSGD_Exchanger``) and ``lib/exchanger_strategy.py`` (the pluggable
+allreduce implementations). One SPMD program over a named
+``jax.sharding.Mesh`` replaces process-per-GPU + mpirun; collectives
+compiled into the step replace between-step MPI calls.
+"""
+
+from theanompi_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    make_mesh,
+    host_local_batch_slice,
+)
+from theanompi_tpu.parallel.strategies import get_strategy  # noqa: F401
+from theanompi_tpu.parallel.bsp import make_bsp_train_step, make_bsp_eval_step  # noqa: F401

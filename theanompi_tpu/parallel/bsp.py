@@ -1,0 +1,90 @@
+"""BSP data-parallel training step.
+
+TPU-native rebuild of the reference's BSP rule (reference:
+``lib/exchanger.py`` — ``BSP_Exchanger.exchange()`` called between
+Theano functions each iteration; SURVEY.md §3.2). Here the whole BSP
+iteration — forward, backward, gradient allreduce, update — is ONE
+``jax.jit``-compiled SPMD program over a ``('data',)`` mesh:
+
+- the per-device batch shard comes in sharded along ``data``;
+- params / optimizer state are replicated; every device computes the
+  identical update after the gradient mean (lockstep by construction —
+  the XLA program IS the barrier, where the reference relied on
+  blocking MPI allreduce);
+- the exchanger strategy is compiled into the step (``psum`` by
+  default, explicit/compressed ring variants for parity with
+  ``asa32``/``asa16``).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from theanompi_tpu.models.contract import Model
+from theanompi_tpu.parallel.mesh import DATA_AXIS
+from theanompi_tpu.parallel.strategies import get_strategy
+from theanompi_tpu.train import TrainState, make_eval_step, make_train_step
+
+
+def make_bsp_train_step(
+    model: Model,
+    mesh: Mesh,
+    steps_per_epoch: int = 1,
+    strategy: str = "psum",
+    axis_name: str = DATA_AXIS,
+    donate: bool = True,
+):
+    """Build the jitted BSP step: ``(state, images, labels, rng) ->
+    (state, metrics)`` over global arrays.
+
+    ``images``/``labels`` hold the GLOBAL batch (sharded or shardable
+    along ``data``); ``state`` is replicated; ``rng`` is a single key —
+    each device folds in its axis index so dropout masks differ per
+    shard (the reference's workers each had their own RNG stream).
+    """
+    n = mesh.shape[axis_name]
+    grad_sync = get_strategy(strategy, axis_name, n)
+    base_step = make_train_step(model, steps_per_epoch, grad_sync=grad_sync)
+
+    def sharded_step(state: TrainState, images, labels, rng):
+        rng = jax.random.fold_in(rng, lax.axis_index(axis_name))
+        new_state, metrics = base_step(state, images, labels, rng)
+        # Per-replica BatchNorm stats diverge across shards; average them
+        # so the output state is truly replicated (the reference kept
+        # per-worker stats and checkpointed rank 0's — averaging is the
+        # better-defined equivalent).
+        new_state = new_state._replace(
+            model_state=lax.pmean(new_state.model_state, axis_name)
+        )
+        metrics = lax.pmean(metrics, axis_name)
+        return new_state, metrics
+
+    # check_vma=False: the exchanger abstraction requires classic pmap AD
+    # semantics (psum transpose = identity) — see make_train_step's note.
+    mapped = jax.shard_map(
+        sharded_step,
+        mesh=mesh,
+        in_specs=(P(), P(axis_name), P(axis_name), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+def make_bsp_eval_step(model: Model, mesh: Mesh, axis_name: str = DATA_AXIS):
+    """Jitted eval step over the mesh: metrics averaged across shards."""
+    base = make_eval_step(model)
+
+    def sharded(state: TrainState, images, labels):
+        return lax.pmean(base(state, images, labels), axis_name)
+
+    mapped = jax.shard_map(
+        sharded,
+        mesh=mesh,
+        in_specs=(P(), P(axis_name), P(axis_name)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(mapped)

@@ -1,0 +1,86 @@
+"""Device-mesh runtime.
+
+Replaces the reference's per-process device/communicator setup
+(reference: ``lib/base.py`` — ``MPI_GPU_Process.init_device``,
+``get_internode_comm`` (MPI world), ``get_intranode_comm`` (NCCL clique);
+SURVEY.md §1 L1). On TPU there is no process-per-device or dual
+MPI/NCCL hierarchy: a named ``Mesh`` spans all chips, XLA lowers
+collectives onto ICI within a slice and DCN across slices, and
+``jax.distributed.initialize`` (multi-host) replaces ``mpirun``.
+
+Axis naming: today's rules are pure data parallelism, so the mesh is
+1-D ``('data',)`` — but everything takes the axis names from here so a
+``('data', 'model')`` mesh is additive later (SURVEY.md §5.7 note).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DATA_AXIS = "data"
+# EASGD runs on a 2-D ('group', 'data') mesh: see parallel/easgd.py
+GROUP_AXIS = "group"
+
+
+def make_mesh(
+    devices: Union[int, Sequence, None] = None,
+    axis_names: tuple[str, ...] = (DATA_AXIS,),
+    shape: Optional[tuple[int, ...]] = None,
+) -> Mesh:
+    """Build a Mesh over ``devices`` (count, explicit list, or None=all).
+
+    ``shape`` reshapes the device list for multi-axis meshes; default is
+    1-D over all requested devices.
+    """
+    if devices is None:
+        devs = jax.devices()
+    elif isinstance(devices, int):
+        all_devs = jax.devices()
+        if devices > len(all_devs):
+            raise ValueError(
+                f"requested {devices} devices but only {len(all_devs)} present "
+                f"({[d.platform for d in all_devs[:1]]}); for CPU-mesh testing set "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=N before jax import"
+            )
+        devs = all_devs[:devices]
+    else:
+        devs = list(devices)
+    arr = np.array(devs)
+    if shape is not None:
+        arr = arr.reshape(shape)
+    elif len(axis_names) > 1:
+        raise ValueError("multi-axis mesh needs an explicit shape")
+    return Mesh(arr, axis_names)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Shard the leading (batch) dim across the data axis."""
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def host_local_batch_slice(mesh: Mesh, global_batch: int) -> slice:
+    """The slice of the global batch this host should produce.
+
+    Single-controller: the whole batch. Multi-controller (one process
+    per TPU host, reference: one loader per worker rank): each host
+    feeds only its addressable shard — the analogue of the reference's
+    per-rank batch-file partition (``models/data/imagenet.py``).
+    """
+    n_proc = jax.process_count()
+    per_host = global_batch // n_proc
+    idx = jax.process_index()
+    return slice(idx * per_host, (idx + 1) * per_host)
+
+
+def put_global_batch(mesh: Mesh, x, axis: str = DATA_AXIS):
+    """Place a host batch onto the mesh sharded along the data axis."""
+    return jax.device_put(x, batch_sharding(mesh, axis))

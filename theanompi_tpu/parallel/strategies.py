@@ -1,0 +1,180 @@
+"""Pluggable gradient-exchange strategies.
+
+TPU-native rebuild of the reference's exchanger strategy layer
+(reference: ``lib/exchanger_strategy.py`` — ``Exch_allreduce`` (host
+MPI), ``Exch_copper``/``Exch_cudaaware`` (GPU-direct MPI), ``Exch_asa32``
+/ ``Exch_asa16`` (hand-rolled alternating-segmented ring allreduce, fp32
+and fp16-compressed), ``Exch_nccl32``/``Exch_nccl16`` (NCCL); SURVEY.md
+§2.1, §5.8).
+
+A strategy is a function ``grads -> synced_grads`` executed INSIDE the
+compiled SPMD step (under ``shard_map``), where the reference ran Python
+MPI calls between Theano calls. All strategies produce the **mean**
+gradient across the data axis.
+
+Like the reference's ``BSP_Exchanger``, gradients are packed into one
+contiguous buffer before the collective (the paper's "big fused buffer"
+optimization) — for ``psum`` XLA would fuse anyway, but for the explicit
+ring variants the single buffer is what makes segmentation work.
+
+Strategy names keep the reference's config vocabulary as aliases:
+``ar``/``cudaaware``/``nccl32`` -> psum, ``asa32`` -> ring,
+``asa16``/``nccl16`` -> ring_bf16 / psum_bf16.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.flatten_util import ravel_pytree
+
+PyTree = Any
+Strategy = Callable[[PyTree], PyTree]
+
+
+def _packed(fn):
+    """Wrap a flat-buffer collective into a pytree strategy: pack all
+    gradient leaves into one contiguous fp32 vector, run the collective,
+    unpack (reference: ``BSP_Exchanger`` pre-concatenated per-param GPU
+    buffers into one big comm buffer)."""
+
+    def strategy(grads: PyTree) -> PyTree:
+        flat, unravel = ravel_pytree(grads)
+        out = fn(flat.astype(jnp.float32))
+        return unravel(out.astype(flat.dtype))
+
+    return strategy
+
+
+# --------------------------------------------------------------------------
+# psum family — XLA-native allreduce (≙ Exch_nccl32 / Exch_allreduce /
+# Exch_cudaaware: on TPU, one ICI collective replaces all three tiers)
+# --------------------------------------------------------------------------
+
+
+def psum_mean(axis_name: str) -> Strategy:
+    def strategy(grads):
+        return lax.pmean(grads, axis_name)
+
+    return strategy
+
+
+def psum_bf16(axis_name: str) -> Strategy:
+    """Compressed allreduce: bf16 operands into a single pmean
+    (≙ ``Exch_nccl16``; see also EQuARX, PAPERS.md). NOTE: XLA reduces in
+    the operand dtype, so accumulation here is bf16 too — cheapest, but at
+    large worker counts low-order gradient bits are lost; ``ring_bf16``
+    is the bf16-wire / fp32-accumulate variant."""
+
+    def strategy(grads):
+        return jax.tree_util.tree_map(
+            lambda g: lax.pmean(g.astype(jnp.bfloat16), axis_name).astype(g.dtype),
+            grads,
+        )
+
+    return strategy
+
+
+# --------------------------------------------------------------------------
+# explicit segmented ring — ≙ Exch_asa32 / Exch_asa16
+# --------------------------------------------------------------------------
+
+
+def _ring_allreduce_flat(
+    flat: jax.Array, axis_name: str, n: int, wire_dtype: Optional[jnp.dtype] = None
+) -> jax.Array:
+    """Alternating-segmented ring allreduce on a flat fp32 buffer:
+    reduce-scatter (n-1 ppermute steps) + allgather (n-1 steps), the
+    algorithm the reference hand-rolled over ``MPI.Sendrecv`` segments
+    (reference: ``lib/exchanger_strategy.py`` — ``Exch_asa32``).
+
+    ``wire_dtype`` casts each transferred segment (bf16 ≙ the fp16
+    compression of ``Exch_asa16``); accumulation stays fp32.
+    Returns the SUM; caller divides for the mean.
+    """
+    if n == 1:
+        return flat
+    L = flat.shape[0]
+    seg = -(-L // n)
+    buf = jnp.zeros((n, seg), flat.dtype).reshape(-1).at[:L].set(flat).reshape(n, seg)
+    # mark the carry device-varying so the fori_loop carry types line up
+    # under shard_map's varying-manual-axes checking
+    buf = lax.pcast(buf, axis_name, to="varying")
+    rank = lax.axis_index(axis_name)
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+
+    def send(chunk):
+        if wire_dtype is not None:
+            chunk = chunk.astype(wire_dtype)
+        out = lax.ppermute(chunk, axis_name, fwd)
+        return out.astype(flat.dtype)
+
+    def rs_step(t, b):
+        idx_send = jnp.mod(rank - t, n)
+        idx_recv = jnp.mod(rank - t - 1, n)
+        recv = send(jnp.take(b, idx_send, axis=0))
+        return b.at[idx_recv].add(recv)
+
+    buf = lax.fori_loop(0, n - 1, rs_step, buf)
+
+    # node r now owns the fully-reduced segment (r + 1) mod n
+    def ag_step(t, b):
+        idx_send = jnp.mod(rank + 1 - t, n)
+        idx_recv = jnp.mod(rank - t, n)
+        recv = send(jnp.take(b, idx_send, axis=0))
+        return b.at[idx_recv].set(recv)
+
+    buf = lax.fori_loop(0, n - 1, ag_step, buf)
+    return buf.reshape(-1)[:L]
+
+
+def ring(axis_name: str, axis_size: int) -> Strategy:
+    return _packed(
+        lambda flat: _ring_allreduce_flat(flat, axis_name, axis_size) / axis_size
+    )
+
+
+def ring_bf16(axis_name: str, axis_size: int) -> Strategy:
+    return _packed(
+        lambda flat: _ring_allreduce_flat(
+            flat, axis_name, axis_size, wire_dtype=jnp.bfloat16
+        )
+        / axis_size
+    )
+
+
+# --------------------------------------------------------------------------
+# registry — reference config names kept as aliases (SURVEY.md §5.6:
+# exch_strategy: 'ar'|'cudaaware'|'asa32'|'asa16'|'nccl32')
+# --------------------------------------------------------------------------
+
+_CANONICAL = {
+    "psum": lambda axis, size: psum_mean(axis),
+    "psum_bf16": lambda axis, size: psum_bf16(axis),
+    "ring": ring,
+    "ring_bf16": ring_bf16,
+}
+
+_ALIASES = {
+    "ar": "psum",
+    "cudaaware": "psum",
+    "copper": "psum",
+    "nccl32": "psum",
+    "nccl16": "psum_bf16",
+    "asa32": "ring",
+    "asa16": "ring_bf16",
+}
+
+
+def get_strategy(name: str, axis_name: str, axis_size: int) -> Strategy:
+    key = _ALIASES.get(name, name)
+    try:
+        return _CANONICAL[key](axis_name, axis_size)
+    except KeyError:
+        raise ValueError(
+            f"unknown exchange strategy {name!r}; available: "
+            f"{sorted(_CANONICAL) + sorted(_ALIASES)}"
+        ) from None

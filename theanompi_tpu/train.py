@@ -1,0 +1,116 @@
+"""Train/eval step construction — the ``compile_iter_fns`` equivalent.
+
+Reference (SURVEY.md §3.2): each model compiled a Theano ``train_fn``
+(fwd+bwd, grads written to velocity shared vars), the exchanger ran MPI
+between calls, then ``update_fn`` applied the averaged velocities. Here
+the entire iteration — forward, backward, gradient sync collective,
+optimizer update, LR schedule — is ONE jitted XLA program; the gradient
+sync is a pluggable function applied to raw grads *inside* the step
+(reference ordering: comm sees raw gradients, update runs post-exchange).
+
+``make_train_step`` builds the single-device / replicated step; the
+parallel layer (``theanompi_tpu.parallel``) wraps it in ``shard_map``
+over a mesh and supplies the collective ``grad_sync``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from theanompi_tpu.models.contract import Model
+from theanompi_tpu.ops.optimizers import apply_updates
+
+PyTree = Any
+GradSync = Callable[[PyTree], PyTree]  # raw grads -> synced grads
+
+
+class TrainState(NamedTuple):
+    """The complete training state pytree — the analogue of the
+    reference's Theano shared variables (params + vels) plus the step
+    counter that drives the LR schedule."""
+
+    params: PyTree
+    model_state: PyTree  # BatchNorm running stats etc.
+    opt_state: PyTree
+    step: jax.Array  # int32 global step
+
+
+def init_train_state(model: Model, key: jax.Array) -> TrainState:
+    params, model_state = model.init(key)
+    opt_state = model.optimizer().init(params)
+    return TrainState(params, model_state, opt_state, jnp.zeros((), jnp.int32))
+
+
+def make_train_step(
+    model: Model,
+    steps_per_epoch: int = 1,
+    grad_sync: Optional[GradSync] = None,
+    loss_scale: float = 1.0,
+):
+    """Build the pure train step: ``(state, images, labels, rng) ->
+    (state, metrics)``.
+
+    ``steps_per_epoch`` converts the step counter to the schedule's unit
+    when the recipe schedules by epoch (reference: ``adjust_hyperp(epoch)``
+    ran between epochs; here the piecewise schedule is evaluated inside
+    the compiled step so nothing happens on the host).
+
+    ``grad_sync`` is the exchanger hook — under ``shard_map`` it holds the
+    collective (psum mean / ring / compressed ring); None means single
+    replica.
+
+    NOTE: the local-grad → allreduce decomposition relies on classic
+    pmap-style AD semantics (``shard_map(..., check_vma=False)``), under
+    which psum's transpose is identity — that is exactly what makes
+    "grad locally, then average" produce the true global gradient, even
+    when the forward pass itself contains collectives (cross-replica
+    BatchNorm). Under ``check_vma=True`` the cotangent of replicated
+    params is already globally summed ("unreduced"), so an explicit
+    exchanger would double-count — verified empirically on jax 0.9; see
+    tests/test_bsp.py. All shard_maps in this framework therefore use
+    ``check_vma=False``.
+    """
+    optimizer = model.optimizer()
+    schedule = model.schedule()
+    per_epoch = float(max(1, steps_per_epoch))
+    by_epoch = model.recipe.lr_unit == "epoch"
+
+    def train_step(state: TrainState, images, labels, rng):
+        def loss_fn(params):
+            logits, new_model_state = model.apply(
+                params, state.model_state, images, train=True, rng=rng
+            )
+            loss = model.loss(logits, labels) * loss_scale
+            return loss, (new_model_state, logits)
+
+        (loss, (new_model_state, logits)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        if loss_scale != 1.0:
+            grads = jax.tree_util.tree_map(lambda g: g / loss_scale, grads)
+        if grad_sync is not None:
+            grads = grad_sync(grads)
+
+        sched_t = state.step / per_epoch if by_epoch else state.step
+        lr = schedule(sched_t)
+        updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params, lr)
+        new_params = apply_updates(state.params, updates)
+
+        metrics = {"loss": loss / loss_scale, "lr": lr, **model.metrics(logits, labels)}
+        new_state = TrainState(new_params, new_model_state, new_opt_state, state.step + 1)
+        return new_state, metrics
+
+    return train_step
+
+
+def make_eval_step(model: Model):
+    """``(state, images, labels) -> metrics`` with loss, on eval stats."""
+
+    def eval_step(state: TrainState, images, labels):
+        logits, _ = model.apply(state.params, state.model_state, images, train=False)
+        return {"loss": model.loss(logits, labels), **model.metrics(logits, labels)}
+
+    return eval_step
