@@ -1,0 +1,31 @@
+"""launch_session.py — script-style session entry (reference parity).
+
+The reference repo's ``launch_session.py`` [N in SURVEY.md] constructed a
+sync rule and launched workers over MPI; this is the same session written
+against the TPU-native API. Run e.g.::
+
+    python launch_session.py                    # BSP WRN on CIFAR-10, all chips
+    python launch_session.py --synthetic        # no dataset on disk needed
+"""
+
+import argparse
+
+from theanompi_tpu import BSP
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=None)
+    ap.add_argument("--epochs", type=int, default=None)
+    ap.add_argument("--synthetic", action="store_true")
+    args = ap.parse_args()
+
+    rule = BSP()
+    rule.init(
+        devices=args.devices,
+        modelfile="theanompi_tpu.models.model_zoo.wrn",
+        modelclass="WRN",
+        n_epochs=args.epochs,
+        dataset="synthetic" if args.synthetic else None,
+    )
+    summary = rule.wait()
+    print("done:", summary)

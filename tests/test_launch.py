@@ -1,0 +1,117 @@
+"""Launcher tests: run_training driver, session API, tmpi CLI
+(reference flow: SURVEY.md §3.1)."""
+
+import json
+
+import pytest
+
+from theanompi_tpu import BSP
+from theanompi_tpu.cli import main as tmpi_main
+from theanompi_tpu.launch.session import resolve_model
+from theanompi_tpu.launch.worker import run_training
+from theanompi_tpu.models.model_zoo.wrn import WRN_16_4
+
+
+_TINY = dict(
+    recipe_overrides={
+        "batch_size": 32,
+        "input_shape": (16, 16, 3),
+        "sched_kwargs": {"lr": 0.05, "boundaries": [10**9]},
+    },
+    dataset="synthetic",
+    dataset_kwargs={"n_train": 64, "n_val": 32, "image_shape": (16, 16, 3)},
+    print_freq=0,
+)
+
+
+def test_run_training_bsp_end_to_end(tmp_path):
+    summary = run_training(
+        rule="bsp",
+        model_cls=WRN_16_4,
+        devices=8,
+        n_epochs=2,
+        save_dir=str(tmp_path),
+        ckpt_dir=str(tmp_path / "ckpt"),
+        **_TINY,
+    )
+    assert summary["steps"] == 4  # 64/32 batches x 2 epochs
+    assert summary["images_per_sec"] > 0
+    assert "val" in summary and "error" in summary["val"]
+    # recorder JSONL + checkpoint written
+    assert (tmp_path / "wrn_16_4_bsp.jsonl").exists()
+    assert any(f.name.startswith("ckpt_") for f in (tmp_path / "ckpt").iterdir())
+
+
+def test_run_training_resume(tmp_path):
+    kw = dict(rule="bsp", model_cls=WRN_16_4, devices=8, ckpt_dir=str(tmp_path / "c"), **_TINY)
+    run_training(n_epochs=1, **kw)
+    summary = run_training(n_epochs=2, resume=True, **kw)
+    assert summary["steps"] == 4  # resumed at 2, trained 2 more
+
+
+def test_run_training_errors():
+    with pytest.raises(ValueError, match="model_cls"):
+        run_training(rule="bsp")
+    with pytest.raises(ValueError, match="unknown rule"):
+        run_training(rule="fancy", model_cls=WRN_16_4, **_TINY)
+    with pytest.raises(ValueError, match="not divisible"):
+        run_training(
+            rule="bsp", model_cls=WRN_16_4, devices=8,
+            recipe_overrides={"batch_size": 12, "input_shape": (16, 16, 3)},
+            dataset="synthetic", dataset_kwargs={"n_train": 24, "n_val": 12, "image_shape": (16, 16, 3)},
+        )
+
+
+def test_session_api_background_and_wait():
+    rule = BSP()
+    rule.init(
+        devices=8,
+        modelfile="theanompi_tpu.models.model_zoo.wrn",
+        modelclass="WRN_16_4",
+        n_epochs=1,
+        **_TINY,
+    )
+    summary = rule.wait()
+    assert summary["steps"] == 2
+    # bad model class fails fast at init() (resolve happens before spawn)
+    with pytest.raises(AttributeError):
+        BSP().init(modelfile="theanompi_tpu.models.model_zoo.wrn", modelclass="Nope")
+    # runtime failure inside the background thread surfaces at wait()
+    rule2 = BSP()
+    rule2.init(
+        modelfile="theanompi_tpu.models.model_zoo.wrn",
+        modelclass="WRN_16_4",
+        dataset="no_such_dataset",
+    )
+    with pytest.raises(ValueError, match="unknown dataset"):
+        rule2.wait()
+
+
+def test_resolve_model_from_file(tmp_path):
+    f = tmp_path / "mymodel.py"
+    f.write_text(
+        "from theanompi_tpu.models.model_zoo.wrn import WRN_16_4\n"
+        "class Mine(WRN_16_4):\n    name = 'mine'\n"
+    )
+    cls = resolve_model(str(f), "Mine")
+    assert cls.name == "mine"
+
+
+def test_tmpi_cli(tmp_path, capsys):
+    rc = tmpi_main(
+        [
+            "BSP", "8",
+            "theanompi_tpu.models.model_zoo.wrn", "WRN_16_4",
+            "--synthetic", "--max-steps", "2", "--epochs", "1",
+            "--batch-size", "32", "--print-freq", "0",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    summary = json.loads(out)
+    assert summary["rule"] == "bsp" and summary["steps"] == 2
+
+
+def test_resolve_model_short_name():
+    assert resolve_model("wrn", "WRN_16_4").name == "wrn_16_4"
+    assert resolve_model("cifar10", "Cifar10_model").name == "cifar10"

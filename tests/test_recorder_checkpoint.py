@@ -1,0 +1,108 @@
+"""Recorder + checkpoint subsystem tests (SURVEY.md §5.1, §5.4)."""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from theanompi_tpu.models.cifar10 import Cifar10_model
+from theanompi_tpu.train import TrainState, init_train_state, make_train_step
+from theanompi_tpu.utils import (
+    Recorder,
+    latest_checkpoint,
+    load_checkpoint,
+    save_checkpoint,
+)
+
+
+def _state():
+    model = Cifar10_model(
+        Cifar10_model.default_recipe().replace(batch_size=8, input_shape=(16, 16, 3))
+    )
+    return model, init_train_state(model, jax.random.PRNGKey(0))
+
+
+# -- recorder ---------------------------------------------------------------
+
+
+def test_recorder_brackets_and_history(tmp_path):
+    rec = Recorder(save_dir=str(tmp_path), run_name="t", print_freq=0)
+    rec.start("step")
+    time.sleep(0.01)
+    dt = rec.end("step")
+    assert dt >= 0.01
+    rec.train_metrics(1, {"loss": 1.5, "error": 0.7}, n_images=32)
+    rec.val_metrics(0, {"loss": 1.2, "error": 0.5, "top5_error": 0.1})
+    rec.start_epoch()
+    rec.end_epoch(0, n_images=320)
+    rec.save()
+    rec.close()
+
+    jsonl = (tmp_path / "t.jsonl").read_text().strip().splitlines()
+    kinds = [json.loads(l)["kind"] for l in jsonl]
+    assert kinds == ["train", "val", "epoch"]
+    assert json.loads(jsonl[0])["images_per_sec"] > 0
+
+    hist = Recorder.load_history(str(tmp_path / "t_history.pkl"))
+    assert hist["history"]["train"][0]["loss"] == 1.5
+
+
+def test_recorder_sync_blocks_on_device_value():
+    rec = Recorder(print_freq=0)
+    rec.start("step")
+    x = jnp.ones((256, 256)) @ jnp.ones((256, 256))
+    rec.end("step", sync=x)
+    assert rec.mean_time("step") > 0
+
+
+# -- checkpoint -------------------------------------------------------------
+
+
+def test_checkpoint_roundtrip_and_resume(tmp_path):
+    model, state = _state()
+    step_fn = jax.jit(make_train_step(model))
+    x = jnp.zeros(model.input_shape)
+    y = jnp.zeros((8,), jnp.int32)
+    state, _ = step_fn(state, x, y, jax.random.PRNGKey(1))
+
+    path = save_checkpoint(str(tmp_path), state, int(state.step), rng=jax.random.PRNGKey(7))
+    assert path and os.path.exists(path)
+
+    _, template = _state()
+    restored, rng = load_checkpoint(path, template)
+    assert int(restored.step) == 1
+    np.testing.assert_array_equal(np.asarray(rng), np.asarray(jax.random.PRNGKey(7)))
+    for a, b in zip(jax.tree_util.tree_leaves(restored), jax.tree_util.tree_leaves(state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # training continues from the restored state
+    restored = jax.tree_util.tree_map(jnp.asarray, restored)
+    s2, _ = step_fn(TrainState(*restored), x, y, jax.random.PRNGKey(2))
+    assert int(s2.step) == 2
+
+
+def test_checkpoint_prune_and_latest(tmp_path):
+    _, state = _state()
+    for s in (1, 2, 3, 4):
+        save_checkpoint(str(tmp_path), state, s, keep=2)
+    files = sorted(os.listdir(tmp_path))
+    assert files == ["ckpt_3.npz", "ckpt_4.npz"]
+    assert latest_checkpoint(str(tmp_path)).endswith("ckpt_4.npz")
+    assert latest_checkpoint(str(tmp_path / "nope")) is None
+
+
+def test_checkpoint_structure_mismatch_raises(tmp_path):
+    _, state = _state()
+    path = save_checkpoint(str(tmp_path), {"a": state.params}, 1)
+    with pytest.raises(KeyError):
+        load_checkpoint(path, {"b": state.params})
+
+
+def test_checkpoint_shape_mismatch_raises(tmp_path):
+    path = save_checkpoint(str(tmp_path), {"w": jnp.zeros((3, 3))}, 1)
+    with pytest.raises(ValueError):
+        load_checkpoint(path, {"w": jnp.zeros((4, 3))})

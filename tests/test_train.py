@@ -92,3 +92,24 @@ def test_synthetic_dataset_deterministic_and_learnable():
     # different epochs shuffle differently
     x3, _ = next(d1.train_epoch(4, 16))
     assert not np.array_equal(x1, x3)
+
+
+def test_cifar_augment_vectorized_oracle():
+    """Vectorized crop+mirror == per-image loop oracle."""
+    import numpy as np
+    from theanompi_tpu.data.datasets import Cifar10_data
+
+    x = np.random.RandomState(0).randn(16, 32, 32, 3).astype(np.float32)
+    ds = Cifar10_data.__new__(Cifar10_data)  # skip file loading
+    got = ds.augment(x, np.random.RandomState(7))
+
+    rng = np.random.RandomState(7)
+    padded = np.pad(x, [(0, 0), (4, 4), (4, 4), (0, 0)], mode="reflect")
+    offs = rng.randint(0, 9, size=(16, 2))
+    flips = rng.rand(16) < 0.5
+    for i in range(16):
+        oy, ox = offs[i]
+        img = padded[i, oy : oy + 32, ox : ox + 32]
+        if flips[i]:
+            img = img[:, ::-1]
+        np.testing.assert_array_equal(got[i], img)

@@ -27,7 +27,7 @@ Session API (reference: ``launch_session.py`` / ``tmpi``)::
 
     from theanompi_tpu import BSP
     rule = BSP()
-    rule.init(devices=8, modelfile='theanompi_tpu.models.wrn', modelclass='WRN')
+    rule.init(devices=8, modelfile='wrn', modelclass='WRN')  # short name or module path
     rule.wait()
 """
 

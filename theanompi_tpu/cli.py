@@ -1,0 +1,96 @@
+"""``tmpi`` — the command-line launcher.
+
+Rebuild of the reference CLI (reference: ``tmpi`` — approx.
+``tmpi <RULE> <n> <devices> <modelfile> <modelclass>``, which built an
+``mpirun`` command line; SURVEY.md §3.1). No mpirun on TPU: the rule
+runs one SPMD program over a device mesh in-process.
+
+Usage::
+
+    tmpi BSP 8 theanompi_tpu.models.model_zoo.wrn WRN
+    tmpi EASGD 8 theanompi_tpu.models.model_zoo.resnet50 ResNet50 --avg-freq 8
+    tmpi GOSGD 8 theanompi_tpu.models.model_zoo.vgg VGG16
+    tmpi BSP 8 my_model.py MyModel --strategy asa16 --epochs 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tmpi",
+        description="TPU-native Theano-MPI: distributed training launcher",
+    )
+    p.add_argument("rule", choices=["BSP", "EASGD", "GOSGD", "bsp", "easgd", "gosgd"])
+    p.add_argument("n_devices", type=int, help="number of chips (0 = all)")
+    p.add_argument("modelfile", help="module path or .py file with the model class")
+    p.add_argument("modelclass", help="model class name (e.g. WRN)")
+    p.add_argument("--strategy", default="psum",
+                   help="gradient exchange strategy (psum|ring|ring_bf16|psum_bf16 "
+                        "or reference names ar|asa32|asa16|nccl32|nccl16)")
+    p.add_argument("--epochs", type=int, default=None, help="override recipe n_epochs")
+    p.add_argument("--max-steps", type=int, default=None)
+    p.add_argument("--batch-size", type=int, default=None, help="override recipe batch")
+    p.add_argument("--dataset", default=None, help="override recipe dataset")
+    p.add_argument("--synthetic", action="store_true",
+                   help="shortcut: --dataset synthetic (smoke runs, no data on disk)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--save-dir", default=None, help="recorder output dir (JSONL + pickle)")
+    p.add_argument("--ckpt-dir", default=None)
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--print-freq", type=int, default=40)
+    p.add_argument("--avg-freq", type=int, default=None,
+                   help="EASGD/GoSGD: steps between exchanges (reference avg_freq)")
+    p.add_argument("--alpha", type=float, default=None, help="EASGD elastic rate")
+    p.add_argument("--p-push", type=float, default=None, help="GoSGD push probability")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from theanompi_tpu.launch.session import resolve_model
+    from theanompi_tpu.launch.worker import run_training
+
+    model_cls = resolve_model(args.modelfile, args.modelclass)
+
+    overrides = {}
+    if args.batch_size:
+        overrides["batch_size"] = args.batch_size
+    if args.synthetic:
+        args.dataset = "synthetic"
+
+    rule_kwargs = {}
+    if args.avg_freq is not None:
+        rule_kwargs["avg_freq"] = args.avg_freq
+    if args.alpha is not None:
+        rule_kwargs["alpha"] = args.alpha
+    if args.p_push is not None:
+        rule_kwargs["p_push"] = args.p_push
+
+    summary = run_training(
+        rule=args.rule.lower(),
+        model_cls=model_cls,
+        devices=args.n_devices or None,
+        strategy=args.strategy,
+        n_epochs=args.epochs,
+        max_steps=args.max_steps,
+        dataset=args.dataset,
+        recipe_overrides=overrides,
+        seed=args.seed,
+        save_dir=args.save_dir,
+        ckpt_dir=args.ckpt_dir,
+        resume=args.resume,
+        print_freq=args.print_freq,
+        **rule_kwargs,
+    )
+    print(json.dumps({k: v for k, v in summary.items() if k != "state"}, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

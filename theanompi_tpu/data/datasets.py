@@ -163,16 +163,15 @@ class Cifar10_data(Dataset):
         )
 
     def augment(self, x: np.ndarray, rng: np.random.RandomState) -> np.ndarray:
-        n, h, w, c = x.shape
+        # vectorized random 32x32 crop from 4-px reflect pad + mirror
+        n, h, w, _ = x.shape
         padded = np.pad(x, [(0, 0), (4, 4), (4, 4), (0, 0)], mode="reflect")
-        out = np.empty_like(x)
         offs = rng.randint(0, 9, size=(n, 2))
         flips = rng.rand(n) < 0.5
-        for i in range(n):
-            oy, ox = offs[i]
-            img = padded[i, oy : oy + h, ox : ox + w]
-            out[i] = img[:, ::-1] if flips[i] else img
-        return out
+        rows = offs[:, 0, None] + np.arange(h)  # (n, h)
+        cols = offs[:, 1, None] + np.arange(w)  # (n, w)
+        cols = np.where(flips[:, None], cols[:, ::-1], cols)
+        return padded[np.arange(n)[:, None, None], rows[:, :, None], cols[:, None, :]]
 
 
 _REGISTRY = {

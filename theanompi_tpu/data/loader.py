@@ -1,0 +1,92 @@
+"""Asynchronous device-prefetch loader.
+
+Rebuild of the reference's parallel-loading subsystem (reference:
+``lib/proc_load_mpi.py`` — one MPI-spawned child process per worker
+pulling ``.hkl`` batch files, preprocessing, and double-buffering so
+I/O + preprocessing hide behind GPU compute; SURVEY.md §3.4). On TPU the
+same overlap needs no process gymnastics: a background thread runs the
+host-side pipeline (load + augment + ``device_put``) a configurable
+depth ahead, while the device executes the current step. ``device_put``
+is async in JAX, so the H2D copy itself overlaps device compute — the
+double-buffer the reference built by hand.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, Iterator, Optional
+
+import jax
+
+
+class PrefetchLoader:
+    """Wrap a host batch iterator; yield device-placed batches ``depth``
+    ahead of consumption.
+
+    ``place`` maps a host batch to device arrays (e.g. sharded
+    ``device_put`` onto a mesh). Exceptions in the worker thread are
+    re-raised at the consumer's next ``__next__``.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(
+        self,
+        batches: Iterable,
+        place: Optional[Callable] = None,
+        depth: int = 2,
+    ):
+        self._place = place or (lambda b: jax.device_put(b))
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+        self._err: Optional[BaseException] = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, args=(iter(batches),), daemon=True
+        )
+        self._thread.start()
+
+    def _run(self, it: Iterator) -> None:
+        try:
+            for batch in it:
+                if self._stop.is_set():
+                    return
+                placed = self._place(batch)
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(placed, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                else:
+                    return
+        except BaseException as e:  # surfaced on the consumer side
+            self._err = e
+        finally:
+            try:
+                self._q.put_nowait(self._SENTINEL)
+            except queue.Full:
+                pass  # consumer stopped; close() drains
+
+    def close(self) -> None:
+        """Stop the producer and drop prefetched batches — call when
+        abandoning the iterator early (e.g. max_steps truncation), so
+        device-placed batches are not pinned for the process lifetime."""
+        self._stop.set()
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=2.0)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._SENTINEL:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item

@@ -19,13 +19,17 @@ from typing import Any, Optional, Sequence, Union
 
 
 def resolve_model(modelfile: str, modelclass: str):
-    """Import ``modelclass`` from module path ``modelfile``.
+    """Import ``modelclass`` from ``modelfile``.
 
     The reference passed a python file path + class name over argv to the
-    workers (reference: ``launch_session.py``); here modelfile is a module
-    path (e.g. ``theanompi_tpu.models.wrn``) or a filesystem path ending
-    in ``.py``.
+    workers (reference: ``launch_session.py``); here modelfile is a zoo
+    short name (``wrn``, ``alexnet``, ...), a module path
+    (``theanompi_tpu.models.model_zoo.wrn``), or a ``.py`` file path.
     """
+    from theanompi_tpu.models import MODEL_REGISTRY, get_model
+
+    if modelfile in MODEL_REGISTRY:
+        modelfile = MODEL_REGISTRY[modelfile][0]
     if modelfile.endswith(".py"):
         spec = importlib.util.spec_from_file_location("_tmpi_model", modelfile)
         mod = importlib.util.module_from_spec(spec)

@@ -1,0 +1,216 @@
+"""The training driver: epoch loop, validation, checkpointing.
+
+Rebuild of the reference's sync-rule worker processes (reference: BSP
+``Worker.run`` epoch/iteration loop with data wait -> train_iter ->
+exchange -> record, per-epoch validation, ``adjust_hyperp``, rank-0
+checkpoint; SURVEY.md §3.2, §2.1 "Sync-rule drivers"). One driver covers
+all rules — the rule picks which compiled step function it runs:
+
+- ``bsp``:   BSP step over a ``('data',)`` mesh (parallel/bsp.py)
+- ``easgd``: elastic-averaging step over a worker mesh (parallel/easgd.py)
+- ``gosgd``: gossip step (parallel/gosgd.py)
+
+There are no worker processes to manage: the mesh is the workers, and
+the driver is plain single-controller Python around jitted SPMD steps
+(multi-controller runs call this same function once per host).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from theanompi_tpu.data import get_dataset
+from theanompi_tpu.data.loader import PrefetchLoader
+from theanompi_tpu.models.contract import Model
+from theanompi_tpu.parallel import make_bsp_eval_step, make_bsp_train_step, make_mesh
+from theanompi_tpu.parallel.mesh import put_global_batch
+from theanompi_tpu.train import TrainState, init_train_state
+from theanompi_tpu.utils import (
+    Recorder,
+    latest_checkpoint,
+    load_checkpoint,
+    save_checkpoint,
+)
+
+
+def run_training(
+    rule: str = "bsp",
+    model_cls: type[Model] = None,
+    devices=None,
+    *,
+    strategy: str = "psum",
+    n_epochs: Optional[int] = None,
+    max_steps: Optional[int] = None,
+    dataset: Optional[str] = None,
+    dataset_kwargs: Optional[dict] = None,
+    recipe_overrides: Optional[dict] = None,
+    seed: int = 0,
+    save_dir: Optional[str] = None,
+    ckpt_dir: Optional[str] = None,
+    ckpt_every_epochs: int = 1,
+    resume: bool = False,
+    print_freq: int = 40,
+    prefetch_depth: int = 2,
+    # rule-specific kwargs (EASGD avg_freq etc.) forwarded to the rule's
+    # step builder
+    **rule_kwargs: Any,
+) -> dict:
+    """Train ``model_cls`` under a sync rule; returns a summary dict.
+
+    The recipe is the model's own (reference: model-owned hyperparams,
+    SURVEY.md §5.6); ``recipe_overrides`` is the session's override hook.
+    """
+    if model_cls is None:
+        raise ValueError("model_cls is required")
+
+    recipe = model_cls.default_recipe()
+    if recipe_overrides:
+        recipe = recipe.replace(**recipe_overrides)
+    model = model_cls(recipe)
+
+    data = get_dataset(dataset or recipe.dataset, **(dataset_kwargs or {}))
+    batch = recipe.batch_size
+    steps_per_epoch = data.n_train_batches(batch)
+    if steps_per_epoch == 0:
+        raise ValueError(
+            f"dataset has {data.n_train} train examples < batch size {batch}"
+        )
+    n_epochs = n_epochs if n_epochs is not None else recipe.n_epochs
+
+    mesh = make_mesh(devices)
+    n_dev = mesh.devices.size
+    if batch % n_dev:
+        raise ValueError(f"global batch {batch} not divisible by {n_dev} devices")
+    vbatch = recipe.val_batch_size or batch
+    if vbatch % n_dev:
+        raise ValueError(f"val batch {vbatch} not divisible by {n_dev} devices")
+
+    rule = rule.lower()
+    if rule == "bsp":
+        train_step = make_bsp_train_step(
+            model, mesh, steps_per_epoch=steps_per_epoch, strategy=strategy
+        )
+        eval_step = make_bsp_eval_step(model, mesh)
+    elif rule == "easgd":
+        from theanompi_tpu.parallel.easgd import make_easgd_driver
+
+        return make_easgd_driver(
+            model=model,
+            data=data,
+            mesh=mesh,
+            n_epochs=n_epochs,
+            max_steps=max_steps,
+            seed=seed,
+            save_dir=save_dir,
+            ckpt_dir=ckpt_dir,
+            resume=resume,
+            print_freq=print_freq,
+            **rule_kwargs,
+        )
+    elif rule == "gosgd":
+        from theanompi_tpu.parallel.gosgd import make_gosgd_driver
+
+        return make_gosgd_driver(
+            model=model,
+            data=data,
+            mesh=mesh,
+            n_epochs=n_epochs,
+            max_steps=max_steps,
+            seed=seed,
+            save_dir=save_dir,
+            ckpt_dir=ckpt_dir,
+            resume=resume,
+            print_freq=print_freq,
+            **rule_kwargs,
+        )
+    else:
+        raise ValueError(f"unknown rule {rule!r}; available: bsp, easgd, gosgd")
+
+    rec = Recorder(
+        rank=jax.process_index(), print_freq=print_freq, save_dir=save_dir,
+        run_name=f"{model.name}_{rule}",
+    )
+    rng = jax.random.PRNGKey(seed)
+    state = init_train_state(model, rng)
+    start_epoch = 0
+    if resume and ckpt_dir:
+        path = latest_checkpoint(ckpt_dir)
+        if path:
+            restored, saved_rng = load_checkpoint(path, state)
+            state = jax.tree_util.tree_map(jnp.asarray, restored)
+            state = TrainState(*state)
+            if saved_rng is not None:
+                rng = jnp.asarray(saved_rng)
+            start_epoch = int(state.step) // steps_per_epoch
+            print(f"resumed from {path} at step {int(state.step)}", flush=True)
+
+    def place(b):
+        x, y = b
+        return (
+            put_global_batch(mesh, jnp.asarray(x)),
+            put_global_batch(mesh, jnp.asarray(y)),
+        )
+
+    summary: dict = {"epochs": [], "rule": rule, "model": model.name}
+    step_count = int(state.step)
+    # Mid-epoch resume (checkpoint written after a max_steps truncation):
+    # fast-forward past the batches the restored step count already
+    # consumed, so data order and epoch accounting stay exact.
+    skip_batches = step_count % steps_per_epoch
+    for epoch in range(start_epoch, n_epochs):
+        rec.start_epoch()
+        epoch_steps = 0
+        loader = PrefetchLoader(
+            data.train_epoch(epoch, batch, seed=seed), place, depth=prefetch_depth
+        )
+        rec.start("wait")
+        for xg, yg in loader:
+            if skip_batches:
+                skip_batches -= 1
+                continue
+            rec.end("wait")
+            rng, sub = jax.random.split(rng)
+            rec.start("step")
+            state, metrics = train_step(state, xg, yg, sub)
+            rec.end("step", sync=metrics["loss"])
+            step_count += 1
+            epoch_steps += 1
+            rec.train_metrics(step_count, metrics, n_images=batch)
+            rec.start("wait")
+            if max_steps and step_count >= max_steps:
+                loader.close()
+                break
+        rec.end("wait")
+        rec.end_epoch(epoch, n_images=epoch_steps * batch)
+
+        # validation (reference: per-epoch val loop on the worker/server)
+        val_accum: dict[str, float] = {}
+        n_val = 0
+        for vx, vy in data.val_epoch(vbatch):
+            vm = eval_step(state, *place((vx, vy)))
+            for k, v in vm.items():
+                val_accum[k] = val_accum.get(k, 0.0) + float(v)
+            n_val += 1
+        if n_val:
+            val_metrics = {k: v / n_val for k, v in val_accum.items()}
+            rec.val_metrics(epoch, val_metrics)
+            summary["val"] = val_metrics
+
+        if ckpt_dir and (epoch + 1) % ckpt_every_epochs == 0:
+            save_checkpoint(ckpt_dir, state, step_count, rng=rng)
+        rec.save()
+        summary["epochs"].append(epoch)
+        if max_steps and step_count >= max_steps:
+            break
+
+    rec.close()
+    summary["steps"] = step_count
+    summary["images_per_sec"] = (
+        batch / rec.mean_time("step", 50) if rec.mean_time("step", 50) else 0.0
+    )
+    return summary

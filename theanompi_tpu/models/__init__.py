@@ -28,7 +28,9 @@ MODEL_REGISTRY = {
 
 
 def get_model(name: str) -> type:
-    """Resolve a model class by short name (used by the tmpi CLI)."""
+    """Resolve a model class by zoo short name (used by
+    ``launch.session.resolve_model`` for ``tmpi BSP 8 wrn WRN``-style
+    invocations)."""
     import importlib
 
     try:

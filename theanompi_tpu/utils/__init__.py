@@ -1,0 +1,8 @@
+"""Cross-cutting utilities: recorder (timing/metrics), checkpointing, logging."""
+
+from theanompi_tpu.utils.recorder import Recorder  # noqa: F401
+from theanompi_tpu.utils.checkpoint import (  # noqa: F401
+    load_checkpoint,
+    latest_checkpoint,
+    save_checkpoint,
+)

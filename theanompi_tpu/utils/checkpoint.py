@@ -1,0 +1,124 @@
+"""Checkpoint / resume.
+
+Rebuild of the reference's weight persistence (reference:
+``lib/helper_funcs.py`` — ``save_weights``/``load_weights``: one ``.npy``
+per Theano shared param, saved each epoch from rank 0, no atomicity;
+SURVEY.md §5.4). Here the WHOLE TrainState pytree (params + BatchNorm
+state + optimizer state + step) plus the RNG key goes into one ``.npz``
+written atomically (tmp + rename), so resume restores training exactly —
+including the LR schedule, which is a pure function of the restored step.
+
+Arrays are pulled to host with ``jax.device_get``; on restore the caller
+re-places them (replicated or sharded) via its usual device_put path.
+Multi-host: only process 0 writes (same contract as the reference's
+rank-0 save); sharded-per-host formats can layer on later without
+changing this API.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import tempfile
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+
+PyTree = Any
+
+_CKPT_RE = re.compile(r"ckpt_(\d+)\.npz$")
+
+
+def _flatten_with_paths(tree: PyTree) -> dict[str, np.ndarray]:
+    flat = {}
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+    for path, leaf in leaves_with_paths:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        flat[key] = np.asarray(jax.device_get(leaf))
+    return flat
+
+
+def save_checkpoint(
+    directory: str,
+    state: PyTree,
+    step: int,
+    rng: Optional[jax.Array] = None,
+    keep: int = 3,
+) -> Optional[str]:
+    """Atomically write ``ckpt_{step}.npz``; prune to the newest ``keep``.
+    Only process 0 writes in multi-host runs; returns the path (or None
+    on non-writer processes)."""
+    if jax.process_index() != 0:
+        return None
+    os.makedirs(directory, exist_ok=True)
+    flat = _flatten_with_paths(state)
+    if rng is not None:
+        flat["__rng__"] = np.asarray(jax.device_get(rng))
+    path = os.path.join(directory, f"ckpt_{step}.npz")
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **flat)
+        os.replace(tmp, path)  # atomic on POSIX
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    _prune(directory, keep)
+    return path
+
+
+def _prune(directory: str, keep: int) -> None:
+    ckpts = sorted(
+        (int(m.group(1)), f)
+        for f in os.listdir(directory)
+        if (m := _CKPT_RE.search(f))
+    )
+    for _, f in ckpts[:-keep] if keep else []:
+        os.unlink(os.path.join(directory, f))
+
+
+def latest_checkpoint(directory: str) -> Optional[str]:
+    if not os.path.isdir(directory):
+        return None
+    ckpts = sorted(
+        (int(m.group(1)), f)
+        for f in os.listdir(directory)
+        if (m := _CKPT_RE.search(f))
+    )
+    return os.path.join(directory, ckpts[-1][1]) if ckpts else None
+
+
+def load_checkpoint(
+    path: str, state_template: PyTree
+) -> tuple[PyTree, Optional[np.ndarray]]:
+    """Restore a pytree matching ``state_template``'s structure (the
+    template supplies structure + dtypes; values are ignored). Returns
+    ``(state, rng_or_None)`` as host numpy arrays — caller device_puts.
+
+    A structure mismatch (renamed layer, different optimizer) raises
+    KeyError naming the missing entry, rather than silently reinitializing
+    — resume must be exact or explicit.
+    """
+    data = np.load(path)
+    rng = data["__rng__"] if "__rng__" in data.files else None
+
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(state_template)
+    new_leaves = []
+    for p, leaf in leaves_with_paths:
+        key = "/".join(str(getattr(q, "key", getattr(q, "idx", q))) for q in p)
+        if key not in data.files:
+            raise KeyError(
+                f"checkpoint {path} is missing {key!r} — structure mismatch "
+                f"(available: {sorted(data.files)[:8]}...)"
+            )
+        arr = data[key]
+        want = np.asarray(leaf)
+        if tuple(arr.shape) != tuple(want.shape):
+            raise ValueError(
+                f"checkpoint leaf {key!r} has shape {arr.shape}, expected {want.shape}"
+            )
+        new_leaves.append(arr.astype(want.dtype))
+    return jax.tree_util.tree_unflatten(treedef, new_leaves), rng

@@ -1,0 +1,159 @@
+"""Recorder: per-iteration timing split + train/val metric history.
+
+Rebuild of the reference's observability layer (reference:
+``lib/recorder.py`` — ``Recorder`` with ``start()``/``end('calc'|'comm')``
+wall-clock brackets, train cost/error accumulation, val cost/error/top-5,
+periodic console prints, pickled history; SURVEY.md §5.1, §5.5). The API
+is kept because it was good; additions over the reference:
+
+- JSONL event log (machine-readable) instead of pickle-only;
+- images/sec and cumulative epoch timing (the BASELINE.json metric);
+- correct device-timing semantics for XLA: an async dispatch means
+  host-side brackets measure nothing unless the caller synchronizes —
+  ``end()`` optionally blocks on a ``jax.Array`` for honest splits.
+
+Note on calc/comm split: in the reference these were separate host
+phases (Theano call, then MPI). Here the collective is fused INSIDE the
+compiled step, so per-phase brackets cannot separate them; the honest
+equivalents are ``step`` (whole-iteration device time) plus
+``jax.profiler`` traces for the in-step breakdown. The bracket API
+remains for the host-visible phases (data wait / step / eval).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import time
+from collections import defaultdict
+from typing import Optional
+
+import numpy as np
+
+
+class Recorder:
+    def __init__(
+        self,
+        rank: int = 0,
+        print_freq: int = 40,
+        save_dir: Optional[str] = None,
+        run_name: str = "run",
+    ):
+        self.rank = rank
+        self.print_freq = print_freq
+        self.save_dir = save_dir
+        self.run_name = run_name
+        self._t0: dict[str, float] = {}
+        self.timings: dict[str, list[float]] = defaultdict(list)
+        self.history: dict[str, list] = defaultdict(list)
+        self.epoch_start: Optional[float] = None
+        self._jsonl = None
+        if save_dir:
+            os.makedirs(save_dir, exist_ok=True)
+            self._jsonl = open(os.path.join(save_dir, f"{run_name}.jsonl"), "a")
+
+    # -- timing brackets (reference API) ------------------------------------
+    def start(self, category: str = "calc") -> None:
+        self._t0[category] = time.perf_counter()
+
+    def end(self, category: str = "calc", sync=None) -> float:
+        """Close a bracket. Pass a ``jax.Array`` (e.g. the loss) as
+        ``sync`` to block until the device work really finished —
+        without it the bracket only measures dispatch."""
+        if sync is not None:
+            try:
+                sync.block_until_ready()
+            except AttributeError:
+                pass
+        dt = time.perf_counter() - self._t0.pop(category)
+        self.timings[category].append(dt)
+        return dt
+
+    # -- metric accumulation -------------------------------------------------
+    def train_metrics(self, step: int, metrics: dict, n_images: int = 0) -> None:
+        rec = {k: float(v) for k, v in metrics.items()}
+        rec["step"] = int(step)
+        if n_images and self.timings.get("step"):
+            rec["images_per_sec"] = n_images / self.timings["step"][-1]
+        self.history["train"].append(rec)
+        self._emit("train", rec)
+        if self.print_freq and len(self.history["train"]) % self.print_freq == 0:
+            self._print_train(rec)
+
+    def val_metrics(self, epoch: int, metrics: dict) -> None:
+        rec = {k: float(v) for k, v in metrics.items()}
+        rec["epoch"] = int(epoch)
+        self.history["val"].append(rec)
+        self._emit("val", rec)
+        loss = rec.get("loss", float("nan"))
+        err = rec.get("error", float("nan"))
+        top5 = rec.get("top5_error")
+        msg = f"[rank {self.rank}] epoch {epoch} val: loss={loss:.4f} err={err:.4f}"
+        if top5 is not None:
+            msg += f" top5_err={top5:.4f}"
+        print(msg, flush=True)
+
+    # -- epoch accounting ----------------------------------------------------
+    def start_epoch(self) -> None:
+        self.epoch_start = time.perf_counter()
+
+    def end_epoch(self, epoch: int, n_images: int = 0) -> float:
+        dt = time.perf_counter() - (self.epoch_start or time.perf_counter())
+        rec = {"epoch": int(epoch), "seconds": dt}
+        if n_images:
+            rec["images_per_sec"] = n_images / dt
+        self.history["epoch"].append(rec)
+        self._emit("epoch", rec)
+        print(
+            f"[rank {self.rank}] epoch {epoch} done in {dt:.1f}s"
+            + (f" ({rec['images_per_sec']:.0f} img/s)" if n_images else ""),
+            flush=True,
+        )
+        return dt
+
+    # -- summaries -----------------------------------------------------------
+    def mean_time(self, category: str, last_n: Optional[int] = None) -> float:
+        ts = self.timings.get(category, [])
+        if not ts:
+            return 0.0
+        return float(np.mean(ts[-last_n:] if last_n else ts))
+
+    def _print_train(self, rec: dict) -> None:
+        parts = [f"step {rec['step']}"]
+        for k in ("loss", "error", "lr"):
+            if k in rec:
+                parts.append(f"{k}={rec[k]:.4f}")
+        for cat in ("wait", "step"):
+            if self.timings.get(cat):
+                parts.append(f"{cat}={1000*self.mean_time(cat, self.print_freq):.1f}ms")
+        if "images_per_sec" in rec:
+            parts.append(f"{rec['images_per_sec']:.0f} img/s")
+        print(f"[rank {self.rank}] " + " ".join(parts), flush=True)
+
+    def _emit(self, kind: str, rec: dict) -> None:
+        if self._jsonl:
+            self._jsonl.write(json.dumps({"kind": kind, **rec}) + "\n")
+            self._jsonl.flush()
+
+    def save(self, path: Optional[str] = None) -> None:
+        """Pickle the full history (reference: ``Recorder.save`` pickled
+        its lists for offline plotting)."""
+        if path is None:
+            if not self.save_dir:
+                return
+            path = os.path.join(self.save_dir, f"{self.run_name}_history.pkl")
+        with open(path, "wb") as f:
+            pickle.dump(
+                {"history": dict(self.history), "timings": dict(self.timings)}, f
+            )
+
+    @staticmethod
+    def load_history(path: str) -> dict:
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+    def close(self) -> None:
+        if self._jsonl:
+            self._jsonl.close()
+            self._jsonl = None
