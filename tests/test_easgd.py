@@ -1,0 +1,114 @@
+"""EASGD tests: algebra vs sequential simulation + training behavior
+(SURVEY.md §4 item (b): EASGD algebra vs sequential simulation)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from theanompi_tpu.data import get_dataset
+from theanompi_tpu.models.model_zoo.wrn import WRN_16_4
+from theanompi_tpu.parallel.easgd import EASGDEngine
+from theanompi_tpu.parallel.mesh import put_global_batch
+
+
+def _model(batch=64):
+    recipe = WRN_16_4.default_recipe().replace(
+        batch_size=batch,
+        dataset="synthetic",
+        input_shape=(16, 16, 3),
+        sched_kwargs={"lr": 0.05, "boundaries": [10**9]},
+    )
+    return WRN_16_4(recipe)
+
+
+def _batch(model, n=64):
+    data = get_dataset("synthetic", n_train=n, n_val=n, image_shape=model.recipe.input_shape)
+    x, y = next(data.train_epoch(0, n))
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def test_easgd_local_steps_keep_workers_distinct(mesh8):
+    """Between exchanges, workers see different shards and must diverge —
+    the reference's workers trained independently between swaps."""
+    model = _model()
+    x, y = _batch(model)
+    eng = EASGDEngine(model, mesh8, avg_freq=4)
+    state = eng.init_state(jax.random.PRNGKey(0))
+    state, m = eng.train_step(state, put_global_batch(mesh8, x), put_global_batch(mesh8, y), jax.random.PRNGKey(1))
+    w = jax.device_get(jax.tree_util.tree_leaves(state.workers.params)[0])
+    assert w.shape[0] == 8
+    # workers differ pairwise after one local step
+    assert not np.allclose(w[0], w[1])
+    # center untouched by local steps
+    c0 = jax.tree_util.tree_leaves(eng.init_state(jax.random.PRNGKey(0)).center_params)[0]
+    c1 = jax.tree_util.tree_leaves(state.center_params)[0]
+    np.testing.assert_array_equal(np.asarray(c0), np.asarray(c1))
+
+
+def test_easgd_exchange_matches_sequential_algebra(mesh8):
+    """Exchange == synchronous-EASGD update computed in numpy:
+    w_i -= a(w_i - c);  c += a * sum_i(w_i - c)."""
+    model = _model()
+    x, y = _batch(model)
+    eng = EASGDEngine(model, mesh8, avg_freq=1, alpha=0.05)
+    state = eng.init_state(jax.random.PRNGKey(0))
+    state, _ = eng.train_step(state, put_global_batch(mesh8, x), put_global_batch(mesh8, y), jax.random.PRNGKey(1))
+
+    w_before = [np.asarray(l) for l in jax.device_get(jax.tree_util.tree_leaves(state.workers.params))]
+    c_before = [np.asarray(l) for l in jax.device_get(jax.tree_util.tree_leaves(state.center_params))]
+
+    state2 = eng.exchange(state)
+    w_after = [np.asarray(l) for l in jax.device_get(jax.tree_util.tree_leaves(state2.workers.params))]
+    c_after = [np.asarray(l) for l in jax.device_get(jax.tree_util.tree_leaves(state2.center_params))]
+
+    a = 0.05
+    for wb, cb, wa, ca in zip(w_before, c_before, w_after, c_after):
+        diff = a * (wb - cb[None])  # (8, ...)
+        np.testing.assert_allclose(wa, wb - diff, rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(ca, cb + diff.sum(axis=0), rtol=1e-4, atol=1e-6)
+
+
+def test_easgd_trains_and_center_tracks_workers(mesh8):
+    model = _model()
+    data = get_dataset("synthetic", n_train=128, n_val=64, image_shape=(16, 16, 3))
+    eng = EASGDEngine(model, mesh8, avg_freq=2)
+    state = eng.init_state(jax.random.PRNGKey(0))
+    losses = []
+    step = 0
+    for epoch in range(6):
+        for x, y in data.train_epoch(epoch, 64):
+            xg, yg = put_global_batch(mesh8, jnp.asarray(x)), put_global_batch(mesh8, jnp.asarray(y))
+            state, m = eng.train_step(state, xg, yg, jax.random.PRNGKey(step))
+            step += 1
+            if step % 2 == 0:
+                state = eng.exchange(state)
+            losses.append(float(m["loss"]))
+    assert losses[-1] < 0.7 * losses[0], (losses[0], losses[-1])
+    # center must have moved toward workers
+    vx, vy = next(data.val_epoch(64))
+    vm = eng.eval_step(state, put_global_batch(mesh8, jnp.asarray(vx)), put_global_batch(mesh8, jnp.asarray(vy)))
+    assert np.isfinite(float(vm["loss"]))
+    assert eng.get_step(state) == step
+
+
+def test_easgd_via_run_training(tmp_path):
+    from theanompi_tpu.launch.worker import run_training
+
+    summary = run_training(
+        rule="easgd",
+        model_cls=WRN_16_4,
+        devices=8,
+        n_epochs=2,
+        avg_freq=2,
+        dataset="synthetic",
+        dataset_kwargs={"n_train": 64, "n_val": 32, "image_shape": (16, 16, 3)},
+        recipe_overrides={
+            "batch_size": 32,
+            "input_shape": (16, 16, 3),
+            "sched_kwargs": {"lr": 0.05, "boundaries": [10**9]},
+        },
+        print_freq=0,
+        ckpt_dir=str(tmp_path / "c"),
+    )
+    assert summary["steps"] == 4
+    assert "val" in summary

@@ -27,9 +27,8 @@ import numpy as np
 from theanompi_tpu.data import get_dataset
 from theanompi_tpu.data.loader import PrefetchLoader
 from theanompi_tpu.models.contract import Model
-from theanompi_tpu.parallel import make_bsp_eval_step, make_bsp_train_step, make_mesh
+from theanompi_tpu.parallel import make_mesh
 from theanompi_tpu.parallel.mesh import put_global_batch
-from theanompi_tpu.train import TrainState, init_train_state
 from theanompi_tpu.utils import (
     Recorder,
     latest_checkpoint,
@@ -92,42 +91,28 @@ def run_training(
 
     rule = rule.lower()
     if rule == "bsp":
-        train_step = make_bsp_train_step(
+        from theanompi_tpu.parallel.bsp import BSPEngine
+
+        if rule_kwargs:
+            raise ValueError(
+                f"rule 'bsp' got unexpected options {sorted(rule_kwargs)} "
+                "(avg_freq/alpha/p_push apply to EASGD/GoSGD only)"
+            )
+        engine = BSPEngine(
             model, mesh, steps_per_epoch=steps_per_epoch, strategy=strategy
         )
-        eval_step = make_bsp_eval_step(model, mesh)
     elif rule == "easgd":
-        from theanompi_tpu.parallel.easgd import make_easgd_driver
+        from theanompi_tpu.parallel.easgd import EASGDEngine
 
-        return make_easgd_driver(
-            model=model,
-            data=data,
-            mesh=mesh,
-            n_epochs=n_epochs,
-            max_steps=max_steps,
-            seed=seed,
-            save_dir=save_dir,
-            ckpt_dir=ckpt_dir,
-            resume=resume,
-            print_freq=print_freq,
-            **rule_kwargs,
-        )
+        if strategy != "psum":
+            raise ValueError("strategy applies to the BSP rule only")
+        engine = EASGDEngine(model, mesh, steps_per_epoch=steps_per_epoch, **rule_kwargs)
     elif rule == "gosgd":
-        from theanompi_tpu.parallel.gosgd import make_gosgd_driver
+        from theanompi_tpu.parallel.gosgd import GOSGDEngine
 
-        return make_gosgd_driver(
-            model=model,
-            data=data,
-            mesh=mesh,
-            n_epochs=n_epochs,
-            max_steps=max_steps,
-            seed=seed,
-            save_dir=save_dir,
-            ckpt_dir=ckpt_dir,
-            resume=resume,
-            print_freq=print_freq,
-            **rule_kwargs,
-        )
+        if strategy != "psum":
+            raise ValueError("strategy applies to the BSP rule only")
+        engine = GOSGDEngine(model, mesh, steps_per_epoch=steps_per_epoch, **rule_kwargs)
     else:
         raise ValueError(f"unknown rule {rule!r}; available: bsp, easgd, gosgd")
 
@@ -136,18 +121,17 @@ def run_training(
         run_name=f"{model.name}_{rule}",
     )
     rng = jax.random.PRNGKey(seed)
-    state = init_train_state(model, rng)
+    state = engine.init_state(rng)
     start_epoch = 0
     if resume and ckpt_dir:
         path = latest_checkpoint(ckpt_dir)
         if path:
             restored, saved_rng = load_checkpoint(path, state)
             state = jax.tree_util.tree_map(jnp.asarray, restored)
-            state = TrainState(*state)
             if saved_rng is not None:
                 rng = jnp.asarray(saved_rng)
-            start_epoch = int(state.step) // steps_per_epoch
-            print(f"resumed from {path} at step {int(state.step)}", flush=True)
+            start_epoch = engine.get_step(state) // steps_per_epoch
+            print(f"resumed from {path} at step {engine.get_step(state)}", flush=True)
 
     def place(b):
         x, y = b
@@ -157,7 +141,7 @@ def run_training(
         )
 
     summary: dict = {"epochs": [], "rule": rule, "model": model.name}
-    step_count = int(state.step)
+    step_count = engine.get_step(state)
     # Mid-epoch resume (checkpoint written after a max_steps truncation):
     # fast-forward past the batches the restored step count already
     # consumed, so data order and epoch accounting stay exact.
@@ -176,10 +160,16 @@ def run_training(
             rec.end("wait")
             rng, sub = jax.random.split(rng)
             rec.start("step")
-            state, metrics = train_step(state, xg, yg, sub)
+            state, metrics = engine.train_step(state, xg, yg, sub)
             rec.end("step", sync=metrics["loss"])
             step_count += 1
             epoch_steps += 1
+            # periodic exchange (EASGD avg_freq; reference: worker loop
+            # calling exchanger.exchange() — recorded as 'comm')
+            if engine.exchange_every and step_count % engine.exchange_every == 0:
+                rec.start("comm")
+                state = engine.exchange(state)
+                rec.end("comm")
             rec.train_metrics(step_count, metrics, n_images=batch)
             rec.start("wait")
             if max_steps and step_count >= max_steps:
@@ -192,7 +182,7 @@ def run_training(
         val_accum: dict[str, float] = {}
         n_val = 0
         for vx, vy in data.val_epoch(vbatch):
-            vm = eval_step(state, *place((vx, vy)))
+            vm = engine.eval_step(state, *place((vx, vy)))
             for k, v in vm.items():
                 val_accum[k] = val_accum.get(k, 0.0) + float(v)
             n_val += 1

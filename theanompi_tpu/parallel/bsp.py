@@ -25,7 +25,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from theanompi_tpu.models.contract import Model
 from theanompi_tpu.parallel.mesh import DATA_AXIS
 from theanompi_tpu.parallel.strategies import get_strategy
-from theanompi_tpu.train import TrainState, make_eval_step, make_train_step
+from theanompi_tpu.train import TrainState, init_train_state, make_eval_step, make_train_step
 
 
 def make_bsp_train_step(
@@ -71,6 +71,45 @@ def make_bsp_train_step(
         check_vma=False,
     )
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+class BSPEngine:
+    """Rule-engine wrapper over the BSP step (uniform driver protocol
+    shared with EASGDEngine/GOSGDEngine)."""
+
+    name = "bsp"
+    exchange_every = 0  # the allreduce is inside every step
+
+    def __init__(
+        self,
+        model: Model,
+        mesh: Mesh,
+        steps_per_epoch: int = 1,
+        strategy: str = "psum",
+        axis_name: str = DATA_AXIS,
+    ):
+        self.model = model
+        self.mesh = mesh
+        self._step = make_bsp_train_step(
+            model, mesh, steps_per_epoch=steps_per_epoch, strategy=strategy,
+            axis_name=axis_name,
+        )
+        self._eval = make_bsp_eval_step(model, mesh, axis_name=axis_name)
+
+    def init_state(self, rng):
+        return init_train_state(self.model, rng)
+
+    def train_step(self, state, images, labels, rng):
+        return self._step(state, images, labels, rng)
+
+    def exchange(self, state):
+        return state
+
+    def eval_step(self, state, images, labels):
+        return self._eval(state, images, labels)
+
+    def get_step(self, state) -> int:
+        return int(jax.device_get(state.step))
 
 
 def make_bsp_eval_step(model: Model, mesh: Mesh, axis_name: str = DATA_AXIS):

@@ -84,3 +84,13 @@ def host_local_batch_slice(mesh: Mesh, global_batch: int) -> slice:
 def put_global_batch(mesh: Mesh, x, axis: str = DATA_AXIS):
     """Place a host batch onto the mesh sharded along the data axis."""
     return jax.device_put(x, batch_sharding(mesh, axis))
+
+
+def stack_replicas(tree, n: int):
+    """Broadcast a pytree to ``n`` stacked replicas on a new leading axis
+    (per-worker state for the EASGD/GoSGD rules)."""
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda a: jnp.broadcast_to(a[None], (n, *a.shape)), tree
+    )
