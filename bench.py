@@ -1,0 +1,92 @@
+"""bench.py — headline benchmark, run on real TPU hardware by the driver.
+
+Metric (BASELINE.json): AlexNet ImageNet images/sec. The authoritative
+reference target is "match 8xP100 BSP wall-clock on ImageNet AlexNet";
+8xP100 AlexNet BSP throughput is ~8000 img/s (fp32 cuDNN era, near-linear
+scaling per the paper), so vs_baseline = img/s / 8000 with the
+chips we have (one v5e here; the 8-chip pod target divides per-chip).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+BASELINE_IMG_S = 8000.0  # 8xP100 AlexNet BSP (BASELINE.md authoritative target)
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from theanompi_tpu.models.alex_net import AlexNet
+    from theanompi_tpu.parallel import make_mesh
+    from theanompi_tpu.parallel.mesh import put_global_batch
+
+    from theanompi_tpu.train import make_multi_step, make_train_step, init_train_state
+    from theanompi_tpu.parallel.strategies import get_strategy
+
+    n_dev = len(jax.devices())
+    # reference recipe: batch 128/worker (SURVEY.md §2.1 AlexNet)
+    batch = 128 * n_dev
+    model = AlexNet(AlexNet.default_recipe().replace(batch_size=batch))
+    mesh = make_mesh(n_dev)
+    steps = 20
+
+    # the full BSP train step (fwd+bwd+sync+update), k steps fused into
+    # one program so host dispatch latency doesn't pollute the measurement
+    if n_dev == 1:
+        runner = jax.jit(make_multi_step(make_train_step(model), steps))
+    else:
+        from jax.sharding import PartitionSpec as P
+
+        base = make_train_step(model, grad_sync=get_strategy("psum", "data", n_dev))
+        runner = jax.jit(
+            jax.shard_map(
+                make_multi_step(base, steps),
+                mesh=mesh,
+                in_specs=(P(), P("data"), P("data"), P()),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )
+        )
+
+    state = init_train_state(model, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    x = put_global_batch(
+        mesh, jnp.asarray(rng.randn(batch, 227, 227, 3), jnp.float32)
+    )
+    y = put_global_batch(mesh, jnp.asarray(rng.randint(0, 1000, batch), jnp.int32))
+
+    # warmup / compile
+    state, metrics = runner(state, x, y, jax.random.PRNGKey(1))
+    jax.block_until_ready(metrics["loss"])
+
+    best = None
+    for trial in range(3):
+        t0 = time.perf_counter()
+        state, metrics = runner(state, x, y, jax.random.PRNGKey(2 + trial))
+        jax.block_until_ready(metrics["loss"])
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+
+    img_s = steps * batch / best
+    print(
+        json.dumps(
+            {
+                "metric": f"alexnet_imagenet_bsp_images_per_sec_{n_dev}chip",
+                "value": round(img_s, 1),
+                "unit": "images/sec",
+                "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

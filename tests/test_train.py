@@ -113,3 +113,42 @@ def test_cifar_augment_vectorized_oracle():
         if flips[i]:
             img = img[:, ::-1]
         np.testing.assert_array_equal(got[i], img)
+
+
+def test_make_multi_step_matches_sequential():
+    """k scanned steps == k sequential steps (same rng folding)."""
+    from theanompi_tpu.train import make_multi_step, make_train_step
+
+    model = _small(Cifar10_model, sched_kwargs={"lr": 0.05, "boundaries": [10**9]})
+    data = get_dataset("synthetic", n_train=32, n_val=32)
+    x, y = next(data.train_epoch(0, 32))
+    x, y = jnp.asarray(x), jnp.asarray(y)
+    step = make_train_step(model)
+    rng = jax.random.PRNGKey(9)
+
+    s_seq = init_train_state(model, jax.random.PRNGKey(0))
+    for i in range(4):
+        s_seq, m_seq = step(s_seq, x, y, jax.random.fold_in(rng, i))
+
+    runner = jax.jit(make_multi_step(step, 4))
+    s_scan, metrics = runner(init_train_state(model, jax.random.PRNGKey(0)), x, y, rng)
+    assert metrics["loss"].shape == (4,)
+    # tolerances: the fused scan program and the per-step program compile
+    # separately, so fp reassociation differences compound over 4 steps
+    np.testing.assert_allclose(float(metrics["loss"][-1]), float(m_seq["loss"]), rtol=1e-3)
+    for a, b in zip(jax.tree_util.tree_leaves(s_scan.params), jax.tree_util.tree_leaves(s_seq.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-2, atol=2e-4)
+
+
+def test_make_multi_step_stacked_batches():
+    from theanompi_tpu.train import make_multi_step, make_train_step
+
+    model = _small(Cifar10_model)
+    data = get_dataset("synthetic", n_train=64, n_val=32)
+    batches = list(data.train_epoch(0, 32))
+    xs = jnp.stack([jnp.asarray(b[0]) for b in batches])
+    ys = jnp.stack([jnp.asarray(b[1]) for b in batches])
+    runner = jax.jit(make_multi_step(make_train_step(model), 2, stacked=True))
+    state, metrics = runner(init_train_state(model, jax.random.PRNGKey(0)), xs, ys, jax.random.PRNGKey(1))
+    assert int(state.step) == 2
+    assert metrics["loss"].shape == (2,)

@@ -1,0 +1,155 @@
+"""ImageNet model-zoo tests: parity param counts, smoke steps, and the
+mmap shard pipeline (SURVEY.md §4 item (c))."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from theanompi_tpu.data import get_dataset
+from theanompi_tpu.data.imagenet import ImageNet_data, write_shards
+from theanompi_tpu.models import get_model
+from theanompi_tpu.models.alex_net import AlexNet
+from theanompi_tpu.models.googlenet import GoogLeNet
+from theanompi_tpu.models.model_zoo.resnet50 import ResNet50
+from theanompi_tpu.models.model_zoo.vgg import VGG16
+from theanompi_tpu.train import init_train_state, make_train_step
+
+
+def _count(params):
+    return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params))
+
+
+# -- parity: parameter counts at the canonical input sizes ------------------
+
+
+@pytest.mark.parametrize(
+    "name,expected_m,tol",
+    [
+        ("alexnet", 60.97, 0.1),   # Krizhevsky 2012: ~61M
+        ("vgg16", 138.36, 0.1),    # Simonyan 2014 config D: ~138M
+        ("resnet50", 25.56, 0.1),  # He 2015: ~25.5M
+        ("wrn", 36.48, 0.2),       # WRN-28-10: ~36.5M
+    ],
+)
+def test_param_counts_match_papers(name, expected_m, tol):
+    model_cls = get_model(name)
+    model = model_cls()
+    params, _ = model.init(jax.random.PRNGKey(0))
+    count_m = _count(params) / 1e6
+    assert abs(count_m - expected_m) < tol, f"{name}: {count_m:.2f}M vs {expected_m}M"
+
+
+def test_googlenet_param_count():
+    """GoogLeNet: ~7M in the main network (aux heads add ~6M, train-only)."""
+    model = GoogLeNet()
+    params, _ = model.init(jax.random.PRNGKey(0))
+    main = {k: v for k, v in params.items() if not k.startswith("aux")}
+    assert abs(_count(main) / 1e6 - 6.99) < 0.15
+    assert _count(params) / 1e6 > 9  # aux heads present
+
+
+# -- smoke: one train step at reduced input sizes ---------------------------
+
+
+def _smoke(model_cls, input_shape, batch=8, num_classes=10):
+    recipe = model_cls.default_recipe().replace(
+        batch_size=batch,
+        input_shape=input_shape,
+        num_classes=num_classes,
+        compute_dtype=jnp.float32,
+        sched_kwargs={"lr": 0.01, "boundaries": [10**9]}
+        if "boundaries" in model_cls.default_recipe().sched_kwargs
+        else model_cls.default_recipe().sched_kwargs,
+    )
+    model = model_cls(recipe)
+    state = init_train_state(model, jax.random.PRNGKey(0))
+    step = jax.jit(make_train_step(model))
+    x = jnp.asarray(np.random.RandomState(0).randn(batch, *input_shape), jnp.float32)
+    y = jnp.asarray(np.random.RandomState(1).randint(0, num_classes, batch))
+    state, metrics = step(state, x, y, jax.random.PRNGKey(1))
+    assert np.isfinite(float(metrics["loss"]))
+    state, m2 = step(state, x, y, jax.random.PRNGKey(2))
+    assert np.isfinite(float(m2["loss"]))
+    return model
+
+
+def test_alexnet_smoke_step():
+    _smoke(AlexNet, (67, 67, 3))
+
+
+def test_googlenet_smoke_step_with_aux():
+    model = _smoke(GoogLeNet, (128, 128, 3))
+    # eval path returns plain logits; train path returned aux tuple
+    state = init_train_state(model, jax.random.PRNGKey(0))
+    x = jnp.zeros((8, 128, 128, 3))
+    logits, _ = model.apply(state.params, state.model_state, x, train=False)
+    assert logits.shape == (8, 10)
+    out, _ = model.apply(
+        state.params, state.model_state, x, train=True, rng=jax.random.PRNGKey(0)
+    )
+    assert isinstance(out, tuple) and len(out) == 3
+
+
+def test_vgg16_smoke_step():
+    _smoke(VGG16, (64, 64, 3))
+
+
+def test_resnet50_smoke_step():
+    _smoke(ResNet50, (64, 64, 3))
+
+
+# -- imagenet shard pipeline ------------------------------------------------
+
+
+def _fake_shards(tmp_path, n_train=64, n_val=32, size=32):
+    r = np.random.RandomState(0)
+    write_shards(
+        str(tmp_path), "train",
+        r.randint(0, 256, (n_train, size, size, 3), dtype=np.uint8),
+        r.randint(0, 10, n_train), shard_size=32,
+    )
+    write_shards(
+        str(tmp_path), "val",
+        r.randint(0, 256, (n_val, size, size, 3), dtype=np.uint8),
+        r.randint(0, 10, n_val), shard_size=32,
+    )
+
+
+def test_imagenet_shard_pipeline(tmp_path):
+    _fake_shards(tmp_path)
+    data = ImageNet_data(root=str(tmp_path), crop=24)
+    assert data.n_train == 64 and data.n_val == 32
+    assert data.n_train_batches(16) == 4
+
+    batches = list(data.train_epoch(0, 16))
+    assert len(batches) == 4
+    x, y = batches[0]
+    assert x.shape == (16, 24, 24, 3) and x.dtype == np.float32
+    assert y.shape == (16,) and y.dtype == np.int32
+    assert abs(float(x.mean())) < 1.0  # mean-normalized
+
+    # deterministic given (seed, epoch); different across epochs
+    x2, y2 = next(data.train_epoch(0, 16))
+    np.testing.assert_array_equal(x, x2)
+    x3, _ = next(data.train_epoch(1, 16))
+    assert not np.array_equal(x, x3)
+
+    # val: deterministic center crop
+    vx, vy = next(data.val_epoch(16))
+    vx2, _ = next(data.val_epoch(16))
+    np.testing.assert_array_equal(vx, vx2)
+
+
+def test_imagenet_missing_dir_message(tmp_path, monkeypatch):
+    monkeypatch.delenv("IMAGENET_DIR", raising=False)
+    with pytest.raises(FileNotFoundError, match="imagenet_synthetic"):
+        ImageNet_data(root=str(tmp_path / "nope"))
+
+
+def test_imagenet_synthetic_registered():
+    data = get_dataset("imagenet_synthetic", n_train=32, n_val=16, crop=32, n_classes=10)
+    x, y = next(data.train_epoch(0, 16))
+    assert x.shape == (16, 32, 32, 3) and x.dtype == np.float32
+    vx, _ = next(data.val_epoch(16))
+    assert vx.dtype == np.float32

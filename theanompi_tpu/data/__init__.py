@@ -10,3 +10,4 @@ does the same without process gymnastics).
 """
 
 from theanompi_tpu.data.datasets import Dataset, get_dataset  # noqa: F401
+from theanompi_tpu.data import imagenet as _imagenet  # noqa: F401  (registers datasets)

@@ -1,0 +1,211 @@
+"""ImageNet pipeline: memory-mapped preprocessed shards.
+
+Reference: ``models/data/imagenet.py`` — ``ImageNet_data`` over
+preprocessed hickle ``.hkl`` file-batches (256x256 uint8) with
+``img_mean`` subtraction and random 227-crop + mirror done in the
+spawned loader (``lib/proc_load_mpi.py``; SURVEY.md §2.1, §3.4). The
+TPU-native equivalent replaces HDF5 file-batches with plain ``.npy``
+shards opened via ``np.load(mmap_mode='r')`` — zero-copy reads, no
+codec dependency, trivially producible from any source:
+
+    $IMAGENET_DIR/
+      train_images_0000.npy   uint8 [N, S, S, 3]   (S >= crop size, e.g. 256)
+      train_labels_0000.npy   int   [N]
+      ...more shards...
+      val_images_0000.npy / val_labels_0000.npy
+      mean.npy                float [S, S, 3] or [3]   (optional)
+
+Shuffling follows the reference's file-batch scheme: shard order and
+intra-shard order are permuted per epoch (seeded, same on every host);
+batches never span shards, keeping reads sequential per shard.
+
+``Imagenet_synthetic`` generates shape-identical fake data in memory —
+the benchmarking/CI stand-in when no ImageNet is on disk.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from typing import Iterator, Optional
+
+import numpy as np
+
+from theanompi_tpu.data.datasets import Dataset, register_dataset
+
+
+def write_shards(
+    directory: str,
+    split: str,
+    images: np.ndarray,
+    labels: np.ndarray,
+    shard_size: int = 1024,
+) -> int:
+    """Write uint8 images/labels as the shard format above (used by tests
+    and by any user conversion script). Returns the number of shards."""
+    os.makedirs(directory, exist_ok=True)
+    n = len(images)
+    n_shards = -(-n // shard_size)
+    for i in range(n_shards):
+        sl = slice(i * shard_size, (i + 1) * shard_size)
+        np.save(os.path.join(directory, f"{split}_images_{i:04d}.npy"), images[sl])
+        np.save(os.path.join(directory, f"{split}_labels_{i:04d}.npy"), labels[sl])
+    return n_shards
+
+
+class ImageNet_data(Dataset):
+    """ImageNet-1k from preprocessed mmap shards."""
+
+    name = "imagenet"
+    n_classes = 1000
+
+    SEARCH = ("/root/data/imagenet", "/data/imagenet")
+
+    def __init__(
+        self,
+        root: Optional[str] = None,
+        crop: int = 227,
+        train_mirror: bool = True,
+    ):
+        base = self._find(root)
+        self.crop = crop
+        self.image_shape = (crop, crop, 3)
+        self._train = self._index(base, "train")
+        self._val = self._index(base, "val")
+        if not self._train:
+            raise FileNotFoundError(f"no train_images_*.npy shards under {base}")
+        mean_path = os.path.join(base, "mean.npy")
+        # reference: per-pixel img_mean subtracted in the loader
+        self.mean = (
+            np.load(mean_path).astype(np.float32)
+            if os.path.exists(mean_path)
+            else np.float32(127.5)
+        )
+        self.scale = np.float32(1.0 / 58.0)  # ~global pixel std
+
+    @classmethod
+    def _find(cls, root: Optional[str]) -> str:
+        env = os.environ.get("IMAGENET_DIR", "")
+        for c in ([root] if root else [p for p in (env, *cls.SEARCH) if p]):
+            if c and glob.glob(os.path.join(c, "train_images_*.npy")):
+                return c
+        raise FileNotFoundError(
+            "ImageNet shards not found; set $IMAGENET_DIR to a directory of "
+            "train/val_images_*.npy shards (see module docstring for the "
+            "format; use dataset='imagenet_synthetic' for benchmarks without data)"
+        )
+
+    @staticmethod
+    def _index(base: str, split: str) -> list[tuple[str, str, int]]:
+        shards = []
+        for img_path in sorted(glob.glob(os.path.join(base, f"{split}_images_*.npy"))):
+            lbl_path = img_path.replace("_images_", "_labels_")
+            n = len(np.load(lbl_path, mmap_mode="r"))
+            shards.append((img_path, lbl_path, n))
+        return shards
+
+    # -- Dataset interface over shards --------------------------------------
+    @property
+    def n_train(self) -> int:
+        return sum(n for _, _, n in self._train)
+
+    @property
+    def n_val(self) -> int:
+        return sum(n for _, _, n in self._val)
+
+    def n_train_batches(self, batch_size: int) -> int:
+        return sum(n // batch_size for _, _, n in self._train)
+
+    def n_val_batches(self, batch_size: int) -> int:
+        return sum(n // batch_size for _, _, n in self._val)
+
+    def train_epoch(self, epoch: int, batch_size: int, seed: int = 0) -> Iterator:
+        rng = np.random.RandomState(seed * 100003 + epoch)
+        order = rng.permutation(len(self._train))
+        for si in order:
+            img_path, lbl_path, n = self._train[si]
+            images = np.load(img_path, mmap_mode="r")
+            labels = np.load(lbl_path)
+            perm = rng.permutation(n)
+            for b in range(n // batch_size):
+                idx = np.sort(perm[b * batch_size : (b + 1) * batch_size])
+                x = np.asarray(images[idx])  # mmap gather
+                y = labels[idx].astype(np.int32)
+                yield self._preprocess(x, rng, train=True), y
+
+    def val_epoch(self, batch_size: int) -> Iterator:
+        for img_path, lbl_path, n in self._val:
+            images = np.load(img_path, mmap_mode="r")
+            labels = np.load(lbl_path)
+            for b in range(n // batch_size):
+                sl = slice(b * batch_size, (b + 1) * batch_size)
+                x = np.asarray(images[sl])
+                yield self._preprocess(x, None, train=False), labels[sl].astype(np.int32)
+
+    def _preprocess(
+        self, x: np.ndarray, rng: Optional[np.random.RandomState], train: bool
+    ) -> np.ndarray:
+        """Random crop + mirror + mean/scale (reference:
+        ``proc_load_mpi`` crop/mirror funcs). Val: center crop."""
+        n, h, w, _ = x.shape
+        c = self.crop
+        if train:
+            offs = rng.randint(0, (h - c + 1) * (w - c + 1), size=n)
+            oy, ox = offs // (w - c + 1), offs % (w - c + 1)
+            flips = rng.rand(n) < 0.5
+        else:
+            oy = np.full(n, (h - c) // 2)
+            ox = np.full(n, (w - c) // 2)
+            flips = np.zeros(n, bool)
+        rows = oy[:, None] + np.arange(c)
+        cols = ox[:, None] + np.arange(c)
+        cols = np.where(flips[:, None], cols[:, ::-1], cols)
+        out = x[np.arange(n)[:, None, None], rows[:, :, None], cols[:, None, :]]
+        out = out.astype(np.float32)
+        if np.ndim(self.mean) == 3 and self.mean.shape[0] != c:
+            m = self.mean[
+                (self.mean.shape[0] - c) // 2 : (self.mean.shape[0] - c) // 2 + c,
+                (self.mean.shape[1] - c) // 2 : (self.mean.shape[1] - c) // 2 + c,
+            ]
+        else:
+            m = self.mean
+        return (out - m) * self.scale
+
+
+class Imagenet_synthetic(Dataset):
+    """Shape-correct fake ImageNet for benchmarks/CI (no disk, seeded)."""
+
+    name = "imagenet_synthetic"
+
+    def __init__(
+        self,
+        n_train: int = 2048,
+        n_val: int = 256,
+        crop: int = 227,
+        n_classes: int = 1000,
+        seed: int = 0,
+    ):
+        self.image_shape = (crop, crop, 3)
+        self.n_classes = n_classes
+        rng = np.random.RandomState(seed)
+
+        def make(n, salt):
+            r = np.random.RandomState(seed + salt)
+            y = r.randint(0, n_classes, size=n).astype(np.int32)
+            x = r.randint(0, 256, size=(n, *self.image_shape)).astype(np.uint8)
+            return x, y
+
+        self.x_train, self.y_train = make(n_train, 1)
+        self.x_val, self.y_val = make(n_val, 2)
+
+    def augment(self, x: np.ndarray, rng: np.random.RandomState) -> np.ndarray:
+        return (x.astype(np.float32) - 127.5) / 58.0
+
+    def val_epoch(self, batch_size: int):
+        for x, y in super().val_epoch(batch_size):
+            yield (x.astype(np.float32) - 127.5) / 58.0, y
+
+
+register_dataset("imagenet", ImageNet_data)
+register_dataset("imagenet_synthetic", Imagenet_synthetic)

@@ -1,0 +1,125 @@
+"""ResNet-50 — BASELINE config #4 (EASGD, 1 center + 16 workers) and the
+second headline benchmark model (images/sec + 90% scaling efficiency).
+
+Reference: ``models/lasagne_model_zoo/resnet50.py`` — ``ResNet50`` with
+residual-block builders (SURVEY.md §2.1). He et al. 2015 architecture:
+7x7/2 stem, four stages of bottleneck blocks [3,4,6,3] at widths
+256/512/1024/2048, post-activation BN, projection shortcuts on stage
+entry. Stride placement follows the v1.5 convention (stride on the 3x3)
+— the variant every modern throughput baseline quotes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from theanompi_tpu import nn
+from theanompi_tpu.models.contract import Model, Recipe
+from theanompi_tpu.nn import init as initializers
+from theanompi_tpu.nn.layers import Layer
+
+_he = initializers.he_normal()
+
+
+class Bottleneck(Layer):
+    """1x1 -> 3x3(stride) -> 1x1 with BN after each conv; relu after the
+    residual add (post-activation v1 form, as the lasagne zoo built it)."""
+
+    def __init__(self, in_c, width, out_c, stride=1, bn_axis=None, name="bneck"):
+        self.name = name
+        self.needs_proj = stride != 1 or in_c != out_c
+        mk = lambda c, k, s, nm: nn.Conv(c, k, stride=s, padding="SAME", use_bias=False, w_init=_he, name=nm)
+        self.conv1, self.bn1 = mk(width, 1, 1, "c1"), nn.BatchNorm(axis_name=bn_axis)
+        self.conv2, self.bn2 = mk(width, 3, stride, "c2"), nn.BatchNorm(axis_name=bn_axis)
+        self.conv3, self.bn3 = mk(out_c, 1, 1, "c3"), nn.BatchNorm(axis_name=bn_axis)
+        if self.needs_proj:
+            self.proj, self.bnp = mk(out_c, 1, stride, "proj"), nn.BatchNorm(axis_name=bn_axis)
+
+    def init(self, key, in_shape):
+        keys = jax.random.split(key, 4)
+        params, state = {}, {}
+        shape = in_shape
+        for i, (conv, bn) in enumerate(
+            [(self.conv1, self.bn1), (self.conv2, self.bn2), (self.conv3, self.bn3)], 1
+        ):
+            p, _ = conv.init(keys[i - 1], shape)
+            shape = conv.out_shape(shape)
+            bp, bs = bn.init(keys[i - 1], shape)
+            params[f"c{i}"], params[f"bn{i}"], state[f"bn{i}"] = p, bp, bs
+        if self.needs_proj:
+            p, _ = self.proj.init(keys[3], in_shape)
+            pshape = self.proj.out_shape(in_shape)
+            bp, bs = self.bnp.init(keys[3], pshape)
+            params["proj"], params["bnp"], state["bnp"] = p, bp, bs
+        return params, state
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        new_state = dict(state)
+        h = x
+        for i, (conv, bn) in enumerate(
+            [(self.conv1, self.bn1), (self.conv2, self.bn2), (self.conv3, self.bn3)], 1
+        ):
+            h, _ = conv.apply(params[f"c{i}"], {}, h)
+            h, new_state[f"bn{i}"] = bn.apply(params[f"bn{i}"], state[f"bn{i}"], h, train=train)
+            if i < 3:
+                h = jax.nn.relu(h)
+        if self.needs_proj:
+            sc, _ = self.proj.apply(params["proj"], {}, x)
+            sc, new_state["bnp"] = self.bnp.apply(params["bnp"], state["bnp"], sc, train=train)
+        else:
+            sc = x
+        return jax.nn.relu(h + sc), new_state
+
+    def out_shape(self, in_shape):
+        s = self.conv2.out_shape(self.conv1.out_shape(in_shape))
+        return self.conv3.out_shape(s)
+
+
+_STAGES = [(3, 64, 256, 1), (4, 128, 512, 2), (6, 256, 1024, 2), (3, 512, 2048, 2)]
+
+
+class ResNet50(Model):
+    name = "resnet50"
+
+    @classmethod
+    def default_recipe(cls) -> Recipe:
+        return Recipe(
+            batch_size=256,
+            n_epochs=90,
+            optimizer="momentum",
+            opt_kwargs={"momentum": 0.9, "weight_decay": 1e-4},
+            schedule="step",
+            sched_kwargs={"lr": 0.1, "boundaries": [30, 60, 80], "factor": 0.1},
+            lr_unit="epoch",
+            input_shape=(224, 224, 3),
+            num_classes=1000,
+            compute_dtype=jnp.bfloat16,
+            dataset="imagenet",
+        )
+
+    def build(self):
+        bn_axis = self.recipe.bn_axis_name
+        layers: list[Layer] = [
+            nn.Conv(64, 7, stride=2, padding="SAME", use_bias=False, w_init=_he, name="stem"),
+            nn.BatchNorm(axis_name=bn_axis, name="stem_bn"),
+            nn.Activation("relu"),
+            nn.Pool(3, stride=2, padding=1, mode="max"),
+        ]
+        in_c = 64
+        for si, (reps, width, out_c, stride) in enumerate(_STAGES, 2):
+            for ri in range(reps):
+                layers.append(
+                    Bottleneck(
+                        in_c, width, out_c,
+                        stride=stride if ri == 0 else 1,
+                        bn_axis=bn_axis,
+                        name=f"res{si}{chr(97 + ri)}",
+                    )
+                )
+                in_c = out_c
+        layers += [
+            nn.GlobalAvgPool(),
+            nn.Dense(self.recipe.num_classes, name="fc1000"),
+        ]
+        return nn.Sequential(layers, name="resnet50")

@@ -1,0 +1,59 @@
+"""VGG16 — BASELINE config #5 (GoSGD, 64 workers).
+
+Reference: ``models/lasagne_model_zoo/vgg.py`` — ``build_model_vgg``
+(SURVEY.md §2.1). Simonyan & Zisserman 2014 configuration D: thirteen
+3x3 convs in five blocks (64/128/256/512/512) with 2x2 max pools, three
+FC layers (4096/4096/1000) with 0.5 dropout.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from theanompi_tpu import nn
+from theanompi_tpu.models.contract import Model, Recipe
+from theanompi_tpu.nn import init as initializers
+
+_BLOCKS = [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]
+
+
+class VGG16(Model):
+    name = "vgg16"
+
+    @classmethod
+    def default_recipe(cls) -> Recipe:
+        return Recipe(
+            batch_size=256,
+            n_epochs=74,
+            optimizer="momentum",
+            opt_kwargs={"momentum": 0.9, "weight_decay": 5e-4},
+            schedule="step",
+            sched_kwargs={"lr": 0.01, "boundaries": [50, 65], "factor": 0.1},
+            lr_unit="epoch",
+            input_shape=(224, 224, 3),
+            num_classes=1000,
+            compute_dtype=jnp.bfloat16,
+            dataset="imagenet",
+        )
+
+    def build(self):
+        he = initializers.he_normal()
+        layers = []
+        for bi, (reps, width) in enumerate(_BLOCKS):
+            for ri in range(reps):
+                layers += [
+                    nn.Conv(width, 3, padding="SAME", w_init=he, name=f"conv{bi + 1}_{ri + 1}"),
+                    nn.Activation("relu"),
+                ]
+            layers.append(nn.Pool(2, stride=2, mode="max"))
+        layers += [
+            nn.Flatten(),
+            nn.Dense(4096, w_init=initializers.gaussian(0.01), name="fc6"),
+            nn.Activation("relu"),
+            nn.Dropout(0.5),
+            nn.Dense(4096, w_init=initializers.gaussian(0.01), name="fc7"),
+            nn.Activation("relu"),
+            nn.Dropout(0.5),
+            nn.Dense(self.recipe.num_classes, w_init=initializers.gaussian(0.01), name="fc8"),
+        ]
+        return nn.Sequential(layers, name="vgg16")

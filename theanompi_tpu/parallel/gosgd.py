@@ -178,7 +178,11 @@ class GOSGDEngine:
         from theanompi_tpu.parallel.mesh import stack_replicas
 
         ts = init_train_state(self.model, rng)
-        self._count = 0
+        # _count stays None: the first train_step derives it from the
+        # state's step counter, which is also correct when the driver
+        # swaps in a restored checkpoint after init_state (resume keeps
+        # the gossip cadence aligned with the global step).
+        self._count = None
         return GOSGDState(
             workers=stack_replicas(ts, self.n),
             alpha=jnp.full((self.n,), 1.0 / self.n),

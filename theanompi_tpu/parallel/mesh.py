@@ -82,7 +82,15 @@ def host_local_batch_slice(mesh: Mesh, global_batch: int) -> slice:
 
 
 def put_global_batch(mesh: Mesh, x, axis: str = DATA_AXIS):
-    """Place a host batch onto the mesh sharded along the data axis."""
+    """Place a host batch onto the mesh sharded along the data axis.
+
+    Single-device meshes use a plain device placement: some backends
+    (measured: the axon-tunneled v5e) run programs whose inputs carry a
+    NamedSharding ~90x slower than identical unsharded programs, and with
+    one device the sharding is vacuous anyway.
+    """
+    if mesh.devices.size == 1:
+        return jax.device_put(x, mesh.devices.reshape(-1)[0])
     return jax.device_put(x, batch_sharding(mesh, axis))
 
 

@@ -106,6 +106,42 @@ def make_train_step(
     return train_step
 
 
+def make_multi_step(step_fn, k: int, stacked: bool = False):
+    """Fuse ``k`` successive train steps into one compiled program via
+    ``lax.scan`` — one host dispatch per k steps.
+
+    ``step_fn`` is any pure step ``(state, x, y, rng) -> (state, metrics)``
+    (e.g. from :func:`make_train_step`). With ``stacked=False`` the one
+    given batch is reused every sub-step (benchmarking); with
+    ``stacked=True`` images/labels carry a leading dim of size ``k`` (a
+    compiled epoch slice). The mode is explicit — inferring it from
+    shapes would misfire whenever batch_size == k. Per-sub-step rngs are
+    derived by folding the step index into ``rng``. Returns
+    ``(state, metrics)`` with metrics stacked over ``k``.
+
+    Host dispatch costs ~10ms on tunneled backends (measured on the axon
+    v5e), which swamps a ~15ms AlexNet step — scanning restores real
+    device throughput. On directly-attached hardware it simply removes
+    Python from the loop.
+    """
+
+    def run(state, images, labels, rng):
+        if stacked and images.shape[0] != k:
+            raise ValueError(
+                f"stacked=True expects leading dim {k}, got {images.shape[0]}"
+            )
+
+        def body(st, idx):
+            x = images[idx] if stacked else images
+            y = labels[idx] if stacked else labels
+            st, m = step_fn(st, x, y, jax.random.fold_in(rng, idx))
+            return st, m
+
+        return jax.lax.scan(body, state, jnp.arange(k))
+
+    return run
+
+
 def make_eval_step(model: Model):
     """``(state, images, labels) -> metrics`` with loss, on eval stats."""
 
