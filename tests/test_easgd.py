@@ -101,9 +101,10 @@ def test_easgd_via_run_training(tmp_path):
         n_epochs=2,
         avg_freq=2,
         dataset="synthetic",
+        # per-worker batch semantics: global batch = 8 workers x 4 = 32
         dataset_kwargs={"n_train": 64, "n_val": 32, "image_shape": (16, 16, 3)},
         recipe_overrides={
-            "batch_size": 32,
+            "batch_size": 4,
             "input_shape": (16, 16, 3),
             "sched_kwargs": {"lr": 0.05, "boundaries": [10**9]},
         },

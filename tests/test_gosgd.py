@@ -90,14 +90,15 @@ def test_gosgd_merge_algebra_vs_simulation(mesh8):
     w_after = np.asarray(jax.device_get(jax.tree_util.tree_leaves(state2.workers.params)[0]))
     a_after = _alphas(state2)
 
-    # replay decisions exactly as the engine draws them
+    # replay decisions exactly as the engine draws them: one shared
+    # shift per round, independent Bernoulli pushes per worker
     _, gossip_rng = jax.random.split(rng)
-    push, hop = [], []
-    for i in range(n):
-        dev = jax.random.fold_in(gossip_rng, i)
-        pk, hk = jax.random.split(dev)
-        push.append(bool(jax.random.bernoulli(pk, 0.9)))
-        hop.append(int(jax.random.randint(hk, (), 1, n)))
+    hop_key, push_base = jax.random.split(gossip_rng)
+    hop = int(jax.random.randint(hop_key, (), 1, n))
+    push = [
+        bool(jax.random.bernoulli(jax.random.fold_in(push_base, i), 0.9))
+        for i in range(n)
+    ]
 
     send = [a_before[i] * 0.5 if push[i] else 0.0 for i in range(n)]
     keep = [a_before[i] - send[i] for i in range(n)]
@@ -105,12 +106,51 @@ def test_gosgd_merge_algebra_vs_simulation(mesh8):
     acc_a = list(keep)
     for j in range(n):
         if push[j]:
-            dst = (j + hop[j]) % n
+            dst = (j + hop) % n
             acc[dst] = acc[dst] + send[j] * w_before[j]
             acc_a[dst] += send[j]
     for i in range(n):
         np.testing.assert_allclose(a_after[i], acc_a[i], rtol=1e-5)
         np.testing.assert_allclose(w_after[i], acc[i] / acc_a[i], rtol=1e-4, atol=1e-6)
+
+
+def _walk_jaxpr(jaxpr, in_cond=False):
+    """Yield (primitive_name, in_cond) for every eqn, recursing into
+    sub-jaxprs (raw Jaxpr or ClosedJaxpr params alike); ``in_cond``
+    marks eqns inside a cond/switch branch."""
+    for eqn in jaxpr.eqns:
+        yield eqn.primitive.name, in_cond
+        sub_cond = in_cond or eqn.primitive.name == "cond"
+        for v in eqn.params.values():
+            for sub in (v if isinstance(v, (list, tuple)) else [v]):
+                inner = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+                if hasattr(inner, "eqns"):
+                    yield from _walk_jaxpr(inner, sub_cond)
+
+
+def test_gosgd_round_cost_is_one_ppermute(mesh8):
+    """Bandwidth law: a gossip round executes exactly ONE ppermute
+    (O(|w|), independent of n). The n-1 static shift permutations live
+    in mutually-exclusive switch branches — none at the top level, one
+    per branch — so per-round wire cost is a single |w|+1 buffer."""
+    n = 8
+    model = _model()
+    x, y = _batch(model)
+    eng = GOSGDEngine(model, mesh8, p_push=0.5)
+    state = eng.init_state(jax.random.PRNGKey(0))
+    jaxpr = jax.make_jaxpr(eng._step_gossip)(
+        state, put_global_batch(mesh8, x), put_global_batch(mesh8, y),
+        jax.random.PRNGKey(1),
+    )
+    hits = [inc for name, inc in _walk_jaxpr(jaxpr.jaxpr) if name == "ppermute"]
+    assert sum(1 for inc in hits if not inc) == 0, (
+        "found ppermute(s) outside the shift switch: every one of those "
+        "executes every round (the old O(n*|w|) pattern)"
+    )
+    assert sum(1 for inc in hits if inc) == n - 1, (
+        f"expected {n - 1} branch ppermutes (one per static shift), got "
+        f"{sum(1 for inc in hits if inc)}"
+    )
 
 
 def test_gosgd_consensus_under_heavy_gossip(mesh8):
@@ -149,9 +189,10 @@ def test_gosgd_via_run_training():
         n_epochs=2,
         p_push=0.5,
         dataset="synthetic",
+        # per-worker batch semantics: global batch = 8 workers x 4 = 32
         dataset_kwargs={"n_train": 64, "n_val": 32, "image_shape": (16, 16, 3)},
         recipe_overrides={
-            "batch_size": 32,
+            "batch_size": 4,
             "input_shape": (16, 16, 3),
             "sched_kwargs": {"lr": 0.05, "boundaries": [10**9]},
         },

@@ -1,0 +1,84 @@
+"""PrefetchLoader unit tests.
+
+Covers the end-of-epoch sentinel delivery bug: when the producer thread
+exhausts its iterator while the bounded queue is FULL (production faster
+than consumption — the normal steady state), the StopIteration sentinel
+must still reach the consumer or the training loop deadlocks in
+``q.get()`` at the end of every epoch.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from theanompi_tpu.data.loader import PrefetchLoader
+
+
+def _host_place(b):
+    return b  # keep batches on host: these tests exercise queue mechanics
+
+
+def test_yields_all_batches_in_order():
+    batches = [np.full((2,), i) for i in range(7)]
+    loader = PrefetchLoader(batches, place=_host_place, depth=2)
+    out = list(loader)
+    assert len(out) == 7
+    for i, b in enumerate(out):
+        np.testing.assert_array_equal(b, batches[i])
+
+
+@pytest.mark.parametrize("depth", [1, 2])
+def test_end_of_epoch_with_full_queue_no_deadlock(depth):
+    """Regression: n_batches > depth with a slow consumer => producer
+    finishes while the queue is full; the sentinel must still arrive."""
+    n_batches = depth + 4
+    batches = [np.full((2,), i) for i in range(n_batches)]
+    loader = PrefetchLoader(batches, place=_host_place, depth=depth)
+    # let the producer run to exhaustion against a full queue
+    time.sleep(0.3)
+
+    seen = []
+    done = threading.Event()
+
+    def consume():
+        for b in loader:  # slow consumer
+            seen.append(int(b[0]))
+            time.sleep(0.05)
+        done.set()
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    assert done.wait(timeout=10.0), (
+        f"consumer deadlocked at end of epoch; consumed {len(seen)}/{n_batches}"
+    )
+    assert seen == list(range(n_batches))
+
+
+def test_producer_error_reraised_at_consumer():
+    def gen():
+        yield np.zeros((2,))
+        raise RuntimeError("boom in pipeline")
+
+    loader = PrefetchLoader(gen(), place=_host_place, depth=2)
+    next(loader)
+    with pytest.raises(RuntimeError, match="boom in pipeline"):
+        # the error sentinel must arrive even through a full queue
+        for _ in range(3):
+            next(loader)
+
+
+def test_close_mid_epoch_stops_producer():
+    produced = []
+
+    def gen():
+        for i in range(1000):
+            produced.append(i)
+            yield np.full((2,), i)
+
+    loader = PrefetchLoader(gen(), place=_host_place, depth=2)
+    next(loader)
+    loader.close()
+    assert loader._thread.is_alive() is False
+    assert len(produced) < 1000  # stopped early, not drained to the end

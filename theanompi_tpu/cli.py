@@ -38,6 +38,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dataset", default=None, help="override recipe dataset")
     p.add_argument("--synthetic", action="store_true",
                    help="shortcut: --dataset synthetic (smoke runs, no data on disk)")
+    p.add_argument("--dataset-arg", action="append", default=[], metavar="K=V",
+                   help="dataset constructor kwarg (repeatable), e.g. "
+                        "--dataset-arg n_train=512 --dataset-arg root=/data")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--save-dir", default=None, help="recorder output dir (JSONL + pickle)")
     p.add_argument("--ckpt-dir", default=None)
@@ -47,11 +50,68 @@ def build_parser() -> argparse.ArgumentParser:
                    help="EASGD/GoSGD: steps between exchanges (reference avg_freq)")
     p.add_argument("--alpha", type=float, default=None, help="EASGD elastic rate")
     p.add_argument("--p-push", type=float, default=None, help="GoSGD push probability")
+    p.add_argument("--nproc", type=int, default=None,
+                   help="spawn N controller processes on THIS machine (multi-host "
+                        "simulation over virtual CPU devices; the mpirun equivalent). "
+                        "On a real pod, run one tmpi per host with TMPI_* env or "
+                        "TMPI_AUTO_INIT=1 instead.")
+    p.add_argument("--devices-per-proc", type=int, default=None,
+                   help="with --nproc: virtual CPU devices per process (default: "
+                        "n_devices / nproc)")
     return p
+
+
+def _strip_flags(argv: list, flags: tuple) -> list:
+    """Remove ``--flag value`` / ``--flag=value`` pairs from argv."""
+    out, skip = [], False
+    for a in argv:
+        if skip:
+            skip = False
+            continue
+        if a in flags:
+            skip = True
+            continue
+        if any(a.startswith(f + "=") for f in flags):
+            continue
+        out.append(a)
+    return out
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+
+    if args.nproc and args.nproc > 1:
+        # mpirun equivalent: re-invoke this CLI as nproc cooperating
+        # controller processes over sliced virtual CPU devices
+        import shlex
+
+        from theanompi_tpu.launch.multihost import spawn_local
+
+        child_argv = list(argv) if argv is not None else sys.argv[1:]
+        child_argv = _strip_flags(child_argv, ("--nproc", "--devices-per-proc"))
+        per_proc = args.devices_per_proc or max(1, (args.n_devices or args.nproc) // args.nproc)
+        codes = spawn_local(
+            args.nproc,
+            ["-m", "theanompi_tpu.cli", *child_argv],
+            devices_per_proc=per_proc,
+        )
+        if any(codes):
+            print(f"controller exit codes: {codes} "
+                  f"({shlex.join(child_argv)})", file=sys.stderr)
+        return max(codes)
+
+    # join the multi-controller world BEFORE any backend use (no-op when
+    # not configured; reference: MPI_GPU_Process init at worker start)
+    import os
+
+    if os.environ.get("TMPI_FORCE_PLATFORM"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["TMPI_FORCE_PLATFORM"])
+
+    from theanompi_tpu.parallel.distributed import initialize_distributed
+
+    initialize_distributed()
 
     from theanompi_tpu.launch.session import resolve_model
     from theanompi_tpu.launch.worker import run_training
@@ -63,6 +123,16 @@ def main(argv=None) -> int:
         overrides["batch_size"] = args.batch_size
     if args.synthetic:
         args.dataset = "synthetic"
+
+    dataset_kwargs = {}
+    for kv in args.dataset_arg:
+        k, _, v = kv.partition("=")
+        if not _:
+            raise SystemExit(f"--dataset-arg expects K=V, got {kv!r}")
+        try:
+            dataset_kwargs[k] = json.loads(v)
+        except json.JSONDecodeError:
+            dataset_kwargs[k] = v
 
     rule_kwargs = {}
     if args.avg_freq is not None:
@@ -80,6 +150,7 @@ def main(argv=None) -> int:
         n_epochs=args.epochs,
         max_steps=args.max_steps,
         dataset=args.dataset,
+        dataset_kwargs=dataset_kwargs,
         recipe_overrides=overrides,
         seed=args.seed,
         save_dir=args.save_dir,

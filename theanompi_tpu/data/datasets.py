@@ -48,22 +48,39 @@ class Dataset:
         return self.n_val // batch_size
 
     def train_epoch(
-        self, epoch: int, batch_size: int, seed: int = 0
+        self,
+        epoch: int,
+        batch_size: int,
+        seed: int = 0,
+        part: Optional[slice] = None,
     ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
         """Deterministically shuffled epoch (seed + epoch → permutation),
         so every data-parallel worker computes the same global order —
         the reference broadcast shuffled filename lists from rank 0 for
-        the same reason (reference: ``models/data/imagenet.py``)."""
+        the same reason (reference: ``models/data/imagenet.py``).
+
+        ``part`` (multi-controller): this host's slice of each global
+        batch (``host_local_batch_slice``) — the permutation is shared
+        (seeded) across hosts, and each host gathers + augments ONLY its
+        own rows, the analogue of the reference's per-rank loader feed.
+        """
         rng = np.random.RandomState(seed * 100003 + epoch)
         perm = rng.permutation(self.n_train)
         for i in range(self.n_train_batches(batch_size)):
             idx = perm[i * batch_size : (i + 1) * batch_size]
+            if part is not None:
+                idx = idx[part]
             yield self.augment(self.x_train[idx], rng), self.y_train[idx]
 
-    def val_epoch(self, batch_size: int) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    def val_epoch(
+        self, batch_size: int, part: Optional[slice] = None
+    ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
         for i in range(self.n_val_batches(batch_size)):
             sl = slice(i * batch_size, (i + 1) * batch_size)
-            yield self.x_val[sl], self.y_val[sl]
+            x, y = self.x_val[sl], self.y_val[sl]
+            if part is not None:
+                x, y = x[part], y[part]
+            yield x, y
 
     def augment(self, x: np.ndarray, rng: np.random.RandomState) -> np.ndarray:
         """Train-time augmentation hook; default identity."""

@@ -120,7 +120,16 @@ class ImageNet_data(Dataset):
     def n_val_batches(self, batch_size: int) -> int:
         return sum(n // batch_size for _, _, n in self._val)
 
-    def train_epoch(self, epoch: int, batch_size: int, seed: int = 0) -> Iterator:
+    def train_epoch(
+        self,
+        epoch: int,
+        batch_size: int,
+        seed: int = 0,
+        part: Optional[slice] = None,
+    ) -> Iterator:
+        """``part`` (multi-controller): this host's slice of each global
+        batch — sliced from the UNSORTED permutation (a random subset),
+        then sorted for sequential mmap reads."""
         rng = np.random.RandomState(seed * 100003 + epoch)
         order = rng.permutation(len(self._train))
         for si in order:
@@ -129,19 +138,25 @@ class ImageNet_data(Dataset):
             labels = np.load(lbl_path)
             perm = rng.permutation(n)
             for b in range(n // batch_size):
-                idx = np.sort(perm[b * batch_size : (b + 1) * batch_size])
+                idx = perm[b * batch_size : (b + 1) * batch_size]
+                if part is not None:
+                    idx = idx[part]
+                idx = np.sort(idx)
                 x = np.asarray(images[idx])  # mmap gather
                 y = labels[idx].astype(np.int32)
                 yield self._preprocess(x, rng, train=True), y
 
-    def val_epoch(self, batch_size: int) -> Iterator:
+    def val_epoch(self, batch_size: int, part: Optional[slice] = None) -> Iterator:
         for img_path, lbl_path, n in self._val:
             images = np.load(img_path, mmap_mode="r")
             labels = np.load(lbl_path)
             for b in range(n // batch_size):
                 sl = slice(b * batch_size, (b + 1) * batch_size)
                 x = np.asarray(images[sl])
-                yield self._preprocess(x, None, train=False), labels[sl].astype(np.int32)
+                y = labels[sl].astype(np.int32)
+                if part is not None:
+                    x, y = x[part], y[part]
+                yield self._preprocess(x, None, train=False), y
 
     def _preprocess(
         self, x: np.ndarray, rng: Optional[np.random.RandomState], train: bool
@@ -202,8 +217,8 @@ class Imagenet_synthetic(Dataset):
     def augment(self, x: np.ndarray, rng: np.random.RandomState) -> np.ndarray:
         return (x.astype(np.float32) - 127.5) / 58.0
 
-    def val_epoch(self, batch_size: int):
-        for x, y in super().val_epoch(batch_size):
+    def val_epoch(self, batch_size: int, part: Optional[slice] = None):
+        for x, y in super().val_epoch(batch_size, part=part):
             yield (x.astype(np.float32) - 127.5) / 58.0, y
 
 

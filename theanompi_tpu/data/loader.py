@@ -63,10 +63,17 @@ class PrefetchLoader:
         except BaseException as e:  # surfaced on the consumer side
             self._err = e
         finally:
-            try:
-                self._q.put_nowait(self._SENTINEL)
-            except queue.Full:
-                pass  # consumer stopped; close() drains
+            # The sentinel MUST reach the consumer even when the queue is
+            # full (the normal case when production outpaces the train
+            # step): block-with-timeout and retry until close() stops us,
+            # exactly like the batch path above — a dropped sentinel
+            # deadlocks the consumer in q.get() at end of epoch.
+            while not self._stop.is_set():
+                try:
+                    self._q.put(self._SENTINEL, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
 
     def close(self) -> None:
         """Stop the producer and drop prefetched batches — call when

@@ -1,0 +1,105 @@
+"""Multi-controller process launcher — the ``mpirun`` equivalent.
+
+Reference: the launcher built an ``mpirun -n N python worker.py`` command
+line with per-rank device env (``lib/base.py`` + rule ``init()``;
+SURVEY.md §3.1). On TPU pods each HOST already runs one controller
+process (started by the pod runtime / GKE / SLURM, picked up via
+``TMPI_AUTO_INIT=1``), so a production launcher is usually unnecessary.
+This module provides the same capability for the cases that need it:
+
+- **Local simulation**: N controller processes on one machine, each
+  owning a slice of virtual CPU devices — the multi-host integration
+  test bed (``--xla_force_host_platform_device_count``), usable by any
+  developer without a pod.
+- **Ad-hoc clusters**: print/spawn the env each host needs.
+
+``spawn_local(n_proc, argv)`` forks this Python interpreter N times with
+``TMPI_*`` env set; rank 0's output streams through; returns exit codes.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+from typing import Optional, Sequence
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def controller_env(
+    process_id: int,
+    num_processes: int,
+    coordinator: str,
+    devices_per_proc: Optional[int] = None,
+    platform: Optional[str] = None,
+) -> dict:
+    """The env one controller process needs to join the world."""
+    env = {
+        "TMPI_COORDINATOR": coordinator,
+        "TMPI_NUM_PROCESSES": str(num_processes),
+        "TMPI_PROCESS_ID": str(process_id),
+    }
+    if devices_per_proc is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        env["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={devices_per_proc}"
+        ).strip()
+    if platform is not None:
+        # Plain JAX_PLATFORMS can be clobbered by site hooks that run at
+        # interpreter start (seen with the axon TPU plugin); the CLI also
+        # applies TMPI_FORCE_PLATFORM via jax.config before backend init.
+        env["JAX_PLATFORMS"] = platform
+        env["TMPI_FORCE_PLATFORM"] = platform
+    return env
+
+
+def spawn_local(
+    n_proc: int,
+    argv: Sequence[str],
+    devices_per_proc: Optional[int] = None,
+    coordinator: Optional[str] = None,
+    timeout: Optional[float] = None,
+) -> list[int]:
+    """Run ``python -m/argv`` as ``n_proc`` cooperating controller
+    processes on this machine (CPU simulation of a multi-host pod).
+    Streams rank-0 output; captures other ranks to buffers printed on
+    failure. Returns the per-rank exit codes.
+    """
+    coordinator = coordinator or f"127.0.0.1:{_free_port()}"
+    procs = []
+    for pid in range(n_proc):
+        env = dict(os.environ)
+        env.update(
+            controller_env(
+                pid, n_proc, coordinator,
+                devices_per_proc=devices_per_proc,
+                platform="cpu" if devices_per_proc is not None else None,
+            )
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, *argv],
+                env=env,
+                stdout=None if pid == 0 else subprocess.PIPE,
+                stderr=None if pid == 0 else subprocess.STDOUT,
+                text=pid != 0,
+            )
+        )
+    codes = []
+    for pid, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        codes.append(p.returncode)
+        if p.returncode != 0 and pid != 0 and out:
+            sys.stderr.write(f"--- rank {pid} output ---\n{out}\n")
+    return codes

@@ -28,7 +28,7 @@ from theanompi_tpu.data import get_dataset
 from theanompi_tpu.data.loader import PrefetchLoader
 from theanompi_tpu.models.contract import Model
 from theanompi_tpu.parallel import make_mesh
-from theanompi_tpu.parallel.mesh import put_global_batch
+from theanompi_tpu.parallel.mesh import host_local_batch_slice, put_global_batch
 from theanompi_tpu.utils import (
     Recorder,
     latest_checkpoint,
@@ -72,52 +72,98 @@ def run_training(
         recipe = recipe.replace(**recipe_overrides)
     model = model_cls(recipe)
 
-    data = get_dataset(dataset or recipe.dataset, **(dataset_kwargs or {}))
-    batch = recipe.batch_size
+    dataset = dataset or recipe.dataset
+    dataset_kwargs = dict(dataset_kwargs or {})
+    if dataset in ("synthetic", "imagenet_synthetic"):
+        # Synthetic stand-ins default to the MODEL's shapes, so
+        # `tmpi ... --synthetic` works for ImageNet-shaped models instead
+        # of failing deep in a matmul on 32x32 defaults.
+        if dataset == "synthetic":
+            dataset_kwargs.setdefault("image_shape", tuple(recipe.input_shape))
+        else:
+            dataset_kwargs.setdefault("crop", recipe.input_shape[0])
+        dataset_kwargs.setdefault("n_classes", recipe.num_classes)
+    mesh = make_mesh(devices)
+    n_dev = mesh.devices.size
+    rule = rule.lower()
+    # Batch semantics per rule (reference meaning, SURVEY.md §3.3/§3.5):
+    # - bsp:  recipe.batch_size is the GLOBAL batch, sharded across the
+    #         mesh (lockstep SGD is defined by its global batch).
+    # - easgd/gosgd: recipe.batch_size is the PER-WORKER batch — every
+    #         worker (device) trains on its own full batch each local
+    #         step, exactly like the reference's per-rank streams; the
+    #         global images/step is n_workers x batch_size.
+    per_worker_rules = ("easgd", "gosgd")
+    if rule not in ("bsp", *per_worker_rules):
+        raise ValueError(f"unknown rule {rule!r}; available: bsp, easgd, gosgd")
+    if rule == "bsp" and rule_kwargs:
+        raise ValueError(
+            f"rule 'bsp' got unexpected options {sorted(rule_kwargs)} "
+            "(avg_freq/alpha/p_push apply to EASGD/GoSGD only)"
+        )
+    if rule in per_worker_rules and strategy != "psum":
+        raise ValueError("strategy applies to the BSP rule only")
+    batch = recipe.batch_size * (n_dev if rule in per_worker_rules else 1)
+
+    data = get_dataset(dataset, **dataset_kwargs)
+    if tuple(data.image_shape) != tuple(recipe.input_shape):
+        raise ValueError(
+            f"dataset {dataset!r} yields images {tuple(data.image_shape)} but "
+            f"model {model_cls.__name__} expects {tuple(recipe.input_shape)}; "
+            "pass dataset_kwargs/--dataset matching the recipe (or override "
+            "recipe.input_shape)"
+        )
+    if data.n_classes != recipe.num_classes:
+        raise ValueError(
+            f"dataset {dataset!r} has {data.n_classes} classes but model head "
+            f"expects {recipe.num_classes} (override recipe.num_classes or the "
+            "dataset's n_classes)"
+        )
     steps_per_epoch = data.n_train_batches(batch)
     if steps_per_epoch == 0:
         raise ValueError(
-            f"dataset has {data.n_train} train examples < batch size {batch}"
+            f"dataset has {data.n_train} train examples < the global batch "
+            f"{batch} ({'= n_workers x recipe.batch_size' if rule in per_worker_rules else '= recipe.batch_size'})"
         )
     n_epochs = n_epochs if n_epochs is not None else recipe.n_epochs
 
-    mesh = make_mesh(devices)
-    n_dev = mesh.devices.size
     if batch % n_dev:
         raise ValueError(f"global batch {batch} not divisible by {n_dev} devices")
     vbatch = recipe.val_batch_size or batch
     if vbatch % n_dev:
         raise ValueError(f"val batch {vbatch} not divisible by {n_dev} devices")
 
-    rule = rule.lower()
     if rule == "bsp":
         from theanompi_tpu.parallel.bsp import BSPEngine
 
-        if rule_kwargs:
-            raise ValueError(
-                f"rule 'bsp' got unexpected options {sorted(rule_kwargs)} "
-                "(avg_freq/alpha/p_push apply to EASGD/GoSGD only)"
-            )
         engine = BSPEngine(
             model, mesh, steps_per_epoch=steps_per_epoch, strategy=strategy
         )
     elif rule == "easgd":
         from theanompi_tpu.parallel.easgd import EASGDEngine
 
-        if strategy != "psum":
-            raise ValueError("strategy applies to the BSP rule only")
         engine = EASGDEngine(model, mesh, steps_per_epoch=steps_per_epoch, **rule_kwargs)
-    elif rule == "gosgd":
+    else:
         from theanompi_tpu.parallel.gosgd import GOSGDEngine
 
-        if strategy != "psum":
-            raise ValueError("strategy applies to the BSP rule only")
         engine = GOSGDEngine(model, mesh, steps_per_epoch=steps_per_epoch, **rule_kwargs)
-    else:
-        raise ValueError(f"unknown rule {rule!r}; available: bsp, easgd, gosgd")
+
+    # Multi-controller: this host produces only its slice of every
+    # global batch (reference: per-rank loader feed, lib/proc_load_mpi.py)
+    n_proc = jax.process_count()
+    part = host_local_batch_slice(mesh, batch) if n_proc > 1 else None
+    vpart = host_local_batch_slice(mesh, vbatch) if n_proc > 1 else None
+    if n_proc > 1 and (batch % n_proc or vbatch % n_proc):
+        raise ValueError(
+            f"global batch {batch} / val batch {vbatch} must divide the "
+            f"{n_proc} controller processes"
+        )
 
     rec = Recorder(
-        rank=jax.process_index(), print_freq=print_freq, save_dir=save_dir,
+        rank=jax.process_index(), print_freq=print_freq,
+        # files are written by the rank-0 controller only (reference:
+        # rank-0 recorder save); console prints keep their rank prefix
+        save_dir=save_dir if jax.process_index() == 0 else None,
         run_name=f"{model.name}_{rule}",
     )
     rng = jax.random.PRNGKey(seed)
@@ -133,11 +179,11 @@ def run_training(
             start_epoch = engine.get_step(state) // steps_per_epoch
             print(f"resumed from {path} at step {engine.get_step(state)}", flush=True)
 
-    def place(b):
+    def place(b, rows=batch):
         x, y = b
         return (
-            put_global_batch(mesh, jnp.asarray(x)),
-            put_global_batch(mesh, jnp.asarray(y)),
+            put_global_batch(mesh, x, global_rows=rows),
+            put_global_batch(mesh, y, global_rows=rows),
         )
 
     summary: dict = {"epochs": [], "rule": rule, "model": model.name}
@@ -150,7 +196,9 @@ def run_training(
         rec.start_epoch()
         epoch_steps = 0
         loader = PrefetchLoader(
-            data.train_epoch(epoch, batch, seed=seed), place, depth=prefetch_depth
+            data.train_epoch(epoch, batch, seed=seed, part=part),
+            place,
+            depth=prefetch_depth,
         )
         rec.start("wait")
         for xg, yg in loader:
@@ -169,7 +217,10 @@ def run_training(
             if engine.exchange_every and step_count % engine.exchange_every == 0:
                 rec.start("comm")
                 state = engine.exchange(state)
-                rec.end("comm")
+                # sync on a leaf of the exchanged state: without it the
+                # bracket measures only async dispatch and the collective's
+                # real cost bleeds into the next wait/step brackets
+                rec.end("comm", sync=jax.tree_util.tree_leaves(state)[0])
             rec.train_metrics(step_count, metrics, n_images=batch)
             rec.start("wait")
             if max_steps and step_count >= max_steps:
@@ -181,8 +232,8 @@ def run_training(
         # validation (reference: per-epoch val loop on the worker/server)
         val_accum: dict[str, float] = {}
         n_val = 0
-        for vx, vy in data.val_epoch(vbatch):
-            vm = engine.eval_step(state, *place((vx, vy)))
+        for vx, vy in data.val_epoch(vbatch, part=vpart):
+            vm = engine.eval_step(state, *place((vx, vy), rows=vbatch))
             for k, v in vm.items():
                 val_accum[k] = val_accum.get(k, 0.0) + float(v)
             n_val += 1

@@ -125,7 +125,9 @@ class BSPEngine:
         return self._eval(state, images, labels)
 
     def get_step(self, state) -> int:
-        return int(jax.device_get(state.step))
+        from theanompi_tpu.parallel.mesh import first_local_value
+
+        return int(first_local_value(state.step))
 
 
 def make_bsp_eval_step(model: Model, mesh: Mesh, axis_name: str = DATA_AXIS):

@@ -1,0 +1,103 @@
+"""Multi-controller (multi-host) runtime bootstrap.
+
+Rebuild of the reference's L1 process runtime (reference: ``lib/base.py``
+— ``MPI_GPU_Process`` with ``get_internode_comm()`` returning
+``MPI.COMM_WORLD``, one OS process per GPU launched by ``mpirun``;
+SURVEY.md §1 L1, §5.8). The TPU-native process model is JAX
+multi-controller SPMD: ONE process per TPU host (not per chip), every
+process runs the identical program, and ``jax.distributed.initialize``
+replaces ``mpirun``'s world setup — after it, ``jax.devices()`` spans
+the whole pod and collectives ride ICI/DCN picked by XLA.
+
+Bootstrap sources, in precedence order:
+
+1. Explicit kwargs to :func:`initialize_distributed`.
+2. ``TMPI_COORDINATOR`` / ``TMPI_NUM_PROCESSES`` / ``TMPI_PROCESS_ID``
+   env vars (set by ``tmpi --nproc`` / :mod:`launch.multihost`, the
+   mpirun equivalent — also how tests run 2+ controller processes on
+   CPU with ``--xla_force_host_platform_device_count``).
+3. JAX's own cluster auto-detection (TPU pod metadata, SLURM, etc.):
+   ``jax.distributed.initialize()`` with no args — used when
+   ``TMPI_AUTO_INIT=1``.
+
+On a single host with none of those set, this is a no-op: the framework
+stays single-controller exactly as before.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def initialize_distributed(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[list[int]] = None,
+) -> bool:
+    """Join the multi-controller world if configured; returns True iff
+    ``jax.distributed`` was initialized (now or earlier this process).
+
+    Must run BEFORE any JAX backend use (first jit/devices() call).
+    Idempotent: a second call is a no-op.
+    """
+    global _initialized
+    if _initialized:
+        return True
+
+    env = os.environ
+    coordinator = coordinator or env.get("TMPI_COORDINATOR") or None
+    if num_processes is None and env.get("TMPI_NUM_PROCESSES"):
+        num_processes = int(env["TMPI_NUM_PROCESSES"])
+    if process_id is None and env.get("TMPI_PROCESS_ID"):
+        process_id = int(env["TMPI_PROCESS_ID"])
+
+    if coordinator is None and num_processes is None:
+        if env.get("TMPI_AUTO_INIT") == "1":
+            # TPU pod / SLURM: let JAX's cluster detection fill everything
+            jax.distributed.initialize()
+            _initialized = True
+            return True
+        return False
+    if num_processes is not None and num_processes <= 1 and coordinator is None:
+        return False
+    if coordinator is None or num_processes is None or process_id is None:
+        raise ValueError(
+            "multi-controller bootstrap needs coordinator, num_processes AND "
+            f"process_id (got {coordinator=}, {num_processes=}, {process_id=}); "
+            "set TMPI_COORDINATOR/TMPI_NUM_PROCESSES/TMPI_PROCESS_ID or pass "
+            "them explicitly"
+        )
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _initialized = True
+    return True
+
+
+def is_multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
+def assert_same_across_processes(value: float, name: str, atol: float = 0.0) -> None:
+    """Debug guard: verify a host-side scalar is identical on every
+    controller (e.g. the loss after a lockstep BSP step). Collective —
+    every process must call it."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(np.float64(value))
+    ref = np.asarray(gathered).reshape(-1)
+    if not np.all(np.abs(ref - ref[0]) <= atol):
+        raise AssertionError(
+            f"{name} differs across processes: {ref.tolist()} (atol={atol})"
+        )

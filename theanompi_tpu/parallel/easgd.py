@@ -21,6 +21,12 @@ communicating on the same round):
 Timing-model divergence from the reference (documented per SURVEY.md §7
 item 6): exchanges are gang-scheduled rather than FCFS-async, so every
 worker exchanges on the same step. The per-worker algebra is identical.
+
+Batch semantics (reference meaning, SURVEY.md §3.3): each worker trains
+on its OWN full ``recipe.batch_size`` stream — the incoming global batch
+must be ``n_workers x batch_size``, sharded so each device's shard IS
+one worker's batch (the driver feeds this; config #4 "ResNet-50 EASGD,
+16 workers, batch 256" means 256 examples per worker per local step).
 """
 
 from __future__ import annotations
@@ -165,4 +171,6 @@ class EASGDEngine:
         return self._eval(state, images, labels)
 
     def get_step(self, state) -> int:
-        return int(jax.device_get(state.workers.step)[0])
+        from theanompi_tpu.parallel.mesh import first_local_value
+
+        return int(first_local_value(state.workers.step))

@@ -9,20 +9,33 @@ share; SURVEY.md §3.5; algorithm: Blot et al. 2016, "Gossip training for
 deep learning").
 
 SPMD redesign: MPI isend/iprobe does not exist under gang scheduling.
-A gossip round runs as n-1 masked ``ppermute`` shifts — shift ``s``
-delivers exactly the messages whose sender chose the peer ``s`` hops
-away, so every sender still picks its peer independently and uniformly,
-preserving the reference algorithm's probability law exactly. Messages
-are (params * share/2, share/2) pairs; non-pushing senders contribute
-zeros. Bandwidth per round is O(n * |w|) worst case versus the
-reference's O(pushes * |w|) point-to-point — the price of SPMD; with
-the default p = avg_freq^-1 ~ small, most rounds move only zeros and
-XLA still ships them, so set ``gossip_every`` > 1 to thin rounds on
-real hardware (p is then applied per-round, identical law).
+A gossip round draws ONE shared uniform shift ``s in [1, n-1]`` (from
+the round's shared rng); every worker that pushes this round sends to
+the peer ``s`` hops forward. The round is realized as a SINGLE
+``lax.ppermute`` of the packed (share*w, share) buffer, selected from
+the n-1 static shift permutations by ``lax.switch`` (every device
+computes the same ``s``, so all replicas take the same branch — safe
+for a collective under SPMD). Round cost is O(|w|), independent of n —
+the same wire cost as one reference point-to-point push.
+
+Probability-law note (documented divergence, SURVEY.md §7 hard-part 1):
+each sender's peer is still EXACTLY uniform over the other n-1 workers,
+and the push decisions stay independent Bernoulli(p) per worker — the
+per-(sender, receiver) marginal law matches the reference. What changes
+is the joint law across senders within one round: peers are perfectly
+correlated (everyone shifts by the same s), which makes the assignment
+receiver-side conflict-free — at most one message per receiver per
+round, where the reference could deliver several queued gossip messages
+in one iteration. Merge algebra per delivered message is identical.
 
 ``gossip_every=k`` runs the gossip collective only every k-th step (two
 compiled step variants; the host picks — no recompile), cutting gossip
 bandwidth by k while applying the same per-round push law.
+
+Batch semantics (reference meaning, SURVEY.md §3.5): each worker trains
+on its OWN full ``recipe.batch_size`` stream — the incoming global
+batch is ``n_workers x batch_size``, sharded so each device's shard IS
+one worker's batch (the driver feeds this).
 
 Share-weight invariant: sum_i alpha_i == 1 at all times (checked in
 tests); consensus params = sum_i alpha_i * w_i. On a 1-device mesh
@@ -84,33 +97,40 @@ class GOSGDEngine:
         ax, n, p = axis_name, self.n, float(p_push)
 
         def gossip(params: PyTree, alpha: jax.Array, rng: jax.Array):
-            """One gossip round: masked ppermute shifts; returns merged
+            """One gossip round: ONE executed ppermute; returns merged
             (params, alpha). ``rng`` must be identical across devices —
-            per-device decisions come from folding in the device index.
-            Identity on a 1-device mesh (no recipient exists)."""
+            the shared shift comes straight from it, per-device push
+            decisions from folding in the device index. Identity on a
+            1-device mesh (no recipient exists)."""
             if n == 1:
                 return params, alpha
             me = lax.axis_index(ax)
-            dev_rng = jax.random.fold_in(rng, me)
-            push_key, peer_key = jax.random.split(dev_rng)
-            push = jax.random.bernoulli(push_key, p)
-            # uniform peer != me: draw in [1, n-1] hops forward
-            hop = jax.random.randint(peer_key, (), 1, n)
+            hop_key, push_base = jax.random.split(rng)
+            # shared across devices: every replica draws the same shift
+            hop = jax.random.randint(hop_key, (), 1, n)
+            push = jax.random.bernoulli(jax.random.fold_in(push_base, me), p)
 
             send_share = jnp.where(push, alpha * 0.5, 0.0)
             keep_share = alpha - send_share
             # big-buffer pack (reference: exchanger packed params into one
-            # contiguous comm buffer): one ppermute per shift, not per leaf
+            # contiguous comm buffer): share rides in the last slot so the
+            # whole round is a single collective
             from jax.flatten_util import ravel_pytree
 
             flat, unravel = ravel_pytree(params)
-            acc = keep_share * flat
-            acc_share = keep_share
-            for s in range(1, n):
-                perm = [(i, (i + s) % n) for i in range(n)]
-                mask = jnp.where(hop == s, send_share, 0.0)
-                acc_share = acc_share + lax.ppermute(mask, ax, perm)
-                acc = acc + lax.ppermute(mask * flat, ax, perm)
+            payload = jnp.concatenate([send_share * flat, send_share[None]])
+            # one ppermute, shift chosen at runtime: lax.switch over the
+            # n-1 static shift permutations (ppermute's perm is static).
+            # Uniform predicate across replicas => same branch everywhere.
+            branches = [
+                lambda x, _s=s: lax.ppermute(
+                    x, ax, [(i, (i + _s) % n) for i in range(n)]
+                )
+                for s in range(1, n)
+            ]
+            received = lax.switch(hop - 1, branches, payload)
+            acc = keep_share * flat + received[:-1]
+            acc_share = keep_share + received[-1]
             return unravel(acc / acc_share), acc_share
 
         def make_sharded_step(with_gossip: bool):
@@ -206,4 +226,6 @@ class GOSGDEngine:
         return self._eval(state, images, labels)
 
     def get_step(self, state) -> int:
-        return int(jax.device_get(state.workers.step)[0])
+        from theanompi_tpu.parallel.mesh import first_local_value
+
+        return int(first_local_value(state.workers.step))

@@ -23,7 +23,10 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 DATA_AXIS = "data"
-# EASGD runs on a 2-D ('group', 'data') mesh: see parallel/easgd.py
+# Reserved axis name for 2-D (worker-group x data) meshes, where each
+# async-rule "worker" is itself a data-parallel group of chips. Today's
+# rules all run 1-D ('data',); make_mesh accepts multi-axis shapes so
+# adding the group axis is additive.
 GROUP_AXIS = "group"
 
 
@@ -81,17 +84,45 @@ def host_local_batch_slice(mesh: Mesh, global_batch: int) -> slice:
     return slice(idx * per_host, (idx + 1) * per_host)
 
 
-def put_global_batch(mesh: Mesh, x, axis: str = DATA_AXIS):
+def put_global_batch(mesh: Mesh, x, axis: str = DATA_AXIS, global_rows: Optional[int] = None):
     """Place a host batch onto the mesh sharded along the data axis.
+
+    ``x`` holds THIS PROCESS's rows: in single-controller runs that is
+    the whole global batch; in multi-controller runs each host passes
+    only its ``host_local_batch_slice`` rows (the analogue of the
+    reference's per-rank batch-file partition) and the global array is
+    assembled from the per-process shards without any cross-host copy.
+    ``global_rows`` overrides the inferred global batch (defaults to
+    ``rows_here * process_count``, the equal-split case).
 
     Single-device meshes use a plain device placement: some backends
     (measured: the axon-tunneled v5e) run programs whose inputs carry a
     NamedSharding ~90x slower than identical unsharded programs, and with
     one device the sharding is vacuous anyway.
     """
+    n_proc = jax.process_count()
+    if n_proc > 1:
+        x = np.asarray(x)
+        rows = global_rows if global_rows is not None else x.shape[0] * n_proc
+        return jax.make_array_from_process_local_data(
+            batch_sharding(mesh, axis), x, (rows, *x.shape[1:])
+        )
     if mesh.devices.size == 1:
         return jax.device_put(x, mesh.devices.reshape(-1)[0])
     return jax.device_put(x, batch_sharding(mesh, axis))
+
+
+def first_local_value(x):
+    """First element of a (possibly multi-host sharded) array, read from
+    this process's first addressable shard — ``device_get`` of a global
+    array raises on non-addressable shards, this never does. For values
+    replicated or stacked per-worker (engine step counters), any shard's
+    first element is the answer."""
+    try:
+        shard = x.addressable_shards[0].data
+    except AttributeError:  # plain numpy / python scalar
+        shard = x
+    return np.asarray(shard).reshape(-1)[0]
 
 
 def stack_replicas(tree, n: int):

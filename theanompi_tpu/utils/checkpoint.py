@@ -31,12 +31,24 @@ PyTree = Any
 _CKPT_RE = re.compile(r"ckpt_(\d+)\.npz$")
 
 
+def _pull_to_host(leaf) -> np.ndarray:
+    """Materialize one leaf on the host. Leaves sharded across OTHER
+    processes (EASGD/GoSGD per-worker state under multi-controller) are
+    gathered with a cross-host collective — so this is collective: every
+    process must reach it, even though only rank 0 writes the file."""
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(leaf, tiled=True))
+    return np.asarray(jax.device_get(leaf))
+
+
 def _flatten_with_paths(tree: PyTree) -> dict[str, np.ndarray]:
     flat = {}
     leaves_with_paths = jax.tree_util.tree_flatten_with_path(tree)[0]
     for path, leaf in leaves_with_paths:
         key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
-        flat[key] = np.asarray(jax.device_get(leaf))
+        flat[key] = _pull_to_host(leaf)
     return flat
 
 
@@ -48,14 +60,15 @@ def save_checkpoint(
     keep: int = 3,
 ) -> Optional[str]:
     """Atomically write ``ckpt_{step}.npz``; prune to the newest ``keep``.
-    Only process 0 writes in multi-host runs; returns the path (or None
-    on non-writer processes)."""
-    if jax.process_index() != 0:
-        return None
-    os.makedirs(directory, exist_ok=True)
+    COLLECTIVE in multi-host runs: every process must call it (sharded
+    leaves are gathered cross-host), then only process 0 writes; returns
+    the path (or None on non-writer processes)."""
     flat = _flatten_with_paths(state)
     if rng is not None:
         flat["__rng__"] = np.asarray(jax.device_get(rng))
+    if jax.process_index() != 0:
+        return None
+    os.makedirs(directory, exist_ok=True)
     path = os.path.join(directory, f"ckpt_{step}.npz")
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
     try:
