@@ -80,3 +80,56 @@ def test_spawn_local_propagates_failure(tmp_path):
         timeout=120,
     )
     assert codes == [0, 1]
+
+
+def test_spawn_local_kills_hung_survivors():
+    """A rank dying early must not hang the launcher while the other
+    rank blocks forever (here: sleeps) — survivors are killed after the
+    failure grace period."""
+    import time
+
+    t0 = time.monotonic()
+    codes = spawn_local(
+        2,
+        [
+            "-c",
+            "import sys, os, time\n"
+            "rank = int(os.environ['TMPI_PROCESS_ID'])\n"
+            "sys.exit(1) if rank == 1 else time.sleep(600)",
+        ],
+        devices_per_proc=1,
+        timeout=300,
+        failure_grace=3.0,
+    )
+    assert time.monotonic() - t0 < 60, "launcher did not kill hung rank 0"
+    assert codes[1] == 1
+    assert codes[0] != 0  # killed, not a clean exit
+
+
+def test_cli_refuses_nested_respawn(monkeypatch, capsys):
+    """--nproc inside an already-spawned controller must not fork again
+    (fork-bomb guard), and abbreviated --npro must be rejected."""
+    import theanompi_tpu.cli as cli
+
+    monkeypatch.setenv("TMPI_PROCESS_ID", "0")
+    monkeypatch.setenv("TMPI_NUM_PROCESSES", "2")
+    called = {}
+    monkeypatch.setattr(
+        "theanompi_tpu.launch.multihost.spawn_local",
+        lambda *a, **k: called.setdefault("spawned", True) or [0],
+    )
+    # run_training / distributed init will be reached instead of a
+    # respawn; stub them out (no real world to join in this test)
+    import theanompi_tpu.launch.worker as worker
+    import theanompi_tpu.parallel.distributed as dist
+
+    monkeypatch.setattr(worker, "run_training", lambda **k: {"steps": 0, "epochs": []})
+    monkeypatch.setattr(dist, "initialize_distributed", lambda *a, **k: False)
+    rc = cli.main(
+        ["BSP", "1", *_WRN, "--nproc", "2", "--max-steps", "1", "--synthetic"]
+    )
+    assert rc == 0
+    assert "spawned" not in called
+
+    with pytest.raises(SystemExit):
+        cli.build_parser().parse_args(["BSP", "1", *_WRN, "--npro", "2"])

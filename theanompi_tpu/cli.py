@@ -24,6 +24,9 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="tmpi",
         description="TPU-native Theano-MPI: distributed training launcher",
+        # no prefix abbreviation: an abbreviated --npro would survive
+        # _strip_flags in the respawn path and fork forever
+        allow_abbrev=False,
     )
     p.add_argument("rule", choices=["BSP", "EASGD", "GOSGD", "bsp", "easgd", "gosgd"])
     p.add_argument("n_devices", type=int, help="number of chips (0 = all)")
@@ -78,7 +81,20 @@ def _strip_flags(argv: list, flags: tuple) -> list:
 
 
 def main(argv=None) -> int:
+    import os
+
     args = build_parser().parse_args(argv)
+
+    if args.nproc and args.nproc > 1 and (
+        "TMPI_PROCESS_ID" in os.environ or "TMPI_NUM_PROCESSES" in os.environ
+    ):
+        # already a spawned controller: never respawn (fork-bomb guard)
+        print(
+            "tmpi: ignoring --nproc inside an already-spawned controller "
+            f"(TMPI_PROCESS_ID={os.environ.get('TMPI_PROCESS_ID')})",
+            file=sys.stderr,
+        )
+        args.nproc = None
 
     if args.nproc and args.nproc > 1:
         # mpirun equivalent: re-invoke this CLI as nproc cooperating
@@ -98,12 +114,12 @@ def main(argv=None) -> int:
         if any(codes):
             print(f"controller exit codes: {codes} "
                   f"({shlex.join(child_argv)})", file=sys.stderr)
-        return max(codes)
+        # signal deaths have NEGATIVE returncodes — max() would report 0
+        # when another rank exited cleanly; any non-zero code is failure
+        return 1 if any(codes) else 0
 
     # join the multi-controller world BEFORE any backend use (no-op when
     # not configured; reference: MPI_GPU_Process init at worker start)
-    import os
-
     if os.environ.get("TMPI_FORCE_PLATFORM"):
         import jax
 

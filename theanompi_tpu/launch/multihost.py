@@ -65,12 +65,23 @@ def spawn_local(
     devices_per_proc: Optional[int] = None,
     coordinator: Optional[str] = None,
     timeout: Optional[float] = None,
+    failure_grace: float = 15.0,
 ) -> list[int]:
     """Run ``python -m/argv`` as ``n_proc`` cooperating controller
     processes on this machine (CPU simulation of a multi-host pod).
     Streams rank-0 output; captures other ranks to buffers printed on
     failure. Returns the per-rank exit codes.
+
+    Supervision: children are POLLED, not waited-on in rank order — if
+    any rank dies non-zero while the others block in a collective, the
+    survivors get ``failure_grace`` seconds to exit on their own, then
+    are killed, and the failed rank's buffered output is printed.
+    ``timeout`` (None = unbounded, the default: training runs are long)
+    caps total wall clock and raises ``TimeoutExpired``.
     """
+    import threading
+    import time as _time
+
     coordinator = coordinator or f"127.0.0.1:{_free_port()}"
     procs = []
     for pid in range(n_proc):
@@ -91,15 +102,50 @@ def spawn_local(
                 text=pid != 0,
             )
         )
-    codes = []
+
+    # drain non-rank-0 pipes concurrently (a full pipe buffer would
+    # deadlock the child)
+    outputs: dict[int, str] = {}
+    drains = []
     for pid, p in enumerate(procs):
-        try:
-            out, _ = p.communicate(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        codes.append(p.returncode)
-        if p.returncode != 0 and pid != 0 and out:
-            sys.stderr.write(f"--- rank {pid} output ---\n{out}\n")
+        if p.stdout is not None:
+            t = threading.Thread(
+                target=lambda pid=pid, p=p: outputs.__setitem__(pid, p.stdout.read()),
+                daemon=True,
+            )
+            t.start()
+            drains.append(t)
+
+    deadline = (_time.monotonic() + timeout) if timeout else None
+
+    def _kill_survivors():
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    while True:
+        rcs = [p.poll() for p in procs]
+        if all(rc is not None for rc in rcs):
+            break
+        if any(rc not in (None, 0) for rc in rcs):
+            grace_end = _time.monotonic() + failure_grace
+            while any(p.poll() is None for p in procs) and _time.monotonic() < grace_end:
+                _time.sleep(0.2)
+            _kill_survivors()
+            break
+        if deadline is not None and _time.monotonic() > deadline:
+            _kill_survivors()
+            for t in drains:
+                t.join(timeout=5)
+            raise subprocess.TimeoutExpired([sys.executable, *argv], timeout)
+        _time.sleep(0.2)
+
+    for p in procs:
+        p.wait()
+    for t in drains:
+        t.join(timeout=5)
+    codes = [p.returncode for p in procs]
+    for pid, rc in enumerate(codes):
+        if rc != 0 and outputs.get(pid):
+            sys.stderr.write(f"--- rank {pid} (exit {rc}) output ---\n{outputs[pid]}\n")
     return codes

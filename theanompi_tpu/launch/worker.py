@@ -31,6 +31,7 @@ from theanompi_tpu.parallel import make_mesh
 from theanompi_tpu.parallel.mesh import host_local_batch_slice, put_global_batch
 from theanompi_tpu.utils import (
     Recorder,
+    checkpoint_step,
     latest_checkpoint,
     load_checkpoint,
     save_checkpoint,
@@ -171,6 +172,29 @@ def run_training(
     start_epoch = 0
     if resume and ckpt_dir:
         path = latest_checkpoint(ckpt_dir)
+        if n_proc > 1:
+            # Every controller must resume from the SAME step or the
+            # lockstep SPMD program diverges/deadlocks. ckpt_dir must be
+            # shared storage (same contract as the reference's NFS-visible
+            # rank-0 save). Allgather every rank's resolved step and have
+            # EVERY rank (including 0) compare the full vector, so all
+            # processes fail together instead of rank 0 sailing into a
+            # collective that will never complete.
+            from jax.experimental import multihost_utils
+
+            steps_seen = np.asarray(
+                multihost_utils.process_allgather(
+                    np.int64(checkpoint_step(path))
+                )
+            ).reshape(-1)
+            if not np.all(steps_seen == steps_seen[0]):
+                raise RuntimeError(
+                    f"controller processes resolved different checkpoint "
+                    f"steps {steps_seen.tolist()} (this is process "
+                    f"{jax.process_index()}): ckpt_dir={ckpt_dir!r} is not "
+                    "shared storage visible to all controllers (required "
+                    "for --resume)"
+                )
         if path:
             restored, saved_rng = load_checkpoint(path, state)
             state = jax.tree_util.tree_map(jnp.asarray, restored)

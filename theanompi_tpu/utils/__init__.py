@@ -2,6 +2,7 @@
 
 from theanompi_tpu.utils.recorder import Recorder  # noqa: F401
 from theanompi_tpu.utils.checkpoint import (  # noqa: F401
+    checkpoint_step,
     load_checkpoint,
     latest_checkpoint,
     save_checkpoint,

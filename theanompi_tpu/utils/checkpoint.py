@@ -93,6 +93,17 @@ def _prune(directory: str, keep: int) -> None:
         os.unlink(os.path.join(directory, f))
 
 
+def checkpoint_step(path: Optional[str]) -> int:
+    """The step number encoded in a checkpoint filename; -1 for None
+    (used to compare resume decisions across controller processes)."""
+    if path is None:
+        return -1
+    m = _CKPT_RE.search(os.path.basename(path))
+    if not m:
+        raise ValueError(f"{path!r} is not a checkpoint path")
+    return int(m.group(1))
+
+
 def latest_checkpoint(directory: str) -> Optional[str]:
     if not os.path.isdir(directory):
         return None
@@ -128,10 +139,15 @@ def load_checkpoint(
                 f"(available: {sorted(data.files)[:8]}...)"
             )
         arr = data[key]
-        want = np.asarray(leaf)
-        if tuple(arr.shape) != tuple(want.shape):
+        # Read shape/dtype WITHOUT materializing the template leaf: a
+        # non-fully-addressable (multi-host sharded) template would raise
+        # on np.asarray, and resume templates are allowed to be the live
+        # sharded state.
+        want_shape = tuple(getattr(leaf, "shape", np.shape(leaf)))
+        want_dtype = getattr(leaf, "dtype", None) or np.result_type(leaf)
+        if tuple(arr.shape) != want_shape:
             raise ValueError(
-                f"checkpoint leaf {key!r} has shape {arr.shape}, expected {want.shape}"
+                f"checkpoint leaf {key!r} has shape {arr.shape}, expected {want_shape}"
             )
-        new_leaves.append(arr.astype(want.dtype))
+        new_leaves.append(arr.astype(want_dtype))
     return jax.tree_util.tree_unflatten(treedef, new_leaves), rng
