@@ -1,90 +1,309 @@
-"""bench.py — headline benchmark, run on real TPU hardware by the driver.
+"""bench.py — benchmark harness; run on real TPU hardware by the driver.
 
-Metric (BASELINE.json): AlexNet ImageNet images/sec. The authoritative
-reference target is "match 8xP100 BSP wall-clock on ImageNet AlexNet";
-8xP100 AlexNet BSP throughput is ~8000 img/s (fp32 cuDNN era, near-linear
-scaling per the paper), so vs_baseline = img/s / 8000 with the
-chips we have (one v5e here; the 8-chip pod target divides per-chip).
+Headline metric (BASELINE.json): AlexNet ImageNet images/sec, BSP. The
+authoritative target is "match 8xP100 BSP wall-clock on ImageNet
+AlexNet"; 8xP100 AlexNet BSP throughput is ESTIMATED at ~8000 img/s
+(fp32 cuDNN era, near-linear scaling per arXiv:1605.08325 — no published
+number survives, see BASELINE.md). vs_baseline = img/s / 8000 against
+the FULL 8-GPU cluster number, deliberately NOT normalized per chip
+(same semantics as BENCH_r01/r02): a single v5e already exceeding the
+8xP100 cluster is the headline claim, and vs_baseline > 1 states it.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Modes (default ``compute`` keeps the driver contract: the LAST stdout
+line is ONE JSON object {"metric", "value", "unit", "vs_baseline", ...}):
+
+  python bench.py                  # compute: fused train steps, synthetic batch
+  python bench.py --mode e2e       # full run_training over disk shards +
+                                   #   PrefetchLoader; reports wait fraction
+  python bench.py --mode scaling   # 1..8-device weak-scaling table on the
+                                   #   virtual CPU mesh (comm-overhead audit);
+                                   #   writes SCALING.json
+
+Beyond img/s, compute mode reports achieved TFLOP/s and MFU from XLA's
+cost analysis of the compiled program (utils/flops.py) — the reference
+never measured utilization (SURVEY.md §5.1); the BASELINE scaling-
+efficiency metric needs it.
 """
 
+import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
+BASELINE_IMG_S = 8000.0  # ESTIMATED 8xP100 AlexNet BSP (BASELINE.md)
 
-BASELINE_IMG_S = 8000.0  # 8xP100 AlexNet BSP (BASELINE.md authoritative target)
+
+def _measure(runner, args, sync_leaf, trials=3):
+    """Best wall-clock of ``trials`` invocations (post-warmup)."""
+    out = runner(*args)
+    jax_block(sync_leaf(out))
+    best = None
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        out = runner(*args)
+        jax_block(sync_leaf(out))
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return best
 
 
-def main() -> int:
+def jax_block(x):
+    import jax
+
+    jax.block_until_ready(x)
+
+
+def bench_compute(steps: int = 20, trials: int = 3) -> dict:
+    """Fused-step device throughput: fwd+bwd+sync+update, input pipeline
+    excluded (see e2e mode for the honest framework number)."""
     import jax
     import jax.numpy as jnp
 
     from theanompi_tpu.models.alex_net import AlexNet
     from theanompi_tpu.parallel import make_mesh
     from theanompi_tpu.parallel.mesh import put_global_batch
-
-    from theanompi_tpu.train import make_multi_step, make_train_step, init_train_state
     from theanompi_tpu.parallel.strategies import get_strategy
+    from theanompi_tpu.train import init_train_state, make_multi_step, make_train_step
+    from theanompi_tpu.utils.flops import compiled_flops, peak_flops
 
     n_dev = len(jax.devices())
     # reference recipe: batch 128/worker (SURVEY.md §2.1 AlexNet)
     batch = 128 * n_dev
     model = AlexNet(AlexNet.default_recipe().replace(batch_size=batch))
     mesh = make_mesh(n_dev)
-    steps = 20
 
-    # the full BSP train step (fwd+bwd+sync+update), k steps fused into
-    # one program so host dispatch latency doesn't pollute the measurement
     if n_dev == 1:
+        single = jax.jit(make_train_step(model))
         runner = jax.jit(make_multi_step(make_train_step(model), steps))
     else:
         from jax.sharding import PartitionSpec as P
 
         base = make_train_step(model, grad_sync=get_strategy("psum", "data", n_dev))
-        runner = jax.jit(
-            jax.shard_map(
-                make_multi_step(base, steps),
-                mesh=mesh,
-                in_specs=(P(), P("data"), P("data"), P()),
-                out_specs=(P(), P()),
-                check_vma=False,
-            )
+        specs = dict(
+            mesh=mesh,
+            in_specs=(P(), P("data"), P("data"), P()),
+            out_specs=(P(), P()),
+            check_vma=False,
         )
+        single = jax.jit(jax.shard_map(base, **specs))
+        runner = jax.jit(jax.shard_map(make_multi_step(base, steps), **specs))
 
     state = init_train_state(model, jax.random.PRNGKey(0))
     rng = np.random.RandomState(0)
-    x = put_global_batch(
-        mesh, jnp.asarray(rng.randn(batch, 227, 227, 3), jnp.float32)
-    )
+    x = put_global_batch(mesh, jnp.asarray(rng.randn(batch, 227, 227, 3), jnp.float32))
     y = put_global_batch(mesh, jnp.asarray(rng.randint(0, 1000, batch), jnp.int32))
+    args = (state, x, y, jax.random.PRNGKey(1))
 
-    # warmup / compile
-    state, metrics = runner(state, x, y, jax.random.PRNGKey(1))
-    jax.block_until_ready(metrics["loss"])
-
-    best = None
-    for trial in range(3):
-        t0 = time.perf_counter()
-        state, metrics = runner(state, x, y, jax.random.PRNGKey(2 + trial))
-        jax.block_until_ready(metrics["loss"])
-        dt = time.perf_counter() - t0
-        best = dt if best is None else min(best, dt)
-
+    # XLA's cost analysis counts a scan body ONCE regardless of trip
+    # count (measured), so take one step's FLOPs and multiply
+    flops_step = compiled_flops(single, *args)
+    flops_total = flops_step * steps if flops_step else None
+    peak_bound = peak_flops()
+    best = _measure(runner, args, lambda out: out[1]["loss"], trials)
     img_s = steps * batch / best
-    print(
-        json.dumps(
-            {
-                "metric": f"alexnet_imagenet_bsp_images_per_sec_{n_dev}chip",
-                "value": round(img_s, 1),
-                "unit": "images/sec",
-                "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
-            }
+
+    # Physics guard: a transient backend fault can make calls return
+    # without executing (observed once on the tunneled chip: 21M img/s).
+    # Anything beyond the 100%-MFU bound is impossible — re-measure.
+    if flops_step and peak_bound:
+        max_img_s = peak_bound * batch / flops_step
+        for _ in range(3):
+            if img_s <= max_img_s:
+                break
+            time.sleep(5)
+            best = _measure(runner, args, lambda out: out[1]["loss"], trials)
+            img_s = steps * batch / best
+        else:
+            raise RuntimeError(
+                f"measured {img_s:.0f} img/s exceeds the 100%-MFU bound "
+                f"{max_img_s:.0f} — backend not actually executing"
+            )
+    flops_s = flops_total / best if flops_total else None
+    peak = peak_flops()
+    result = {
+        "metric": f"alexnet_imagenet_bsp_images_per_sec_{n_dev}chip",
+        "value": round(img_s, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
+        "baseline_estimated": True,
+        "n_devices": n_dev,
+        "device_kind": jax.devices()[0].device_kind,
+        "tflops_per_sec": round(flops_s / 1e12, 2) if flops_s else None,
+        "mfu": round(flops_s / peak, 4) if (flops_s and peak) else None,
+        "batch": batch,
+    }
+    return result
+
+
+def bench_e2e(max_steps: int = 48, batch: int = 0) -> dict:
+    """The honest framework benchmark: run_training end-to-end — disk
+    shards -> mmap gather -> crop/mirror/normalize -> PrefetchLoader ->
+    H2D -> fused step. The reference's headline claim was "I/O fully
+    hidden behind compute" (SURVEY.md §6); wait_frac measures it.
+    ``batch=0``: recipe batch (128) per visible device."""
+    import tempfile
+
+    import jax
+
+    from theanompi_tpu.data.imagenet import write_shards
+    from theanompi_tpu.launch.worker import run_training
+    from theanompi_tpu.models.alex_net import AlexNet
+
+    n_dev = len(jax.devices())
+    batch = batch or 128 * n_dev
+    rng = np.random.RandomState(0)
+    n_train = max(2048, 8 * batch)
+    with tempfile.TemporaryDirectory(prefix="tmpi_bench_") as d:
+        write_shards(
+            d, "train",
+            rng.randint(0, 256, size=(n_train, 256, 256, 3)).astype(np.uint8),
+            rng.randint(0, 1000, size=n_train).astype(np.int64),
+            shard_size=1024,
         )
-    )
+        write_shards(
+            d, "val",
+            rng.randint(0, 256, size=(256, 256, 256, 3)).astype(np.uint8),
+            rng.randint(0, 1000, size=256).astype(np.int64),
+            shard_size=256,
+        )
+        summary = run_training(
+            rule="bsp",
+            model_cls=AlexNet,
+            dataset="imagenet",
+            dataset_kwargs={"root": d},
+            recipe_overrides={"batch_size": batch},
+            n_epochs=max(1, max_steps // (n_train // batch)),
+            max_steps=max_steps,
+            print_freq=0,
+            return_recorder=True,
+        )
+    rec = summary["recorder"]
+    # drop the first epoch's first steps (compile) via last-n means
+    n = max(4, max_steps // 2)
+    step_t = rec.mean_time("step", n)
+    wait_t = rec.mean_time("wait", n)
+    img_s = batch / (step_t + wait_t) if (step_t + wait_t) else 0.0
+    return {
+        "metric": f"alexnet_e2e_images_per_sec_{n_dev}chip",
+        "value": round(img_s, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
+        "baseline_estimated": True,
+        "wait_ms": round(1000 * wait_t, 2),
+        "step_ms": round(1000 * step_t, 2),
+        "wait_frac": round(wait_t / (step_t + wait_t), 4) if step_t else None,
+        "batch": batch,
+        "max_steps": max_steps,
+    }
+
+
+_SCALING_PROBE = """
+# per-step timing, no scan fusion: XLA:CPU compiles a k-step scan of a
+# conv model pathologically slowly (~5 min measured), and CPU dispatch
+# overhead is negligible anyway
+import os, jax, json, time
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np, jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from theanompi_tpu.models.cifar10 import Cifar10_model
+from theanompi_tpu.parallel import make_mesh
+from theanompi_tpu.parallel.mesh import put_global_batch
+from theanompi_tpu.parallel.strategies import get_strategy
+from theanompi_tpu.train import init_train_state, make_train_step
+n_dev = {n}; steps = {steps}
+batch = 512  # TOTAL batch fixed across n (fixed-work overhead audit)
+model = Cifar10_model(Cifar10_model.default_recipe().replace(batch_size=batch))
+mesh = make_mesh(n_dev)
+if n_dev == 1:
+    runner = jax.jit(make_train_step(model))
+else:
+    base = make_train_step(model, grad_sync=get_strategy('psum', 'data', n_dev))
+    runner = jax.jit(jax.shard_map(base, mesh=mesh,
+        in_specs=(P(), P('data'), P('data'), P()), out_specs=(P(), P()), check_vma=False))
+state = init_train_state(model, jax.random.PRNGKey(0))
+r = np.random.RandomState(0)
+x = put_global_batch(mesh, jnp.asarray(r.randn(batch, 32, 32, 3), jnp.float32))
+y = put_global_batch(mesh, jnp.asarray(r.randint(0, 10, batch), jnp.int32))
+state, m = runner(state, x, y, jax.random.PRNGKey(1)); jax.block_until_ready(m['loss'])
+best = None
+for trial in range(3):
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, m = runner(state, x, y, jax.random.PRNGKey(2 + i))
+    jax.block_until_ready(m['loss'])
+    best = min(best or 1e9, time.perf_counter() - t0)
+print(json.dumps({{'n': n_dev, 'img_s': steps * batch / best}}))
+"""
+
+
+def bench_scaling(ns=(1, 2, 4, 8), steps: int = 4) -> dict:
+    """Fixed-work (strong-scaling) overhead audit on the virtual CPU
+    mesh. All virtual devices share the same host cores, so total FLOPs
+    throughput is invariant in n — which makes any slowdown vs n=1 a
+    direct measurement of the partition + collective overhead the
+    framework adds per step. (Weak scaling per-device throughput is
+    meaningless here: n=8 splits the same cores 8 ways.) Run on a real
+    pod for the true BASELINE scaling-efficiency number; this mode
+    guards against framework-inserted overhead regressions."""
+    rows = []
+    for n in ns:  # sequential: concurrent probes contend for host cores
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "") + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+        env["JAX_PLATFORMS"] = "cpu"
+        env["TMPI_FORCE_PLATFORM"] = "cpu"
+        p = subprocess.run(
+            [sys.executable, "-c", _SCALING_PROBE.format(n=n, steps=steps)],
+            env=env, capture_output=True, text=True, timeout=900,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if p.returncode != 0:
+            sys.stderr.write(p.stderr[-2000:])
+            raise RuntimeError(f"scaling probe n={n} failed")
+        rows.append(json.loads(p.stdout.strip().splitlines()[-1]))
+
+    base = rows[0]["img_s"]
+    table = [
+        {
+            "n_devices": r["n"],
+            "images_per_sec": round(r["img_s"], 1),
+            "efficiency": round(r["img_s"] / base, 4),  # t(1)/t(n), work fixed
+        }
+        for r in rows
+    ]
+    result = {
+        "metric": "cifar10_cnn_bsp_fixed_work_efficiency_cpu_mesh",
+        "value": table[-1]["efficiency"],
+        "unit": "t(n=1)/t(n) at fixed total batch",
+        "vs_baseline": round(table[-1]["efficiency"] / 0.90, 4),  # target >=90%
+        "table": table,
+        "note": "virtual CPU mesh, shared host cores, total work fixed: "
+        "deviation from 1.0 = partition/collective overhead the framework "
+        "adds per step (NOT chip scaling; run on a pod for that)",
+    }
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)), "SCALING.json"), "w") as f:
+        json.dump(result, f, indent=1)
+    return result
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--mode", choices=["compute", "e2e", "scaling"], default="compute")
+    ap.add_argument("--steps", type=int, default=None)
+    args = ap.parse_args()
+
+    if args.mode == "compute":
+        result = bench_compute(steps=args.steps or 20)
+    elif args.mode == "e2e":
+        result = bench_e2e(max_steps=args.steps or 48)
+    else:
+        result = bench_scaling(steps=args.steps or 4)
+    print(json.dumps(result))
     return 0
 
 

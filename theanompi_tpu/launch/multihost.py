@@ -135,6 +135,8 @@ def spawn_local(
             break
         if deadline is not None and _time.monotonic() > deadline:
             _kill_survivors()
+            for p in procs:  # reap — no zombie children on the timeout path
+                p.wait()
             for t in drains:
                 t.join(timeout=5)
             raise subprocess.TimeoutExpired([sys.executable, *argv], timeout)

@@ -56,6 +56,7 @@ def run_training(
     resume: bool = False,
     print_freq: int = 40,
     prefetch_depth: int = 2,
+    return_recorder: bool = False,
     # rule-specific kwargs (EASGD avg_freq etc.) forwarded to the rule's
     # step builder
     **rule_kwargs: Any,
@@ -278,4 +279,6 @@ def run_training(
     summary["images_per_sec"] = (
         batch / rec.mean_time("step", 50) if rec.mean_time("step", 50) else 0.0
     )
+    if return_recorder:
+        summary["recorder"] = rec
     return summary

@@ -1,0 +1,69 @@
+"""FLOP accounting and MFU (model FLOPs utilization).
+
+The reference had no FLOPs accounting at all — its recorder reported
+images/sec only (reference: ``lib/recorder.py``, SURVEY.md §5.1). On TPU
+the honest scaling story needs achieved TFLOP/s vs the chip's peak, so
+the bench and recorder report MFU alongside img/s (BASELINE metric
+"scaling eff" is defined in those terms).
+
+FLOPs come from XLA's own cost model on the COMPILED program
+(``Compiled.cost_analysis()``) — the same HLO the chip executes, so
+fusion/rematerialization are accounted for. Peak numbers are a small
+device-kind table (public spec-sheet bf16 peaks); unknown devices (CPU
+test meshes) report ``mfu=None`` rather than a made-up number.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# public spec-sheet dense bf16 peak FLOP/s per chip; substring-matched
+# against jax.Device.device_kind (ORDER MATTERS: first match wins)
+_PEAK_BF16 = (
+    ("v5 lite", 197e12),  # v5e ("TPU v5 lite")
+    ("v5litepod", 197e12),
+    ("v5e", 197e12),
+    ("v6 lite", 918e12),  # v6e / Trillium
+    ("v6e", 918e12),
+    ("v5p", 459e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def peak_flops(device=None) -> Optional[float]:
+    """Per-chip peak bf16 FLOP/s for ``device`` (default: first visible
+    device); None when unknown (e.g. CPU)."""
+    import jax
+
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, peak in _PEAK_BF16:
+        if key in kind:
+            return peak
+    return None
+
+
+def compiled_flops(jitted, *args, **kwargs) -> Optional[float]:
+    """Total FLOPs of one invocation of an already-jitted function, from
+    XLA's cost analysis of the lowered+compiled program. None when the
+    backend provides no cost model."""
+    try:
+        compiled = jitted.lower(*args, **kwargs).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:
+        return None
+
+
+def mfu(flops_per_sec: Optional[float], device=None) -> Optional[float]:
+    peak = peak_flops(device)
+    if not peak or not flops_per_sec:
+        return None
+    return flops_per_sec / peak
