@@ -115,3 +115,18 @@ def test_tmpi_cli(tmp_path, capsys):
 def test_resolve_model_short_name():
     assert resolve_model("wrn", "WRN_16_4").name == "wrn_16_4"
     assert resolve_model("cifar10", "Cifar10_model").name == "cifar10"
+
+
+def test_profile_trace_capture(tmp_path):
+    """--profile-dir must produce a real jax.profiler trace (SURVEY §5.1
+    TPU equivalent: the in-step comm/compute split comes from the XLA
+    trace, not host brackets)."""
+    prof = tmp_path / "trace"
+    # 2 steps/epoch (64/32): the capture window [2, 4) spans epochs,
+    # which profile_tick must handle (global step, not per-epoch)
+    run_training(
+        rule="bsp", model_cls=WRN_16_4, max_steps=8, n_epochs=4,
+        profile_dir=str(prof), profile_steps=2, **_TINY,
+    )
+    produced = list(prof.rglob("*.xplane.pb")) + list(prof.rglob("*.trace.json.gz"))
+    assert produced, f"no trace files under {prof}: {list(prof.rglob('*'))}"

@@ -49,6 +49,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--resume", action="store_true")
     p.add_argument("--print-freq", type=int, default=40)
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler device trace of a few steps "
+                        "into this dir (view with tensorboard; the in-step "
+                        "comm/compute split the reference read from host "
+                        "brackets)")
+    p.add_argument("--profile-steps", type=int, default=4)
     p.add_argument("--avg-freq", type=int, default=None,
                    help="EASGD/GoSGD: steps between exchanges (reference avg_freq)")
     p.add_argument("--alpha", type=float, default=None, help="EASGD elastic rate")
@@ -173,6 +179,8 @@ def main(argv=None) -> int:
         ckpt_dir=args.ckpt_dir,
         resume=args.resume,
         print_freq=args.print_freq,
+        profile_dir=args.profile_dir,
+        profile_steps=args.profile_steps,
         **rule_kwargs,
     )
     print(json.dumps({k: v for k, v in summary.items() if k != "state"}, default=str))

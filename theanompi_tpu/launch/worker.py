@@ -57,6 +57,8 @@ def run_training(
     print_freq: int = 40,
     prefetch_depth: int = 2,
     return_recorder: bool = False,
+    profile_dir: Optional[str] = None,
+    profile_steps: int = 4,
     # rule-specific kwargs (EASGD avg_freq etc.) forwarded to the rule's
     # step builder
     **rule_kwargs: Any,
@@ -168,6 +170,11 @@ def run_training(
         save_dir=save_dir if jax.process_index() == 0 else None,
         run_name=f"{model.name}_{rule}",
     )
+    if profile_dir and jax.process_index() == 0:
+        # reference: the recorder WAS the profiler (host brackets); the
+        # XLA in-step comm/compute split needs a device trace (§5.1).
+        # Offset is relative to the first tick, so resume is handled.
+        rec.enable_profile(profile_dir, start_offset=2, n_steps=profile_steps)
     rng = jax.random.PRNGKey(seed)
     state = engine.init_state(rng)
     start_epoch = 0
@@ -217,64 +224,70 @@ def run_training(
     # fast-forward past the batches the restored step count already
     # consumed, so data order and epoch accounting stay exact.
     skip_batches = step_count % steps_per_epoch
-    for epoch in range(start_epoch, n_epochs):
-        rec.start_epoch()
-        epoch_steps = 0
-        loader = PrefetchLoader(
-            data.train_epoch(epoch, batch, seed=seed, part=part),
-            place,
-            depth=prefetch_depth,
-        )
-        rec.start("wait")
-        for xg, yg in loader:
-            if skip_batches:
-                skip_batches -= 1
-                continue
-            rec.end("wait")
-            rng, sub = jax.random.split(rng)
-            rec.start("step")
-            state, metrics = engine.train_step(state, xg, yg, sub)
-            rec.end("step", sync=metrics["loss"])
-            step_count += 1
-            epoch_steps += 1
-            # periodic exchange (EASGD avg_freq; reference: worker loop
-            # calling exchanger.exchange() — recorded as 'comm')
-            if engine.exchange_every and step_count % engine.exchange_every == 0:
-                rec.start("comm")
-                state = engine.exchange(state)
-                # sync on a leaf of the exchanged state: without it the
-                # bracket measures only async dispatch and the collective's
-                # real cost bleeds into the next wait/step brackets
-                rec.end("comm", sync=jax.tree_util.tree_leaves(state)[0])
-            rec.train_metrics(step_count, metrics, n_images=batch)
+    # the device trace and the JSONL log must be closed even when a
+    # step raises (OOM, loader failure, Ctrl-C) — close() stops a
+    # live capture and warns if the window never opened
+    try:
+        for epoch in range(start_epoch, n_epochs):
+            rec.start_epoch()
+            epoch_steps = 0
+            loader = PrefetchLoader(
+                data.train_epoch(epoch, batch, seed=seed, part=part),
+                place,
+                depth=prefetch_depth,
+            )
             rec.start("wait")
+            for xg, yg in loader:
+                if skip_batches:
+                    skip_batches -= 1
+                    continue
+                rec.end("wait")
+                rec.profile_tick(step_count)
+                rng, sub = jax.random.split(rng)
+                rec.start("step")
+                state, metrics = engine.train_step(state, xg, yg, sub)
+                rec.end("step", sync=metrics["loss"])
+                step_count += 1
+                epoch_steps += 1
+                # periodic exchange (EASGD avg_freq; reference: worker loop
+                # calling exchanger.exchange() — recorded as 'comm')
+                if engine.exchange_every and step_count % engine.exchange_every == 0:
+                    rec.start("comm")
+                    state = engine.exchange(state)
+                    # sync on a leaf of the exchanged state: without it the
+                    # bracket measures only async dispatch and the collective's
+                    # real cost bleeds into the next wait/step brackets
+                    rec.end("comm", sync=jax.tree_util.tree_leaves(state)[0])
+                rec.train_metrics(step_count, metrics, n_images=batch)
+                rec.start("wait")
+                if max_steps and step_count >= max_steps:
+                    loader.close()
+                    break
+            rec.end("wait")
+            rec.end_epoch(epoch, n_images=epoch_steps * batch)
+
+            # validation (reference: per-epoch val loop on the worker/server)
+            val_accum: dict[str, float] = {}
+            n_val = 0
+            for vx, vy in data.val_epoch(vbatch, part=vpart):
+                vm = engine.eval_step(state, *place((vx, vy), rows=vbatch))
+                for k, v in vm.items():
+                    val_accum[k] = val_accum.get(k, 0.0) + float(v)
+                n_val += 1
+            if n_val:
+                val_metrics = {k: v / n_val for k, v in val_accum.items()}
+                rec.val_metrics(epoch, val_metrics)
+                summary["val"] = val_metrics
+
+            if ckpt_dir and (epoch + 1) % ckpt_every_epochs == 0:
+                save_checkpoint(ckpt_dir, state, step_count, rng=rng)
+            rec.save()
+            summary["epochs"].append(epoch)
             if max_steps and step_count >= max_steps:
-                loader.close()
                 break
-        rec.end("wait")
-        rec.end_epoch(epoch, n_images=epoch_steps * batch)
 
-        # validation (reference: per-epoch val loop on the worker/server)
-        val_accum: dict[str, float] = {}
-        n_val = 0
-        for vx, vy in data.val_epoch(vbatch, part=vpart):
-            vm = engine.eval_step(state, *place((vx, vy), rows=vbatch))
-            for k, v in vm.items():
-                val_accum[k] = val_accum.get(k, 0.0) + float(v)
-            n_val += 1
-        if n_val:
-            val_metrics = {k: v / n_val for k, v in val_accum.items()}
-            rec.val_metrics(epoch, val_metrics)
-            summary["val"] = val_metrics
-
-        if ckpt_dir and (epoch + 1) % ckpt_every_epochs == 0:
-            save_checkpoint(ckpt_dir, state, step_count, rng=rng)
-        rec.save()
-        summary["epochs"].append(epoch)
-        if max_steps and step_count >= max_steps:
-            break
-
-    rec.close()
+    finally:
+        rec.close()
     summary["steps"] = step_count
     summary["images_per_sec"] = (
         batch / rec.mean_time("step", 50) if rec.mean_time("step", 50) else 0.0

@@ -53,6 +53,61 @@ class Recorder:
             os.makedirs(save_dir, exist_ok=True)
             self._jsonl = open(os.path.join(save_dir, f"{run_name}.jsonl"), "a")
 
+    # -- XLA trace capture ---------------------------------------------------
+    # The reference's calc/comm split came from host brackets around
+    # separate Theano/MPI phases (lib/recorder.py). Here the collective
+    # is fused inside one XLA program, so the in-step breakdown comes
+    # from a jax.profiler device trace instead (SURVEY.md §5.1 "TPU
+    # equivalent"): view with tensorboard/xprof to read the comm vs
+    # compute fraction of each step.
+    def enable_profile(
+        self, profile_dir: str, start_offset: int = 2, n_steps: int = 4
+    ) -> None:
+        """Arm a ``jax.profiler`` trace capture of ``n_steps`` steps,
+        starting ``start_offset`` steps after the FIRST
+        :meth:`profile_tick` (relative, so resumed runs still skip the
+        recompile/warmup steps)."""
+        self._prof = {
+            "dir": profile_dir,
+            "offset": int(start_offset),
+            "n": int(n_steps),
+            "state": "armed",
+            "base": None,
+            "started_at": None,
+        }
+
+    def profile_tick(self, step: int) -> None:
+        """Start/stop the armed trace based on the global step count.
+        Call once per training step, before dispatching it."""
+        p = getattr(self, "_prof", None)
+        if p is None or p["state"] == "done":
+            return
+        if p["state"] == "armed":
+            if p["base"] is None:
+                p["base"] = step
+            if step >= p["base"] + p["offset"]:
+                import jax
+
+                os.makedirs(p["dir"], exist_ok=True)
+                jax.profiler.start_trace(p["dir"])
+                p["state"] = "tracing"
+                p["started_at"] = step
+        elif p["state"] == "tracing" and step >= p["started_at"] + p["n"]:
+            self._profile_stop()
+
+    def _profile_stop(self, reason: str = "") -> None:
+        p = self._prof
+        import jax
+
+        jax.profiler.stop_trace()
+        p["state"] = "done"
+        print(
+            f"[rank {self.rank}] wrote XLA trace to {p['dir']}"
+            + (f" ({reason})" if reason else "")
+            + " (view: tensorboard --logdir)",
+            flush=True,
+        )
+
     # -- timing brackets (reference API) ------------------------------------
     def start(self, category: str = "calc") -> None:
         self._t0[category] = time.perf_counter()
@@ -99,6 +154,11 @@ class Recorder:
         self.epoch_start = time.perf_counter()
 
     def end_epoch(self, epoch: int, n_images: int = 0) -> float:
+        p = getattr(self, "_prof", None)
+        if p is not None and p["state"] == "tracing":
+            # never let the trace run through validation/checkpoint I/O —
+            # it exists to read the train-step comm/compute split
+            self._profile_stop("stopped at epoch end")
         dt = time.perf_counter() - (self.epoch_start or time.perf_counter())
         rec = {"epoch": int(epoch), "seconds": dt}
         if n_images:
@@ -154,6 +214,17 @@ class Recorder:
             return pickle.load(f)
 
     def close(self) -> None:
+        p = getattr(self, "_prof", None)
+        if p is not None and p["state"] == "tracing":  # run ended mid-capture
+            self._profile_stop("run ended mid-capture")
+        elif p is not None and p["state"] == "armed":
+            print(
+                f"[rank {self.rank}] WARNING: profile was armed but the run "
+                f"ended before the capture window opened — no trace in "
+                f"{p['dir']} (need > {p['offset']} steps)",
+                flush=True,
+            )
+            p["state"] = "done"
         if self._jsonl:
             self._jsonl.close()
             self._jsonl = None
