@@ -33,6 +33,7 @@ from typing import Iterator, Optional
 import numpy as np
 
 from theanompi_tpu.data.datasets import Dataset, register_dataset
+from theanompi_tpu import native
 
 
 def write_shards(
@@ -70,6 +71,7 @@ class ImageNet_data(Dataset):
     ):
         base = self._find(root)
         self.crop = crop
+        self.train_mirror = train_mirror
         self.image_shape = (crop, crop, 3)
         self._train = self._index(base, "train")
         self._val = self._index(base, "val")
@@ -142,7 +144,11 @@ class ImageNet_data(Dataset):
                 if part is not None:
                     idx = idx[part]
                 idx = np.sort(idx)
-                x = np.asarray(images[idx])  # mmap gather
+                # mmap gather: multithreaded memcpy when the native lib
+                # built (reference loader's hkl read), numpy otherwise
+                x = native.gather_rows(images, idx)
+                if x is None:
+                    x = np.asarray(images[idx])
                 y = labels[idx].astype(np.int32)
                 yield self._preprocess(x, rng, train=True), y
 
@@ -158,34 +164,50 @@ class ImageNet_data(Dataset):
                     x, y = x[part], y[part]
                 yield self._preprocess(x, None, train=False), y
 
+    def _mean_for_crop(self, c: int) -> np.ndarray:
+        """The mean as applied post-crop: scalar / per-channel pass
+        through; a full-plane mean is CENTER-cropped to the crop size for
+        every sample (the plane is smooth; identical to the numpy path)."""
+        if np.ndim(self.mean) == 3 and self.mean.shape[0] != c:
+            return self.mean[
+                (self.mean.shape[0] - c) // 2 : (self.mean.shape[0] - c) // 2 + c,
+                (self.mean.shape[1] - c) // 2 : (self.mean.shape[1] - c) // 2 + c,
+            ]
+        return np.asarray(self.mean, np.float32)
+
     def _preprocess(
         self, x: np.ndarray, rng: Optional[np.random.RandomState], train: bool
     ) -> np.ndarray:
         """Random crop + mirror + mean/scale (reference:
-        ``proc_load_mpi`` crop/mirror funcs). Val: center crop."""
+        ``proc_load_mpi`` crop/mirror funcs). Val: center crop. The hot
+        loop runs in the native C++ kernel when built (same RNG draws,
+        bit-identical output — tests/test_native.py), numpy otherwise."""
         n, h, w, _ = x.shape
         c = self.crop
         if train:
             offs = rng.randint(0, (h - c + 1) * (w - c + 1), size=n)
             oy, ox = offs // (w - c + 1), offs % (w - c + 1)
+            # draw even when mirroring is off: the data order downstream
+            # of the RNG must not depend on the train_mirror flag
             flips = rng.rand(n) < 0.5
+            if not self.train_mirror:
+                flips = np.zeros(n, bool)
         else:
             oy = np.full(n, (h - c) // 2)
             ox = np.full(n, (w - c) // 2)
             flips = np.zeros(n, bool)
+        m = self._mean_for_crop(c)
+        if x.dtype == np.uint8:
+            out = native.crop_mirror_normalize(
+                x, oy, ox, flips, c, m, float(self.scale)
+            )
+            if out is not None:
+                return out
         rows = oy[:, None] + np.arange(c)
         cols = ox[:, None] + np.arange(c)
         cols = np.where(flips[:, None], cols[:, ::-1], cols)
         out = x[np.arange(n)[:, None, None], rows[:, :, None], cols[:, None, :]]
-        out = out.astype(np.float32)
-        if np.ndim(self.mean) == 3 and self.mean.shape[0] != c:
-            m = self.mean[
-                (self.mean.shape[0] - c) // 2 : (self.mean.shape[0] - c) // 2 + c,
-                (self.mean.shape[1] - c) // 2 : (self.mean.shape[1] - c) // 2 + c,
-            ]
-        else:
-            m = self.mean
-        return (out - m) * self.scale
+        return (out.astype(np.float32) - m) * self.scale
 
 
 class Imagenet_synthetic(Dataset):

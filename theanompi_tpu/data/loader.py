@@ -47,6 +47,12 @@ class PrefetchLoader:
         self._thread.start()
 
     def _run(self, it: Iterator) -> None:
+        # hwloc equivalent (reference: lib/hwloc_utils.py): pin the
+        # preprocessing thread to the configured cpuset so it stays off
+        # the controller/XLA-runtime cores; no-op unless TMPI_LOADER_CPUS
+        from theanompi_tpu.utils.hostaffinity import pin_thread
+
+        pin_thread()
         try:
             for batch in it:
                 if self._stop.is_set():

@@ -1,0 +1,72 @@
+"""Host CPU binding — the ``hwloc`` equivalent.
+
+The reference bound each worker process (and its spawned loader child)
+to cores near its GPU for NUMA locality (reference:
+``lib/hwloc_utils.py``; SURVEY.md §2.1 "CPU binding"). On TPU the
+runtime owns accelerator placement, so the only binding that matters is
+the HOST side: keep the input-pipeline (prefetch/preprocess) threads off
+the cores the controller and the XLA host runtime are using.
+
+Config is one env var, same spirit as the reference's launcher flags:
+
+    TMPI_LOADER_CPUS="4-7"     # cpuset for loader threads (range/list)
+    TMPI_LOADER_CPUS="2,3,6"   #   ...explicit list form
+
+Unset means no pinning (the OS scheduler usually does fine on a
+dedicated host; pinning matters when the controller shares the host
+with other ranks or heavy services). ``parse_cpuset``/``pin_thread``
+are safe no-ops on platforms without ``sched_setaffinity``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def parse_cpuset(spec: str) -> set[int]:
+    """``"0-3,8,10-11"`` -> {0,1,2,3,8,10,11} (taskset list syntax)."""
+    cpus: set[int] = set()
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "-" in part:
+            lo, hi = part.split("-", 1)
+            cpus.update(range(int(lo), int(hi) + 1))
+        else:
+            cpus.add(int(part))
+    if not cpus:
+        raise ValueError(f"empty cpuset {spec!r}")
+    return cpus
+
+
+def loader_cpuset() -> Optional[set[int]]:
+    """The configured loader cpuset, intersected with this process's
+    affinity mask (a cpuset outside the container's share is an error
+    the kernel would reject); None when unconfigured."""
+    spec = os.environ.get("TMPI_LOADER_CPUS")
+    if not spec:
+        return None
+    want = parse_cpuset(spec)
+    try:
+        allowed = os.sched_getaffinity(0)
+    except AttributeError:
+        return None
+    usable = want & allowed
+    return usable or None
+
+
+def pin_thread(cpus: Optional[set[int]] = None) -> bool:
+    """Pin the CALLING thread to ``cpus`` (default: the configured
+    loader cpuset). Returns True iff a pin was applied. Linux pins
+    per-thread when called from within that thread."""
+    if cpus is None:
+        cpus = loader_cpuset()
+    if not cpus:
+        return False
+    try:
+        os.sched_setaffinity(0, cpus)
+        return True
+    except (AttributeError, OSError):
+        return False
