@@ -1,0 +1,142 @@
+"""tools/make_shards: JPEG tree -> shard conversion (reference: the
+offline pipeline that produced the 256x256 uint8 hkl batches + img_mean
+consumed by ``lib/proc_load_mpi.py``; SURVEY.md §7 hard-part 3)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image  # noqa: E402
+
+from theanompi_tpu.tools.make_shards import convert_split, main  # noqa: E402
+
+
+def _make_tree(root, split, classes, per_class, seed=0, wh=(48, 40)):
+    r = np.random.RandomState(seed)
+    for cls in classes:
+        d = root / split / cls
+        d.mkdir(parents=True)
+        for i in range(per_class):
+            w, h = wh
+            arr = r.randint(0, 256, (h + i, w + 2 * i, 3)).astype(np.uint8)
+            Image.fromarray(arr).save(d / f"img_{i}.jpeg")
+
+
+def test_convert_split_roundtrip(tmp_path):
+    src = tmp_path / "jpeg"
+    out = tmp_path / "shards"
+    classes = ["n01", "n02", "n03"]
+    _make_tree(src, "train", classes, per_class=5)
+    _make_tree(src, "val", classes, per_class=2, seed=1)
+
+    info = convert_split(
+        str(src), str(out), "train",
+        size=32, shard_size=8, workers=2, compute_mean=True,
+    )
+    assert info["n_images"] == 15
+    assert info["n_shards"] == 2  # 8 + 7
+    assert info["class_index"] == {c: i for i, c in enumerate(classes)}
+    convert_split(str(src), str(out), "val", size=32, shard_size=8,
+                  class_index=info["class_index"])
+
+    # shards have the documented format and load through ImageNet_data
+    x0 = np.load(out / "train_images_0000.npy")
+    assert x0.shape == (8, 32, 32, 3) and x0.dtype == np.uint8
+    y0 = np.load(out / "train_labels_0000.npy")
+    assert set(np.unique(y0)).issubset({0, 1, 2})
+    mean = np.load(out / "mean.npy")
+    assert mean.shape == (32, 32, 3) and mean.dtype == np.float32
+    assert 0 < mean.mean() < 255
+    idx = json.loads((out / "class_index.json").read_text())
+    assert idx == {"n01": 0, "n02": 1, "n03": 2}
+
+    from theanompi_tpu.data.imagenet import ImageNet_data
+
+    ds = ImageNet_data(root=str(out), crop=27)
+    ds.n_classes = 3
+    batches = list(ds.train_epoch(0, 4, seed=0))
+    assert len(batches) == 3  # 8//4 + 7//4
+    xb, yb = batches[0]
+    assert xb.shape == (4, 27, 27, 3) and xb.dtype == np.float32
+
+
+def test_shards_are_class_mixed(tmp_path):
+    """The one-shot shuffle must mix classes within shards — batches
+    never span shards, so a sorted shard biases every batch."""
+    src = tmp_path / "jpeg"
+    out = tmp_path / "shards"
+    _make_tree(src, "train", ["a", "b"], per_class=16)
+    convert_split(str(src), str(out), "train", size=16, shard_size=16, workers=1)
+    y0 = np.load(out / "train_labels_0000.npy")
+    assert len(set(np.unique(y0))) == 2, "shard 0 contains one class only"
+
+
+def test_cli_main(tmp_path, capsys):
+    src = tmp_path / "jpeg"
+    out = tmp_path / "shards"
+    _make_tree(src, "train", ["a", "b"], per_class=3)
+    rc = main([str(src), str(out), "--size", "16", "--shard-size", "4",
+               "--workers", "1", "--splits", "train"])
+    assert rc == 0
+    lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert lines[-1]["n_images"] == 6 and lines[-1]["n_classes"] == 2
+    assert (out / "mean.npy").exists()
+
+
+def test_corrupt_file_skipped(tmp_path):
+    src = tmp_path / "jpeg"
+    out = tmp_path / "shards"
+    _make_tree(src, "train", ["a"], per_class=3)
+    (src / "train" / "a" / "broken.jpeg").write_bytes(b"not a jpeg")
+    info = convert_split(str(src), str(out), "train", size=16, shard_size=8)
+    assert info["n_images"] == 3  # corrupt file skipped, not fatal
+
+
+def test_resize_convention(tmp_path):
+    """Shorter side -> size, center crop: a wide solid-color image with
+    distinct side bands must keep its center band."""
+    src = tmp_path / "jpeg" / "train" / "x"
+    src.mkdir(parents=True)
+    arr = np.zeros((32, 96, 3), np.uint8)
+    arr[:, 32:64] = 200  # center band bright
+    Image.fromarray(arr).save(src / "img.png")  # png: lossless
+    out = tmp_path / "shards"
+    convert_split(str(tmp_path / "jpeg"), str(out), "train", size=32, shard_size=4)
+    x = np.load(out / "train_images_0000.npy")[0]
+    assert x.shape == (32, 32, 3)
+    assert x.mean() > 150, "center crop lost the bright center band"
+
+
+def test_val_labels_pinned_to_train_index(tmp_path):
+    """A split missing a class must keep the TRAIN label ids, and an
+    unknown class in val must be an error — never a silent shift."""
+    src = tmp_path / "jpeg"
+    out = tmp_path / "shards"
+    _make_tree(src, "train", ["a", "b", "c"], per_class=2)
+    _make_tree(src, "val", ["a", "c"], per_class=2, seed=1)  # no 'b'
+    rc = main([str(src), str(out), "--size", "16", "--shard-size", "8",
+               "--workers", "1", "--splits", "val,train"])  # order-proof
+    assert rc == 0
+    yv = np.load(out / "val_labels_0000.npy")
+    assert set(np.unique(yv)) == {0, 2}, "val 'c' must keep train label 2"
+    idx = json.loads((out / "class_index.json").read_text())
+    assert idx == {"a": 0, "b": 1, "c": 2}
+
+    _make_tree(src, "val2", ["zz"], per_class=1)
+    with pytest.raises(ValueError, match="absent from the train"):
+        convert_split(str(src), str(out), "val2", size=16, shard_size=8,
+                      class_index=idx)
+
+
+def test_loader_surfaces_bad_cpuset(monkeypatch):
+    """A malformed TMPI_LOADER_CPUS must raise at the consumer, not
+    deadlock it (the pin runs inside the producer's try block)."""
+    from theanompi_tpu.data.loader import PrefetchLoader
+
+    monkeypatch.setenv("TMPI_LOADER_CPUS", "4-")
+    loader = PrefetchLoader([([1], [2])], place=lambda b: b)
+    with pytest.raises(ValueError):
+        next(iter(loader))

@@ -36,6 +36,16 @@ from theanompi_tpu.data.datasets import Dataset, register_dataset
 from theanompi_tpu import native
 
 
+def shard_path(directory: str, split: str, kind: str, i: int) -> str:
+    """Canonical shard filename — the ONE place the naming convention
+    lives (write_shards, tools/make_shards, and the index glob agree)."""
+    return os.path.join(directory, f"{split}_{kind}_{i:04d}.npy")
+
+
+def shard_glob(directory: str, split: str, kind: str) -> str:
+    return os.path.join(directory, f"{split}_{kind}_*.npy")
+
+
 def write_shards(
     directory: str,
     split: str,
@@ -50,8 +60,8 @@ def write_shards(
     n_shards = -(-n // shard_size)
     for i in range(n_shards):
         sl = slice(i * shard_size, (i + 1) * shard_size)
-        np.save(os.path.join(directory, f"{split}_images_{i:04d}.npy"), images[sl])
-        np.save(os.path.join(directory, f"{split}_labels_{i:04d}.npy"), labels[sl])
+        np.save(shard_path(directory, split, "images", i), images[sl])
+        np.save(shard_path(directory, split, "labels", i), labels[sl])
     return n_shards
 
 
@@ -90,7 +100,7 @@ class ImageNet_data(Dataset):
     def _find(cls, root: Optional[str]) -> str:
         env = os.environ.get("IMAGENET_DIR", "")
         for c in ([root] if root else [p for p in (env, *cls.SEARCH) if p]):
-            if c and glob.glob(os.path.join(c, "train_images_*.npy")):
+            if c and glob.glob(shard_glob(c, "train", "images")):
                 return c
         raise FileNotFoundError(
             "ImageNet shards not found; set $IMAGENET_DIR to a directory of "
@@ -101,7 +111,7 @@ class ImageNet_data(Dataset):
     @staticmethod
     def _index(base: str, split: str) -> list[tuple[str, str, int]]:
         shards = []
-        for img_path in sorted(glob.glob(os.path.join(base, f"{split}_images_*.npy"))):
+        for img_path in sorted(glob.glob(shard_glob(base, split, "images"))):
             lbl_path = img_path.replace("_images_", "_labels_")
             n = len(np.load(lbl_path, mmap_mode="r"))
             shards.append((img_path, lbl_path, n))

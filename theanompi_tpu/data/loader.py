@@ -47,13 +47,16 @@ class PrefetchLoader:
         self._thread.start()
 
     def _run(self, it: Iterator) -> None:
-        # hwloc equivalent (reference: lib/hwloc_utils.py): pin the
-        # preprocessing thread to the configured cpuset so it stays off
-        # the controller/XLA-runtime cores; no-op unless TMPI_LOADER_CPUS
-        from theanompi_tpu.utils.hostaffinity import pin_thread
-
-        pin_thread()
         try:
+            # hwloc equivalent (reference: lib/hwloc_utils.py): pin the
+            # preprocessing thread to the configured cpuset so it stays
+            # off the controller/XLA-runtime cores; no-op unless
+            # TMPI_LOADER_CPUS is set. Inside the try: a malformed
+            # cpuset must surface as an error at the consumer, not a
+            # dead producer and a consumer blocked forever on the queue.
+            from theanompi_tpu.utils.hostaffinity import pin_thread
+
+            pin_thread()
             for batch in it:
                 if self._stop.is_set():
                     return

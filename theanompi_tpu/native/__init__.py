@@ -55,6 +55,10 @@ def default_threads() -> int:
 
 
 def _build() -> bool:
+    if not os.path.exists(_SRC):
+        # source missing (e.g. wheel without package data): a cached .so
+        # for this host is still trustworthy; otherwise degrade
+        return os.path.exists(_SO)
     if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
         return True
     # pid-unique tmp: N controller processes on one host may race to
@@ -92,10 +96,20 @@ def _load() -> Optional[ctypes.CDLL]:
         if os.environ.get("TMPI_NATIVE", "1") == "0":
             return None
         if not _build():
+            print(
+                "theanompi_tpu.native: C++ loader kernels unavailable "
+                "(g++/source missing?) — using the slower numpy path",
+                flush=True,
+            )
             return None
         try:
             lib = ctypes.CDLL(_SO)
         except OSError:
+            print(
+                f"theanompi_tpu.native: failed to load {_SO} — using the "
+                "slower numpy path",
+                flush=True,
+            )
             return None
         lib.tmpi_crop_mirror_normalize.restype = ctypes.c_int
         lib.tmpi_crop_mirror_normalize.argtypes = [

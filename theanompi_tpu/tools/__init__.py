@@ -1,0 +1,1 @@
+"""Offline data tooling (shard conversion etc.)."""
