@@ -55,7 +55,7 @@ def test_convert_split_roundtrip(tmp_path):
 
     from theanompi_tpu.data.imagenet import ImageNet_data
 
-    ds = ImageNet_data(root=str(out), crop=27)
+    ds = ImageNet_data(root=str(out), crop=27, device_normalize=False)
     ds.n_classes = 3
     batches = list(ds.train_epoch(0, 4, seed=0))
     assert len(batches) == 3  # 8//4 + 7//4

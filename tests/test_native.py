@@ -91,7 +91,7 @@ def test_imagenet_pipeline_native_equals_numpy(tmp_path, monkeypatch):
     write_shards(str(tmp_path), "val", imgs[:16], lbls[:16], shard_size=16)
     np.save(tmp_path / "mean.npy", r.rand(36, 36, 3).astype(np.float32) * 255)
 
-    ds = ImageNet_data(root=str(tmp_path), crop=27)
+    ds = ImageNet_data(root=str(tmp_path), crop=27, device_normalize=False)
     native_batches = [(x.copy(), y.copy()) for x, y in ds.train_epoch(0, 16, seed=5)]
 
     # force the numpy fallback for an identical second pass
@@ -156,8 +156,10 @@ def test_train_mirror_flag_disables_flips(tmp_path):
     write_shards(str(tmp_path), "train", imgs, lbls, shard_size=32)
     write_shards(str(tmp_path), "val", imgs[:8], lbls[:8], shard_size=8)
 
-    on = ImageNet_data(root=str(tmp_path), crop=27, train_mirror=True)
-    off = ImageNet_data(root=str(tmp_path), crop=27, train_mirror=False)
+    on = ImageNet_data(root=str(tmp_path), crop=27, train_mirror=True,
+                       device_normalize=False)
+    off = ImageNet_data(root=str(tmp_path), crop=27, train_mirror=False,
+                        device_normalize=False)
     xa, _ = next(iter(on.train_epoch(0, 16, seed=7)))
     xb, _ = next(iter(off.train_epoch(0, 16, seed=7)))
     # same crops (same RNG draw order), but at least one image mirrored
@@ -169,3 +171,44 @@ def test_train_mirror_flag_disables_flips(tmp_path):
             np.array_equal(xa[i], xb[i])
             or np.array_equal(xa[i], xb[i][:, ::-1])
         )
+
+
+@needs_native
+def test_crop_mirror_u8_matches_numpy():
+    r = np.random.RandomState(5)
+    n, h, w, c, crop = 11, 40, 36, 3, 27
+    x = r.randint(0, 256, (n, h, w, c)).astype(np.uint8)
+    oy = r.randint(0, h - crop + 1, n)
+    ox = r.randint(0, w - crop + 1, n)
+    flips = r.rand(n) < 0.5
+    got = native.crop_mirror_u8(x, oy, ox, flips, crop)
+    assert got is not None and got.dtype == np.uint8
+    rows = oy[:, None] + np.arange(crop)
+    cols = ox[:, None] + np.arange(crop)
+    cols = np.where(flips[:, None], cols[:, ::-1], cols)
+    want = x[np.arange(n)[:, None, None], rows[:, :, None], cols[:, None, :]]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_device_normalize_pipeline_agrees_with_host(tmp_path):
+    """uint8 batches + on-device (x-mean)*scale must equal the host
+    float pipeline after the transform."""
+    from theanompi_tpu.data.imagenet import ImageNet_data, write_shards
+
+    r = np.random.RandomState(6)
+    imgs = r.randint(0, 256, (32, 36, 36, 3)).astype(np.uint8)
+    lbls = r.randint(0, 10, 32).astype(np.int64)
+    write_shards(str(tmp_path), "train", imgs, lbls, shard_size=32)
+    write_shards(str(tmp_path), "val", imgs[:8], lbls[:8], shard_size=8)
+    np.save(tmp_path / "mean.npy", (r.rand(36, 36, 3) * 255).astype(np.float32))
+
+    dev = ImageNet_data(root=str(tmp_path), crop=27)  # default: device path
+    host = ImageNet_data(root=str(tmp_path), crop=27, device_normalize=False)
+    (xd, yd) = next(iter(dev.train_epoch(0, 16, seed=9)))
+    (xh, yh) = next(iter(host.train_epoch(0, 16, seed=9)))
+    assert xd.dtype == np.uint8 and xh.dtype == np.float32
+    np.testing.assert_array_equal(yd, yh)
+    t = dev.device_transform
+    np.testing.assert_allclose(
+        (xd.astype(np.float32) - t["mean"]) * t["scale"], xh, rtol=1e-5, atol=1e-5
+    )

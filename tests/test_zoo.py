@@ -118,7 +118,7 @@ def _fake_shards(tmp_path, n_train=64, n_val=32, size=32):
 
 def test_imagenet_shard_pipeline(tmp_path):
     _fake_shards(tmp_path)
-    data = ImageNet_data(root=str(tmp_path), crop=24)
+    data = ImageNet_data(root=str(tmp_path), crop=24, device_normalize=False)
     assert data.n_train == 64 and data.n_val == 32
     assert data.n_train_batches(16) == 4
 
@@ -149,7 +149,20 @@ def test_imagenet_missing_dir_message(tmp_path, monkeypatch):
 
 def test_imagenet_synthetic_registered():
     data = get_dataset("imagenet_synthetic", n_train=32, n_val=16, crop=32, n_classes=10)
+    # default: device-normalize pipeline — compact uint8 host batches
     x, y = next(data.train_epoch(0, 16))
-    assert x.shape == (16, 32, 32, 3) and x.dtype == np.float32
+    assert x.shape == (16, 32, 32, 3) and x.dtype == np.uint8
+    assert data.device_transform is not None
     vx, _ = next(data.val_epoch(16))
-    assert vx.dtype == np.float32
+    assert vx.dtype == np.uint8
+
+    host = get_dataset(
+        "imagenet_synthetic", n_train=32, n_val=16, crop=32, n_classes=10,
+        device_normalize=False,
+    )
+    hx, _ = next(host.train_epoch(0, 16))
+    assert hx.dtype == np.float32
+    # the two pipelines agree once the device transform is applied
+    np.testing.assert_allclose(
+        (x.astype(np.float32) - 127.5) / 58.0, hx, rtol=1e-6
+    )

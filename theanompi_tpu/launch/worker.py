@@ -137,20 +137,40 @@ def run_training(
     if vbatch % n_dev:
         raise ValueError(f"val batch {vbatch} not divisible by {n_dev} devices")
 
+    # Device-side normalization (dataset opt-in): the loader ships
+    # compact uint8 batches and (x - mean) * scale fuses into the
+    # compiled step — 4x less H2D than float32 (the reference normalized
+    # in the host loader; on TPU the wire is the scarcer resource).
+    input_transform = None
+    dtf = getattr(data, "device_transform", None)
+    if dtf is not None:
+        mean_c = jnp.asarray(dtf["mean"], jnp.float32)
+        scale_c = jnp.float32(dtf["scale"])
+
+        def input_transform(x):
+            return (x.astype(jnp.float32) - mean_c) * scale_c
+
     if rule == "bsp":
         from theanompi_tpu.parallel.bsp import BSPEngine
 
         engine = BSPEngine(
-            model, mesh, steps_per_epoch=steps_per_epoch, strategy=strategy
+            model, mesh, steps_per_epoch=steps_per_epoch, strategy=strategy,
+            input_transform=input_transform,
         )
     elif rule == "easgd":
         from theanompi_tpu.parallel.easgd import EASGDEngine
 
-        engine = EASGDEngine(model, mesh, steps_per_epoch=steps_per_epoch, **rule_kwargs)
+        engine = EASGDEngine(
+            model, mesh, steps_per_epoch=steps_per_epoch,
+            input_transform=input_transform, **rule_kwargs,
+        )
     else:
         from theanompi_tpu.parallel.gosgd import GOSGDEngine
 
-        engine = GOSGDEngine(model, mesh, steps_per_epoch=steps_per_epoch, **rule_kwargs)
+        engine = GOSGDEngine(
+            model, mesh, steps_per_epoch=steps_per_epoch,
+            input_transform=input_transform, **rule_kwargs,
+        )
 
     # Multi-controller: this host produces only its slice of every
     # global batch (reference: per-rank loader feed, lib/proc_load_mpi.py)

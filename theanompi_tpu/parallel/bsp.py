@@ -35,6 +35,7 @@ def make_bsp_train_step(
     strategy: str = "psum",
     axis_name: str = DATA_AXIS,
     donate: bool = True,
+    input_transform=None,
 ):
     """Build the jitted BSP step: ``(state, images, labels, rng) ->
     (state, metrics)`` over global arrays.
@@ -54,7 +55,7 @@ def make_bsp_train_step(
         # backend donated buffers trigger a relayout-recompile and a
         # ~4x steady-state slowdown (measured), and the memory it would
         # save is not binding on one chip.
-        base = make_train_step(model, steps_per_epoch)
+        base = make_train_step(model, steps_per_epoch, input_transform=input_transform)
 
         def single_step(state, images, labels, rng):
             return base(state, images, labels, jax.random.fold_in(rng, 0))
@@ -62,7 +63,9 @@ def make_bsp_train_step(
         return jax.jit(single_step)
 
     grad_sync = get_strategy(strategy, axis_name, n)
-    base_step = make_train_step(model, steps_per_epoch, grad_sync=grad_sync)
+    base_step = make_train_step(
+        model, steps_per_epoch, grad_sync=grad_sync, input_transform=input_transform
+    )
 
     def sharded_step(state: TrainState, images, labels, rng):
         rng = jax.random.fold_in(rng, lax.axis_index(axis_name))
@@ -103,14 +106,17 @@ class BSPEngine:
         steps_per_epoch: int = 1,
         strategy: str = "psum",
         axis_name: str = DATA_AXIS,
+        input_transform=None,
     ):
         self.model = model
         self.mesh = mesh
         self._step = make_bsp_train_step(
             model, mesh, steps_per_epoch=steps_per_epoch, strategy=strategy,
-            axis_name=axis_name,
+            axis_name=axis_name, input_transform=input_transform,
         )
-        self._eval = make_bsp_eval_step(model, mesh, axis_name=axis_name)
+        self._eval = make_bsp_eval_step(
+            model, mesh, axis_name=axis_name, input_transform=input_transform
+        )
 
     def init_state(self, rng):
         return init_train_state(self.model, rng)
@@ -130,9 +136,11 @@ class BSPEngine:
         return int(first_local_value(state.step))
 
 
-def make_bsp_eval_step(model: Model, mesh: Mesh, axis_name: str = DATA_AXIS):
+def make_bsp_eval_step(
+    model: Model, mesh: Mesh, axis_name: str = DATA_AXIS, input_transform=None
+):
     """Jitted eval step over the mesh: metrics averaged across shards."""
-    base = make_eval_step(model)
+    base = make_eval_step(model, input_transform=input_transform)
     if mesh.shape[axis_name] == 1:
         return jax.jit(base)
 

@@ -70,6 +70,7 @@ class EASGDEngine:
         avg_freq: int = 8,
         alpha: Optional[float] = None,
         axis_name: str = DATA_AXIS,
+        input_transform=None,
     ):
         self.model = model
         self.mesh = mesh
@@ -77,8 +78,10 @@ class EASGDEngine:
         self.n = mesh.shape[axis_name]
         self.avg_freq = max(1, avg_freq)
         self.alpha = alpha if alpha is not None else 0.9 / self.n
-        base_step = make_train_step(model, steps_per_epoch)
-        base_eval = make_eval_step(model)
+        base_step = make_train_step(
+            model, steps_per_epoch, input_transform=input_transform
+        )
+        base_eval = make_eval_step(model, input_transform=input_transform)
         ax = axis_name
         a = self.alpha
 

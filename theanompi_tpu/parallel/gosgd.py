@@ -82,6 +82,7 @@ class GOSGDEngine:
         avg_freq: int | None = None,
         gossip_every: int = 1,
         axis_name: str = DATA_AXIS,
+        input_transform=None,
     ):
         self.model = model
         self.mesh = mesh
@@ -92,8 +93,10 @@ class GOSGDEngine:
         self.p_push = float(p_push)
         self.gossip_every = max(1, int(gossip_every))
         self._count: int | None = None
-        base_step = make_train_step(model, steps_per_epoch)
-        base_eval = make_eval_step(model)
+        base_step = make_train_step(
+            model, steps_per_epoch, input_transform=input_transform
+        )
+        base_eval = make_eval_step(model, input_transform=input_transform)
         ax, n, p = axis_name, self.n, float(p_push)
 
         def gossip(params: PyTree, alpha: jax.Array, rng: jax.Array):
