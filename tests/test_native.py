@@ -183,10 +183,9 @@ def test_crop_mirror_u8_matches_numpy():
     flips = r.rand(n) < 0.5
     got = native.crop_mirror_u8(x, oy, ox, flips, crop)
     assert got is not None and got.dtype == np.uint8
-    rows = oy[:, None] + np.arange(crop)
-    cols = ox[:, None] + np.arange(crop)
-    cols = np.where(flips[:, None], cols[:, ::-1], cols)
-    want = x[np.arange(n)[:, None, None], rows[:, :, None], cols[:, None, :]]
+    from theanompi_tpu.data.imagenet import ImageNet_data
+
+    want = ImageNet_data._numpy_crop_mirror(x, oy, ox, flips, crop)
     np.testing.assert_array_equal(got, want)
 
 

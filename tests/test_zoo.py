@@ -166,3 +166,18 @@ def test_imagenet_synthetic_registered():
     np.testing.assert_allclose(
         (x.astype(np.float32) - 127.5) / 58.0, hx, rtol=1e-6
     )
+def test_digits_dataset():
+    """Real-data fixture: sklearn digits as a registered dataset."""
+    import numpy as np
+
+    from theanompi_tpu.data import get_dataset
+
+    ds = get_dataset("digits", size=16)
+    assert ds.image_shape == (16, 16, 3) and ds.n_classes == 10
+    assert ds.n_train + ds.n_val == 1797
+    x, y = next(ds.train_epoch(0, 32))
+    assert x.shape == (32, 16, 16, 3) and x.dtype == np.float32
+    assert y.dtype == np.int32 and set(np.unique(y)).issubset(range(10))
+    # deterministic split: val disjoint sizes stable
+    ds2 = get_dataset("digits", size=16)
+    np.testing.assert_array_equal(ds.y_val, ds2.y_val)

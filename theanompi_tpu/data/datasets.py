@@ -191,9 +191,58 @@ class Cifar10_data(Dataset):
         return padded[np.arange(n)[:, None, None], rows[:, :, None], cols[:, None, :]]
 
 
+class Digits_data(Dataset):
+    """Real image data with zero downloads: sklearn's bundled handwritten
+    digits (1,797 8x8 grayscale images, 10 classes). The smallest REAL
+    dataset available in a no-network environment — used by the
+    committed convergence experiments (experiments/) as evidence the
+    training stack learns actual data, standing in for BASELINE config
+    #1 until CIFAR-10 files are present (see ``Cifar10_data``).
+
+    Images are nearest-upsampled to ``size`` x ``size`` and replicated to
+    3 channels so the CNN zoo applies unchanged; split 80/20
+    deterministic; normalized to zero mean / unit std like the CIFAR
+    recipe.
+    """
+
+    name = "digits"
+
+    def __init__(self, size: int = 16, val_frac: float = 0.2, seed: int = 0):
+        try:
+            from sklearn.datasets import load_digits
+        except ImportError as e:
+            raise ImportError(
+                "dataset 'digits' needs scikit-learn (bundled data); "
+                "use dataset='synthetic' if unavailable"
+            ) from e
+        digits = load_digits()
+        x = digits.images.astype(np.float32)  # [N, 8, 8], values 0..16
+        y = digits.target.astype(np.int32)
+        rep = size // 8
+        if size % 8:
+            raise ValueError(f"size must be a multiple of 8, got {size}")
+        x = x.repeat(rep, axis=1).repeat(rep, axis=2)
+        x = np.stack([x, x, x], axis=-1)  # [N, size, size, 3]
+        self.image_shape = (size, size, 3)
+        self.n_classes = 10
+        rng = np.random.RandomState(seed)
+        order = rng.permutation(len(x))
+        n_val = int(len(x) * val_frac)
+        val_idx, train_idx = order[:n_val], order[n_val:]
+        self.x_train, self.y_train = x[train_idx], y[train_idx]
+        self.x_val, self.y_val = x[val_idx], y[val_idx]
+        # normalization stats from the TRAIN split only (same discipline
+        # as Cifar10_data — no val leakage into the constants)
+        mean = self.x_train.mean()
+        std = self.x_train.std() + 1e-7
+        self.x_train = (self.x_train - mean) / std
+        self.x_val = (self.x_val - mean) / std
+
+
 _REGISTRY = {
     "synthetic": Synthetic_data,
     "cifar10": Cifar10_data,
+    "digits": Digits_data,
 }
 
 

@@ -267,8 +267,12 @@ class Imagenet_synthetic(Dataset):
         self.image_shape = (crop, crop, 3)
         self.n_classes = n_classes
         rng = np.random.RandomState(seed)
+        # the ONE definition of the normalization constants — the
+        # device_transform dict and both host-path conversions use these
+        self.mean = np.float32(127.5)
+        self.scale = np.float32(1.0 / 58.0)
         self.device_transform = (
-            {"mean": np.float32(127.5), "scale": float(1.0 / 58.0)}
+            {"mean": self.mean, "scale": float(self.scale)}
             if device_normalize
             else None
         )
@@ -282,15 +286,18 @@ class Imagenet_synthetic(Dataset):
         self.x_train, self.y_train = make(n_train, 1)
         self.x_val, self.y_val = make(n_val, 2)
 
+    def _normalize(self, x: np.ndarray) -> np.ndarray:
+        return (x.astype(np.float32) - self.mean) * self.scale
+
     def augment(self, x: np.ndarray, rng: np.random.RandomState) -> np.ndarray:
         if self.device_transform is not None:
             return x  # uint8; normalized on device
-        return (x.astype(np.float32) - 127.5) / 58.0
+        return self._normalize(x)
 
     def val_epoch(self, batch_size: int, part: Optional[slice] = None):
         for x, y in super().val_epoch(batch_size, part=part):
             if self.device_transform is None:
-                x = (x.astype(np.float32) - 127.5) / 58.0
+                x = self._normalize(x)
             yield x, y
 
 

@@ -104,13 +104,21 @@ def _load() -> Optional[ctypes.CDLL]:
             return None
         try:
             lib = ctypes.CDLL(_SO)
-        except OSError:
+            _bind(lib)
+        except (OSError, AttributeError):
+            # load failure OR a stale cached .so missing a newer symbol
+            # (source absent so no rebuild possible): degrade, don't crash
             print(
-                f"theanompi_tpu.native: failed to load {_SO} — using the "
-                "slower numpy path",
+                f"theanompi_tpu.native: failed to load/bind {_SO} — using "
+                "the slower numpy path",
                 flush=True,
             )
             return None
+        _lib = lib
+        return _lib
+
+
+def _bind(lib: ctypes.CDLL) -> None:
         lib.tmpi_crop_mirror_normalize.restype = ctypes.c_int
         lib.tmpi_crop_mirror_normalize.argtypes = [
             ctypes.c_void_p,  # in u8
@@ -140,8 +148,6 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_void_p, ctypes.c_int,
         ]
-        _lib = lib
-        return _lib
 
 
 def available() -> bool:
