@@ -1,0 +1,103 @@
+"""10-crop validation protocol (reference era's published top-1
+protocol: 4 corners + center, each mirrored, logits averaged per image;
+SURVEY.md §7 hard-part 3 "exact val protocol")."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from theanompi_tpu.data.imagenet import ImageNet_data, write_shards
+from theanompi_tpu.train import make_eval_step
+
+
+def _shards(tmp_path, size=24, n=32):
+    r = np.random.RandomState(0)
+    imgs = r.randint(0, 256, (n, size, size, 3)).astype(np.uint8)
+    lbls = r.randint(0, 10, n).astype(np.int64)
+    write_shards(str(tmp_path), "train", imgs, lbls, shard_size=n)
+    write_shards(str(tmp_path), "val", imgs, lbls, shard_size=n)
+    return imgs, lbls
+
+
+def test_ten_crop_views(tmp_path):
+    imgs, lbls = _shards(tmp_path)
+    ds = ImageNet_data(root=str(tmp_path), crop=16, val_crops=10)
+    assert ds.val_views == 10
+    x, y = next(iter(ds.val_epoch(8)))
+    assert x.shape == (80, 16, 16, 3) and x.dtype == np.uint8
+    assert y.shape == (8,)
+    # view-major per image: rows [10i, 10(i+1)) belong to image i;
+    # view 0 = top-left corner crop, view 1 = its mirror
+    first = ds._index(str(tmp_path), "val")[0][0]
+    raw = np.load(first)
+    np.testing.assert_array_equal(x[0], raw[0][:16, :16])
+    np.testing.assert_array_equal(x[1], raw[0][:16, :16][:, ::-1])
+    # center crop is view 8
+    ctr = (24 - 16) // 2
+    np.testing.assert_array_equal(
+        x[8], raw[0][ctr : ctr + 16, ctr : ctr + 16]
+    )
+    with pytest.raises(ValueError, match="val_crops"):
+        ImageNet_data(root=str(tmp_path), crop=16, val_crops=4)
+
+
+def test_eval_step_view_averaging():
+    """views=10 must average LOGITS per image before metrics — a model
+    whose logits are a fixed function of the input mean makes the
+    expected average exact."""
+
+    class Toy:
+        def apply(self, params, state, x, train=False, rng=None):
+            # logits: [mean(x), -mean(x)] per row
+            m = x.reshape(x.shape[0], -1).mean(axis=1)
+            return jnp.stack([m, -m], axis=1), state
+
+        def loss(self, logits, labels):
+            return jnp.mean(
+                -jax.nn.log_softmax(logits)[jnp.arange(len(labels)), labels]
+            )
+
+        def metrics(self, logits, labels):
+            return {"error": jnp.mean(jnp.argmax(logits, -1) != labels)}
+
+    model = Toy()
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.randn(4 * 10, 3, 3, 1), jnp.float32)  # 4 images x 10 views
+    labels = jnp.asarray([0, 1, 0, 1], jnp.int32)
+
+    from types import SimpleNamespace
+
+    ev = make_eval_step(model, views=10)
+    got = ev(SimpleNamespace(params=None, model_state=None), x, labels)
+
+    per_view = np.asarray(x).reshape(4, 10, -1).mean(axis=2)
+    avg_logit = per_view.mean(axis=1)  # logit 0 per image
+    want_err = np.mean((avg_logit < 0).astype(int) != np.asarray(labels))
+    assert abs(float(got["error"]) - want_err) < 1e-6
+
+
+def test_run_training_ten_crop_end_to_end(tmp_path):
+    """The driver runs 10-crop val through the 8-way mesh (image rows =
+    10x label rows across the sharded eval step)."""
+    from theanompi_tpu.launch.worker import run_training
+    from theanompi_tpu.models.cifar10 import Cifar10_model
+
+    _shards(tmp_path, size=24, n=64)
+    summary = run_training(
+        rule="bsp",
+        model_cls=Cifar10_model,
+        devices=8,
+        n_epochs=1,
+        max_steps=2,
+        dataset="imagenet",
+        dataset_kwargs={"root": str(tmp_path), "crop": 16, "val_crops": 10},
+        recipe_overrides={
+            "batch_size": 16,
+            "input_shape": (16, 16, 3),
+            "num_classes": 1000,
+            "sched_kwargs": {"lr": 0.01, "boundaries": [10**9]},
+        },
+        print_freq=0,
+    )
+    assert "val" in summary and np.isfinite(summary["val"]["loss"])

@@ -35,6 +35,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--strategy", default="psum",
                    help="gradient exchange strategy (psum|ring|ring_bf16|psum_bf16 "
                         "or reference names ar|asa32|asa16|nccl32|nccl16)")
+    p.add_argument("--slices", type=int, default=None,
+                   help="BSP over a 2-D (dcn, data) multi-slice mesh with this "
+                        "many slices (pod-scale: allreduce rides ICI within a "
+                        "slice, DCN across)")
     p.add_argument("--epochs", type=int, default=None, help="override recipe n_epochs")
     p.add_argument("--max-steps", type=int, default=None)
     p.add_argument("--batch-size", type=int, default=None, help="override recipe batch")
@@ -44,6 +48,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dataset-arg", action="append", default=[], metavar="K=V",
                    help="dataset constructor kwarg (repeatable), e.g. "
                         "--dataset-arg n_train=512 --dataset-arg root=/data")
+    p.add_argument("--recipe-arg", action="append", default=[], metavar="K=V",
+                   help="recipe override (repeatable, JSON values), e.g. "
+                        "--recipe-arg 'input_shape=[16,16,3]' "
+                        "--recipe-arg num_classes=1000 (the model owns its "
+                        "recipe; this is the session's override hook)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--save-dir", default=None, help="recorder output dir (JSONL + pickle)")
     p.add_argument("--ckpt-dir", default=None)
@@ -146,15 +155,22 @@ def main(argv=None) -> int:
     if args.synthetic:
         args.dataset = "synthetic"
 
-    dataset_kwargs = {}
-    for kv in args.dataset_arg:
-        k, _, v = kv.partition("=")
-        if not _:
-            raise SystemExit(f"--dataset-arg expects K=V, got {kv!r}")
-        try:
-            dataset_kwargs[k] = json.loads(v)
-        except json.JSONDecodeError:
-            dataset_kwargs[k] = v
+    def parse_kv(pairs, flag):
+        out = {}
+        for kv in pairs:
+            k, sep, v = kv.partition("=")
+            if not sep:
+                raise SystemExit(f"{flag} expects K=V, got {kv!r}")
+            try:
+                out[k] = json.loads(v)
+            except json.JSONDecodeError:
+                out[k] = v
+        return out
+
+    dataset_kwargs = parse_kv(args.dataset_arg, "--dataset-arg")
+    for k, v in parse_kv(args.recipe_arg, "--recipe-arg").items():
+        # recipes store shapes as tuples; JSON gives lists
+        overrides[k] = tuple(v) if isinstance(v, list) else v
 
     rule_kwargs = {}
     if args.avg_freq is not None:
@@ -169,6 +185,7 @@ def main(argv=None) -> int:
         model_cls=model_cls,
         devices=args.n_devices or None,
         strategy=args.strategy,
+        n_slices=args.slices,
         n_epochs=args.epochs,
         max_steps=args.max_steps,
         dataset=args.dataset,

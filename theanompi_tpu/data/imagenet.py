@@ -79,10 +79,18 @@ class ImageNet_data(Dataset):
         crop: int = 227,
         train_mirror: bool = True,
         device_normalize: bool = True,
+        val_crops: int = 1,
     ):
         base = self._find(root)
         self.crop = crop
         self.train_mirror = train_mirror
+        if val_crops not in (1, 10):
+            raise ValueError("val_crops must be 1 (center) or 10 (10-crop)")
+        # 1 = center crop; 10 = the AlexNet-era protocol (4 corners +
+        # center, each mirrored), logits averaged per image by the eval
+        # step (train.make_eval_step(views=10)) — the published top-1
+        # numbers the recipes were validated with use this
+        self.val_views = val_crops
         self.image_shape = (crop, crop, 3)
         self._train = self._index(base, "train")
         self._val = self._index(base, "val")
@@ -182,7 +190,29 @@ class ImageNet_data(Dataset):
                 y = labels[sl].astype(np.int32)
                 if part is not None:
                     x, y = x[part], y[part]
-                yield self._preprocess(x, None, train=False), y
+                if self.val_views == 10:
+                    yield self._ten_crop(x), y
+                else:
+                    yield self._preprocess(x, None, train=False), y
+
+    def _ten_crop(self, x: np.ndarray) -> np.ndarray:
+        """4 corners + center, each mirrored — view-major rows per image
+        ``[img0_v0..img0_v9, img1_v0, ...]``, so a batch-dim shard holds
+        whole images (the eval step averages logits over the 10 views).
+        uint8 when the device-normalize path is on, floats otherwise."""
+        n, h, w, _ = x.shape
+        c = self.crop
+        oys = [0, 0, h - c, h - c, (h - c) // 2]
+        oxs = [0, w - c, 0, w - c, (w - c) // 2]
+        views = []
+        for oy, ox in zip(oys, oxs):
+            v = x[:, oy : oy + c, ox : ox + c]
+            views.append(v)
+            views.append(v[:, :, ::-1])
+        out = np.stack(views, axis=1).reshape(n * 10, c, c, x.shape[-1])
+        if self.device_transform is not None:
+            return np.ascontiguousarray(out)
+        return (out.astype(np.float32) - self._mean_for_crop(c)) * self.scale
 
     def _mean_for_crop(self, c: int) -> np.ndarray:
         """The mean as applied post-crop: scalar / per-channel pass

@@ -44,6 +44,7 @@ def run_training(
     devices=None,
     *,
     strategy: str = "psum",
+    n_slices: Optional[int] = None,
     n_epochs: Optional[int] = None,
     max_steps: Optional[int] = None,
     dataset: Optional[str] = None,
@@ -87,9 +88,19 @@ def run_training(
         else:
             dataset_kwargs.setdefault("crop", recipe.input_shape[0])
         dataset_kwargs.setdefault("n_classes", recipe.num_classes)
-    mesh = make_mesh(devices)
-    n_dev = mesh.devices.size
     rule = rule.lower()
+    if n_slices and n_slices > 1:
+        if rule != "bsp":
+            raise ValueError(
+                "multi-slice (dcn, data) meshes support the BSP rule; "
+                "EASGD/GoSGD map workers onto a single axis"
+            )
+        from theanompi_tpu.parallel.mesh import make_multislice_mesh
+
+        mesh = make_multislice_mesh(devices, n_slices=n_slices)
+    else:
+        mesh = make_mesh(devices)
+    n_dev = mesh.devices.size
     # Batch semantics per rule (reference meaning, SURVEY.md §3.3/§3.5):
     # - bsp:  recipe.batch_size is the GLOBAL batch, sharded across the
     #         mesh (lockstep SGD is defined by its global batch).
@@ -141,6 +152,7 @@ def run_training(
     # compact uint8 batches and (x - mean) * scale fuses into the
     # compiled step — 4x less H2D than float32 (the reference normalized
     # in the host loader; on TPU the wire is the scarcer resource).
+    eval_views = int(getattr(data, "val_views", 1))
     input_transform = None
     dtf = getattr(data, "device_transform", None)
     if dtf is not None:
@@ -155,21 +167,23 @@ def run_training(
 
         engine = BSPEngine(
             model, mesh, steps_per_epoch=steps_per_epoch, strategy=strategy,
-            input_transform=input_transform,
+            input_transform=input_transform, eval_views=eval_views,
         )
     elif rule == "easgd":
         from theanompi_tpu.parallel.easgd import EASGDEngine
 
         engine = EASGDEngine(
             model, mesh, steps_per_epoch=steps_per_epoch,
-            input_transform=input_transform, **rule_kwargs,
+            input_transform=input_transform, eval_views=eval_views,
+            **rule_kwargs,
         )
     else:
         from theanompi_tpu.parallel.gosgd import GOSGDEngine
 
         engine = GOSGDEngine(
             model, mesh, steps_per_epoch=steps_per_epoch,
-            input_transform=input_transform, **rule_kwargs,
+            input_transform=input_transform, eval_views=eval_views,
+            **rule_kwargs,
         )
 
     # Multi-controller: this host produces only its slice of every
@@ -231,12 +245,12 @@ def run_training(
             start_epoch = engine.get_step(state) // steps_per_epoch
             print(f"resumed from {path} at step {engine.get_step(state)}", flush=True)
 
-    def place(b, rows=batch):
+    def place(b):
+        # global rows inferred per array (local rows x process_count):
+        # x and y may carry different row counts (10-crop val ships
+        # views x batch image rows against batch label rows)
         x, y = b
-        return (
-            put_global_batch(mesh, x, global_rows=rows),
-            put_global_batch(mesh, y, global_rows=rows),
-        )
+        return (put_global_batch(mesh, x), put_global_batch(mesh, y))
 
     summary: dict = {"epochs": [], "rule": rule, "model": model.name}
     step_count = engine.get_step(state)
@@ -290,7 +304,7 @@ def run_training(
             val_accum: dict[str, float] = {}
             n_val = 0
             for vx, vy in data.val_epoch(vbatch, part=vpart):
-                vm = engine.eval_step(state, *place((vx, vy), rows=vbatch))
+                vm = engine.eval_step(state, *place((vx, vy)))
                 for k, v in vm.items():
                     val_accum[k] = val_accum.get(k, 0.0) + float(v)
                 n_val += 1

@@ -28,12 +28,26 @@ from theanompi_tpu.parallel.strategies import get_strategy
 from theanompi_tpu.train import TrainState, init_train_state, make_eval_step, make_train_step
 
 
+def _axes_tuple(axis_name) -> tuple:
+    return (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+
+
+def _fold_linear_index(rng, axes, mesh: Mesh):
+    """Fold this device's linearized mesh index into ``rng`` (per-shard
+    dropout streams on 1-D and multi-slice meshes alike)."""
+    idx = None
+    for a in axes:
+        i = lax.axis_index(a)
+        idx = i if idx is None else idx * mesh.shape[a] + i
+    return jax.random.fold_in(rng, idx)
+
+
 def make_bsp_train_step(
     model: Model,
     mesh: Mesh,
     steps_per_epoch: int = 1,
     strategy: str = "psum",
-    axis_name: str = DATA_AXIS,
+    axis_name=DATA_AXIS,
     donate: bool = True,
     input_transform=None,
 ):
@@ -44,8 +58,16 @@ def make_bsp_train_step(
     along ``data``); ``state`` is replicated; ``rng`` is a single key —
     each device folds in its axis index so dropout masks differ per
     shard (the reference's workers each had their own RNG stream).
+
+    ``axis_name`` may be a TUPLE of mesh axes for multi-slice meshes
+    (``('dcn', 'data')``): the gradient mean then reduces over ICI
+    within each slice and DCN across slices — XLA lowers the hierarchy
+    from the mesh layout (SURVEY.md §5.8 "topology split").
     """
-    n = mesh.shape[axis_name]
+    axes = _axes_tuple(axis_name)
+    n = 1
+    for a in axes:
+        n *= mesh.shape[a]
     if n == 1:
         get_strategy(strategy, axis_name, n)  # validate the name early
         # Single-device fast path: no collectives exist, so skip the
@@ -68,7 +90,7 @@ def make_bsp_train_step(
     )
 
     def sharded_step(state: TrainState, images, labels, rng):
-        rng = jax.random.fold_in(rng, lax.axis_index(axis_name))
+        rng = _fold_linear_index(rng, axes, mesh)
         new_state, metrics = base_step(state, images, labels, rng)
         # Per-replica BatchNorm stats diverge across shards; average them
         # so the output state is truly replicated (the reference kept
@@ -82,10 +104,11 @@ def make_bsp_train_step(
 
     # check_vma=False: the exchanger abstraction requires classic pmap AD
     # semantics (psum transpose = identity) — see make_train_step's note.
+    spec = P(axes)  # P accepts a 1-tuple identically to the bare name
     mapped = jax.shard_map(
         sharded_step,
         mesh=mesh,
-        in_specs=(P(), P(axis_name), P(axis_name), P()),
+        in_specs=(P(), spec, spec, P()),
         out_specs=(P(), P()),
         check_vma=False,
     )
@@ -105,9 +128,14 @@ class BSPEngine:
         mesh: Mesh,
         steps_per_epoch: int = 1,
         strategy: str = "psum",
-        axis_name: str = DATA_AXIS,
+        axis_name=None,
         input_transform=None,
+        eval_views: int = 1,
     ):
+        if axis_name is None:
+            from theanompi_tpu.parallel.mesh import batch_axes
+
+            axis_name = batch_axes(mesh)
         self.model = model
         self.mesh = mesh
         self._step = make_bsp_train_step(
@@ -115,7 +143,8 @@ class BSPEngine:
             axis_name=axis_name, input_transform=input_transform,
         )
         self._eval = make_bsp_eval_step(
-            model, mesh, axis_name=axis_name, input_transform=input_transform
+            model, mesh, axis_name=axis_name, input_transform=input_transform,
+            eval_views=eval_views,
         )
 
     def init_state(self, rng):
@@ -137,20 +166,23 @@ class BSPEngine:
 
 
 def make_bsp_eval_step(
-    model: Model, mesh: Mesh, axis_name: str = DATA_AXIS, input_transform=None
+    model: Model, mesh: Mesh, axis_name=DATA_AXIS, input_transform=None,
+    eval_views: int = 1,
 ):
     """Jitted eval step over the mesh: metrics averaged across shards."""
-    base = make_eval_step(model, input_transform=input_transform)
-    if mesh.shape[axis_name] == 1:
+    base = make_eval_step(model, input_transform=input_transform, views=eval_views)
+    axes = _axes_tuple(axis_name)
+    if all(mesh.shape[a] == 1 for a in axes):
         return jax.jit(base)
 
     def sharded(state: TrainState, images, labels):
         return lax.pmean(base(state, images, labels), axis_name)
 
+    spec = P(axes)
     mapped = jax.shard_map(
         sharded,
         mesh=mesh,
-        in_specs=(P(), P(axis_name), P(axis_name)),
+        in_specs=(P(), spec, spec),
         out_specs=P(),
         check_vma=False,
     )

@@ -71,6 +71,7 @@ class EASGDEngine:
         alpha: Optional[float] = None,
         axis_name: str = DATA_AXIS,
         input_transform=None,
+        eval_views: int = 1,
     ):
         self.model = model
         self.mesh = mesh
@@ -81,7 +82,9 @@ class EASGDEngine:
         base_step = make_train_step(
             model, steps_per_epoch, input_transform=input_transform
         )
-        base_eval = make_eval_step(model, input_transform=input_transform)
+        base_eval = make_eval_step(
+            model, input_transform=input_transform, views=eval_views
+        )
         ax = axis_name
         a = self.alpha
 

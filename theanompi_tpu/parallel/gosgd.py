@@ -83,6 +83,7 @@ class GOSGDEngine:
         gossip_every: int = 1,
         axis_name: str = DATA_AXIS,
         input_transform=None,
+        eval_views: int = 1,
     ):
         self.model = model
         self.mesh = mesh
@@ -96,7 +97,9 @@ class GOSGDEngine:
         base_step = make_train_step(
             model, steps_per_epoch, input_transform=input_transform
         )
-        base_eval = make_eval_step(model, input_transform=input_transform)
+        base_eval = make_eval_step(
+            model, input_transform=input_transform, views=eval_views
+        )
         ax, n, p = axis_name, self.n, float(p_push)
 
         def gossip(params: PyTree, alpha: jax.Array, rng: jax.Array):

@@ -23,11 +23,23 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 DATA_AXIS = "data"
-# Reserved axis name for 2-D (worker-group x data) meshes, where each
-# async-rule "worker" is itself a data-parallel group of chips. Today's
-# rules all run 1-D ('data',); make_mesh accepts multi-axis shapes so
-# adding the group axis is additive.
-GROUP_AXIS = "group"
+# Cross-slice axis for multi-slice (pod-scale) meshes: collectives over
+# DATA_AXIS ride ICI inside a slice, collectives over DCN_AXIS cross the
+# data-center network between slices. See make_multislice_mesh.
+DCN_AXIS = "dcn"
+
+
+def _slice_major(devs):
+    """Canonical device linearization: slice-major, then id — shared by
+    every mesh builder (changing it changes per-device RNG streams)."""
+    return sorted(devs, key=lambda d: (getattr(d, "slice_index", 0), d.id))
+
+
+def batch_axes(mesh: Mesh):
+    """The axis spec batches shard over: the single data axis on a 1-D
+    mesh, ALL axes on a multi-axis (multi-slice) mesh."""
+    names = mesh.axis_names
+    return names[0] if len(names) == 1 else tuple(names)
 
 
 def make_mesh(
@@ -41,7 +53,11 @@ def make_mesh(
     1-D over all requested devices.
     """
     if devices is None:
-        devs = jax.devices()
+        # Order by (slice, device) so the 1-D data axis is slice-
+        # contiguous: XLA then lowers the allreduce hierarchically —
+        # reduce over ICI within each slice, exchange partials over DCN
+        # across slices — instead of striding DCN hops through the ring.
+        devs = _slice_major(jax.devices())
     elif isinstance(devices, int):
         all_devs = jax.devices()
         if devices > len(all_devs):
@@ -61,12 +77,65 @@ def make_mesh(
     return Mesh(arr, axis_names)
 
 
+def make_multislice_mesh(
+    devices: Union[int, Sequence, None] = None,
+    n_slices: Optional[int] = None,
+) -> Mesh:
+    """2-D ``(DCN_AXIS, DATA_AXIS)`` mesh for multi-slice deployments —
+    the 256-chip BASELINE shape (e.g. 4 slices x 64 chips).
+
+    Rows are slices: a collective over ``DATA_AXIS`` stays on ICI inside
+    one slice; a collective over ``DCN_AXIS`` crosses slices over DCN.
+    The BSP gradient mean over BOTH axes is lowered by XLA into exactly
+    that two-tier hierarchy — the reference built the same split by hand
+    with NCCL cliques inside a node and MPI across nodes
+    (``lib/exchanger_strategy.py``; SURVEY.md §5.8 "topology split").
+
+    ``n_slices``: explicit row count — required on hardware without
+    ``slice_index`` metadata (CPU simulation) and for carving a single
+    real slice into virtual rows; defaults to the device-reported slice
+    count.
+    """
+    if devices is None or isinstance(devices, int):
+        devs = list(make_mesh(devices).devices.reshape(-1))
+    else:
+        devs = list(devices)
+    # slice-contiguous ordering on EVERY path (make_mesh only sorts the
+    # devices=None case): a row that straddles physical slices would put
+    # DCN hops inside the 'data' axis and defeat the hierarchy
+    devs = _slice_major(devs)
+    slice_ids = [getattr(d, "slice_index", 0) for d in devs]
+    if n_slices is None:
+        n_slices = len(set(slice_ids))
+    if n_slices < 1 or len(devs) % n_slices:
+        raise ValueError(
+            f"{len(devs)} devices do not divide into {n_slices} slices"
+        )
+    per = len(devs) // n_slices
+    arr = np.array(devs).reshape(n_slices, per)
+    if len(set(slice_ids)) > 1:
+        # real slice metadata present: every row must be single-slice
+        for r in range(n_slices):
+            row_ids = {slice_ids[r * per + i] for i in range(per)}
+            if len(row_ids) > 1:
+                raise ValueError(
+                    f"mesh row {r} would span physical slices {sorted(row_ids)} "
+                    f"(device count {len(devs)} does not align with the "
+                    "per-slice chip count); choose a device count that is a "
+                    "whole number of slices"
+                )
+    return Mesh(arr, (DCN_AXIS, DATA_AXIS))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
-def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
-    """Shard the leading (batch) dim across the data axis."""
+def batch_sharding(mesh: Mesh, axis: Union[str, tuple, None] = None) -> NamedSharding:
+    """Shard the leading (batch) dim across the data axis (1-D mesh) or
+    across ALL mesh axes (multi-slice mesh)."""
+    if axis is None:
+        axis = batch_axes(mesh)
     return NamedSharding(mesh, PartitionSpec(axis))
 
 
@@ -84,7 +153,7 @@ def host_local_batch_slice(mesh: Mesh, global_batch: int) -> slice:
     return slice(idx * per_host, (idx + 1) * per_host)
 
 
-def put_global_batch(mesh: Mesh, x, axis: str = DATA_AXIS, global_rows: Optional[int] = None):
+def put_global_batch(mesh: Mesh, x, axis=None, global_rows: Optional[int] = None):
     """Place a host batch onto the mesh sharded along the data axis.
 
     ``x`` holds THIS PROCESS's rows: in single-controller runs that is

@@ -169,8 +169,17 @@ _ALIASES = {
 }
 
 
-def get_strategy(name: str, axis_name: str, axis_size: int) -> Strategy:
+def get_strategy(name: str, axis_name, axis_size: int) -> Strategy:
+    """``axis_name`` may be a tuple of mesh axes (multi-slice BSP): the
+    psum family reduces over all of them (XLA lowers ICI-then-DCN); the
+    explicit ring variants are single-axis algorithms by construction."""
     key = _ALIASES.get(name, name)
+    if not isinstance(axis_name, str) and key in ("ring", "ring_bf16"):
+        raise ValueError(
+            f"strategy {name!r} is a single-axis ring; on a multi-slice "
+            "mesh use 'psum'/'psum_bf16' (XLA lowers the ICI/DCN "
+            "hierarchy from the mesh layout)"
+        )
     try:
         return _CANONICAL[key](axis_name, axis_size)
     except KeyError:

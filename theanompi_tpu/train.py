@@ -153,13 +153,25 @@ def make_multi_step(step_fn, k: int, stacked: bool = False):
     return run
 
 
-def make_eval_step(model: Model, input_transform: Optional[Callable] = None):
-    """``(state, images, labels) -> metrics`` with loss, on eval stats."""
+def make_eval_step(
+    model: Model,
+    input_transform: Optional[Callable] = None,
+    views: int = 1,
+):
+    """``(state, images, labels) -> metrics`` with loss, on eval stats.
+
+    ``views > 1``: multi-view evaluation (the AlexNet-era 10-crop val
+    protocol — 4 corners + center, each mirrored). ``images`` carries
+    ``len(labels) * views`` rows, view-major per image; per-image logits
+    are the mean over views before loss/metrics (reference: the
+    published top-1 protocol the recipes were validated with)."""
 
     def eval_step(state: TrainState, images, labels):
         if input_transform is not None:
             images = input_transform(images)
         logits, _ = model.apply(state.params, state.model_state, images, train=False)
+        if views > 1:
+            logits = logits.reshape(-1, views, logits.shape[-1]).mean(axis=1)
         return {"loss": model.loss(logits, labels), **model.metrics(logits, labels)}
 
     return eval_step
