@@ -1,0 +1,95 @@
+"""Fused multi-step dispatch (steps_per_dispatch): k BSP steps in one
+compiled program over stacked batches. Contract: the fused step computes
+the SAME math as the per-step path — one step agrees to float epsilon
+(asserted at 1e-6); over many steps the two XLA programs' different
+fusion choices accumulate ULP-level drift through the training dynamics,
+so trajectory-level metrics are compared loosely. (No reference
+analogue: Python drove every iteration; on TPU host dispatch is a real
+cost the compiled scan removes.)"""
+
+import jax
+import numpy as np
+import pytest
+
+from theanompi_tpu.launch.worker import run_training
+from theanompi_tpu.models.model_zoo.wrn import WRN_16_4
+
+_KW = dict(
+    rule="bsp",
+    model_cls=WRN_16_4,
+    devices=8,
+    n_epochs=2,
+    dataset="synthetic",
+    dataset_kwargs={"n_train": 96, "n_val": 32, "image_shape": [16, 16, 3]},
+    recipe_overrides={
+        "batch_size": 16,
+        "input_shape": (16, 16, 3),
+        "sched_kwargs": {"lr": 0.05, "boundaries": [10**9]},
+    },
+    print_freq=0,
+)
+
+pytestmark = pytest.mark.slow
+
+
+def test_fused_single_step_exact():
+    """One fused group of size 1 == one per-step call to float epsilon
+    (same RNG key, same data): the fused program is the same math."""
+    import jax
+
+    from theanompi_tpu.parallel import make_mesh
+    from theanompi_tpu.parallel.bsp import BSPEngine
+    from theanompi_tpu.parallel.mesh import put_global_batch, put_stacked_batches
+
+    model = WRN_16_4(
+        WRN_16_4.default_recipe().replace(
+            batch_size=16, input_shape=(16, 16, 3),
+            sched_kwargs={"lr": 0.05, "boundaries": [10**9]},
+        )
+    )
+    mesh = make_mesh(8)
+    eng = BSPEngine(model, mesh, steps_per_epoch=6)
+    r = np.random.RandomState(0)
+    x = r.randn(16, 16, 16, 3).astype(np.float32)
+    y = r.randint(0, 10, 16).astype(np.int32)
+    sub = jax.random.PRNGKey(99)
+    sA = eng.init_state(jax.random.PRNGKey(11))
+    s1, m1 = eng.train_step(
+        sA, put_global_batch(mesh, x), put_global_batch(mesh, y), sub
+    )
+    sB = eng.init_state(jax.random.PRNGKey(11))  # train_step donates sA
+    s2, m2 = eng.fused_train_step(
+        sB, put_stacked_batches(mesh, x[None]),
+        put_stacked_batches(mesh, y[None]), sub[None],
+    )
+    assert float(m1["loss"]) == float(m2["loss"][0])
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s1.params), jax.tree_util.tree_leaves(s2.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_fused_trajectory_close_to_per_step():
+    """6 steps/epoch with k=4 exercises a full group + a remainder group
+    of 2; end-of-training val metrics track the per-step run (loose:
+    different XLA programs accumulate ULP drift through training)."""
+    base = run_training(seed=11, **_KW)
+    fused = run_training(seed=11, steps_per_dispatch=4, **_KW)
+    assert base["steps"] == fused["steps"] == 12
+    assert abs(base["val"]["loss"] - fused["val"]["loss"]) < 0.1
+    assert abs(base["val"]["error"] - fused["val"]["error"]) < 0.1
+
+
+def test_fused_max_steps_exact():
+    """max_steps not a multiple of k: the final group is trimmed so the
+    run lands exactly on max_steps."""
+    out = run_training(seed=3, steps_per_dispatch=4, max_steps=5, **_KW)
+    assert out["steps"] == 5
+
+
+def test_fused_rejected_for_async_rules():
+    with pytest.raises(ValueError, match="steps_per_dispatch"):
+        run_training(
+            seed=0, steps_per_dispatch=2,
+            **{**_KW, "rule": "gosgd"},
+        )

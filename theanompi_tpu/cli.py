@@ -35,6 +35,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--strategy", default="psum",
                    help="gradient exchange strategy (psum|ring|ring_bf16|psum_bf16 "
                         "or reference names ar|asa32|asa16|nccl32|nccl16)")
+    p.add_argument("--steps-per-dispatch", type=int, default=1,
+                   help="BSP: fuse this many steps into one compiled dispatch "
+                        "(one H2D transfer + one host dispatch per group; "
+                        "amortizes dispatch latency on directly-attached "
+                        "hosts — measured HARMFUL on network-tunneled dev "
+                        "chips, whose large single transfers stall)")
     p.add_argument("--slices", type=int, default=None,
                    help="BSP over a 2-D (dcn, data) multi-slice mesh with this "
                         "many slices (pod-scale: allreduce rides ICI within a "
@@ -186,6 +192,7 @@ def main(argv=None) -> int:
         devices=args.n_devices or None,
         strategy=args.strategy,
         n_slices=args.slices,
+        steps_per_dispatch=args.steps_per_dispatch,
         n_epochs=args.epochs,
         max_steps=args.max_steps,
         dataset=args.dataset,

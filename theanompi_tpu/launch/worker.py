@@ -45,6 +45,7 @@ def run_training(
     *,
     strategy: str = "psum",
     n_slices: Optional[int] = None,
+    steps_per_dispatch: int = 1,
     n_epochs: Optional[int] = None,
     max_steps: Optional[int] = None,
     dataset: Optional[str] = None,
@@ -118,6 +119,12 @@ def run_training(
         )
     if rule in per_worker_rules and strategy != "psum":
         raise ValueError("strategy applies to the BSP rule only")
+    fuse = max(1, int(steps_per_dispatch))
+    if fuse > 1 and rule != "bsp":
+        raise ValueError(
+            "steps_per_dispatch > 1 fuses the allreduce-inside BSP step; "
+            "EASGD/GoSGD exchange between host steps"
+        )
     batch = recipe.batch_size * (n_dev if rule in per_worker_rules else 1)
 
     data = get_dataset(dataset, **dataset_kwargs)
@@ -252,6 +259,25 @@ def run_training(
         x, y = b
         return (put_global_batch(mesh, x), put_global_batch(mesh, y))
 
+    def place_group(group):
+        # fused dispatch: stack g host batches -> ONE [g, batch, ...]
+        # transfer (dim 0 replicated, dim 1 sharded)
+        from theanompi_tpu.parallel.mesh import put_stacked_batches
+
+        xs = np.stack([b[0] for b in group])
+        ys = np.stack([b[1] for b in group])
+        return put_stacked_batches(mesh, xs), put_stacked_batches(mesh, ys)
+
+    def grouper(it, k):
+        buf = []
+        for b in it:
+            buf.append(b)
+            if len(buf) == k:
+                yield buf
+                buf = []
+        if buf:  # epoch remainder: a smaller fused program (cached)
+            yield buf
+
     summary: dict = {"epochs": [], "rule": rule, "model": model.name}
     step_count = engine.get_step(state)
     # Mid-epoch resume (checkpoint written after a max_steps truncation):
@@ -265,40 +291,98 @@ def run_training(
         for epoch in range(start_epoch, n_epochs):
             rec.start_epoch()
             epoch_steps = 0
-            loader = PrefetchLoader(
-                data.train_epoch(epoch, batch, seed=seed, part=part),
-                place,
-                depth=prefetch_depth,
-            )
-            rec.start("wait")
-            for xg, yg in loader:
-                if skip_batches:
-                    skip_batches -= 1
-                    continue
-                rec.end("wait")
-                rec.profile_tick(step_count)
-                rng, sub = jax.random.split(rng)
-                rec.start("step")
-                state, metrics = engine.train_step(state, xg, yg, sub)
-                rec.end("step", sync=metrics["loss"])
-                step_count += 1
-                epoch_steps += 1
-                # periodic exchange (EASGD avg_freq; reference: worker loop
-                # calling exchanger.exchange() — recorded as 'comm')
-                if engine.exchange_every and step_count % engine.exchange_every == 0:
-                    rec.start("comm")
-                    state = engine.exchange(state)
-                    # sync on a leaf of the exchanged state: without it the
-                    # bracket measures only async dispatch and the collective's
-                    # real cost bleeds into the next wait/step brackets
-                    rec.end("comm", sync=jax.tree_util.tree_leaves(state)[0])
-                rec.train_metrics(step_count, metrics, n_images=batch)
+            if fuse > 1:
+                # fused dispatch: groups of <= fuse batches, stacked and
+                # shipped in one transfer, run by one compiled program
+                import itertools
+
+                loader = PrefetchLoader(
+                    grouper(
+                        itertools.islice(
+                            data.train_epoch(epoch, batch, seed=seed, part=part),
+                            skip_batches,
+                            None,
+                        ),
+                        fuse,
+                    ),
+                    place_group,
+                    # depth counts GROUPS here: keep device-resident input
+                    # comparable to the per-step path (depth x fuse steps
+                    # prefetched would scale input HBM by fuse)
+                    depth=max(1, prefetch_depth // fuse),
+                )
+                skip_batches = 0
                 rec.start("wait")
+                for xs, ys in loader:
+                    rec.end("wait")
+                    if max_steps and step_count + xs.shape[0] > max_steps:
+                        # trim the final group to land exactly on max_steps
+                        keep = max_steps - step_count
+                        xs, ys = xs[:keep], ys[:keep]
+                    rec.profile_tick(step_count)
+                    g = int(xs.shape[0])
+                    # the SAME sequential splits the per-step path draws,
+                    # shipped stacked — fused training is bit-identical
+                    subs = []
+                    for _ in range(g):
+                        rng, s = jax.random.split(rng)
+                        subs.append(s)
+                    rec.start("step")
+                    state, metrics = engine.fused_train_step(
+                        state, xs, ys, jnp.stack(subs)
+                    )
+                    rec.end("step", sync=metrics["loss"])
+                    step_count += g
+                    epoch_steps += g
+                    rec.train_metrics(
+                        step_count,
+                        {k: v.mean() for k, v in metrics.items()},
+                        n_images=batch * g,
+                    )
+                    rec.start("wait")
+                    if max_steps and step_count >= max_steps:
+                        loader.close()
+                        break
+                rec.end("wait")
+                rec.end_epoch(epoch, n_images=epoch_steps * batch)
                 if max_steps and step_count >= max_steps:
-                    loader.close()
-                    break
-            rec.end("wait")
-            rec.end_epoch(epoch, n_images=epoch_steps * batch)
+                    pass  # fall through to validation/checkpoint below
+            else:
+                loader = PrefetchLoader(
+                    data.train_epoch(epoch, batch, seed=seed, part=part),
+                    place,
+                    depth=prefetch_depth,
+                )
+                rec.start("wait")
+                for xg, yg in loader:
+                    if skip_batches:
+                        skip_batches -= 1
+                        continue
+                    rec.end("wait")
+                    rec.profile_tick(step_count)
+                    rng, sub = jax.random.split(rng)
+                    rec.start("step")
+                    state, metrics = engine.train_step(state, xg, yg, sub)
+                    rec.end("step", sync=metrics["loss"])
+                    step_count += 1
+                    epoch_steps += 1
+                    # periodic exchange (EASGD avg_freq; reference: worker
+                    # loop calling exchanger.exchange() — recorded as 'comm')
+                    if engine.exchange_every and step_count % engine.exchange_every == 0:
+                        rec.start("comm")
+                        state = engine.exchange(state)
+                        # sync on a leaf of the exchanged state: without it
+                        # the bracket measures only async dispatch and the
+                        # collective's real cost bleeds into the next
+                        # wait/step brackets
+                        rec.end("comm", sync=jax.tree_util.tree_leaves(state)[0])
+                    rec.train_metrics(step_count, metrics, n_images=batch)
+                    rec.start("wait")
+                    if max_steps and step_count >= max_steps:
+                        loader.close()
+                        break
+                rec.end("wait")
+                rec.end_epoch(epoch, n_images=epoch_steps * batch)
 
             # validation (reference: per-epoch val loop on the worker/server)
             val_accum: dict[str, float] = {}

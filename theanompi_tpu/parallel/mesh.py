@@ -181,6 +181,26 @@ def put_global_batch(mesh: Mesh, x, axis=None, global_rows: Optional[int] = None
     return jax.device_put(x, batch_sharding(mesh, axis))
 
 
+def put_stacked_batches(mesh: Mesh, x, axis=None, global_rows: Optional[int] = None):
+    """Place a STACKED group of batches ``[k, batch, ...]`` — the fused
+    multi-step dispatch ships k steps of data in one transfer; dim 0 (the
+    step index) is replicated, dim 1 (the batch) shards across the mesh.
+    Multi-controller hosts pass their local rows of dim 1 as usual."""
+    if axis is None:
+        axis = batch_axes(mesh)
+    spec = NamedSharding(mesh, PartitionSpec(None, axis))
+    n_proc = jax.process_count()
+    if n_proc > 1:
+        x = np.asarray(x)
+        rows = global_rows if global_rows is not None else x.shape[1] * n_proc
+        return jax.make_array_from_process_local_data(
+            spec, x, (x.shape[0], rows, *x.shape[2:])
+        )
+    if mesh.devices.size == 1:
+        return jax.device_put(x, mesh.devices.reshape(-1)[0])
+    return jax.device_put(x, spec)
+
+
 def first_local_value(x):
     """First element of a (possibly multi-host sharded) array, read from
     this process's first addressable shard — ``device_get`` of a global
