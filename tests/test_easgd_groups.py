@@ -1,0 +1,60 @@
+"""EASGD worker groups: each elastic worker = a data-parallel group of
+chips (SURVEY.md §7.6's subgroup-mesh shape — 16 workers on 256 chips).
+The invariant: a group of g chips IS one bigger worker — same per-worker
+batch in, same trajectory out as group_size=1 with the same worker
+count (WRN has no dropout, so runs are deterministic)."""
+
+import jax
+import numpy as np
+import pytest
+
+from theanompi_tpu.launch.worker import run_training
+from theanompi_tpu.models.model_zoo.wrn import WRN_16_4
+
+pytestmark = pytest.mark.slow
+
+_KW = dict(
+    rule="easgd",
+    model_cls=WRN_16_4,
+    n_epochs=2,
+    avg_freq=2,
+    dataset="synthetic",
+    dataset_kwargs={"n_train": 128, "n_val": 128, "image_shape": [16, 16, 3]},
+    recipe_overrides={
+        "batch_size": 16,
+        "input_shape": (16, 16, 3),
+        "sched_kwargs": {"lr": 0.05, "boundaries": [10**9]},
+    },
+    print_freq=0,
+    seed=5,
+)
+
+
+def test_grouped_matches_ungrouped_workers():
+    """4 workers as 4x2-chip groups (8 devices) == 4 single-chip workers
+    (4 devices): same worker count, same per-worker batch, same data
+    order -> same center after training (up to cross-program float
+    drift)."""
+    ungrouped = run_training(devices=4, **_KW)
+    grouped = run_training(devices=8, group_size=2, **_KW)
+    assert ungrouped["steps"] == grouped["steps"]
+    np.testing.assert_allclose(
+        ungrouped["val"]["loss"], grouped["val"]["loss"], rtol=2e-3,
+        err_msg="grouped EASGD diverged from ungrouped with same workers",
+    )
+    np.testing.assert_allclose(
+        ungrouped["val"]["error"], grouped["val"]["error"], atol=0.05
+    )
+
+
+def test_group_size_must_divide():
+    with pytest.raises(ValueError, match="groups of 3"):
+        run_training(devices=8, group_size=3, **_KW)
+
+
+def test_grouped_global_batch_semantics():
+    """8 devices in groups of 4 = 2 workers: the global batch must be
+    2 x recipe.batch (not 8x)."""
+    out = run_training(devices=8, group_size=4, max_steps=4, **_KW)
+    # n_train=128, batch=2x16=32 -> 4 steps/epoch; max_steps=4 = 1 epoch
+    assert out["steps"] == 4

@@ -72,6 +72,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-steps", type=int, default=4)
     p.add_argument("--avg-freq", type=int, default=None,
                    help="EASGD/GoSGD: steps between exchanges (reference avg_freq)")
+    p.add_argument("--group-size", type=int, default=None,
+                   help="EASGD: chips per worker — each elastic worker is a "
+                        "data-parallel group (16 workers on 256 chips = "
+                        "--group-size 16)")
     p.add_argument("--alpha", type=float, default=None, help="EASGD elastic rate")
     p.add_argument("--p-push", type=float, default=None, help="GoSGD push probability")
     p.add_argument("--nproc", type=int, default=None,
@@ -181,6 +185,8 @@ def main(argv=None) -> int:
     rule_kwargs = {}
     if args.avg_freq is not None:
         rule_kwargs["avg_freq"] = args.avg_freq
+    if args.group_size is not None:
+        rule_kwargs["group_size"] = args.group_size
     if args.alpha is not None:
         rule_kwargs["alpha"] = args.alpha
     if args.p_push is not None:

@@ -76,6 +76,18 @@ def run_training(
     recipe = model_cls.default_recipe()
     if recipe_overrides:
         recipe = recipe.replace(**recipe_overrides)
+    if (
+        rule.lower() == "easgd"
+        and int(rule_kwargs.get("group_size", 1)) > 1
+        and recipe.bn_axis_name is None
+        and "bn_axis_name" not in (recipe_overrides or {})
+    ):
+        # a worker GROUP must be statistically one worker: sync BN batch
+        # stats across the group's data axis (override explicitly via
+        # recipe_overrides={'bn_axis_name': None} for per-chip BN)
+        from theanompi_tpu.parallel.mesh import DATA_AXIS
+
+        recipe = recipe.replace(bn_axis_name=DATA_AXIS)
     model = model_cls(recipe)
 
     dataset = dataset or recipe.dataset
@@ -125,7 +137,17 @@ def run_training(
             "steps_per_dispatch > 1 fuses the allreduce-inside BSP step; "
             "EASGD/GoSGD exchange between host steps"
         )
-    batch = recipe.batch_size * (n_dev if rule in per_worker_rules else 1)
+    # EASGD worker groups: each worker = group_size chips, so the worker
+    # count (and the global batch multiplier) is n_dev / group_size
+    if "group_size" in rule_kwargs and rule != "easgd":
+        raise ValueError("group_size applies to the EASGD rule only")
+    group_size = int(rule_kwargs.get("group_size", 1)) if rule == "easgd" else 1
+    if group_size > 1 and n_dev % group_size:
+        raise ValueError(
+            f"{n_dev} devices do not divide into EASGD groups of {group_size}"
+        )
+    n_workers = n_dev // max(1, group_size)
+    batch = recipe.batch_size * (n_workers if rule in per_worker_rules else 1)
 
     data = get_dataset(dataset, **dataset_kwargs)
     if tuple(data.image_shape) != tuple(recipe.input_shape):
@@ -345,8 +367,6 @@ def run_training(
                         break
                 rec.end("wait")
                 rec.end_epoch(epoch, n_images=epoch_steps * batch)
-                if max_steps and step_count >= max_steps:
-                    pass  # fall through to validation/checkpoint below
             else:
                 loader = PrefetchLoader(
                     data.train_epoch(epoch, batch, seed=seed, part=part),

@@ -32,14 +32,7 @@ def _axes_tuple(axis_name) -> tuple:
     return (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
 
 
-def _fold_linear_index(rng, axes, mesh: Mesh):
-    """Fold this device's linearized mesh index into ``rng`` (per-shard
-    dropout streams on 1-D and multi-slice meshes alike)."""
-    idx = None
-    for a in axes:
-        i = lax.axis_index(a)
-        idx = i if idx is None else idx * mesh.shape[a] + i
-    return jax.random.fold_in(rng, idx)
+from theanompi_tpu.parallel.mesh import fold_linear_index as _fold_linear_index
 
 
 def make_bsp_train_step(

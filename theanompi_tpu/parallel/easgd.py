@@ -27,6 +27,17 @@ on its OWN full ``recipe.batch_size`` stream — the incoming global batch
 must be ``n_workers x batch_size``, sharded so each device's shard IS
 one worker's batch (the driver feeds this; config #4 "ResNet-50 EASGD,
 16 workers, batch 256" means 256 examples per worker per local step).
+
+**Worker groups** (``group_size > 1``): each EASGD worker is itself a
+data-parallel GROUP of chips — the engine reshapes the mesh to 2-D
+``(worker, data)``, runs BSP (in-step psum over the group's ``data``
+axis) inside every group, and the elastic exchange couples the
+group-replicated worker params with the center over the ``worker`` axis.
+This is how a 256-chip pod runs "16 workers": 16 groups x 16 chips,
+each group seeing the worker's full batch (SURVEY.md §7.6's
+recommended subgroup-mesh shape). A group of g chips is numerically a
+single bigger worker: per-worker trajectories match group_size=1 runs
+with the same per-worker batch (tests/test_easgd_groups.py).
 """
 
 from __future__ import annotations
@@ -72,36 +83,72 @@ class EASGDEngine:
         axis_name: str = DATA_AXIS,
         input_transform=None,
         eval_views: int = 1,
+        group_size: int = 1,
     ):
+        from theanompi_tpu.parallel.mesh import WORKER_AXIS
+        from theanompi_tpu.parallel.strategies import get_strategy
+
         self.model = model
+        self.group_size = g = max(1, int(group_size))
+        n_dev = mesh.devices.size
+        if n_dev % g:
+            raise ValueError(f"{n_dev} devices do not divide into groups of {g}")
+        if g > 1:
+            # reshape to (worker, data): rows are workers, columns the
+            # chips data-parallel WITHIN one worker
+            mesh = Mesh(
+                mesh.devices.reshape(n_dev // g, g), (WORKER_AXIS, DATA_AXIS)
+            )
+            ax = WORKER_AXIS
+            batch_axes = (WORKER_AXIS, DATA_AXIS)
+            grad_sync = get_strategy("psum", DATA_AXIS, g)
+        else:
+            ax = axis_name
+            batch_axes = (ax,)
+            grad_sync = None
         self.mesh = mesh
-        self.axis_name = axis_name
-        self.n = mesh.shape[axis_name]
+        self.axis_name = ax
+        self.n = mesh.shape[ax]  # number of WORKERS
         self.avg_freq = max(1, avg_freq)
         self.alpha = alpha if alpha is not None else 0.9 / self.n
         base_step = make_train_step(
-            model, steps_per_epoch, input_transform=input_transform
+            model, steps_per_epoch, grad_sync=grad_sync,
+            input_transform=input_transform,
         )
         base_eval = make_eval_step(
             model, input_transform=input_transform, views=eval_views
         )
-        ax = axis_name
         a = self.alpha
+        bspec = P(batch_axes)
+        all_axes = tuple(mesh.axis_names)
 
-        # ---- local step: each worker trains its own replica, no comm ----
+        from theanompi_tpu.parallel.mesh import fold_linear_index
+
+        def fold_all(rng):
+            # distinct stream per DEVICE (worker identity + group slot)
+            return fold_linear_index(rng, all_axes, mesh)
+
+        # ---- local step: each worker trains its own replica; groups
+        # ---- psum gradients over their internal data axis, no comm
+        # ---- crosses workers ----
         def sharded_step(state: EASGDState, images, labels, rng):
             local = jax.tree_util.tree_map(lambda v: v[0], state.workers)
-            rng = jax.random.fold_in(rng, lax.axis_index(ax))
-            new_local, metrics = base_step(local, images, labels, rng)
+            new_local, metrics = base_step(local, images, labels, fold_all(rng))
+            if g > 1:
+                # group-replicated state: average BN stats within the
+                # group (grads were already psummed; BN stats are not)
+                new_local = new_local._replace(
+                    model_state=lax.pmean(new_local.model_state, DATA_AXIS)
+                )
             workers = jax.tree_util.tree_map(lambda v: v[None], new_local)
-            metrics = lax.pmean(metrics, ax)
+            metrics = lax.pmean(metrics, all_axes)
             return state._replace(workers=workers), metrics
 
         self._step = jax.jit(
             jax.shard_map(
                 sharded_step,
                 mesh=mesh,
-                in_specs=(EASGDState(P(ax), P(), P()), P(ax), P(ax), P()),
+                in_specs=(EASGDState(P(ax), P(), P()), bspec, bspec, P()),
                 out_specs=(EASGDState(P(ax), P(), P()), P()),
                 check_vma=False,
             ),
@@ -142,13 +189,13 @@ class EASGDEngine:
                 state.center_params, state.center_model_state,
                 opt_state=(), step=jnp.zeros((), jnp.int32),
             )
-            return lax.pmean(base_eval(center, images, labels), ax)
+            return lax.pmean(base_eval(center, images, labels), all_axes)
 
         self._eval = jax.jit(
             jax.shard_map(
                 sharded_eval,
                 mesh=mesh,
-                in_specs=(EASGDState(P(ax), P(), P()), P(ax), P(ax)),
+                in_specs=(EASGDState(P(ax), P(), P()), bspec, bspec),
                 out_specs=P(),
                 check_vma=False,
             )

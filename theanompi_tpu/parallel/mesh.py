@@ -27,12 +27,29 @@ DATA_AXIS = "data"
 # DATA_AXIS ride ICI inside a slice, collectives over DCN_AXIS cross the
 # data-center network between slices. See make_multislice_mesh.
 DCN_AXIS = "dcn"
+# Async-rule worker axis for (worker, data) meshes: each elastic/gossip
+# "worker" is itself a data-parallel GROUP of chips (EASGD group mode).
+WORKER_AXIS = "worker"
 
 
 def _slice_major(devs):
     """Canonical device linearization: slice-major, then id — shared by
     every mesh builder (changing it changes per-device RNG streams)."""
     return sorted(devs, key=lambda d: (getattr(d, "slice_index", 0), d.id))
+
+
+def fold_linear_index(rng, axes, mesh: Mesh):
+    """Fold this device's linearized mesh index (over ``axes``, row-major)
+    into ``rng`` — THE per-device RNG stream definition shared by every
+    rule engine (changing the linearization changes dropout/augment
+    streams everywhere at once)."""
+    from jax import lax
+
+    idx = None
+    for a in axes:
+        i = lax.axis_index(a)
+        idx = i if idx is None else idx * mesh.shape[a] + i
+    return jax.random.fold_in(rng, idx)
 
 
 def batch_axes(mesh: Mesh):
@@ -153,32 +170,36 @@ def host_local_batch_slice(mesh: Mesh, global_batch: int) -> slice:
     return slice(idx * per_host, (idx + 1) * per_host)
 
 
+def _place_batch(mesh: Mesh, x, sharding: NamedSharding, batch_dim: int,
+                 global_rows: Optional[int]):
+    """Shared placement core. Multi-controller: assemble the global array
+    from per-process rows of ``batch_dim`` (no cross-host copy).
+    Single-device meshes use a plain device placement: some backends
+    (measured: the axon-tunneled v5e) run programs whose inputs carry a
+    NamedSharding ~90x slower than identical unsharded programs, and with
+    one device the sharding is vacuous anyway."""
+    n_proc = jax.process_count()
+    if n_proc > 1:
+        x = np.asarray(x)
+        rows = global_rows if global_rows is not None else x.shape[batch_dim] * n_proc
+        shape = list(x.shape)
+        shape[batch_dim] = rows
+        return jax.make_array_from_process_local_data(sharding, x, tuple(shape))
+    if mesh.devices.size == 1:
+        return jax.device_put(x, mesh.devices.reshape(-1)[0])
+    return jax.device_put(x, sharding)
+
+
 def put_global_batch(mesh: Mesh, x, axis=None, global_rows: Optional[int] = None):
     """Place a host batch onto the mesh sharded along the data axis.
 
     ``x`` holds THIS PROCESS's rows: in single-controller runs that is
     the whole global batch; in multi-controller runs each host passes
     only its ``host_local_batch_slice`` rows (the analogue of the
-    reference's per-rank batch-file partition) and the global array is
-    assembled from the per-process shards without any cross-host copy.
-    ``global_rows`` overrides the inferred global batch (defaults to
-    ``rows_here * process_count``, the equal-split case).
-
-    Single-device meshes use a plain device placement: some backends
-    (measured: the axon-tunneled v5e) run programs whose inputs carry a
-    NamedSharding ~90x slower than identical unsharded programs, and with
-    one device the sharding is vacuous anyway.
-    """
-    n_proc = jax.process_count()
-    if n_proc > 1:
-        x = np.asarray(x)
-        rows = global_rows if global_rows is not None else x.shape[0] * n_proc
-        return jax.make_array_from_process_local_data(
-            batch_sharding(mesh, axis), x, (rows, *x.shape[1:])
-        )
-    if mesh.devices.size == 1:
-        return jax.device_put(x, mesh.devices.reshape(-1)[0])
-    return jax.device_put(x, batch_sharding(mesh, axis))
+    reference's per-rank batch-file partition). ``global_rows`` overrides
+    the inferred global batch (defaults to ``rows_here * process_count``,
+    the equal-split case)."""
+    return _place_batch(mesh, x, batch_sharding(mesh, axis), 0, global_rows)
 
 
 def put_stacked_batches(mesh: Mesh, x, axis=None, global_rows: Optional[int] = None):
@@ -188,17 +209,8 @@ def put_stacked_batches(mesh: Mesh, x, axis=None, global_rows: Optional[int] = N
     Multi-controller hosts pass their local rows of dim 1 as usual."""
     if axis is None:
         axis = batch_axes(mesh)
-    spec = NamedSharding(mesh, PartitionSpec(None, axis))
-    n_proc = jax.process_count()
-    if n_proc > 1:
-        x = np.asarray(x)
-        rows = global_rows if global_rows is not None else x.shape[1] * n_proc
-        return jax.make_array_from_process_local_data(
-            spec, x, (x.shape[0], rows, *x.shape[2:])
-        )
-    if mesh.devices.size == 1:
-        return jax.device_put(x, mesh.devices.reshape(-1)[0])
-    return jax.device_put(x, spec)
+    sharding = NamedSharding(mesh, PartitionSpec(None, axis))
+    return _place_batch(mesh, x, sharding, 1, global_rows)
 
 
 def first_local_value(x):
