@@ -1,0 +1,135 @@
+"""Pallas int8 quantization kernels + the int8-wire ring strategy
+(≙ an escalation of the reference's fp16-compressed ``Exch_asa16`` ring;
+SURVEY.md §2.3 / §7 hard-part 4 "compressed custom collectives"). On CPU
+the kernels run through the Pallas interpreter — same numerics as the
+native TPU lowering."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from theanompi_tpu.ops.pallas_quant import (
+    dequantize_int8,
+    quantize_int8,
+    wire_decode,
+    wire_encode,
+)
+
+
+def test_quantize_roundtrip_error_bound():
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.randn(16, 128).astype(np.float32)) * 3.0
+    vals, scale = quantize_int8(x)
+    assert vals.dtype == jnp.int8 and scale.shape == (1, 1)
+    back = dequantize_int8(vals, scale)
+    amax = float(jnp.max(jnp.abs(x)))
+    # round-to-nearest: error <= scale/2 = amax/254
+    assert float(jnp.max(jnp.abs(back - x))) <= amax / 254 + 1e-6
+
+
+def test_quantize_matches_jnp_fallback(monkeypatch):
+    r = np.random.RandomState(1)
+    x = jnp.asarray(r.randn(8, 128).astype(np.float32))
+    v1, s1 = quantize_int8(x)
+    monkeypatch.setenv("TMPI_PALLAS", "0")
+    v2, s2 = quantize_int8(x)
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), rtol=1e-7)
+
+
+def test_wire_encode_decode():
+    r = np.random.RandomState(2)
+    flat = jnp.asarray(r.randn(5 * 128).astype(np.float32))
+    packed = wire_encode(flat)
+    assert packed.shape == (6, 128) and packed.dtype == jnp.int8  # +scale row
+    back = wire_decode(packed)
+    assert back.shape == flat.shape
+    amax = float(jnp.max(jnp.abs(flat)))
+    assert float(jnp.max(jnp.abs(back - flat))) <= amax / 254 + 1e-6
+
+
+def test_ring_int8_strategy_close_to_mean_oracle():
+    """8-way int8 ring vs the exact mean: error bounded by the per-hop
+    quantization noise (amax/254 per hop, n-1 reduce + n-1 gather hops)."""
+    from jax.sharding import PartitionSpec as P
+
+    from theanompi_tpu.parallel import make_mesh
+    from theanompi_tpu.parallel.strategies import get_strategy
+
+    n = 8
+    mesh = make_mesh(n)
+    r = np.random.RandomState(3)
+    per_dev = {
+        "w": r.randn(n, 40, 7).astype(np.float32),
+        "b": r.randn(n, 11).astype(np.float32),
+    }
+    strat = get_strategy("ring_int8", "data", n)
+
+    def f(tree):
+        return strat(tree)
+
+    out = jax.jit(
+        jax.shard_map(
+            f, mesh=mesh, in_specs=(P("data"),), out_specs=P("data"),
+            check_vma=False,
+        )
+    )({k: jnp.asarray(v) for k, v in per_dev.items()})
+    # oracle: mean over the device axis, broadcast back
+    for k in per_dev:
+        got = np.asarray(out[k])
+        want = per_dev[k].mean(axis=0, keepdims=True).repeat(n, axis=0)
+        amax = np.abs(per_dev[k]).max()
+        tol = amax / 254 * (2 * (n - 1)) + 1e-5
+        np.testing.assert_allclose(got, want, atol=tol)
+
+
+@pytest.mark.slow
+def test_ring_int8_trains(tmp_path):
+    """End-to-end: BSP training with the int8-wire strategy learns the
+    synthetic task (quantization noise must not break convergence)."""
+    from theanompi_tpu.launch.worker import run_training
+    from theanompi_tpu.models.model_zoo.wrn import WRN_16_4
+
+    out = run_training(
+        rule="bsp", model_cls=WRN_16_4, devices=8, strategy="ring_int8",
+        n_epochs=3, dataset="synthetic",
+        dataset_kwargs={"n_train": 256, "n_val": 64, "image_shape": [16, 16, 3]},
+        recipe_overrides={
+            "batch_size": 64, "input_shape": (16, 16, 3),
+            "sched_kwargs": {"lr": 0.05, "boundaries": [10**9]},
+        },
+        print_freq=0, seed=4,
+    )
+    assert out["val"]["loss"] < 1.5, f"int8-ring training failed: {out['val']}"
+
+
+@pytest.mark.parametrize("name", ["ring_bf16", "ring_int8"])
+def test_compressed_ring_replicas_identical(name):
+    """REGRESSION: the segment owner must hold the same post-allreduce
+    value as every receiver (the owner's kept segment is roundtripped
+    through the wire compression) — BSP's replicated-state invariant
+    depends on all devices computing the identical result."""
+    from jax.sharding import PartitionSpec as P
+
+    from theanompi_tpu.parallel import make_mesh
+    from theanompi_tpu.parallel.strategies import get_strategy
+
+    n = 8
+    mesh = make_mesh(n)
+    r = np.random.RandomState(7)
+    x = jnp.asarray(r.randn(n, 700).astype(np.float32))
+    strat = get_strategy(name, "data", n)
+    out = jax.jit(
+        jax.shard_map(
+            lambda t: strat(t), mesh=mesh,
+            in_specs=(P("data"),), out_specs=P("data"), check_vma=False,
+        )
+    )(x)
+    rows = np.asarray(out)
+    for i in range(1, n):
+        np.testing.assert_array_equal(
+            rows[0], rows[i],
+            err_msg=f"{name}: device {i} result differs from device 0",
+        )

@@ -33,8 +33,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("modelfile", help="module path or .py file with the model class")
     p.add_argument("modelclass", help="model class name (e.g. WRN)")
     p.add_argument("--strategy", default="psum",
-                   help="gradient exchange strategy (psum|ring|ring_bf16|psum_bf16 "
-                        "or reference names ar|asa32|asa16|nccl32|nccl16)")
+                   help="gradient exchange strategy (psum|ring|ring_bf16|ring_int8|"
+                        "psum_bf16 or reference names ar|asa32|asa16|nccl32|"
+                        "nccl16)")
     p.add_argument("--steps-per-dispatch", type=int, default=1,
                    help="BSP: fuse this many steps into one compiled dispatch "
                         "(one H2D transfer + one host dispatch per group; "

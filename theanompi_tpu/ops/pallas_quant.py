@@ -1,0 +1,129 @@
+"""Pallas int8 quantize/dequantize kernels — the compressed-wire
+building block for gradient exchange.
+
+The reference's ``Exch_asa16`` cast ring segments to fp16 on the wire
+(reference: ``lib/exchanger_strategy.py``; SURVEY.md §2.3 "fp16-
+compressed comm"); the TPU-native escalation is int8 with a per-chunk
+scale (EQuARX-style, PAPERS.md): 4x wire compression vs fp32 with the
+accumulation still fp32. The quantize/dequantize hot loops are Pallas
+TPU kernels (VPU elementwise over VMEM tiles); off-TPU (CPU test
+meshes) the same kernels run through the Pallas interpreter, so the
+numerics are identical everywhere.
+
+Layout: kernels take the flat buffer reshaped to (rows, 128) lanes —
+the natural VPU shape; callers pad to a multiple of 128 (the ring
+already pads segments).
+
+``TMPI_PALLAS=0`` switches to the pure-jnp fallback (same math).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+_LANES = 128
+
+
+def _use_pallas() -> bool:
+    return os.environ.get("TMPI_PALLAS", "1") != "0"
+
+
+def _interpret() -> bool:
+    # native lowering on TPU; interpreter elsewhere (CPU test meshes)
+    return jax.default_backend() != "tpu"
+
+
+def _quant_kernel(x_ref, vals_ref, scale_ref):
+    amax = jnp.max(jnp.abs(x_ref[:]))
+    scale = jnp.maximum(amax, 1e-30) / 127.0
+    scale_ref[0, 0] = scale
+    scaled = x_ref[:] / scale
+    # round-to-nearest-even, clamp to int8 range
+    vals_ref[:] = jnp.clip(jnp.round(scaled), -127, 127).astype(jnp.int8)
+
+
+def _dequant_kernel(vals_ref, scale_ref, out_ref):
+    out_ref[:] = vals_ref[:].astype(jnp.float32) * scale_ref[0, 0]
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _quantize_jnp(x2d):
+    amax = jnp.max(jnp.abs(x2d))
+    scale = jnp.maximum(amax, 1e-30) / 127.0
+    vals = jnp.clip(jnp.round(x2d / scale), -127, 127).astype(jnp.int8)
+    return vals, jnp.reshape(scale, (1, 1))
+
+
+def quantize_int8(x2d: jax.Array):
+    """``(rows, 128) f32 -> ((rows, 128) int8, (1, 1) f32 scale)`` with a
+    single per-buffer absmax scale."""
+    if not _use_pallas():
+        return _quantize_jnp(x2d)
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pl.pallas_call(
+        _quant_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct(x2d.shape, jnp.int8),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ),
+        interpret=_interpret(),
+    )(x2d)
+
+
+def dequantize_int8(vals: jax.Array, scale: jax.Array) -> jax.Array:
+    """Inverse of :func:`quantize_int8`."""
+    if not _use_pallas():
+        return vals.astype(jnp.float32) * scale[0, 0]
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pl.pallas_call(
+        _dequant_kernel,
+        out_shape=jax.ShapeDtypeStruct(vals.shape, jnp.float32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(vals, scale)
+
+
+def wire_encode(chunk: jax.Array) -> jax.Array:
+    """Flat f32 chunk -> ONE packed int8 message ``(rows + 1, 128)``:
+    quantized lanes plus a final row carrying the f32 scale's 4 bytes —
+    a single ppermute per ring hop instead of a values+scale pair (the
+    hops are latency-bound, especially over DCN). Chunk length must be a
+    multiple of 128 (ring segments are padded)."""
+    rows = chunk.shape[0] // _LANES
+    vals, scale = quantize_int8(chunk.reshape(rows, _LANES))
+    scale_bytes = jax.lax.bitcast_convert_type(scale, jnp.int8).reshape(1, 4)
+    tail = jnp.zeros((1, _LANES), jnp.int8).at[:, :4].set(scale_bytes)
+    return jnp.concatenate([vals, tail], axis=0)
+
+
+def wire_decode(packed: jax.Array) -> jax.Array:
+    """Inverse of :func:`wire_encode` -> flat f32."""
+    vals = packed[:-1]
+    scale = jax.lax.bitcast_convert_type(
+        packed[-1, :4].reshape(1, 1, 4), jnp.float32
+    ).reshape(1, 1)
+    return dequantize_int8(vals, scale).reshape(-1)
+
+
+def wire_roundtrip(chunk: jax.Array) -> jax.Array:
+    """decode(encode(chunk)) — what a RECEIVER would hold. The ring's
+    allgather applies this to the sender's own kept segment so every
+    replica ends bit-identical (quantization is idempotent)."""
+    return wire_decode(wire_encode(chunk))
