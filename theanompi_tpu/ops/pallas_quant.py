@@ -19,7 +19,6 @@ already pads segments).
 
 from __future__ import annotations
 
-import functools
 import os
 
 import jax
@@ -50,7 +49,6 @@ def _dequant_kernel(vals_ref, scale_ref, out_ref):
     out_ref[:] = vals_ref[:].astype(jnp.float32) * scale_ref[0, 0]
 
 
-@functools.partial(jax.jit, static_argnames=())
 def _quantize_jnp(x2d):
     amax = jnp.max(jnp.abs(x2d))
     scale = jnp.maximum(amax, 1e-30) / 127.0
@@ -121,9 +119,3 @@ def wire_decode(packed: jax.Array) -> jax.Array:
     ).reshape(1, 1)
     return dequantize_int8(vals, scale).reshape(-1)
 
-
-def wire_roundtrip(chunk: jax.Array) -> jax.Array:
-    """decode(encode(chunk)) — what a RECEIVER would hold. The ring's
-    allgather applies this to the sender's own kept segment so every
-    replica ends bit-identical (quantization is idempotent)."""
-    return wire_decode(wire_encode(chunk))

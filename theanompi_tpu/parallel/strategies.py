@@ -125,20 +125,6 @@ def _ring_allreduce_flat(
         out = lax.ppermute(chunk, axis_name, fwd)
         return out.astype(flat.dtype)
 
-    def roundtrip(chunk):
-        """What a receiver of ``chunk`` holds after the wire — applied to
-        the sender's own KEPT segment before allgather, so every replica
-        ends with the identical value (without this the segment owner
-        keeps exact fp32 while receivers hold the quantized copy: the
-        replicas drift, violating BSP's replicated-state invariant)."""
-        if wire == "int8":
-            from theanompi_tpu.ops.pallas_quant import wire_roundtrip
-
-            return wire_roundtrip(chunk)
-        if wire == "bf16":
-            return chunk.astype(jnp.bfloat16).astype(flat.dtype)
-        return chunk
-
     def rs_step(t, b):
         idx_send = jnp.mod(rank - t, n)
         idx_recv = jnp.mod(rank - t - 1, n)
@@ -146,13 +132,42 @@ def _ring_allreduce_flat(
         return b.at[idx_recv].add(recv)
 
     buf = lax.fori_loop(0, n - 1, rs_step, buf)
+    # node r now owns the fully-reduced segment (r + 1) mod n
 
-    # node r now owns the fully-reduced segment (r + 1) mod n; align it
-    # with what receivers will hold (quantization is idempotent, so one
-    # roundtrip here makes the final state identical on every device)
-    if wire is not None:
+    if wire == "int8":
+        # Allgather with PACKED forwarding: the owner quantizes its
+        # reduced segment ONCE; the int8 bytes then travel every hop
+        # UNCHANGED and every device (owner included) decodes the same
+        # message. Re-quantizing at each hop is NOT bit-idempotent (the
+        # re-derived scale fl(fl(127*s)/127) drifts 1 ulp on ~3% of
+        # buffers — found empirically in review), which would leave
+        # replicas at different hop distances holding different values
+        # and break BSP's replicated-state invariant. Packed forwarding
+        # is also cheaper: one quantize total instead of one per hop.
+        from theanompi_tpu.ops.pallas_quant import wire_decode, wire_encode
+
         own = jnp.mod(rank + 1, n)
-        buf = buf.at[own].set(roundtrip(jnp.take(buf, own, axis=0)))
+        packed = wire_encode(jnp.take(buf, own, axis=0))
+        buf = buf.at[own].set(wire_decode(packed))
+
+        def ag_step_packed(t, carry):
+            b, pk = carry
+            pk = lax.ppermute(pk, axis_name, fwd)
+            idx_recv = jnp.mod(rank - t, n)
+            return b.at[idx_recv].set(wire_decode(pk)), pk
+
+        buf, _ = lax.fori_loop(0, n - 1, ag_step_packed, (buf, packed))
+        return buf.reshape(-1)[:L]
+
+    if wire == "bf16":
+        # bf16 re-cast IS exact (value already representable), so the
+        # plain hop loop keeps replicas identical once the owner's kept
+        # segment is cast-aligned with what receivers hold
+        own = jnp.mod(rank + 1, n)
+        buf = buf.at[own].set(
+            jnp.take(buf, own, axis=0).astype(jnp.bfloat16).astype(flat.dtype)
+        )
+
     def ag_step(t, b):
         idx_send = jnp.mod(rank + 1 - t, n)
         idx_recv = jnp.mod(rank - t, n)
