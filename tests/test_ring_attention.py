@@ -1,0 +1,80 @@
+"""Ring attention (sequence parallelism) vs the full-attention oracle on
+the 8-way CPU mesh. Beyond-parity extension (SURVEY.md §5.7 design
+note: the 'seq' axis is additive on the named mesh)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from theanompi_tpu.ops.ring_attention import (
+    full_attention_reference,
+    ring_attention,
+)
+from theanompi_tpu.parallel import make_mesh
+
+
+def _run_ring(q, k, v, n, causal):
+    mesh = make_mesh(n, axis_names=("seq",))
+
+    def f(q, k, v):
+        return ring_attention(q, k, v, "seq", causal=causal)
+
+    return jax.jit(
+        jax.shard_map(
+            f, mesh=mesh,
+            in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
+            out_specs=P(None, "seq"),
+            check_vma=False,
+        )
+    )(q, k, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_full_attention(causal):
+    r = np.random.RandomState(0)
+    B, T, H, D = 2, 64, 4, 16  # T sharded 8 ways -> blocks of 8
+    q = jnp.asarray(r.randn(B, T, H, D).astype(np.float32))
+    k = jnp.asarray(r.randn(B, T, H, D).astype(np.float32))
+    v = jnp.asarray(r.randn(B, T, H, D).astype(np.float32))
+
+    got = _run_ring(q, k, v, 8, causal)
+    want = full_attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_ring_single_device_degenerates():
+    r = np.random.RandomState(1)
+    q = jnp.asarray(r.randn(1, 16, 2, 8).astype(np.float32))
+    k = jnp.asarray(r.randn(1, 16, 2, 8).astype(np.float32))
+    v = jnp.asarray(r.randn(1, 16, 2, 8).astype(np.float32))
+    got = _run_ring(q, k, v, 1, causal=True)
+    want = full_attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_ring_attention_grads_flow():
+    """The recurrence must be differentiable (training usage)."""
+    mesh = make_mesh(4, axis_names=("seq",))
+    r = np.random.RandomState(2)
+    q = jnp.asarray(r.randn(1, 32, 2, 8).astype(np.float32))
+    k = jnp.asarray(r.randn(1, 32, 2, 8).astype(np.float32))
+    v = jnp.asarray(r.randn(1, 32, 2, 8).astype(np.float32))
+
+    def loss(q, k, v):
+        def f(q, k, v):
+            return ring_attention(q, k, v, "seq", causal=True)
+
+        out = jax.shard_map(
+            f, mesh=mesh,
+            in_specs=(P(None, "seq"),) * 3, out_specs=P(None, "seq"),
+            check_vma=False,
+        )(q, k, v)
+        return jnp.sum(out ** 2)
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    for gi in g:
+        assert np.isfinite(np.asarray(gi)).all()
+        assert float(jnp.max(jnp.abs(gi))) > 0
