@@ -1,0 +1,148 @@
+"""Sequence-parallel transformer LM — the long-context demonstrator.
+
+BEYOND-PARITY EXTENSION (the reference is a 2016 CNN framework;
+SURVEY.md §5.7). This module proves the framework's long-context story
+end to end: a decoder-only transformer whose attention is
+:func:`theanompi_tpu.ops.ring_attention.ring_attention`, trained with
+the SEQUENCE dimension sharded over a named mesh axis — each device
+holds T/n tokens of every example, K/V blocks stream around the ring,
+activations never materialize the full sequence on one chip. The
+training step is one SPMD program like every other rule here: params
+replicated, token shards local, gradients psum'd over the seq axis.
+
+Deliberately small and self-contained (the image zoo's ``Model``
+contract is classifier-shaped); the point is the PARALLELISM pattern:
+``make_sp_train_step`` is to sequence parallelism what
+``parallel/bsp.py`` is to data parallelism.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from theanompi_tpu.ops.ring_attention import ring_attention
+
+PyTree = Any
+
+SEQ_AXIS = "seq"
+
+
+class TransformerLM(NamedTuple):
+    """Architecture config (params live in a plain dict pytree)."""
+
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 256
+    max_len: int = 1024
+
+    def init(self, key: jax.Array) -> PyTree:
+        ks = jax.random.split(key, 3 + 4 * self.n_layers)
+        d, h = self.d_model, self.d_ff
+        s = 0.02
+        params = {
+            "tok_emb": s * jax.random.normal(ks[0], (self.vocab, d)),
+            "pos_emb": s * jax.random.normal(ks[1], (self.max_len, d)),
+            "head": s * jax.random.normal(ks[2], (d, self.vocab)),
+            "blocks": [],
+        }
+        for i in range(self.n_layers):
+            k0, k1, k2, k3 = ks[3 + 4 * i : 7 + 4 * i]
+            params["blocks"].append(
+                {
+                    "qkv": s * jax.random.normal(k0, (d, 3 * d)),
+                    "proj": s * jax.random.normal(k1, (d, d)),
+                    "mlp_in": s * jax.random.normal(k2, (d, h)),
+                    "mlp_out": s * jax.random.normal(k3, (h, d)),
+                    "ln1": jnp.ones((d,)),
+                    "ln2": jnp.ones((d,)),
+                }
+            )
+        return params
+
+    def apply(
+        self, params: PyTree, tokens: jax.Array, axis_name: str = SEQ_AXIS
+    ) -> jax.Array:
+        """``tokens [B, T_local] -> logits [B, T_local, V]``; must run
+        inside ``shard_map`` with the sequence sharded over
+        ``axis_name`` (positions are global via the axis index)."""
+        B, T = tokens.shape
+        rank = lax.axis_index(axis_name)
+        pos = rank * T + jnp.arange(T)
+        x = params["tok_emb"][tokens] + params["pos_emb"][pos][None]
+
+        def rms(x, g):
+            return x * lax.rsqrt(jnp.mean(x * x, -1, keepdims=True) + 1e-6) * g
+
+        nh = self.n_heads
+        hd = self.d_model // nh
+        for blk in params["blocks"]:
+            hin = rms(x, blk["ln1"])
+            qkv = hin @ blk["qkv"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(B, T, nh, hd)
+            k = k.reshape(B, T, nh, hd)
+            v = v.reshape(B, T, nh, hd)
+            att = ring_attention(q, k, v, axis_name, causal=True)
+            x = x + att.reshape(B, T, self.d_model) @ blk["proj"]
+            hin = rms(x, blk["ln2"])
+            x = x + jax.nn.gelu(hin @ blk["mlp_in"]) @ blk["mlp_out"]
+        return x @ params["head"]
+
+    def loss(
+        self, params: PyTree, tokens: jax.Array, axis_name: str = SEQ_AXIS
+    ) -> jax.Array:
+        """Next-token cross-entropy over the GLOBAL sequence. The target
+        of a shard's last position is the NEXT shard's first token —
+        fetched with one backward ppermute; the final global position
+        has no target and is masked. Returns the global mean loss
+        (identical on every device)."""
+        n = lax.psum(1, axis_name)
+        rank = lax.axis_index(axis_name)
+        logits = self.apply(params, tokens, axis_name)
+        # neighbor's first token (shard r receives from shard r+1)
+        nxt = lax.ppermute(
+            tokens[:, 0], axis_name, [((i + 1) % n, i) for i in range(n)]
+        )
+        targets = jnp.concatenate([tokens[:, 1:], nxt[:, None]], axis=1)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        is_last_shard = rank == n - 1
+        T = tokens.shape[1]
+        valid = jnp.where(
+            is_last_shard & (jnp.arange(T) == T - 1)[None, :], 0.0, 1.0
+        ) * jnp.ones_like(nll)
+        # global mean over valid positions
+        total = lax.psum(jnp.sum(nll * valid), axis_name)
+        count = lax.psum(jnp.sum(valid), axis_name)
+        return total / count
+
+
+def make_sp_train_step(model: TransformerLM, mesh: Mesh, lr: float = 1e-2):
+    """Jitted sequence-parallel SGD step ``(params, tokens) -> (params,
+    loss)``: params replicated, tokens ``[B, T]`` sharded over the seq
+    axis, gradients psum'd over it (each shard contributes its tokens'
+    cotangents — the sum IS the global-loss gradient)."""
+
+    def sharded(params, tokens):
+        loss, grads = jax.value_and_grad(model.loss)(params, tokens)
+        grads = lax.psum(grads, SEQ_AXIS)
+        new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+        return new_params, loss
+
+    return jax.jit(
+        jax.shard_map(
+            sharded,
+            mesh=mesh,
+            in_specs=(P(), P(None, SEQ_AXIS)),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+    )

@@ -69,11 +69,9 @@ def ring_attention(
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def body(carry, t):
-        o, m, l, kt, vt = carry
-        # this kv block originated on rank - t (blocks move forward one
-        # hop per iteration)
-        src = jnp.mod(rank - t, n)
+    def attend(o, m, l, kt, vt, src):
+        """Fold one K/V block (originating on rank ``src``) into the
+        online-softmax accumulators."""
         s = jnp.einsum(
             "bqhd,bkhd->bhqk", q.astype(jnp.float32), kt.astype(jnp.float32),
             precision=precision,
@@ -94,12 +92,21 @@ def ring_attention(
         o = o * corr[..., None] + jnp.einsum(
             "bhqk,bkhd->bhqd", p, vt.astype(jnp.float32), precision=precision
         )
-        # rotate K/V to the next neighbor (skip the final, unused hop)
-        kt = lax.ppermute(kt, axis_name, perm)
-        vt = lax.ppermute(vt, axis_name, perm)
-        return (o, m_new, l, kt, vt), None
+        return o, m_new, l
 
-    (o, m, l, _, _), _ = lax.scan(body, (o0, m0, l0, k, v), jnp.arange(n))
+    # local block first (no rotation), then exactly n-1 hops; K and V
+    # travel as ONE stacked ppermute per hop
+    o, m, l = attend(o0, m0, l0, k, v, rank)
+    kv = jnp.stack([k, v])
+
+    def body(carry, t):
+        o, m, l, kv = carry
+        kv = lax.ppermute(kv, axis_name, perm)
+        src = jnp.mod(rank - t, n)
+        o, m, l = attend(o, m, l, kv[0], kv[1], src)
+        return (o, m, l, kv), None
+
+    (o, m, l, _), _ = lax.scan(body, (o, m, l, kv), jnp.arange(1, n))
     # causal guarantees >= 1 valid key per query (its own position), so l > 0
     out = o / l[..., None]
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B, Tq, H, D]
