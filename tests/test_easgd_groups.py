@@ -58,3 +58,17 @@ def test_grouped_global_batch_semantics():
     out = run_training(devices=8, group_size=4, max_steps=4, **_KW)
     # n_train=128, batch=2x16=32 -> 4 steps/epoch; max_steps=4 = 1 epoch
     assert out["steps"] == 4
+
+
+def test_gosgd_grouped_matches_ungrouped_workers():
+    """GoSGD with 4 workers as 4x2-chip groups == 4 single-chip workers
+    (same shared gossip rng stream per round, same per-worker batches)."""
+    kw = dict(_KW, rule="gosgd", p_push=0.5)
+    kw.pop("avg_freq")
+    ungrouped = run_training(devices=4, **kw)
+    grouped = run_training(devices=8, group_size=2, **kw)
+    assert ungrouped["steps"] == grouped["steps"]
+    np.testing.assert_allclose(
+        ungrouped["val"]["loss"], grouped["val"]["loss"], rtol=2e-3,
+        err_msg="grouped GoSGD diverged from ungrouped with same workers",
+    )

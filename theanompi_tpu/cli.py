@@ -74,8 +74,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--avg-freq", type=int, default=None,
                    help="EASGD/GoSGD: steps between exchanges (reference avg_freq)")
     p.add_argument("--group-size", type=int, default=None,
-                   help="EASGD: chips per worker — each elastic worker is a "
-                        "data-parallel group (16 workers on 256 chips = "
+                   help="EASGD/GoSGD: chips per worker — each async worker is "
+                        "a data-parallel group (16 workers on 256 chips = "
                         "--group-size 16)")
     p.add_argument("--alpha", type=float, default=None, help="EASGD elastic rate")
     p.add_argument("--p-push", type=float, default=None, help="GoSGD push probability")

@@ -77,7 +77,7 @@ def run_training(
     if recipe_overrides:
         recipe = recipe.replace(**recipe_overrides)
     if (
-        rule.lower() == "easgd"
+        rule.lower() in ("easgd", "gosgd")
         and int(rule_kwargs.get("group_size", 1)) > 1
         and recipe.bn_axis_name is None
         and "bn_axis_name" not in (recipe_overrides or {})
@@ -127,7 +127,7 @@ def run_training(
     if rule == "bsp" and rule_kwargs:
         raise ValueError(
             f"rule 'bsp' got unexpected options {sorted(rule_kwargs)} "
-            "(avg_freq/alpha/p_push apply to EASGD/GoSGD only)"
+            "(avg_freq/alpha/p_push/group_size apply to EASGD/GoSGD only)"
         )
     if rule in per_worker_rules and strategy != "psum":
         raise ValueError("strategy applies to the BSP rule only")
@@ -137,14 +137,15 @@ def run_training(
             "steps_per_dispatch > 1 fuses the allreduce-inside BSP step; "
             "EASGD/GoSGD exchange between host steps"
         )
-    # EASGD worker groups: each worker = group_size chips, so the worker
-    # count (and the global batch multiplier) is n_dev / group_size
-    if "group_size" in rule_kwargs and rule != "easgd":
-        raise ValueError("group_size applies to the EASGD rule only")
-    group_size = int(rule_kwargs.get("group_size", 1)) if rule == "easgd" else 1
+    # Async-rule worker groups: each worker = group_size chips, so the
+    # worker count (and the global batch multiplier) is n_dev / group_size
+    # (bsp with group_size already raised above)
+    group_size = (
+        int(rule_kwargs.get("group_size", 1)) if rule in per_worker_rules else 1
+    )
     if group_size > 1 and n_dev % group_size:
         raise ValueError(
-            f"{n_dev} devices do not divide into EASGD groups of {group_size}"
+            f"{n_dev} devices do not divide into groups of {group_size}"
         )
     n_workers = n_dev // max(1, group_size)
     batch = recipe.batch_size * (n_workers if rule in per_worker_rules else 1)

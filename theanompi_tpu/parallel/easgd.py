@@ -85,27 +85,13 @@ class EASGDEngine:
         eval_views: int = 1,
         group_size: int = 1,
     ):
-        from theanompi_tpu.parallel.mesh import WORKER_AXIS
-        from theanompi_tpu.parallel.strategies import get_strategy
+        from theanompi_tpu.parallel.mesh import make_worker_group_mesh
 
         self.model = model
         self.group_size = g = max(1, int(group_size))
-        n_dev = mesh.devices.size
-        if n_dev % g:
-            raise ValueError(f"{n_dev} devices do not divide into groups of {g}")
-        if g > 1:
-            # reshape to (worker, data): rows are workers, columns the
-            # chips data-parallel WITHIN one worker
-            mesh = Mesh(
-                mesh.devices.reshape(n_dev // g, g), (WORKER_AXIS, DATA_AXIS)
-            )
-            ax = WORKER_AXIS
-            batch_axes = (WORKER_AXIS, DATA_AXIS)
-            grad_sync = get_strategy("psum", DATA_AXIS, g)
-        else:
-            ax = axis_name
-            batch_axes = (ax,)
-            grad_sync = None
+        mesh, gspec, grad_sync = make_worker_group_mesh(mesh, g)
+        ax = mesh.axis_names[0] if g > 1 else axis_name
+        bspec_ = gspec if g > 1 else P(ax)
         self.mesh = mesh
         self.axis_name = ax
         self.n = mesh.shape[ax]  # number of WORKERS
@@ -119,7 +105,7 @@ class EASGDEngine:
             model, input_transform=input_transform, views=eval_views
         )
         a = self.alpha
-        bspec = P(batch_axes)
+        bspec = bspec_
         all_axes = tuple(mesh.axis_names)
 
         from theanompi_tpu.parallel.mesh import fold_linear_index

@@ -37,6 +37,12 @@ on its OWN full ``recipe.batch_size`` stream — the incoming global
 batch is ``n_workers x batch_size``, sharded so each device's shard IS
 one worker's batch (the driver feeds this).
 
+**Worker groups** (``group_size > 1``): as in EASGD, each gossip worker
+is a data-parallel GROUP of chips on a 2-D ``(worker, data)`` mesh —
+BSP inside the group, gossip ppermute over the worker axis (payloads
+are group-replicated, the whole group pushes together). See
+parallel/easgd.py's worker-group notes.
+
 Share-weight invariant: sum_i alpha_i == 1 at all times (checked in
 tests); consensus params = sum_i alpha_i * w_i. On a 1-device mesh
 gossip is the identity (a push would otherwise leak share mass with no
@@ -84,23 +90,33 @@ class GOSGDEngine:
         axis_name: str = DATA_AXIS,
         input_transform=None,
         eval_views: int = 1,
+        group_size: int = 1,
     ):
+        from theanompi_tpu.parallel.mesh import make_worker_group_mesh
+
         self.model = model
+        self.group_size = g = max(1, int(group_size))
+        mesh, gspec, grad_sync = make_worker_group_mesh(mesh, g)
+        if g > 1:
+            axis_name = mesh.axis_names[0]
+        bspec = gspec if g > 1 else P(axis_name)
         self.mesh = mesh
         self.axis_name = axis_name
-        self.n = mesh.shape[axis_name]
+        self.n = mesh.shape[axis_name]  # number of WORKERS
         if avg_freq:  # reference-style configuration: p = 1/avg_freq
             p_push = 1.0 / avg_freq
         self.p_push = float(p_push)
         self.gossip_every = max(1, int(gossip_every))
         self._count: int | None = None
         base_step = make_train_step(
-            model, steps_per_epoch, input_transform=input_transform
+            model, steps_per_epoch, grad_sync=grad_sync,
+            input_transform=input_transform,
         )
         base_eval = make_eval_step(
             model, input_transform=input_transform, views=eval_views
         )
         ax, n, p = axis_name, self.n, float(p_push)
+        all_axes = tuple(mesh.axis_names)
 
         def gossip(params: PyTree, alpha: jax.Array, rng: jax.Array):
             """One gossip round: ONE executed ppermute; returns merged
@@ -144,13 +160,21 @@ class GOSGDEngine:
                 local = jax.tree_util.tree_map(lambda v: v[0], state.workers)
                 a_local = state.alpha[0]
                 step_rng, gossip_rng = jax.random.split(rng)
-                step_rng = jax.random.fold_in(step_rng, lax.axis_index(ax))
+                from theanompi_tpu.parallel.mesh import fold_linear_index
+
+                step_rng = fold_linear_index(step_rng, all_axes, mesh)
                 new_local, metrics = base_step(local, images, labels, step_rng)
+                if g > 1:
+                    # group-replicated worker: average BN stats within
+                    # the group (grads were already psummed)
+                    new_local = new_local._replace(
+                        model_state=lax.pmean(new_local.model_state, DATA_AXIS)
+                    )
                 a_new = a_local
                 if with_gossip:
                     merged, a_new = gossip(new_local.params, a_local, gossip_rng)
                     new_local = new_local._replace(params=merged)
-                metrics = lax.pmean(metrics, ax)
+                metrics = lax.pmean(metrics, all_axes)
                 return (
                     GOSGDState(
                         jax.tree_util.tree_map(lambda v: v[None], new_local), a_new[None]
@@ -162,7 +186,7 @@ class GOSGDEngine:
                 jax.shard_map(
                     sharded_step,
                     mesh=mesh,
-                    in_specs=(GOSGDState(P(ax), P(ax)), P(ax), P(ax), P()),
+                    in_specs=(GOSGDState(P(ax), P(ax)), bspec, bspec, P()),
                     out_specs=(GOSGDState(P(ax), P(ax)), P()),
                     check_vma=False,
                 ),
@@ -185,13 +209,13 @@ class GOSGDEngine:
             consensus = TrainState(
                 consensus_params, consensus_ms, opt_state=(), step=jnp.zeros((), jnp.int32)
             )
-            return lax.pmean(base_eval(consensus, images, labels), ax)
+            return lax.pmean(base_eval(consensus, images, labels), all_axes)
 
         self._eval = jax.jit(
             jax.shard_map(
                 sharded_eval,
                 mesh=mesh,
-                in_specs=(GOSGDState(P(ax), P(ax)), P(ax), P(ax)),
+                in_specs=(GOSGDState(P(ax), P(ax)), bspec, bspec),
                 out_specs=P(),
                 check_vma=False,
             )

@@ -144,6 +144,30 @@ def make_multislice_mesh(
     return Mesh(arr, (DCN_AXIS, DATA_AXIS))
 
 
+def make_worker_group_mesh(mesh: Mesh, group_size: int):
+    """Reshape a 1-D mesh for async-rule worker groups: ``(worker,
+    data)`` rows are workers, columns the chips data-parallel WITHIN one
+    worker. Returns ``(mesh2d, batch_spec, grad_sync)`` — the shared
+    construction for EASGD/GoSGD group mode (a group must behave as ONE
+    bigger worker: BSP psum inside, worker-axis collectives across)."""
+    from jax.sharding import PartitionSpec
+
+    from theanompi_tpu.parallel.strategies import get_strategy
+
+    g = max(1, int(group_size))
+    n_dev = mesh.devices.size
+    if n_dev % g:
+        raise ValueError(f"{n_dev} devices do not divide into groups of {g}")
+    if g == 1:
+        return mesh, None, None
+    mesh2d = Mesh(mesh.devices.reshape(n_dev // g, g), (WORKER_AXIS, DATA_AXIS))
+    return (
+        mesh2d,
+        PartitionSpec((WORKER_AXIS, DATA_AXIS)),
+        get_strategy("psum", DATA_AXIS, g),
+    )
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
