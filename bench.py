@@ -284,7 +284,9 @@ def bench_scaling(ns=(1, 2, 4, 8), steps: int = 4) -> dict:
         "table": table,
         "note": "virtual CPU mesh, shared host cores, total work fixed: "
         "deviation from 1.0 = partition/collective overhead the framework "
-        "adds per step (NOT chip scaling; run on a pod for that)",
+        "adds per step (NOT chip scaling; run on a pod for that). "
+        "Run-to-run variance ~±10% on small shared hosts — compare trends, "
+        "not single runs",
     }
     with open(os.path.join(os.path.dirname(os.path.abspath(__file__)), "SCALING.json"), "w") as f:
         json.dump(result, f, indent=1)
