@@ -7,6 +7,7 @@ replicated.
 """
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -34,6 +35,7 @@ def _batch(model, n=64):
     return jnp.asarray(x), jnp.asarray(y)
 
 
+@pytest.mark.slow
 def test_bsp8_matches_single_device(mesh8):
     """Grad-allreduce BSP over 8 shards == one device on the global batch.
 
@@ -63,6 +65,7 @@ def test_bsp8_matches_single_device(mesh8):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_bsp_strategies_agree(mesh8):
     model = _model()
     x, y = _batch(model)
@@ -81,6 +84,7 @@ def test_bsp_strategies_agree(mesh8):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=5e-5)
 
 
+@pytest.mark.slow
 def test_bsp_grads_match_sequential_oracle(mesh8):
     """Per-replica-BN BSP == sequentially simulating each shard and
     averaging grads — the ground truth for the reference's allreduce
@@ -110,6 +114,7 @@ def test_bsp_grads_match_sequential_oracle(mesh8):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_bsp_trains_and_state_replicated(mesh8):
     model = _model()
     x, y = _batch(model)

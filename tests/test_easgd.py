@@ -2,6 +2,7 @@
 (SURVEY.md §4 item (b): EASGD algebra vs sequential simulation)."""
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -68,6 +69,7 @@ def test_easgd_exchange_matches_sequential_algebra(mesh8):
         np.testing.assert_allclose(ca, cb + diff.sum(axis=0), rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_easgd_trains_and_center_tracks_workers(mesh8):
     model = _model()
     data = get_dataset("synthetic", n_train=128, n_val=64, image_shape=(16, 16, 3))

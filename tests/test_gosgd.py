@@ -4,6 +4,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from theanompi_tpu.data import get_dataset
 from theanompi_tpu.models.model_zoo.wrn import WRN_16_4
@@ -153,6 +154,7 @@ def test_gosgd_round_cost_is_one_ppermute(mesh8):
     )
 
 
+@pytest.mark.slow
 def test_gosgd_consensus_under_heavy_gossip(mesh8):
     """With p=1 and no learning, repeated gossip drives workers toward
     the shared consensus (variance shrinks)."""

@@ -42,6 +42,7 @@ def test_run_training_bsp_end_to_end(tmp_path):
     assert any(f.name.startswith("ckpt_") for f in (tmp_path / "ckpt").iterdir())
 
 
+@pytest.mark.slow
 def test_run_training_resume(tmp_path):
     kw = dict(rule="bsp", model_cls=WRN_16_4, devices=8, ckpt_dir=str(tmp_path / "c"), **_TINY)
     run_training(n_epochs=1, **kw)

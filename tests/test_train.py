@@ -2,6 +2,7 @@
 (SURVEY.md §7 step 2) on the synthetic fixture."""
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -26,6 +27,7 @@ def test_train_state_is_pytree():
     assert int(state.step) == 0
 
 
+@pytest.mark.slow
 def test_cifar10_model_overfits_one_batch():
     model = _small(Cifar10_model, sched_kwargs={"lr": 0.05, "boundaries": [10**9]})
     data = get_dataset("synthetic", n_train=32, n_val=32, image_shape=(32, 32, 3))
@@ -115,6 +117,7 @@ def test_cifar_augment_vectorized_oracle():
         np.testing.assert_array_equal(got[i], img)
 
 
+@pytest.mark.slow
 def test_make_multi_step_matches_sequential():
     """k scanned steps == k sequential steps (same rng folding)."""
     from theanompi_tpu.train import make_multi_step, make_train_step
@@ -140,6 +143,7 @@ def test_make_multi_step_matches_sequential():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-2, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_make_multi_step_stacked_batches():
     from theanompi_tpu.train import make_multi_step, make_train_step
 

@@ -40,6 +40,7 @@ def test_param_counts_match_papers(name, expected_m, tol):
     assert abs(count_m - expected_m) < tol, f"{name}: {count_m:.2f}M vs {expected_m}M"
 
 
+@pytest.mark.slow
 def test_googlenet_param_count():
     """GoogLeNet: ~7M in the main network (aux heads add ~6M, train-only)."""
     model = GoogLeNet()
@@ -78,6 +79,7 @@ def test_alexnet_smoke_step():
     _smoke(AlexNet, (67, 67, 3))
 
 
+@pytest.mark.slow
 def test_googlenet_smoke_step_with_aux():
     model = _smoke(GoogLeNet, (128, 128, 3))
     # eval path returns plain logits; train path returned aux tuple
@@ -95,6 +97,7 @@ def test_vgg16_smoke_step():
     _smoke(VGG16, (64, 64, 3))
 
 
+@pytest.mark.slow
 def test_resnet50_smoke_step():
     _smoke(ResNet50, (64, 64, 3))
 
