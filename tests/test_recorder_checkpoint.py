@@ -75,7 +75,9 @@ def test_checkpoint_roundtrip_and_resume(tmp_path):
     _, template = _state()
     restored, rng = load_checkpoint(path, template)
     assert int(restored.step) == 1
-    np.testing.assert_array_equal(np.asarray(rng), np.asarray(jax.random.PRNGKey(7)))
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(rng)), np.asarray(jax.random.PRNGKey(7))
+    )
     for a, b in zip(jax.tree_util.tree_leaves(restored), jax.tree_util.tree_leaves(state)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
@@ -106,3 +108,42 @@ def test_checkpoint_shape_mismatch_raises(tmp_path):
     path = save_checkpoint(str(tmp_path), {"w": jnp.zeros((3, 3))}, 1)
     with pytest.raises(ValueError):
         load_checkpoint(path, {"w": jnp.zeros((4, 3))})
+
+
+def test_checkpoint_rng_cross_impl_resume(tmp_path):
+    """A checkpoint's rng must resume under a DIFFERENT default PRNG impl
+    than the one that wrote it: the package defaults to rbg (width-4 key
+    data) but pre-rbg checkpoints carry threefry width-2 keys."""
+    from theanompi_tpu.utils import wrap_saved_rng
+
+    _, state = _state()
+    # legacy checkpoint: raw threefry key data, as written before the
+    # rbg default existed
+    legacy_dir = str(tmp_path / "legacy")
+    legacy = save_checkpoint(legacy_dir, state, 1, rng=np.array([7, 9], np.uint32))
+    # raw width-2 data under the rbg default: save must NOT stamp 'rbg'
+    # (the width contradicts it) — impl is inferred from width
+    assert str(np.load(legacy)["__rng_impl__"]) == "threefry2x32"
+    # simulate a pre-impl-tracking checkpoint: strip __rng_impl__
+    data = dict(np.load(legacy))
+    del data["__rng_impl__"]
+    np.savez(legacy, **data)
+    _, key = load_checkpoint(legacy, state)
+    assert str(jax.random.key_impl(key)) == "threefry2x32"  # width-inferred
+    a, b = jax.random.split(key)  # would raise under the rbg default pre-fix
+    assert not np.array_equal(jax.random.key_data(a), jax.random.key_data(b))
+
+    # current-impl round trip, including a TYPED key through save: the
+    # stored impl name (not width) drives the wrap, so unsafe_rbg
+    # (width 4, same as rbg) survives exactly
+    cur = jax.random.key(3, impl="unsafe_rbg")
+    path = save_checkpoint(str(tmp_path / "cur"), state, 2, rng=cur)
+    _, key2 = load_checkpoint(path, state)
+    assert str(jax.random.key_impl(key2)) == "unsafe_rbg"
+    np.testing.assert_array_equal(
+        jax.random.key_data(key2), jax.random.key_data(cur)
+    )
+    jax.random.split(key2)
+
+    with pytest.raises(ValueError, match="key-data shape"):
+        wrap_saved_rng(np.zeros((3,), np.uint32))

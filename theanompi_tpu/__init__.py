@@ -33,6 +33,29 @@ Session API (reference: ``launch_session.py`` / ``tmpi``)::
 
 __version__ = "0.1.0"
 
-from theanompi_tpu.launch.session import BSP, EASGD, GOSGD, SyncRule  # noqa: F401
+import os as _os
+
+import jax as _jax
+
+# TPU-native default PRNG: XLA's rng-bit-generator ("rbg") instead of the
+# pure-JAX threefry. Threefry lowers to a long scalar-heavy program that
+# costs ~1.9 ms of a 14.4 ms AlexNet-128 train step on a v5e (dropout
+# masks); rbg generates the same-shaped bits in hardware for ~0.5 ms
+# (measured: 8,723 -> 9,685 img/s). Streams stay deterministic per seed;
+# they differ from threefry's, and split/fold_in derivations remain
+# threefry-based (only bit generation changes). Opt out / override with
+# TMPI_PRNG_IMPL=threefry2x32 (empty string = leave JAX's default).
+# Precedence: TMPI_PRNG_IMPL > the user's own JAX_DEFAULT_PRNG_IMPL
+# (never clobber an explicit JAX-level choice) > our rbg default. A
+# programmatic jax.config.update made before this import is
+# indistinguishable from the default and WILL be overridden — use either
+# env var to pin.
+_impl = _os.environ.get("TMPI_PRNG_IMPL")
+if _impl is None and "JAX_DEFAULT_PRNG_IMPL" not in _os.environ:
+    _impl = "rbg"
+if _impl:
+    _jax.config.update("jax_default_prng_impl", _impl)
+
+from theanompi_tpu.launch.session import BSP, EASGD, GOSGD, SyncRule  # noqa: F401,E402
 
 __all__ = ["BSP", "EASGD", "GOSGD", "SyncRule", "__version__"]

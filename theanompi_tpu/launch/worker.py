@@ -271,7 +271,9 @@ def run_training(
             restored, saved_rng = load_checkpoint(path, state)
             state = jax.tree_util.tree_map(jnp.asarray, restored)
             if saved_rng is not None:
-                rng = jnp.asarray(saved_rng)
+                # already wrapped with the impl that wrote it — a
+                # pre-rbg-default threefry checkpoint keeps resuming
+                rng = saved_rng
             start_epoch = engine.get_step(state) // steps_per_epoch
             print(f"resumed from {path} at step {engine.get_step(state)}", flush=True)
 

@@ -6,4 +6,5 @@ from theanompi_tpu.utils.checkpoint import (  # noqa: F401
     load_checkpoint,
     latest_checkpoint,
     save_checkpoint,
+    wrap_saved_rng,
 )

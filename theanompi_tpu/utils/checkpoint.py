@@ -25,6 +25,7 @@ from typing import Any, Optional
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 
 PyTree = Any
 
@@ -65,7 +66,30 @@ def save_checkpoint(
     the path (or None on non-writer processes)."""
     flat = _flatten_with_paths(state)
     if rng is not None:
-        flat["__rng__"] = np.asarray(jax.device_get(rng))
+        # record WHICH impl produced the key data: width alone is
+        # ambiguous (rbg and unsafe_rbg share width 4 but derive
+        # split/fold_in differently), and resume must reproduce the
+        # exact stream of an uninterrupted run
+        if jnp.issubdtype(getattr(rng, "dtype", None), jax.dtypes.prng_key):
+            impl = str(jax.random.key_impl(rng))
+            rng = jax.random.key_data(rng)  # typed key -> raw uint32 data
+            raw = np.asarray(jax.device_get(rng))
+        else:
+            # raw key data: assume the process default impl, unless the
+            # data width contradicts it (e.g. an explicit threefry
+            # PRNGKey under the rbg default) — then infer from width so
+            # the checkpoint stays loadable
+            raw = np.asarray(jax.device_get(rng))
+            impl = jax.config.jax_default_prng_impl
+            width = raw.shape[-1] if raw.ndim else None
+            if width != _KEY_WIDTH_BY_IMPL.get(impl):
+                impl = _KEY_IMPL_BY_WIDTH.get(width)
+                if impl is None:
+                    raise ValueError(
+                        f"rng has unrecognized key-data shape {raw.shape}"
+                    )
+        flat["__rng__"] = raw
+        flat["__rng_impl__"] = np.asarray(impl)
     if jax.process_index() != 0:
         return None
     os.makedirs(directory, exist_ok=True)
@@ -120,14 +144,19 @@ def load_checkpoint(
 ) -> tuple[PyTree, Optional[np.ndarray]]:
     """Restore a pytree matching ``state_template``'s structure (the
     template supplies structure + dtypes; values are ignored). Returns
-    ``(state, rng_or_None)`` as host numpy arrays — caller device_puts.
+    ``(state, rng_or_None)``: state leaves as host numpy arrays (caller
+    device_puts), rng as a typed PRNG key wrapped with the impl that
+    wrote it (see :func:`wrap_saved_rng`).
 
     A structure mismatch (renamed layer, different optimizer) raises
     KeyError naming the missing entry, rather than silently reinitializing
     — resume must be exact or explicit.
     """
     data = np.load(path)
-    rng = data["__rng__"] if "__rng__" in data.files else None
+    rng = None
+    if "__rng__" in data.files:
+        impl = str(data["__rng_impl__"]) if "__rng_impl__" in data.files else None
+        rng = wrap_saved_rng(data["__rng__"], impl=impl)
 
     leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(state_template)
     new_leaves = []
@@ -151,3 +180,26 @@ def load_checkpoint(
             )
         new_leaves.append(arr.astype(want_dtype))
     return jax.tree_util.tree_unflatten(treedef, new_leaves), rng
+
+
+# key-data width -> the impl that produced it (rbg and unsafe_rbg share a
+# width; rbg is what this framework defaults to, see theanompi_tpu.__init__)
+_KEY_IMPL_BY_WIDTH = {2: "threefry2x32", 4: "rbg"}
+_KEY_WIDTH_BY_IMPL = {"threefry2x32": 2, "rbg": 4, "unsafe_rbg": 4}
+
+
+def wrap_saved_rng(raw: np.ndarray, impl: Optional[str] = None) -> jax.Array:
+    """Turn a checkpoint's raw ``__rng__`` uint32 data back into a usable
+    PRNG key, honoring the impl that WROTE it rather than the process
+    default — a checkpoint saved under threefry (width-2 key data) must
+    resume correctly in a process whose default impl is rbg (width 4) and
+    vice versa. ``impl`` comes from the checkpoint's ``__rng_impl__``
+    entry; pre-impl-tracking checkpoints fall back to width inference.
+    Returns a typed key; all jax.random consumers accept it."""
+    arr = jnp.asarray(raw)
+    impl = impl or _KEY_IMPL_BY_WIDTH.get(arr.shape[-1] if arr.ndim else None)
+    if impl is None:
+        raise ValueError(
+            f"checkpoint rng has unrecognized key-data shape {np.shape(raw)}"
+        )
+    return jax.random.wrap_key_data(arr, impl=impl)
