@@ -57,21 +57,29 @@ def test_sp_loss_matches_single_device():
 
 @pytest.mark.slow
 def test_sp_training_learns():
-    """60 SGD steps on the bigram task over an 8-way seq mesh must drive
-    the loss well below chance (ln(32) ~ 3.47) — gradients flow through
-    ring attention, the boundary ppermute, and the seq-axis psum."""
+    """120 Adam steps on the bigram task over an 8-way seq mesh must
+    drive the loss well below chance (ln(32) ~ 3.47) — gradients flow
+    through ring attention, the boundary ppermute, and the seq-axis
+    psum. (Adam rather than plain SGD: with correctly mesh-invariant
+    gradient scaling, SGD's plateau-escape on this task is too
+    init-stream-sensitive for a deterministic assertion.)"""
+    from theanompi_tpu.models.transformer import make_nd_train_step
+    from theanompi_tpu.ops.optimizers import get_optimizer
+
     vocab = 32
     model = TransformerLM(vocab=vocab, d_model=64, n_heads=4, n_layers=2,
                           d_ff=128, max_len=128)
     mesh = make_mesh(8, axis_names=(SEQ_AXIS,))
-    step = make_sp_train_step(model, mesh, lr=0.05)  # 0.1 diverges (plain SGD)
+    step = make_nd_train_step(model, mesh, lr=3e-3, sp_axis=SEQ_AXIS,
+                              optimizer="adam")
     params = model.init(jax.random.PRNGKey(1))
+    state = (params, get_optimizer("adam").init(params))
 
     first = last = None
     sharding = NamedSharding(mesh, P(None, SEQ_AXIS))  # dim 1 = sequence
     for i, tb in enumerate(_batches(120, 4, 64, vocab, seed=2)):
         toks = jax.device_put(jnp.asarray(tb, jnp.int32), sharding)
-        params, loss = step(params, toks)
+        state, loss = step(state, toks)
         if first is None:
             first = float(loss)
         last = float(loss)

@@ -1,0 +1,235 @@
+"""Mixture-of-experts transformer LM — the expert-parallel demonstrator.
+
+BEYOND-PARITY EXTENSION (SURVEY.md §2.3: EP absent from the 2016
+reference; the named-mesh design note makes the axis additive). Same
+decoder-only skeleton as :class:`theanompi_tpu.models.transformer.
+TransformerLM`, with every block's dense FFN replaced by a Switch-style
+top-1 MoE (:func:`theanompi_tpu.ops.moe.switch_moe`): experts sharded
+over an ``expert`` mesh axis that doubles as the data axis (each device
+routes its own tokens; dispatch rides two ``lax.all_to_all``s over ICI),
+with the Switch load-balance auxiliary loss on global statistics.
+
+``make_ep_train_step`` composes EP with sequence parallelism (tokens
+additionally sharded over a ``seq`` axis, ring or Ulysses attention) —
+one SPMD program over a 2-D ``(expert, seq)`` mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from theanompi_tpu.models.transformer import (
+    _rms,
+    build_spec_step,
+    sync_grads_by_spec,
+)
+from theanompi_tpu.ops.moe import switch_moe
+from theanompi_tpu.ops.ring_attention import (
+    full_attention_reference,
+    ring_attention,
+    ulysses_attention,
+)
+
+PyTree = Any
+
+EXPERT_AXIS = "expert"
+
+
+class MoETransformerLM(NamedTuple):
+    """Config. ``n_experts`` experts per block; with an ``expert`` axis
+    of size n, each device owns ``n_experts/n`` of them. ``d_ff`` is the
+    per-expert hidden width."""
+
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 256
+    max_len: int = 1024
+    n_experts: int = 8
+    capacity_factor: float = 1.25
+    aux_weight: float = 0.01
+    attn: str = "ring"
+
+    def init(self, key: jax.Array) -> PyTree:
+        ks = jax.random.split(key, 3 + 5 * self.n_layers)
+        d, h, E = self.d_model, self.d_ff, self.n_experts
+        nh, hd = self.n_heads, self.d_model // self.n_heads
+        s = 0.02
+        params = {
+            "tok_emb": s * jax.random.normal(ks[0], (self.vocab, d)),
+            "pos_emb": s * jax.random.normal(ks[1], (self.max_len, d)),
+            "head": s * jax.random.normal(ks[2], (d, self.vocab)),
+            "blocks": [],
+        }
+        for i in range(self.n_layers):
+            k0, k1, k2, k3, k4 = ks[3 + 5 * i : 8 + 5 * i]
+            params["blocks"].append(
+                {
+                    "qkv": s * jax.random.normal(k0, (d, 3, nh, hd)),
+                    "proj": s * jax.random.normal(k1, (nh, hd, d)),
+                    "gate": s * jax.random.normal(k2, (d, E)),
+                    "expert_in": s * jax.random.normal(k3, (E, d, h)),
+                    "expert_out": s * jax.random.normal(k4, (E, h, d)),
+                    "ln1": jnp.ones((d,)),
+                    "ln2": jnp.ones((d,)),
+                }
+            )
+        return params
+
+    def forward(
+        self,
+        params: PyTree,
+        tokens: jax.Array,  # [B_local, T_local]
+        *,
+        sp_axis: Optional[str] = None,
+        ep_axis: Optional[str] = None,
+    ) -> tuple[jax.Array, jax.Array, jax.Array]:
+        """-> (logits, aux_loss_sum, dropped_frac_mean). Runs inside
+        shard_map; with ``ep_axis`` the expert leaves arrive sharded per
+        :meth:`ep_param_specs` and this device's tokens are its own
+        batch shard (ep doubles as dp)."""
+        B, T = tokens.shape
+        if sp_axis is not None:
+            pos = lax.axis_index(sp_axis) * T + jnp.arange(T)
+        else:
+            pos = jnp.arange(T)
+        x = params["tok_emb"][tokens] + params["pos_emb"][pos][None]
+
+        aux_total = jnp.zeros(())
+        drop_total = jnp.zeros(())
+        for blk in params["blocks"]:
+            hin = _rms(x, blk["ln1"])
+            qkv = jnp.einsum("btd,dchk->btchk", hin, blk["qkv"])
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            if sp_axis is not None:
+                sp_attn = {"ring": ring_attention, "ulysses": ulysses_attention}[
+                    self.attn
+                ]
+                att = sp_attn(q, k, v, sp_axis, causal=True)
+            else:
+                att = full_attention_reference(q, k, v, causal=True)
+            x = x + jnp.einsum("bthk,hkd->btd", att, blk["proj"])
+
+            hin = _rms(x, blk["ln2"])
+            y, stats = switch_moe(
+                hin.reshape(B * T, self.d_model),
+                blk["gate"],
+                blk["expert_in"],
+                blk["expert_out"],
+                ep_axis,
+                capacity_factor=self.capacity_factor,
+                stats_axes=(ep_axis, sp_axis),  # global over every token shard
+            )
+            x = x + y.reshape(B, T, self.d_model)
+            aux_total = aux_total + stats.aux_loss
+            drop_total = drop_total + stats.dropped_frac
+        return x @ params["head"], aux_total, drop_total / self.n_layers
+
+    def loss(
+        self,
+        params: PyTree,
+        tokens: jax.Array,
+        sp_axis: Optional[str] = None,
+        *,
+        ep_axis: Optional[str] = None,
+    ) -> jax.Array:
+        """Next-token CE (global over the sequence, local over this
+        device's batch) + ``aux_weight`` x the Switch load-balance
+        penalty. Same boundary-target/psum structure as TransformerLM."""
+        logits, aux, _ = self.forward(
+            params, tokens, sp_axis=sp_axis, ep_axis=ep_axis
+        )
+        B, T = tokens.shape
+        if sp_axis is not None:
+            n = lax.psum(1, sp_axis)
+            rank = lax.axis_index(sp_axis)
+            nxt = lax.ppermute(
+                tokens[:, 0], sp_axis, [((i + 1) % n, i) for i in range(n)]
+            )
+            targets = jnp.concatenate([tokens[:, 1:], nxt[:, None]], axis=1)
+            last_shard = rank == n - 1
+        else:
+            targets = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
+            last_shard = True
+        valid = jnp.where(
+            last_shard & (jnp.arange(T) == T - 1)[None, :], 0.0, 1.0
+        ) * jnp.ones((B, T))
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        total = jnp.sum(nll * valid)
+        count = jnp.sum(valid)
+        if sp_axis is not None:
+            total = lax.psum(total, sp_axis)
+            count = lax.psum(count, sp_axis)
+        return total / count + self.aux_weight * aux
+
+    def ep_param_specs(self, ep_axis: str = EXPERT_AXIS) -> PyTree:
+        """Expert weights sharded on their leading (expert) dim;
+        everything else replicated."""
+        blk = {
+            "qkv": P(),
+            "proj": P(),
+            "gate": P(),
+            "expert_in": P(ep_axis, None, None),
+            "expert_out": P(ep_axis, None, None),
+            "ln1": P(),
+            "ln2": P(),
+        }
+        return {
+            "tok_emb": P(),
+            "pos_emb": P(),
+            "head": P(),
+            "blocks": [blk] * self.n_layers,
+        }
+
+
+def make_ep_train_step(
+    model: MoETransformerLM,
+    mesh: Mesh,
+    lr: float = 1e-2,
+    *,
+    ep_axis: str = EXPERT_AXIS,
+    sp_axis: Optional[str] = None,
+    optimizer=None,
+):
+    """Jitted expert-parallel train step: ``(params, tokens) ->
+    (new_params, loss)`` (or over ``(params, opt_state)`` with
+    ``optimizer``, as in make_nd_train_step). Tokens ``[B, T]`` are
+    ``P(ep_axis, sp_axis)`` — the expert axis is also the batch axis.
+    Gradient sync follows the universal spec rule (transformer.py):
+    expert shards carry their own full contribution, replicated leaves
+    psum across both axes."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    axes = [a for a in (ep_axis, sp_axis) if a is not None]
+    for a in axes:
+        if a not in sizes:
+            raise ValueError(f"axis {a!r} not in mesh axes {mesh.axis_names}")
+    nep = sizes[ep_axis]
+    if model.n_experts % nep:
+        raise ValueError(
+            f"n_experts={model.n_experts} must divide the {ep_axis!r} "
+            f"axis size {nep}"
+        )
+    n_total = 1
+    for a in axes:
+        n_total *= sizes[a]
+    param_specs = model.ep_param_specs(ep_axis)
+
+    def body(params, tokens):
+        loss, grads = jax.value_and_grad(model.loss)(
+            params, tokens, sp_axis, ep_axis=ep_axis
+        )
+        grads = sync_grads_by_spec(grads, param_specs, axes, n_total)
+        loss = lax.pmean(loss, ep_axis)  # report the global batch mean
+        return loss, grads
+
+    return build_spec_step(
+        body, mesh, param_specs, P(ep_axis, sp_axis), lr, optimizer,
+        lambda: model.init(jax.random.PRNGKey(0)),
+    )

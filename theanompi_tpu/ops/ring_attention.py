@@ -1,4 +1,5 @@
-"""Ring attention: sequence/context parallelism over a named mesh axis.
+"""Sequence/context-parallel attention over a named mesh axis: ring
+attention (K/V rotation) and Ulysses (head<->sequence all-to-all).
 
 BEYOND-PARITY EXTENSION. The reference is a 2016 CNN framework with no
 attention anywhere (SURVEY.md §5.7: "absent — definitively; do not build
@@ -112,14 +113,56 @@ def ring_attention(
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B, Tq, H, D]
 
 
-def full_attention_reference(q, k, v, causal=False, scale=None):
-    """Single-device oracle (same convention) for tests."""
+def ulysses_attention(
+    q: jax.Array,  # [B, T_local, H, D] — this shard's queries
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    precision=None,
+) -> jax.Array:
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style; Jacobs
+    et al. 2023, PAPERS.md) — the other canonical SP scheme next to
+    :func:`ring_attention`, trading the ring's n-1 K/V hops for two
+    ``lax.all_to_all`` head<->sequence transposes.
+
+    Inside ``shard_map`` with the sequence sharded over ``axis_name``:
+    the first all-to-all scatters heads and gathers sequence, so each
+    device holds ``H/n`` full-sequence heads; attention is then plain
+    local softmax attention (no cross-device mask bookkeeping); the
+    second all-to-all restores the ``[B, T_local, H, D]`` layout.
+    Requires ``H % n == 0``. Peak memory is O(T_global^2) scores for the
+    local heads — choose ring attention when T^2 dominates, Ulysses when
+    head count is plentiful and ICI all-to-all is cheap (both are exact).
+    """
+    n = lax.psum(1, axis_name)
+    # scatter heads (axis 2) across the mesh, gather sequence (axis 1):
+    # [B, T/n, H, D] -> [B, T, H/n, D], blocks concatenated in rank order
+    qg = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    kg = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    vg = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    out = full_attention_reference(
+        qg, kg, vg, causal=causal, scale=scale, precision=precision
+    )
+    # gather heads back, re-scatter the sequence
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def full_attention_reference(q, k, v, causal=False, scale=None, precision=None):
+    """Plain full-softmax attention — the single-device oracle for tests
+    and the local per-head kernel inside :func:`ulysses_attention`."""
     B, T, H, D = q.shape
     sc = scale if scale is not None else 1.0 / math.sqrt(D)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * sc
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32),
+        precision=precision,
+    ) * sc
     if causal:
         mask = jnp.tril(jnp.ones((T, T), bool))
         s = jnp.where(mask[None, None], s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    out = jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v.astype(jnp.float32), precision=precision
+    )
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)

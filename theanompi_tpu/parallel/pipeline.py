@@ -1,0 +1,181 @@
+"""Pipeline parallelism (PP) over a named ``pipe`` mesh axis.
+
+BEYOND-PARITY EXTENSION (SURVEY.md §2.3: PP "absent — not required" in
+the 2016 reference; the named-mesh design note makes the axis additive).
+
+TPU-idiomatic GPipe: transformer layers are stacked on a leading dim and
+SHARDED over the ``pipe`` axis — each device owns a contiguous stage of
+``n_layers / n_pipe`` layers and scans them locally. Microbatches stream
+through the stages with ONE ``lax.ppermute`` hop per schedule tick
+inside a ``lax.scan``; the whole schedule is a single differentiable
+SPMD program, so the backward pass (activation cotangents flowing
+backwards through the transposed ppermutes — reverse pipeline) comes
+from AD, not hand-written schedule code. Memory and bubble profile are
+GPipe's: ``M + n - 1`` ticks for ``M`` microbatches over ``n`` stages,
+bubble fraction ``(n-1)/(M+n-1)``.
+
+Embedding runs on stage 0, head + loss on the last stage; both weight
+tensors are replicated (their gradients arrive via the universal
+spec-sync rule — transformer.py::sync_grads_by_spec). Composes with
+data parallelism on a 2-D ``(pipe, data)`` mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from theanompi_tpu.models.transformer import (
+    TransformerLM,
+    _rms,
+    build_spec_step,
+    sync_grads_by_spec,
+)
+from theanompi_tpu.ops.ring_attention import full_attention_reference
+
+PIPE_AXIS = "pipe"
+
+
+def stack_pipeline_params(params):
+    """Convert TransformerLM params (list of per-layer block dicts) to
+    the pipeline layout: block leaves stacked on a leading layer dim
+    (shardable over the pipe axis); other leaves unchanged."""
+    blocks = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *params["blocks"]
+    )
+    return {k: (blocks if k == "blocks" else v) for k, v in params.items()}
+
+
+def unstack_pipeline_params(stacked, n_layers: int):
+    """Inverse of :func:`stack_pipeline_params` (for checkpoint interop
+    and test oracles)."""
+    blocks = [
+        jax.tree_util.tree_map(lambda x: x[i], stacked["blocks"])
+        for i in range(n_layers)
+    ]
+    return {k: (blocks if k == "blocks" else v) for k, v in stacked.items()}
+
+
+def pipeline_param_specs(pipe_axis: str = PIPE_AXIS):
+    """Specs for the stacked layout: the layer dim sharded over pipe,
+    embeddings/head replicated."""
+    return {
+        "tok_emb": P(),
+        "pos_emb": P(),
+        "head": P(),
+        "blocks": jax.tree_util.tree_map(
+            lambda _: P(pipe_axis), _BLOCK_TEMPLATE
+        ),
+    }
+
+
+# structure template for a block's param dict (leaf values unused)
+_BLOCK_TEMPLATE = {
+    "qkv": 0, "proj": 0, "mlp_in": 0, "mlp_out": 0, "ln1": 0, "ln2": 0
+}
+
+
+def _apply_stage(blocks_local, x):
+    """Scan this device's stacked layers over the activation."""
+
+    def body(h, blk):
+        hin = _rms(h, blk["ln1"])
+        qkv = jnp.einsum("btd,dchk->btchk", hin, blk["qkv"])
+        att = full_attention_reference(
+            qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], causal=True
+        )
+        h = h + jnp.einsum("bthk,hkd->btd", att, blk["proj"])
+        hin = _rms(h, blk["ln2"])
+        h = h + jax.nn.gelu(hin @ blk["mlp_in"]) @ blk["mlp_out"]
+        return h, None
+
+    h, _ = lax.scan(body, x, blocks_local)
+    return h
+
+
+def make_pp_train_step(
+    model: TransformerLM,
+    mesh: Mesh,
+    lr: float = 1e-2,
+    *,
+    pipe_axis: str = PIPE_AXIS,
+    dp_axis: Optional[str] = None,
+    optimizer=None,
+):
+    """Jitted pipeline-parallel train step ``(stacked_params, tokens) ->
+    (stacked_params, loss)`` (or over ``(params, opt_state)`` with
+    ``optimizer``). ``tokens [M, B, T]`` is microbatch-major — build it
+    by reshaping the global batch; ``B`` is sharded over ``dp_axis`` if
+    given. Params use :func:`stack_pipeline_params`'s layout.
+    """
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if pipe_axis not in sizes:
+        raise ValueError(f"axis {pipe_axis!r} not in mesh axes {mesh.axis_names}")
+    if dp_axis is not None and dp_axis not in sizes:
+        raise ValueError(f"axis {dp_axis!r} not in mesh axes {mesh.axis_names}")
+    n_pipe = sizes[pipe_axis]
+    if model.n_layers % n_pipe:
+        raise ValueError(
+            f"n_layers={model.n_layers} must divide the {pipe_axis!r} "
+            f"axis size {n_pipe}"
+        )
+    axes = [pipe_axis] + ([dp_axis] if dp_axis else [])
+    n_total = 1
+    for a in axes:
+        n_total *= sizes[a]
+    param_specs = pipeline_param_specs(pipe_axis)
+
+    def pipeline_loss(params, tokens):
+        M, B, T = tokens.shape
+        n = lax.psum(1, pipe_axis)
+        rank = lax.axis_index(pipe_axis)
+        fwd_perm = [(i, i + 1) for i in range(n - 1)]
+
+        # stage-0 inputs for every microbatch (other ranks' copies are
+        # dead code XLA keeps cheap; grads gate on rank 0 via the where)
+        emb = params["tok_emb"][tokens] + params["pos_emb"][jnp.arange(T)][None, None]
+
+        outs0 = jnp.zeros((M, B, T, model.d_model))
+        act0 = jnp.zeros((B, T, model.d_model))
+
+        def tick(carry, t):
+            act, outs = carry
+            act_in = lax.ppermute(act, pipe_axis, fwd_perm)
+            inject = emb[jnp.clip(t, 0, M - 1)]
+            x = jnp.where(rank == 0, inject, act_in)
+            y = _apply_stage(params["blocks"], x)
+            m = t - (n - 1)
+            take = (m >= 0) & (m < M) & (rank == n - 1)
+            sel = (jnp.arange(M) == jnp.clip(m, 0, M - 1))[:, None, None, None]
+            outs = jnp.where(take & sel, y[None], outs)
+            return (y, outs), None
+
+        (_, outs), _ = lax.scan(tick, (act0, outs0), jnp.arange(M + n - 1))
+
+        logits = outs @ params["head"]  # [M, B, T, V]
+        targets = jnp.concatenate([tokens[:, :, 1:], tokens[:, :, :1]], axis=-1)
+        valid = jnp.broadcast_to(
+            (jnp.arange(T) < T - 1).astype(jnp.float32), tokens.shape
+        )
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        local = jnp.sum(nll * valid) / jnp.sum(valid)
+        # only the last stage computed real logits; broadcast its loss
+        return lax.psum(jnp.where(rank == n - 1, local, 0.0), pipe_axis)
+
+    def body(params, tokens):
+        loss, grads = jax.value_and_grad(pipeline_loss)(params, tokens)
+        grads = sync_grads_by_spec(grads, param_specs, axes, n_total)
+        if dp_axis is not None:
+            loss = lax.pmean(loss, dp_axis)
+        return loss, grads
+
+    tok_spec = P(None, dp_axis) if dp_axis else P()
+    return build_spec_step(
+        body, mesh, param_specs, tok_spec, lr, optimizer,
+        lambda: stack_pipeline_params(model.init(jax.random.PRNGKey(0))),
+    )

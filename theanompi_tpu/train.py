@@ -72,14 +72,20 @@ def make_train_step(
 
     NOTE: the local-grad → allreduce decomposition relies on classic
     pmap-style AD semantics (``shard_map(..., check_vma=False)``), under
-    which psum's transpose is identity — that is exactly what makes
-    "grad locally, then average" produce the true global gradient, even
-    when the forward pass itself contains collectives (cross-replica
-    BatchNorm). Under ``check_vma=True`` the cotangent of replicated
-    params is already globally summed ("unreduced"), so an explicit
-    exchanger would double-count — verified empirically on jax 0.9; see
+    which the transpose of a forward psum is itself a psum (measured on
+    jax 0.9 — cotangents flow across the collective), so each device's
+    backward yields exactly ``d(sum over devices of local_loss)/d
+    theta_local``. Summing those per-device grads over the mesh and
+    dividing by n — the exchanger's psum-mean — is therefore the true
+    gradient of the mean loss, and this stays EXACT even when the
+    forward pass contains collectives (cross-replica BatchNorm), whose
+    cross-device paths the transposed psums account for. Under
+    ``check_vma=True`` the cotangent of replicated params arrives
+    already globally summed ("unreduced"), so an explicit exchanger
+    would double-count — verified empirically on jax 0.9; see
     tests/test_bsp.py. All shard_maps in this framework therefore use
-    ``check_vma=False``.
+    ``check_vma=False``. (models/transformer.py::make_nd_train_step
+    generalizes this rule to multi-axis tp/sp meshes.)
     """
     optimizer = model.optimizer()
     schedule = model.schedule()
