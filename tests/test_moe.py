@@ -116,3 +116,46 @@ def test_ep_training_learns():
         last = float(loss)
     assert first > 2.0
     assert last < 1.0, f"EP training failed to learn: {first} -> {last}"
+
+
+def test_switch_moe_bf16_routing_counts_past_256():
+    """Regression: routing bookkeeping must not run in the activation
+    dtype — a bf16 cumsum cannot count past 256, colliding capacity
+    slots for popular experts. Route 2048 bf16 tokens to few experts
+    and check against the f32-activation result."""
+    r = np.random.RandomState(5)
+    S, d, E = 2048, 16, 4
+    x32 = jnp.asarray(r.randn(S, d), jnp.float32)
+    # deterministic routing: every token to expert 0 (saturated gate),
+    # so bf16 vs f32 differ only by arithmetic rounding — except that a
+    # bf16 cumsum collides slots 256..2047 (pre-fix: garbage outputs)
+    gate = jnp.zeros((d, E), jnp.float32).at[:, 0].set(100.0)
+    ein = jnp.asarray(0.1 * r.randn(E, d, 32), jnp.float32)
+    eout = jnp.asarray(0.1 * r.randn(E, 32, d), jnp.float32)
+
+    y32, s32 = switch_moe(x32, gate, ein, eout, None, capacity_factor=float(E))
+    y16, s16 = switch_moe(
+        x32.astype(jnp.bfloat16), gate.astype(jnp.bfloat16),
+        ein.astype(jnp.bfloat16), eout.astype(jnp.bfloat16),
+        None, capacity_factor=float(E),
+    )
+    assert float(s32.dropped_frac) == 0.0 and float(s16.dropped_frac) == 0.0
+    np.testing.assert_allclose(
+        np.asarray(y16, np.float32), np.asarray(y32), atol=0.15
+    )
+
+
+def test_ulysses_head_divisibility_validated_without_tp():
+    """The friendly error must fire for sp-only and ep steps too (it
+    used to be gated behind tp_axis)."""
+    from theanompi_tpu.models.transformer import TransformerLM, make_nd_train_step
+
+    mesh = make_mesh(8, axis_names=("seq",))
+    lm = TransformerLM(vocab=32, d_model=32, n_heads=4, attn="ulysses")
+    with pytest.raises(ValueError, match="ulysses"):
+        make_nd_train_step(lm, mesh, sp_axis="seq")
+
+    emesh = make_mesh(8, axis_names=(EXPERT_AXIS, "seq"), shape=(2, 4))
+    moe = _model(n_heads=2, attn="ulysses")
+    with pytest.raises(ValueError, match="ulysses"):
+        make_ep_train_step(moe, emesh, sp_axis="seq")

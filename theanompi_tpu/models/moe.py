@@ -25,15 +25,14 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from theanompi_tpu.models.transformer import (
     _rms,
+    attention_block,
     build_spec_step,
+    next_token_loss,
+    softmax_nll,
     sync_grads_by_spec,
+    validate_ulysses_heads,
 )
 from theanompi_tpu.ops.moe import switch_moe
-from theanompi_tpu.ops.ring_attention import (
-    full_attention_reference,
-    ring_attention,
-    ulysses_attention,
-)
 
 PyTree = Any
 
@@ -104,17 +103,7 @@ class MoETransformerLM(NamedTuple):
         aux_total = jnp.zeros(())
         drop_total = jnp.zeros(())
         for blk in params["blocks"]:
-            hin = _rms(x, blk["ln1"])
-            qkv = jnp.einsum("btd,dchk->btchk", hin, blk["qkv"])
-            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-            if sp_axis is not None:
-                sp_attn = {"ring": ring_attention, "ulysses": ulysses_attention}[
-                    self.attn
-                ]
-                att = sp_attn(q, k, v, sp_axis, causal=True)
-            else:
-                att = full_attention_reference(q, k, v, causal=True)
-            x = x + jnp.einsum("bthk,hkd->btd", att, blk["proj"])
+            x = x + attention_block(blk, x, self.attn, sp_axis)
 
             hin = _rms(x, blk["ln2"])
             y, stats = switch_moe(
@@ -145,29 +134,8 @@ class MoETransformerLM(NamedTuple):
         logits, aux, _ = self.forward(
             params, tokens, sp_axis=sp_axis, ep_axis=ep_axis
         )
-        B, T = tokens.shape
-        if sp_axis is not None:
-            n = lax.psum(1, sp_axis)
-            rank = lax.axis_index(sp_axis)
-            nxt = lax.ppermute(
-                tokens[:, 0], sp_axis, [((i + 1) % n, i) for i in range(n)]
-            )
-            targets = jnp.concatenate([tokens[:, 1:], nxt[:, None]], axis=1)
-            last_shard = rank == n - 1
-        else:
-            targets = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
-            last_shard = True
-        valid = jnp.where(
-            last_shard & (jnp.arange(T) == T - 1)[None, :], 0.0, 1.0
-        ) * jnp.ones((B, T))
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        total = jnp.sum(nll * valid)
-        count = jnp.sum(valid)
-        if sp_axis is not None:
-            total = lax.psum(total, sp_axis)
-            count = lax.psum(count, sp_axis)
-        return total / count + self.aux_weight * aux
+        ce = next_token_loss(tokens, sp_axis, softmax_nll(logits))
+        return ce + self.aux_weight * aux
 
     def ep_param_specs(self, ep_axis: str = EXPERT_AXIS) -> PyTree:
         """Expert weights sharded on their leading (expert) dim;
@@ -216,6 +184,7 @@ def make_ep_train_step(
             f"n_experts={model.n_experts} must divide the {ep_axis!r} "
             f"axis size {nep}"
         )
+    validate_ulysses_heads(model, sp_axis, sizes, model.n_heads)
     n_total = 1
     for a in axes:
         n_total *= sizes[a]

@@ -52,6 +52,65 @@ def _rms(x, g):
     return x * lax.rsqrt(jnp.mean(x * x, -1, keepdims=True) + 1e-6) * g
 
 
+def attention_block(blk, x, attn: str, sp_axis: Optional[str]):
+    """Pre-norm attention sub-block shared by the dense and MoE LMs:
+    qkv projection (TP-native ``[d, 3, H, hd]`` layout), causal
+    (ring | ulysses | local full) attention, output projection. Returns
+    the residual delta BEFORE any tp-axis psum (the caller owns that)."""
+    hin = _rms(x, blk["ln1"])
+    qkv = jnp.einsum("btd,dchk->btchk", hin, blk["qkv"])
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B, T, H_local, hd]
+    if sp_axis is not None:
+        sp_attn = {"ring": ring_attention, "ulysses": ulysses_attention}[attn]
+        att = sp_attn(q, k, v, sp_axis, causal=True)
+    else:
+        att = full_attention_reference(q, k, v, causal=True)
+    return jnp.einsum("bthk,hkd->btd", att, blk["proj"])
+
+
+def next_token_loss(tokens, sp_axis: Optional[str], nll_fn):
+    """Next-token objective plumbing shared by the dense and MoE LMs:
+    builds the target sequence (the target of a shard's last position is
+    the NEXT shard's first token, fetched with one backward ppermute),
+    masks the final global position (no target), and reduces to the mean
+    over this device's batch rows x the GLOBAL sequence. ``nll_fn(targets)
+    -> [B, T]`` supplies the per-position negative log-likelihood."""
+    B, T = tokens.shape
+    if sp_axis is not None:
+        n = lax.psum(1, sp_axis)
+        rank = lax.axis_index(sp_axis)
+        nxt = lax.ppermute(
+            tokens[:, 0], sp_axis, [((i + 1) % n, i) for i in range(n)]
+        )
+        targets = jnp.concatenate([tokens[:, 1:], nxt[:, None]], axis=1)
+        last_shard = rank == n - 1
+    else:
+        targets = jnp.concatenate(
+            [tokens[:, 1:], tokens[:, :1]], axis=1
+        )  # wrapped value is masked out below
+        last_shard = True
+    valid = jnp.where(
+        last_shard & (jnp.arange(T) == T - 1)[None, :], 0.0, 1.0
+    ) * jnp.ones((B, T))
+    nll = nll_fn(targets)
+    total = jnp.sum(nll * valid)
+    count = jnp.sum(valid)
+    if sp_axis is not None:
+        total = lax.psum(total, sp_axis)
+        count = lax.psum(count, sp_axis)
+    return total / count
+
+
+def softmax_nll(logits):
+    """Standard per-position NLL from full (unsharded) logits."""
+
+    def nll_fn(targets):
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+
+    return nll_fn
+
+
 class TransformerLM(NamedTuple):
     """Architecture config (params live in a plain dict pytree).
 
@@ -123,17 +182,7 @@ class TransformerLM(NamedTuple):
         x = params["tok_emb"][tokens] + params["pos_emb"][pos][None]
 
         for blk in params["blocks"]:
-            hin = _rms(x, blk["ln1"])
-            qkv = jnp.einsum("btd,dchk->btchk", hin, blk["qkv"])
-            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,T,nh_local,hd]
-            if sp_axis is not None:
-                sp_attn = {"ring": ring_attention, "ulysses": ulysses_attention}[
-                    self.attn
-                ]
-                att = sp_attn(q, k, v, sp_axis, causal=True)
-            else:
-                att = full_attention_reference(q, k, v, causal=True)
-            delta = jnp.einsum("bthk,hkd->btd", att, blk["proj"])
+            delta = attention_block(blk, x, self.attn, sp_axis)
             if tp_axis is not None:
                 delta = lax.psum(delta, tp_axis)  # row-parallel proj
             x = x + delta
@@ -164,36 +213,11 @@ class TransformerLM(NamedTuple):
         sp/tp peer)."""
         sp_axis = axis_name
         logits = self.forward(params, tokens, sp_axis=sp_axis, tp_axis=tp_axis)
-        B, T = tokens.shape
-        if sp_axis is not None:
-            n = lax.psum(1, sp_axis)
-            rank = lax.axis_index(sp_axis)
-            nxt = lax.ppermute(
-                tokens[:, 0], sp_axis, [((i + 1) % n, i) for i in range(n)]
-            )
-            targets = jnp.concatenate([tokens[:, 1:], nxt[:, None]], axis=1)
-            last_shard = rank == n - 1
-        else:
-            targets = jnp.concatenate(
-                [tokens[:, 1:], tokens[:, :1]], axis=1
-            )  # wrapped value is masked out below
-            last_shard = True
-        valid = jnp.where(
-            last_shard & (jnp.arange(T) == T - 1)[None, :], 0.0, 1.0
-        ) * jnp.ones((B, T))
-
         if tp_axis is not None:
-            nll = _vocab_sharded_nll(logits, targets, tp_axis)
+            nll_fn = lambda t: _vocab_sharded_nll(logits, t, tp_axis)  # noqa: E731
         else:
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-
-        total = jnp.sum(nll * valid)
-        count = jnp.sum(valid)
-        if sp_axis is not None:
-            total = lax.psum(total, sp_axis)
-            count = lax.psum(count, sp_axis)
-        return total / count
+            nll_fn = softmax_nll(logits)
+        return next_token_loss(tokens, sp_axis, nll_fn)
 
     # -- TP sharding spec ------------------------------------------------
 
@@ -234,6 +258,19 @@ def _vocab_sharded_nll(logits: jax.Array, targets: jax.Array, tp_axis: str):
     tl = jnp.take_along_axis(logits, idx[..., None], axis=-1)[..., 0]
     tl = lax.psum(jnp.where(in_range, tl, 0.0), tp_axis)
     return jnp.log(z) + m - tl
+
+
+def validate_ulysses_heads(model, sp_axis, sizes, heads_local):
+    """Friendly build-time error for the Ulysses all-to-all's head
+    divisibility requirement (otherwise it surfaces as an opaque
+    lax.all_to_all trace error deep inside the attention)."""
+    if sp_axis and getattr(model, "attn", None) == "ulysses" and (
+        heads_local % sizes[sp_axis]
+    ):
+        raise ValueError(
+            f"ulysses attention needs local heads ({heads_local}) divisible "
+            f"by the {sp_axis!r} axis size {sizes[sp_axis]}"
+        )
 
 
 def opt_state_specs(opt_template, param_specs):
@@ -391,13 +428,9 @@ def make_nd_train_step(
                 f"n_heads/d_ff/vocab ({model.n_heads}/{model.d_ff}/"
                 f"{model.vocab}) must divide the {tp_axis!r} axis size {ntp}"
             )
-        if sp_axis and model.attn == "ulysses" and (
-            (model.n_heads // ntp) % sizes[sp_axis]
-        ):
-            raise ValueError(
-                f"ulysses attention needs local heads ({model.n_heads}//{ntp}) "
-                f"divisible by the {sp_axis!r} axis size {sizes[sp_axis]}"
-            )
+    validate_ulysses_heads(
+        model, sp_axis, sizes, model.n_heads // (sizes[tp_axis] if tp_axis else 1)
+    )
     n_total = 1
     for a in axes:
         n_total *= sizes[a]

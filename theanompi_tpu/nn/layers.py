@@ -168,6 +168,14 @@ class Pool(Layer):
         sh, sw = self.stride
         dims, strides = (1, kh, kw, 1), (1, sh, sw, 1)
         if self.mode == "max":
+            # NOTE: AD of reduce_window-max lowers to select-and-scatter
+            # (~2.5 ms/step on AlexNet's 55x55 map at batch 1024, v5e).
+            # A Theano-style eq-mask custom backward (g * (x == y) summed
+            # over the k x k shifted windows) was tried in two
+            # formulations (per-offset pads; one framed buffer + static
+            # slices) and BOTH measured ~2x slower end-to-end — XLA does
+            # not fuse the 9-way accumulation over these map sizes.
+            # Keeping the native lowering is the measured optimum.
             y = lax.reduce_window(
                 x, -jnp.inf, lax.max, dims, strides, self._pad_arg()
             )
@@ -203,6 +211,10 @@ class LRN(Layer):
     """
 
     def __init__(self, n: int = 5, alpha: float = 1e-4, beta: float = 0.75, k: float = 2.0, name: str = "lrn"):
+        # the banded window sum below is the symmetric |i-j| <= n//2
+        # band, which spans n channels only for odd n (even n would
+        # silently widen to n+1 vs the reference's asymmetric window)
+        assert n % 2 == 1, f"LRN window n must be odd, got {n}"
         self.n = n
         self.alpha = alpha
         self.beta = beta
@@ -211,16 +223,31 @@ class LRN(Layer):
 
     def apply(self, params, state, x, *, train=False, rng=None):
         sq = jnp.square(x)
-        window_sum = lax.reduce_window(
-            sq, 0.0, lax.add, (1, 1, 1, self.n), (1, 1, 1, 1), "SAME"
-        )
+        # Cross-channel window sum as a banded [C, C] matmul: the MXU
+        # eats it (C = 96/256), XLA fuses the square into the GEMM input
+        # and the rsqrt math into its output, and AD's transpose is just
+        # the same band again — where the reduce_window lowering costs
+        # several full HBM passes over AlexNet's 55x55 maps (measured on
+        # v5e at batch 1024: 13,969 -> 18,169 img/s for the whole train
+        # step). A fully fused Pallas kernel was also tried and REJECTED:
+        # XLA picks batch-minor layouts for these conv activations, and
+        # a lane=C kernel's layout constraint forces ~600 MB relayout
+        # copies around every call that cost more than the fusion saves.
+        c = x.shape[-1]
+        i = jnp.arange(c)
+        band = (jnp.abs(i[:, None] - i[None, :]) <= self.n // 2).astype(x.dtype)
+        # output dtype follows x (bf16 on TPU): the MXU accumulates in
+        # f32 internally either way, and asking for an f32 result here
+        # materializes a full-precision copy of the biggest activation
+        # maps in the backward residuals (~1.2 GB at AlexNet batch 1024)
+        window_sum = jnp.einsum("...c,cd->...d", sq, band)
         d = self.k + (self.alpha / self.n) * window_sum
         if self.beta == 0.75:
             # d^-0.75 = rsqrt(d) * rsqrt(sqrt(d)): sqrt/rsqrt are single
             # VPU ops where pow lowers to exp(log) — measurably cheaper
             # on the big early conv maps (agrees with pow to ~1e-6 rel)
-            return x * lax.rsqrt(d) * lax.rsqrt(lax.sqrt(d)), state
-        return x / jnp.power(d, self.beta), state
+            return (x * lax.rsqrt(d) * lax.rsqrt(lax.sqrt(d))).astype(x.dtype), state
+        return (x / jnp.power(d, self.beta)).astype(x.dtype), state
 
 
 class Dense(Layer):
@@ -373,6 +400,15 @@ class Sequential(Layer):
         keys = jax.random.split(key, max(1, len(self.layers)))
         shape = in_shape
         for k, lname, layer in zip(keys, self._keys, self.layers):
+            if any(d <= 0 for d in shape):
+                # fail with the layer name, not a ZeroDivisionError deep
+                # in an initializer (e.g. GoogLeNet on an input smaller
+                # than its pooling stack supports)
+                raise ValueError(
+                    f"{self.name}: input to layer {lname!r} has non-positive "
+                    f"dims {tuple(shape)} — input_shape too small for this "
+                    "architecture"
+                )
             p, s = layer.init(k, shape)
             if p:
                 params[lname] = p

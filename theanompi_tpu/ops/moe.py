@@ -73,14 +73,19 @@ def switch_moe(
     probs = jax.nn.softmax(logits, axis=-1)
     p = jnp.max(probs, axis=-1)  # [S] gate scale of the chosen expert
     e = jnp.argmax(probs, axis=-1)  # [S]
-    onehot = jax.nn.one_hot(e, E, dtype=x.dtype)  # [S, E]
+    # routing bookkeeping in f32 regardless of x.dtype: a bf16 cumsum
+    # cannot count past 256 (8 mantissa bits), which would collide
+    # capacity-slot assignments for popular experts with no error
+    onehot = jax.nn.one_hot(e, E, dtype=jnp.float32)  # [S, E]
 
     # slot position of each token within its expert's capacity buffer
     pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # [S, E]
     kept = (pos < C) & (onehot > 0)
-    dropped = 1.0 - kept.any(axis=-1).astype(x.dtype)
-    slot = jax.nn.one_hot(pos.sum(axis=-1).astype(jnp.int32), C, dtype=x.dtype)
-    dispatch = kept.astype(x.dtype)[:, :, None] * slot[:, None, :]  # [S, E, C]
+    dropped = 1.0 - kept.any(axis=-1).astype(jnp.float32)
+    slot = jax.nn.one_hot(pos.sum(axis=-1).astype(jnp.int32), C, dtype=jnp.float32)
+    dispatch = (kept.astype(jnp.float32)[:, :, None] * slot[:, None, :]).astype(
+        x.dtype
+    )  # [S, E, C]
 
     buf = jnp.einsum("sec,sd->ecd", dispatch, x)  # [E, C, d]
     if axis_name is not None:
