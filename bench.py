@@ -57,13 +57,83 @@ def jax_block(x):
     jax.block_until_ready(x)
 
 
-def bench_compute(steps: int = 20, trials: int = 3) -> dict:
+def _roundtrip_latency() -> float:
+    """Median host<->device round-trip of a trivial varied op — the
+    tunnel's fetch latency (~115 ms on the axon dev chip), measured so
+    the round-trip-synced fallback below can subtract it."""
+    import jax.numpy as jnp
+
+    lats = []
+    for i in range(5):
+        t0 = time.perf_counter()
+        float(jnp.sum(jnp.ones(()) * i))
+        lats.append(time.perf_counter() - t0)
+    return sorted(lats)[len(lats) // 2]
+
+
+def _measure_roundtrip(runner, state, x, y, trials=3):
+    """Fallback timing when block_until_ready stops blocking (a tunneled-
+    backend fault observed after cost-analysis AOT calls: dispatch
+    returns in ~2 ms, results are correct, the sync is a no-op). Each
+    trial varies the rng key (defeats any result caching) and syncs with
+    an actual host fetch of the losses; the separately measured fetch
+    latency is subtracted."""
+    import jax
+
+    lat = _roundtrip_latency()
+    best = None
+    for t in range(trials):
+        t0 = time.perf_counter()
+        out = runner(state, x, y, jax.random.PRNGKey(100 + t))
+        np.asarray(out[1]["loss"])
+        dt = time.perf_counter() - t0 - lat
+        best = dt if best is None else min(best, dt)
+    return max(best, 1e-9)
+
+
+def _zoo_entry(name: str):
+    """(model_cls, single_chip_global_batch) for the benchable zoo.
+
+    Batch policy: AlexNet runs the reference workload's GLOBAL batch
+    (BASELINE config #2: 8 workers x 128 = 1024 — same SGD trajectory,
+    and a v5e only reaches full MXU utilization ~batch 1024); GoogLeNet
+    likewise (config #3: 32 workers x 32 = 1024). ResNet-50 uses config
+    #4's batch 256; VGG16/WRN use the largest power-of-two that fits one
+    chip's HBM comfortably."""
+    if name == "alexnet":
+        from theanompi_tpu.models.alex_net import AlexNet
+
+        return AlexNet, 1024
+    if name == "googlenet":
+        from theanompi_tpu.models.googlenet import GoogLeNet
+
+        # config #3's global batch is 32 x 32 = 1024, but the scanned
+        # multi-step program above batch 256 silently fails on the
+        # tunneled dev backend (single steps run fine at 1024; the scan
+        # returns without executing and trips the physics guard) —
+        # bench at 256 per chip until a directly-attached host says more
+        return GoogLeNet, 256
+    if name == "resnet50":
+        from theanompi_tpu.models.model_zoo.resnet50 import ResNet50
+
+        return ResNet50, 256
+    if name == "vgg16":
+        from theanompi_tpu.models.model_zoo.vgg import VGG16
+
+        return VGG16, 128
+    if name == "wrn":
+        from theanompi_tpu.models.model_zoo.wrn import WRN
+
+        return WRN, 1024
+    raise ValueError(f"unknown bench model {name!r}")
+
+
+def bench_compute(steps: int = 20, trials: int = 3, model_name: str = "alexnet") -> dict:
     """Fused-step device throughput: fwd+bwd+sync+update, input pipeline
     excluded (see e2e mode for the honest framework number)."""
     import jax
     import jax.numpy as jnp
 
-    from theanompi_tpu.models.alex_net import AlexNet
     from theanompi_tpu.parallel import make_mesh
     from theanompi_tpu.parallel.mesh import put_global_batch
     from theanompi_tpu.parallel.strategies import get_strategy
@@ -71,14 +141,12 @@ def bench_compute(steps: int = 20, trials: int = 3) -> dict:
     from theanompi_tpu.utils.flops import compiled_flops, peak_flops
 
     n_dev = len(jax.devices())
-    # The reference workload (BASELINE config #2) is 8 workers x batch 128
-    # = global batch 1024. Below 8 chips we keep the reference's GLOBAL
-    # batch (same SGD trajectory, and a v5e only reaches full MXU
-    # utilization ~batch 1024: 8.7k img/s at 128 vs 14k at 1024); at >=8
-    # chips it is the reference's 128/worker weak-scaling shape.
-    batch = 128 * max(8, n_dev)
-    batch = -(-batch // n_dev) * n_dev  # round up to shard evenly (n_dev=6: 1026)
-    model = AlexNet(AlexNet.default_recipe().replace(batch_size=batch))
+    model_cls, base_batch = _zoo_entry(model_name)
+    # single-chip global batch, scaled per-chip past 8 devices for the
+    # weak-scaling shape; rounded up to shard evenly on any device count
+    batch = base_batch * n_dev // 8 if n_dev > 8 else base_batch
+    batch = -(-batch // n_dev) * n_dev
+    model = model_cls(model_cls.default_recipe().replace(batch_size=batch))
     mesh = make_mesh(n_dev)
 
     if n_dev == 1:
@@ -99,8 +167,10 @@ def bench_compute(steps: int = 20, trials: int = 3) -> dict:
 
     state = init_train_state(model, jax.random.PRNGKey(0))
     rng = np.random.RandomState(0)
-    x = put_global_batch(mesh, jnp.asarray(rng.randn(batch, 227, 227, 3), jnp.float32))
-    y = put_global_batch(mesh, jnp.asarray(rng.randint(0, 1000, batch), jnp.int32))
+    ishape = tuple(model.recipe.input_shape)
+    ncls = model.recipe.num_classes
+    x = put_global_batch(mesh, jnp.asarray(rng.randn(batch, *ishape), jnp.float32))
+    y = put_global_batch(mesh, jnp.asarray(rng.randint(0, ncls, batch), jnp.int32))
     args = (state, x, y, jax.random.PRNGKey(1))
 
     # XLA's cost analysis counts a scan body ONCE regardless of trip
@@ -111,18 +181,16 @@ def bench_compute(steps: int = 20, trials: int = 3) -> dict:
     best = _measure(runner, args, lambda out: out[1]["loss"], trials)
     img_s = steps * batch / best
 
-    # Physics guard: a transient backend fault can make calls return
-    # without executing (observed once on the tunneled chip: 21M img/s).
-    # Anything beyond the 100%-MFU bound is impossible — re-measure.
+    # Physics guard: a backend fault can make block_until_ready return
+    # without blocking (observed on the tunneled chip; results are
+    # correct, only the sync breaks). Anything beyond the 100%-MFU bound
+    # is impossible — fall back to round-trip-synced measurement.
     if flops_step and peak_bound:
         max_img_s = peak_bound * batch / flops_step
-        for _ in range(3):
-            if img_s <= max_img_s:
-                break
-            time.sleep(5)
-            best = _measure(runner, args, lambda out: out[1]["loss"], trials)
+        if img_s > max_img_s:
+            best = _measure_roundtrip(runner, state, x, y, trials)
             img_s = steps * batch / best
-        else:
+        if img_s > max_img_s:
             raise RuntimeError(
                 f"measured {img_s:.0f} img/s exceeds the 100%-MFU bound "
                 f"{max_img_s:.0f} — backend not actually executing"
@@ -130,11 +198,13 @@ def bench_compute(steps: int = 20, trials: int = 3) -> dict:
     flops_s = flops_total / best if flops_total else None
     peak = peak_flops()
     result = {
-        "metric": f"alexnet_imagenet_bsp_images_per_sec_{n_dev}chip",
+        "metric": f"{model_name}_{model.recipe.dataset}_bsp_images_per_sec_{n_dev}chip",
         "value": round(img_s, 1),
         "unit": "images/sec",
-        "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
-        "baseline_estimated": True,
+        # the 8xP100 estimate is an ALEXNET number (BASELINE config #2);
+        # other zoo models report throughput without a baseline ratio
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 4) if model_name == "alexnet" else None,
+        "baseline_estimated": model_name == "alexnet",
         "n_devices": n_dev,
         "device_kind": jax.devices()[0].device_kind,
         "tflops_per_sec": round(flops_s / 1e12, 2) if flops_s else None,
@@ -301,11 +371,15 @@ def bench_scaling(ns=(1, 2, 4, 8), steps: int = 4) -> dict:
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--mode", choices=["compute", "e2e", "scaling"], default="compute")
+    ap.add_argument("--model", default="alexnet",
+                    choices=["alexnet", "googlenet", "resnet50", "vgg16", "wrn"],
+                    help="compute mode: which zoo model to benchmark "
+                         "(the driver contract stays the AlexNet default)")
     ap.add_argument("--steps", type=int, default=None)
     args = ap.parse_args()
 
     if args.mode == "compute":
-        result = bench_compute(steps=args.steps or 20)
+        result = bench_compute(steps=args.steps or 20, model_name=args.model)
     elif args.mode == "e2e":
         result = bench_e2e(max_steps=args.steps or 48)
     else:

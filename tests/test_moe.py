@@ -125,11 +125,13 @@ def test_switch_moe_bf16_routing_counts_past_256():
     and check against the f32-activation result."""
     r = np.random.RandomState(5)
     S, d, E = 2048, 16, 4
-    x32 = jnp.asarray(r.randn(S, d), jnp.float32)
-    # deterministic routing: every token to expert 0 (saturated gate),
-    # so bf16 vs f32 differ only by arithmetic rounding — except that a
-    # bf16 cumsum collides slots 256..2047 (pre-fix: garbage outputs)
-    gate = jnp.zeros((d, E), jnp.float32).at[:, 0].set(100.0)
+    # deterministic routing: a constant +1 feature and a gate reading
+    # only it make logits[:, 0] = 100 for EVERY token regardless of
+    # dtype — bf16 vs f32 then differ only by arithmetic rounding,
+    # except that a bf16 cumsum collides slots 256..2047 (pre-fix:
+    # garbage outputs)
+    x32 = jnp.asarray(r.randn(S, d), jnp.float32).at[:, -1].set(1.0)
+    gate = jnp.zeros((d, E), jnp.float32).at[-1, 0].set(100.0)
     ein = jnp.asarray(0.1 * r.randn(E, d, 32), jnp.float32)
     eout = jnp.asarray(0.1 * r.randn(E, 32, d), jnp.float32)
 

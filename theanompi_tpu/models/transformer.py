@@ -117,6 +117,9 @@ class TransformerLM(NamedTuple):
     ``attn`` picks the sequence-parallel attention scheme: ``"ring"``
     (K/V rotation, O(T/n) memory) or ``"ulysses"`` (head<->sequence
     all-to-all; needs ``n_heads`` divisible by the seq-axis size).
+    ``remat=True`` checkpoints each block (jax.checkpoint): backward
+    recomputes block activations instead of storing them — combine with
+    the seq axis for long-context training beyond HBM.
 
     Param layout is TP-native: ``qkv`` is ``[d, 3, H, hd]`` and ``proj``
     ``[H, hd, d]`` so sharding their head dim over the ``model`` axis is
@@ -130,6 +133,7 @@ class TransformerLM(NamedTuple):
     d_ff: int = 256
     max_len: int = 1024
     attn: str = "ring"
+    remat: bool = False
 
     def init(self, key: jax.Array) -> PyTree:
         ks = jax.random.split(key, 3 + 4 * self.n_layers)
@@ -181,7 +185,7 @@ class TransformerLM(NamedTuple):
             pos = jnp.arange(T)
         x = params["tok_emb"][tokens] + params["pos_emb"][pos][None]
 
-        for blk in params["blocks"]:
+        def block(x, blk):
             delta = attention_block(blk, x, self.attn, sp_axis)
             if tp_axis is not None:
                 delta = lax.psum(delta, tp_axis)  # row-parallel proj
@@ -190,7 +194,16 @@ class TransformerLM(NamedTuple):
             delta = jax.nn.gelu(hin @ blk["mlp_in"]) @ blk["mlp_out"]
             if tp_axis is not None:
                 delta = lax.psum(delta, tp_axis)  # row-parallel mlp_out
-            x = x + delta
+            return x + delta
+
+        if self.remat:
+            # rematerialize per block: backward recomputes the block's
+            # activations (incl. its collectives) instead of keeping
+            # them — O(sqrt-ish) activation memory for long sequences,
+            # the standard jax.checkpoint trade of FLOPs for HBM
+            block = jax.checkpoint(block)
+        for blk in params["blocks"]:
+            x = block(x, blk)
         return x @ params["head"]
 
     def loss(
