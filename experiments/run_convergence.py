@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import subprocess
 import sys
 
@@ -49,7 +50,14 @@ print("RESULT " + json.dumps({
 
 
 def _run(name: str, kwargs: dict, n_devices: int = 8) -> dict:
-    kwargs = dict(kwargs, save_dir=os.path.join(RESULTS, name))
+    # fresh per-run dir, replaced only on SUCCESS: the Recorder APPENDS
+    # to existing JSONL (a naive rerun would accumulate runs in one
+    # artifact), and deleting up front would destroy the committed
+    # evidence if the child fails
+    run_dir = os.path.join(RESULTS, name)
+    tmp_dir = run_dir + ".new"
+    shutil.rmtree(tmp_dir, ignore_errors=True)
+    kwargs = dict(kwargs, save_dir=tmp_dir)
     env = dict(os.environ)
     env["XLA_FLAGS"] = (
         env.get("XLA_FLAGS", "")
@@ -62,8 +70,11 @@ def _run(name: str, kwargs: dict, n_devices: int = 8) -> dict:
         env=env, cwd=REPO, capture_output=True, text=True, timeout=3600,
     )
     if p.returncode != 0:
+        shutil.rmtree(tmp_dir, ignore_errors=True)
         sys.stderr.write(p.stdout[-1000:] + "\n" + p.stderr[-3000:])
         raise RuntimeError(f"experiment {name} failed")
+    shutil.rmtree(run_dir, ignore_errors=True)
+    os.rename(tmp_dir, run_dir)
     line = [l for l in p.stdout.splitlines() if l.startswith("RESULT ")][-1]
     out = json.loads(line[len("RESULT "):])
     print(json.dumps(out))
@@ -71,7 +82,7 @@ def _run(name: str, kwargs: dict, n_devices: int = 8) -> dict:
 
 
 def exp_rules() -> list[dict]:
-    """BSP vs EASGD vs GoSGD at n=8, fixed 240-step budget, synthetic.
+    """BSP vs EASGD vs GoSGD at n=8, fixed 320-step budget, synthetic.
 
     Per-worker batch 16 for the async rules (global 128/step); BSP uses
     global batch 128 — identical images/step across rules.
@@ -80,7 +91,7 @@ def exp_rules() -> list[dict]:
     common = dict(
         devices=8,
         n_epochs=100,  # truncated by max_steps
-        max_steps=240,
+        max_steps=320,
         dataset="synthetic",
         dataset_kwargs={"n_train": 2048, "n_val": 512,
                         "image_shape": [16, 16, 3]},
@@ -147,8 +158,16 @@ def main(argv=None) -> int:
     if which in ("digits", "all"):
         results += exp_digits()
     os.makedirs(RESULTS, exist_ok=True)
-    with open(os.path.join(RESULTS, "summary.json"), "w") as f:
-        json.dump(results, f, indent=1)
+    # merge by name so a partial run ("rules" / "digits") does not drop
+    # the other experiments' entries from the summary
+    path = os.path.join(RESULTS, "summary.json")
+    merged = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            merged = {r["name"]: r for r in json.load(f)}
+    merged.update({r["name"]: r for r in results})
+    with open(path, "w") as f:
+        json.dump(list(merged.values()), f, indent=1)
     return 0
 
 

@@ -108,3 +108,30 @@ def test_remat_step_matches_plain():
     np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
     for a, b in zip(jax.tree_util.tree_leaves(p0), jax.tree_util.tree_leaves(p1)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+@pytest.mark.slow
+def test_long_context_8k_tokens():
+    """Long-context capability: one train step at T=8192 over the 8-way
+    seq mesh (1024 tokens per device) with remat'd blocks — ring
+    attention streams K/V, activations stay O(T/n) per device. Asserts
+    the step runs, the loss is finite, and a second step changes it."""
+    from theanompi_tpu.models.transformer import make_nd_train_step
+
+    T = 8192
+    model = TransformerLM(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                          d_ff=64, max_len=T, remat=True)
+    mesh = make_mesh(8, axis_names=(SEQ_AXIS,))
+    step = make_nd_train_step(model, mesh, lr=0.5, sp_axis=SEQ_AXIS)
+    params = model.init(jax.random.PRNGKey(0))
+    # learnable data (uniform-random tokens are ALREADY at the optimum)
+    toks = jax.device_put(
+        jnp.asarray(np.arange(T)[None] % 64, jnp.int32),
+        NamedSharding(mesh, P(None, SEQ_AXIS)),
+    )
+    losses = []
+    for _ in range(3):
+        params, loss = step(params, toks)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
