@@ -163,11 +163,16 @@ def main(argv=None) -> int:
     path = os.path.join(RESULTS, "summary.json")
     merged = {}
     if os.path.exists(path):
-        with open(path) as f:
-            merged = {r["name"]: r for r in json.load(f)}
+        try:
+            with open(path) as f:
+                merged = {r["name"]: r for r in json.load(f)}
+        except (json.JSONDecodeError, KeyError, TypeError):
+            pass  # a truncated/garbled summary must not sink fresh results
     merged.update({r["name"]: r for r in results})
-    with open(path, "w") as f:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(list(merged.values()), f, indent=1)
+    os.replace(tmp, path)  # atomic: no torn summary on interrupt
     return 0
 
 

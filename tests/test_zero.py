@@ -1,0 +1,133 @@
+"""ZeRO-1 optimizer-state sharding vs the replicated BSP oracle on the
+8-way CPU mesh. Beyond-parity extension (the reference replicated its
+Theano ``vels`` per rank; SURVEY.md §2.1)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as Pspec
+
+from theanompi_tpu.models.cifar10 import Cifar10_model
+from theanompi_tpu.parallel import make_mesh
+from theanompi_tpu.parallel.strategies import get_strategy
+from theanompi_tpu.parallel.zero import make_zero1_train_step
+from theanompi_tpu.train import init_train_state, make_train_step
+
+
+def _model(optimizer):
+    return Cifar10_model(
+        Cifar10_model.default_recipe().replace(
+            batch_size=64,
+            input_shape=(16, 16, 3),
+            optimizer=optimizer,
+            opt_kwargs={},
+        )
+    )
+
+
+def _data(seed=0):
+    r = np.random.RandomState(seed)
+    x = jnp.asarray(r.randn(64, 16, 16, 3), jnp.float32)
+    y = jnp.asarray(r.randint(0, 10, 64), jnp.int32)
+    return x, y
+
+
+@pytest.mark.parametrize("optimizer", ["momentum", "adam"])
+def test_zero1_matches_replicated_bsp(optimizer):
+    """3 ZeRO-1 steps == 3 replicated-BSP steps: identical params (the
+    sharded flat-segment update is the same math, just partitioned)."""
+    model = _model(optimizer)
+    mesh = make_mesh(8)
+
+    init_z, step_z = make_zero1_train_step(model, mesh)
+    zstate = init_z(jax.random.PRNGKey(0))
+
+    base = make_train_step(model, grad_sync=get_strategy("psum", "data", 8))
+    step_r = jax.jit(
+        jax.shard_map(
+            base, mesh=mesh,
+            in_specs=(Pspec(), Pspec("data"), Pspec("data"), Pspec()),
+            out_specs=(Pspec(), Pspec()),
+            check_vma=False,
+        )
+    )
+    rstate = init_train_state(model, jax.random.PRNGKey(0))
+
+    for i in range(3):
+        x, y = _data(seed=i)
+        key = jax.random.PRNGKey(10 + i)
+        zstate, zm = step_z(zstate, x, y, key)
+        rstate, rm = step_r(rstate, x, y, key)
+
+    for a, b in zip(
+        jax.tree_util.tree_leaves(zstate.params),
+        jax.tree_util.tree_leaves(rstate.params),
+    ):
+        # fp32 reduction-order noise (flat psum_scatter vs leafwise pmean)
+        # amplified by adam near v~0 (eps=1e-8): observed 1 outlier at 5.6e-4
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-3)
+    # metric conventions differ (ZeRO reports the GLOBAL pmean; the
+    # replicated step surfaces one device's local loss) — just sanity
+    assert np.isfinite(float(zm["loss"])) and np.isfinite(
+        float(np.asarray(rm["loss"]).mean())
+    )
+
+
+def test_zero1_opt_state_is_sharded():
+    """The point of ZeRO-1: accumulator leaves are 1/n per device (global
+    flat [n * seg] sharded over the data axis, vs a full per-leaf copy)."""
+    model = _model("adam")
+    mesh = make_mesh(8)
+    init_z, _ = make_zero1_train_step(model, mesh)
+    zstate = init_z(jax.random.PRNGKey(0))
+
+    n_params = sum(
+        np.prod(l.shape) for l in jax.tree_util.tree_leaves(zstate.params)
+    )
+    m = zstate.opt_state["m"]
+    seg = -(-int(n_params) // 8)
+    assert m.shape == (8 * seg,)
+    # each device addresses only its 1/8 shard
+    shard_shapes = {s.data.shape for s in m.addressable_shards}
+    assert shard_shapes == {(seg,)}
+
+
+def test_zero1_validates_axis():
+    model = _model("momentum")
+    mesh = make_mesh(8)
+    with pytest.raises(ValueError, match="not in mesh"):
+        make_zero1_train_step(model, mesh, axis_name="nope")
+
+
+def test_zero1_syncs_batchnorm_state():
+    """A BatchNorm model's running stats must come out identical on
+    every device (pmean'd across the axis, like parallel/bsp.py) — the
+    P() out-spec would otherwise silently emit device-divergent state."""
+    from theanompi_tpu.models.model_zoo.wrn import WRN_16_4
+
+    model = WRN_16_4(
+        WRN_16_4.default_recipe().replace(batch_size=32, input_shape=(8, 8, 3))
+    )
+    mesh = make_mesh(8)
+    init_z, step_z = make_zero1_train_step(model, mesh)
+    state = init_z(jax.random.PRNGKey(0))
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.randn(32, 8, 8, 3), jnp.float32)
+    y = jnp.asarray(r.randint(0, 10, 32), jnp.int32)
+    state, _ = step_z(state, x, y, jax.random.PRNGKey(1))
+
+    # compare against BSPEngine, the framework's replicated BSP path
+    # (it pmeans model_state across the axis — the raw make_train_step
+    # under a P() out-spec would surface one device's local stats)
+    from theanompi_tpu.parallel.bsp import BSPEngine
+
+    engine = BSPEngine(model, mesh, strategy="psum")
+    rstate = engine.init_state(jax.random.PRNGKey(0))
+    rstate, _ = engine.train_step(rstate, x, y, jax.random.PRNGKey(1))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state.model_state),
+        jax.tree_util.tree_leaves(rstate.model_state),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)

@@ -44,6 +44,42 @@ def init_train_state(model: Model, key: jax.Array) -> TrainState:
     return TrainState(params, model_state, opt_state, jnp.zeros((), jnp.int32))
 
 
+def make_schedule_fn(model: Model, steps_per_epoch: int = 1):
+    """``step -> lr`` honoring the recipe's schedule unit (the
+    reference's ``adjust_hyperp(epoch)``, evaluated inside the compiled
+    step)."""
+    schedule = model.schedule()
+    per_epoch = float(max(1, steps_per_epoch))
+    by_epoch = model.recipe.lr_unit == "epoch"
+
+    def schedule_lr(step):
+        return schedule(step / per_epoch if by_epoch else step)
+
+    return schedule_lr
+
+
+def loss_and_grads(
+    model: Model, params, model_state, images, labels, rng, loss_scale: float = 1.0
+):
+    """The shared forward+backward core: ``-> (loss, logits,
+    new_model_state, raw_grads)``. Used by make_train_step and the
+    ZeRO-1 step (parallel/zero.py) so step semantics cannot drift."""
+
+    def loss_fn(params):
+        logits, new_model_state = model.apply(
+            params, model_state, images, train=True, rng=rng
+        )
+        loss = model.loss(logits, labels) * loss_scale
+        return loss, (new_model_state, logits)
+
+    (loss, (new_model_state, logits)), grads = jax.value_and_grad(
+        loss_fn, has_aux=True
+    )(params)
+    if loss_scale != 1.0:
+        grads = jax.tree_util.tree_map(lambda g: g / loss_scale, grads)
+    return loss / loss_scale, logits, new_model_state, grads
+
+
 def make_train_step(
     model: Model,
     steps_per_epoch: int = 1,
@@ -88,35 +124,24 @@ def make_train_step(
     generalizes this rule to multi-axis tp/sp meshes.)
     """
     optimizer = model.optimizer()
-    schedule = model.schedule()
-    per_epoch = float(max(1, steps_per_epoch))
-    by_epoch = model.recipe.lr_unit == "epoch"
+    schedule_lr = make_schedule_fn(model, steps_per_epoch)
 
     def train_step(state: TrainState, images, labels, rng):
         if input_transform is not None:
             images = input_transform(images)
 
-        def loss_fn(params):
-            logits, new_model_state = model.apply(
-                params, state.model_state, images, train=True, rng=rng
-            )
-            loss = model.loss(logits, labels) * loss_scale
-            return loss, (new_model_state, logits)
-
-        (loss, (new_model_state, logits)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(state.params)
-        if loss_scale != 1.0:
-            grads = jax.tree_util.tree_map(lambda g: g / loss_scale, grads)
+        loss, logits, new_model_state, grads = loss_and_grads(
+            model, state.params, state.model_state, images, labels, rng,
+            loss_scale=loss_scale,
+        )
         if grad_sync is not None:
             grads = grad_sync(grads)
 
-        sched_t = state.step / per_epoch if by_epoch else state.step
-        lr = schedule(sched_t)
+        lr = schedule_lr(state.step)
         updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params, lr)
         new_params = apply_updates(state.params, updates)
 
-        metrics = {"loss": loss / loss_scale, "lr": lr, **model.metrics(logits, labels)}
+        metrics = {"loss": loss, "lr": lr, **model.metrics(logits, labels)}
         new_state = TrainState(new_params, new_model_state, new_opt_state, state.step + 1)
         return new_state, metrics
 
