@@ -62,7 +62,10 @@ def test_bsp8_matches_single_device(mesh8):
     for a, b in zip(
         jax.tree_util.tree_leaves(s_bsp.params), jax.tree_util.tree_leaves(s_single.params)
     ):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5)
+        # bf16-compute rounding noise depends on the init stream (worst
+        # element observed 5.5e-5 under the rbg default); a sync-logic
+        # error would be orders of magnitude larger (~x8 on every leaf)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-2, atol=1e-4)
 
 
 @pytest.mark.slow
@@ -111,7 +114,9 @@ def test_bsp_grads_match_sequential_oracle(mesh8):
     step = make_bsp_train_step(model, mesh8, strategy="psum", donate=False)
     s, _ = step(state0, put_global_batch(mesh8, x), put_global_batch(mesh8, y), jax.random.PRNGKey(1))
     for a, b in zip(jax.tree_util.tree_leaves(s.params), jax.tree_util.tree_leaves(p_oracle)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+        # init-stream-dependent bf16 rounding: worst element 6.2e-6 under
+        # the rbg default (was inside 1e-6 under threefry draws)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=2e-5)
 
 
 @pytest.mark.slow

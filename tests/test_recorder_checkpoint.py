@@ -147,3 +147,20 @@ def test_checkpoint_rng_cross_impl_resume(tmp_path):
 
     with pytest.raises(ValueError, match="key-data shape"):
         wrap_saved_rng(np.zeros((3,), np.uint32))
+
+
+def test_recorder_tensorboard_scalars(tmp_path):
+    """tensorboard=True writes event files next to the JSONL (soft
+    dependency)."""
+    pytest.importorskip("tensorboardX")
+    rec = Recorder(print_freq=0, save_dir=str(tmp_path), run_name="tbrun",
+                   tensorboard=True)
+    rec.start("step"); time.sleep(0.01); rec.end("step")
+    rec.train_metrics(1, {"loss": 1.5, "error": 0.5}, n_images=8)
+    rec.val_metrics(0, {"loss": 1.2, "error": 0.4})
+    rec.close()
+    tb_dir = tmp_path / "tb" / "tbrun_rank0"
+    events = list(tb_dir.glob("events.out.tfevents.*"))
+    assert events and events[0].stat().st_size > 0
+    # JSONL remains the source of truth alongside
+    assert (tmp_path / "tbrun.jsonl").exists()

@@ -62,6 +62,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "recipe; this is the session's override hook)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--save-dir", default=None, help="recorder output dir (JSONL + pickle)")
+    p.add_argument("--tensorboard", action="store_true",
+                   help="also emit TensorBoard scalars under <save-dir>/tb "
+                        "(soft dependency on tensorboardX)")
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--resume", action="store_true")
     p.add_argument("--print-freq", type=int, default=40)
@@ -193,6 +196,9 @@ def main(argv=None) -> int:
     if args.p_push is not None:
         rule_kwargs["p_push"] = args.p_push
 
+    if args.tensorboard and not args.save_dir:
+        print("WARNING: --tensorboard needs --save-dir; no TB output will "
+              "be written", flush=True)
     summary = run_training(
         rule=args.rule.lower(),
         model_cls=model_cls,
@@ -210,6 +216,7 @@ def main(argv=None) -> int:
         ckpt_dir=args.ckpt_dir,
         resume=args.resume,
         print_freq=args.print_freq,
+        tensorboard=args.tensorboard,
         profile_dir=args.profile_dir,
         profile_steps=args.profile_steps,
         **rule_kwargs,

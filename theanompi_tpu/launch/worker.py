@@ -57,6 +57,7 @@ def run_training(
     ckpt_every_epochs: int = 1,
     resume: bool = False,
     print_freq: int = 40,
+    tensorboard: bool = False,
     prefetch_depth: int = 2,
     return_recorder: bool = False,
     profile_dir: Optional[str] = None,
@@ -233,6 +234,7 @@ def run_training(
         # rank-0 recorder save); console prints keep their rank prefix
         save_dir=save_dir if jax.process_index() == 0 else None,
         run_name=f"{model.name}_{rule}",
+        tensorboard=tensorboard,
     )
     if profile_dir and jax.process_index() == 0:
         # reference: the recorder WAS the profiler (host brackets); the

@@ -64,6 +64,7 @@ def make_zero1_train_step(
     optimizer=None,
     steps_per_epoch: int = 1,
     input_transform: Optional[Callable] = None,
+    donate: bool = True,
 ):
     """Build ``(init_state, train_step)`` for ZeRO-1 BSP training over
     ``mesh``'s ``axis_name``.
@@ -76,6 +77,15 @@ def make_zero1_train_step(
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     if axis_name not in sizes:
         raise ValueError(f"axis {axis_name!r} not in mesh axes {mesh.axis_names}")
+    if len(mesh.axis_names) > 1:
+        # collectives here run over axis_name ONLY; on a multi-axis mesh
+        # the P() out-specs would stamp dcn-divergent params as
+        # replicated with no error
+        raise ValueError(
+            f"ZeRO-1 runs on a 1-D data mesh; got axes {mesh.axis_names} "
+            "(for multi-slice, flatten to one data axis — XLA still "
+            "routes the collectives hierarchically over ICI/DCN)"
+        )
     n = sizes[axis_name]
     opt = (
         get_optimizer(optimizer)
@@ -169,6 +179,11 @@ def make_zero1_train_step(
             in_specs=(state_specs, P(axis_name), P(axis_name), P()),
             out_specs=(state_specs, P()),
             check_vma=False,
-        )
+        ),
+        # donate like parallel/bsp.py: without it every dispatch holds a
+        # second params+opt copy, undercutting the memory saving that is
+        # this module's point (donate=False for oracle tests that reuse
+        # the input state)
+        donate_argnums=(0,) if donate else (),
     )
     return init_state, train_step

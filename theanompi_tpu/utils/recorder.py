@@ -39,6 +39,7 @@ class Recorder:
         print_freq: int = 40,
         save_dir: Optional[str] = None,
         run_name: str = "run",
+        tensorboard: bool = False,
     ):
         self.rank = rank
         self.print_freq = print_freq
@@ -49,9 +50,26 @@ class Recorder:
         self.history: dict[str, list] = defaultdict(list)
         self.epoch_start: Optional[float] = None
         self._jsonl = None
+        self._tb = None
         if save_dir:
             os.makedirs(save_dir, exist_ok=True)
             self._jsonl = open(os.path.join(save_dir, f"{run_name}.jsonl"), "a")
+        if tensorboard and save_dir:
+            # optional TensorBoard scalars (SURVEY.md §5.5 "TPU
+            # equivalent": JSONL + optional TensorBoard) — soft
+            # dependency, JSONL remains the source of truth
+            try:
+                from tensorboardX import SummaryWriter
+
+                self._tb = SummaryWriter(
+                    os.path.join(save_dir, "tb", f"{run_name}_rank{rank}")
+                )
+            except ImportError:
+                print(
+                    f"[rank {rank}] tensorboard=True but tensorboardX is "
+                    "not installed — JSONL/pickle history only",
+                    flush=True,
+                )
 
     # -- XLA trace capture ---------------------------------------------------
     # The reference's calc/comm split came from host brackets around
@@ -195,6 +213,11 @@ class Recorder:
         if self._jsonl:
             self._jsonl.write(json.dumps({"kind": kind, **rec}) + "\n")
             self._jsonl.flush()
+        if self._tb is not None:
+            x = rec.get("step", rec.get("epoch", 0))
+            for k, v in rec.items():
+                if k not in ("step", "epoch") and isinstance(v, float):
+                    self._tb.add_scalar(f"{kind}/{k}", v, int(x))
 
     def save(self, path: Optional[str] = None) -> None:
         """Pickle the full history (reference: ``Recorder.save`` pickled
@@ -228,3 +251,6 @@ class Recorder:
         if self._jsonl:
             self._jsonl.close()
             self._jsonl = None
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None
