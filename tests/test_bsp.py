@@ -63,9 +63,10 @@ def test_bsp8_matches_single_device(mesh8):
         jax.tree_util.tree_leaves(s_bsp.params), jax.tree_util.tree_leaves(s_single.params)
     ):
         # bf16-compute rounding noise depends on the init stream (worst
-        # element observed 5.5e-5 under the rbg default); a sync-logic
-        # error would be orders of magnitude larger (~x8 on every leaf)
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-2, atol=1e-4)
+        # single element observed 1.1e-4 abs under the rbg default, out
+        # of 147k); a sync-logic error would be orders of magnitude
+        # larger (~x8 on every leaf)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4)
 
 
 @pytest.mark.slow
@@ -116,7 +117,7 @@ def test_bsp_grads_match_sequential_oracle(mesh8):
     for a, b in zip(jax.tree_util.tree_leaves(s.params), jax.tree_util.tree_leaves(p_oracle)):
         # init-stream-dependent bf16 rounding: worst element 6.2e-6 under
         # the rbg default (was inside 1e-6 under threefry draws)
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=2e-5)
 
 
 @pytest.mark.slow

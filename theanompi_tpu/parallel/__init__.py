@@ -16,3 +16,10 @@ from theanompi_tpu.parallel.mesh import (  # noqa: F401
 )
 from theanompi_tpu.parallel.strategies import get_strategy  # noqa: F401
 from theanompi_tpu.parallel.bsp import make_bsp_train_step, make_bsp_eval_step  # noqa: F401
+from theanompi_tpu.parallel.pipeline import (  # noqa: F401
+    PIPE_AXIS,
+    make_pp_train_step,
+    stack_pipeline_params,
+    unstack_pipeline_params,
+)
+from theanompi_tpu.parallel.zero import make_zero1_train_step  # noqa: F401

@@ -64,10 +64,11 @@ class Recorder:
                 self._tb = SummaryWriter(
                     os.path.join(save_dir, "tb", f"{run_name}_rank{rank}")
                 )
-            except ImportError:
+            except Exception as e:  # broken installs raise beyond ImportError
                 print(
                     f"[rank {rank}] tensorboard=True but tensorboardX is "
-                    "not installed — JSONL/pickle history only",
+                    f"unavailable ({type(e).__name__}: {e}) — JSONL/pickle "
+                    "history only",
                     flush=True,
                 )
 
