@@ -151,3 +151,23 @@ def test_nd_step_optimizer_state_shapes(opt):
     assert np.isfinite(float(loss))
     for leaf in jax.tree_util.tree_leaves(new_params):
         assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_remat_composes_with_tp():
+    """Per-block jax.checkpoint must also be transparent when the block
+    body contains TP collectives (the recomputation replays the psums)."""
+    toks = _data(seed=5)
+    mesh = make_mesh(8, axis_names=("data", MODEL_AXIS), shape=(4, 2))
+    results = []
+    for remat in (False, True):
+        model = _model(remat=remat)
+        params = model.init(jax.random.PRNGKey(6))
+        step = make_nd_train_step(
+            model, mesh, lr=LR, dp_axis="data", tp_axis=MODEL_AXIS
+        )
+        results.append(step(params, toks))
+    (p0, l0), (p1, l1) = results
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-5)
+    # remat compiles a different program; allow the file's documented
+    # cross-program reduction-order noise band
+    _assert_trees_close(p0, p1)
