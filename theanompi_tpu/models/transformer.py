@@ -29,6 +29,7 @@ contract is classifier-shaped); the point is the PARALLELISM patterns.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, NamedTuple, Optional
 
 import jax
@@ -36,6 +37,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from theanompi_tpu.ops.pallas_attention import flash_attention
 from theanompi_tpu.ops.ring_attention import (
     full_attention_reference,
     ring_attention,
@@ -55,14 +57,31 @@ def _rms(x, g):
 def attention_block(blk, x, attn: str, sp_axis: Optional[str]):
     """Pre-norm attention sub-block shared by the dense and MoE LMs:
     qkv projection (TP-native ``[d, 3, H, hd]`` layout), causal
-    (ring | ulysses | local full) attention, output projection. Returns
-    the residual delta BEFORE any tp-axis psum (the caller owns that)."""
+    (ring | ulysses | flash | local full) attention, output projection.
+    Returns the residual delta BEFORE any tp-axis psum (the caller owns
+    that)."""
     hin = _rms(x, blk["ln1"])
     qkv = jnp.einsum("btd,dchk->btchk", hin, blk["qkv"])
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B, T, H_local, hd]
     if sp_axis is not None:
-        sp_attn = {"ring": ring_attention, "ulysses": ulysses_attention}[attn]
+        if attn == "flash":
+            raise ValueError(
+                "attn='flash' is the fused LOCAL kernel; under sequence "
+                "parallelism pick attn='ring' (K/V rotation, unfused) or "
+                "attn='ulysses_flash' (all-to-all with the fused flash "
+                "local step) — plain attn='ulysses' is the unfused variant"
+            )
+        sp_attn = {
+            "ring": ring_attention,
+            "ulysses": ulysses_attention,
+            "ulysses_flash": functools.partial(
+                ulysses_attention, local_fn=flash_attention
+            ),
+        }[attn]
         att = sp_attn(q, k, v, sp_axis, causal=True)
+    elif attn in ("flash", "ulysses_flash"):
+        # no SP axis: ulysses degenerates to its local step — the fused kernel
+        att = flash_attention(q, k, v, causal=True)
     else:
         att = full_attention_reference(q, k, v, causal=True)
     return jnp.einsum("bthk,hkd->btd", att, blk["proj"])
@@ -114,9 +133,13 @@ def softmax_nll(logits):
 class TransformerLM(NamedTuple):
     """Architecture config (params live in a plain dict pytree).
 
-    ``attn`` picks the sequence-parallel attention scheme: ``"ring"``
-    (K/V rotation, O(T/n) memory) or ``"ulysses"`` (head<->sequence
-    all-to-all; needs ``n_heads`` divisible by the seq-axis size).
+    ``attn`` picks the attention scheme: ``"ring"`` (K/V rotation,
+    O(T/n) memory under SP; plain full attention without an SP axis),
+    ``"ulysses"`` (head<->sequence all-to-all; needs ``n_heads``
+    divisible by the seq-axis size), ``"ulysses_flash"`` (same, with
+    the local step fused via the Pallas flash kernel), or ``"flash"``
+    (single-device / DP-TP-only: the fused Pallas kernel,
+    ops/pallas_attention.py).
     ``remat=True`` checkpoints each block (jax.checkpoint): backward
     recomputes block activations instead of storing them — combine with
     the seq axis for long-context training beyond HBM.
@@ -277,7 +300,7 @@ def validate_ulysses_heads(model, sp_axis, sizes, heads_local):
     """Friendly build-time error for the Ulysses all-to-all's head
     divisibility requirement (otherwise it surfaces as an opaque
     lax.all_to_all trace error deep inside the attention)."""
-    if sp_axis and getattr(model, "attn", None) == "ulysses" and (
+    if sp_axis and getattr(model, "attn", None) in ("ulysses", "ulysses_flash") and (
         heads_local % sizes[sp_axis]
     ):
         raise ValueError(

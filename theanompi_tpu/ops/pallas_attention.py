@@ -1,0 +1,383 @@
+"""Pallas fused flash attention — forward + custom-VJP backward TPU kernels.
+
+BEYOND-PARITY EXTENSION. The 2016 reference has no attention op anywhere
+(SURVEY.md §5.7); this module is the TPU-native fused kernel behind the
+framework's long-context demonstrators. XLA's default lowering of
+softmax attention materializes the [B, H, T, T] score matrix in HBM
+twice (forward + transposed backward); the flash formulation (online
+softmax over K/V blocks, Dao et al.) keeps scores in VMEM tiles and
+streams K/V through them, making attention HBM-traffic-bound in O(T·D)
+instead of O(T^2). Both passes are Pallas TPU kernels:
+
+- forward: one kernel, grid over (batch·heads, query blocks); K/V loops
+  run as ``fori_loop`` over VMEM slices; per-row logsumexp is saved as
+  the softmax residual.
+- backward: the classic two-kernel split — a dq kernel gridded over
+  query blocks and a dk/dv kernel gridded over key blocks — each
+  recomputing the probability tiles from (q, k, lse) so the O(T^2)
+  matrix never exists in either pass.
+
+Numerics: the q·k^T and p·v matmuls run in the INPUT dtype on the MXU
+with fp32 accumulation (``preferred_element_type``); softmax statistics,
+probability tiles, and all gradient accumulators are fp32. For fp32
+inputs the result matches the unfused reference to float tolerance
+(tests/test_pallas_attention.py).
+
+Layout contract matches :func:`theanompi_tpu.ops.ring_attention.
+full_attention_reference`: ``[B, T, H, D] -> [B, Tq, H, D]``, optional
+causal masking in GLOBAL position order (query i attends keys <= i).
+Off-TPU the kernels run through the Pallas interpreter — identical
+numerics on the CPU test meshes. ``TMPI_PALLAS=0`` falls back to the
+unfused reference implementation.
+
+K/V (and in backward Q) blocks for one batch·head row must fit VMEM:
+fine through T ~ 8-16k at D <= 128; beyond that use
+:func:`~theanompi_tpu.ops.ring_attention.ring_attention`, whose local
+block this kernel exactly is (each device's ring hop folds one K/V
+shard — the same online-softmax recurrence, distributed).
+
+Measured (one TPU v5e, B=4 H=8 D=64 bf16, causal, grad step fwd+bwd,
+best-of-3 with the tunnel round-trip subtracted; experiments/results/
+flash_attention.json): T=2048 0.48 ms vs 2.29 ms unfused (**4.8x**);
+T=4096 2.23 ms vs 9.60 ms (**4.3x**; D=128: 4.4x); T=8192 the unfused
+path exhausts HBM on the 16 GB chip while flash runs in 5.73 ms. The
+``block_q=block_k=512`` defaults come from an on-chip sweep — 128x128
+blocks are only 1.4x over unfused (accumulator-rescale overhead
+dominates), 512-wide blocks reach ~5x; the causal block skip
+(:func:`_k_blocks_for`) is worth ~2x of that at large T.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import os
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+_NEG = -1e30  # masked-logit sentinel (finite: keeps exp/max NaN-free)
+
+
+def _use_pallas() -> bool:
+    return os.environ.get("TMPI_PALLAS", "1") != "0"
+
+
+def _interpret() -> bool:
+    # native Mosaic lowering on TPU; interpreter elsewhere (CPU meshes)
+    return jax.default_backend() != "tpu"
+
+
+class _Cfg(NamedTuple):
+    """Static kernel config (hashable: custom_vjp nondiff argument)."""
+
+    causal: bool
+    scale: float
+    Tq: int  # real (unpadded) query length
+    Tk: int  # real (unpadded) key length
+    BQ: int
+    BK: int
+    interpret: bool
+
+
+def _mask(cfg: _Cfg, i, j):
+    """[BQ, BK] validity of (query block i, key block j) in GLOBAL
+    positions: key padding masked always, lower-triangle when causal."""
+    row = i * cfg.BQ + lax.broadcasted_iota(jnp.int32, (cfg.BQ, cfg.BK), 0)
+    col = j * cfg.BK + lax.broadcasted_iota(jnp.int32, (cfg.BQ, cfg.BK), 1)
+    valid = col < cfg.Tk
+    if cfg.causal:
+        valid = valid & (row >= col)
+    return valid
+
+
+def _k_blocks_for(cfg: _Cfg, i, nk):
+    """Last k-block index (exclusive) query block ``i`` touches: under
+    causal masking blocks strictly above the diagonal are all-masked and
+    skipped entirely — ~2x less work at large T."""
+    if not cfg.causal:
+        return nk
+    return jnp.minimum(nk, (i * cfg.BQ + cfg.BQ - 1) // cfg.BK + 1)
+
+
+def _fwd_kernel(cfg: _Cfg, q_ref, k_ref, v_ref, o_ref, lse_ref):
+    i = pl.program_id(1)
+    q = q_ref[0]  # [BQ, D], input dtype
+    D = q.shape[-1]
+    nk = k_ref.shape[1] // cfg.BK
+
+    acc0 = jnp.zeros((cfg.BQ, D), jnp.float32)
+    m0 = jnp.full((cfg.BQ, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((cfg.BQ, 1), jnp.float32)
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(j * cfg.BK, cfg.BK), :]
+        v = v_ref[0, pl.ds(j * cfg.BK, cfg.BK), :]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * cfg.scale
+        valid = _mask(cfg, i, j)
+        s = jnp.where(valid, s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc, m_new, l
+
+    acc, m, l = lax.fori_loop(0, _k_blocks_for(cfg, i, nk), body, (acc0, m0, l0))
+    # causal guarantees key j=row is valid for every real row; padded
+    # rows still see all real keys (causal: keys <= row, row >= Tq-1),
+    # so l > 0 everywhere
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)  # [BQ, 1]
+
+
+def _dq_kernel(cfg: _Cfg, q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, dq_ref):
+    i = pl.program_id(1)
+    q = q_ref[0]
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]  # [BQ, 1]
+    dsum = dsum_ref[0]
+    nk = k_ref.shape[1] // cfg.BK
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * cfg.BK, cfg.BK), :]
+        v = v_ref[0, pl.ds(j * cfg.BK, cfg.BK), :]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * cfg.scale
+        p = jnp.where(_mask(cfg, i, j), jnp.exp(s - lse), 0.0)
+        dp = lax.dot_general(
+            do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = (p * (dp - dsum) * cfg.scale).astype(k.dtype)
+        return dq + lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    dq = lax.fori_loop(
+        0, _k_blocks_for(cfg, i, nk), body, jnp.zeros(q.shape, jnp.float32)
+    )
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(cfg: _Cfg, q_ref, do_ref, lse_ref, dsum_ref, k_ref, v_ref,
+                dk_ref, dv_ref):
+    j = pl.program_id(1)
+    k = k_ref[0]
+    v = v_ref[0]
+    nq = q_ref.shape[1] // cfg.BQ
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * cfg.BQ, cfg.BQ), :]
+        do = do_ref[0, pl.ds(i * cfg.BQ, cfg.BQ), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * cfg.BQ, cfg.BQ), :]   # [BQ, 1]
+        dsum = dsum_ref[0, pl.ds(i * cfg.BQ, cfg.BQ), :]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * cfg.scale
+        p = jnp.where(_mask(cfg, i, j), jnp.exp(s - lse), 0.0)  # [BQ, BK]
+        dv = dv + lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = lax.dot_general(
+            do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = (p * (dp - dsum) * cfg.scale).astype(q.dtype)
+        dk = dk + lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk, dv
+
+    dk0 = jnp.zeros(k.shape, jnp.float32)
+    dv0 = jnp.zeros(v.shape, jnp.float32)
+    # causal: query blocks strictly below this key block's diagonal see
+    # none of it — start at the first overlapping block
+    i0 = (j * cfg.BK) // cfg.BQ if cfg.causal else 0
+    dk, dv = lax.fori_loop(i0, nq, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _fwd(cfg: _Cfg, q3, k3, v3):
+    """Padded [BH, T_pad, D] flash forward -> (o, lse)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, Tqp, D = q3.shape
+    Tkp = k3.shape[1]
+    grid = (BH, Tqp // cfg.BQ)
+    kv_spec = pl.BlockSpec(
+        (1, Tkp, D), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM
+    )
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, cfg),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, cfg.BQ, D), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            kv_spec,
+            kv_spec,
+        ],
+        out_specs=(
+            pl.BlockSpec((1, cfg.BQ, D), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            # [BH, Tqp, 1]: a trailing singleton lane keeps the block's
+            # last-two dims Mosaic-legal ((BQ, 1) == (div 8, full dim))
+            pl.BlockSpec((1, cfg.BQ, 1), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((BH, Tqp, D), q3.dtype),
+            jax.ShapeDtypeStruct((BH, Tqp, 1), jnp.float32),
+        ),
+        interpret=cfg.interpret,
+    )(q3, k3, v3)
+    return o, lse
+
+
+def _bwd(cfg: _Cfg, q3, k3, v3, o, lse, g):
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, Tqp, D = q3.shape
+    Tkp = k3.shape[1]
+    # per-row sum(dO * O) — the softmax-gradient correction term
+    # (padded rows of g are zero, so their dsum is zero); [BH, Tqp, 1]
+    dsum = jnp.sum(
+        g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+    )
+
+    def q_major(shape):
+        return pl.BlockSpec(shape, lambda b, i: (b, i) + (0,) * (len(shape) - 2),
+                            memory_space=pltpu.VMEM)
+
+    def full(shape):
+        return pl.BlockSpec(shape, lambda b, i: (b,) + (0,) * (len(shape) - 1),
+                            memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, cfg),
+        grid=(BH, Tqp // cfg.BQ),
+        in_specs=[
+            q_major((1, cfg.BQ, D)),          # q
+            full((1, Tkp, D)),                # k
+            full((1, Tkp, D)),                # v
+            q_major((1, cfg.BQ, D)),          # dO
+            q_major((1, cfg.BQ, 1)),          # lse
+            q_major((1, cfg.BQ, 1)),          # dsum
+        ],
+        out_specs=q_major((1, cfg.BQ, D)),
+        out_shape=jax.ShapeDtypeStruct((BH, Tqp, D), q3.dtype),
+        interpret=cfg.interpret,
+    )(q3, k3, v3, g, lse, dsum)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, cfg),
+        grid=(BH, Tkp // cfg.BK),
+        in_specs=[
+            full((1, Tqp, D)),                # q
+            full((1, Tqp, D)),                # dO
+            full((1, Tqp, 1)),                # lse
+            full((1, Tqp, 1)),                # dsum
+            q_major((1, cfg.BK, D)),          # k block
+            q_major((1, cfg.BK, D)),          # v block
+        ],
+        out_specs=(q_major((1, cfg.BK, D)), q_major((1, cfg.BK, D))),
+        out_shape=(
+            jax.ShapeDtypeStruct((BH, Tkp, D), k3.dtype),
+            jax.ShapeDtypeStruct((BH, Tkp, D), v3.dtype),
+        ),
+        interpret=cfg.interpret,
+    )(q3, g, lse, dsum, k3, v3)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash(cfg: _Cfg, q3, k3, v3):
+    o, _ = _fwd(cfg, q3, k3, v3)
+    return o
+
+
+def _flash_vjp_fwd(cfg, q3, k3, v3):
+    o, lse = _fwd(cfg, q3, k3, v3)
+    return o, (q3, k3, v3, o, lse)
+
+
+def _flash_vjp_bwd(cfg, res, g):
+    return _bwd(cfg, *res, g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def _to_heads_major(x, B, T, H, D):
+    return jnp.transpose(x, (0, 2, 1, 3)).reshape(B * H, T, D)
+
+
+def flash_attention(
+    q: jax.Array,  # [B, Tq, H, D]
+    k: jax.Array,  # [B, Tk, H, D]
+    v: jax.Array,  # [B, Tk, H, D]
+    causal: bool = False,
+    scale: Optional[float] = None,
+    precision=None,
+    *,
+    block_q: int = 512,
+    block_k: int = 512,
+) -> jax.Array:
+    """Fused blockwise attention, differentiable: drop-in for
+    :func:`~theanompi_tpu.ops.ring_attention.full_attention_reference`.
+
+    Sequence lengths are padded up to the block sizes internally
+    (padded keys masked, padded query rows discarded); head dim is used
+    as-is (Mosaic pads lanes — D a multiple of 128 is fastest).
+
+    ``precision``: matmuls run in the INPUT dtype with fp32 accumulation
+    (softmax statistics are always fp32); ``Precision.HIGHEST`` upcasts
+    the q/k/v tiles to fp32 — same numerics knob as the unfused
+    reference, at ~2x matmul cost for bf16 inputs.
+    """
+    if not _use_pallas():
+        from theanompi_tpu.ops.ring_attention import full_attention_reference
+
+        return full_attention_reference(
+            q, k, v, causal=causal, scale=scale, precision=precision
+        )
+
+    out_dtype = q.dtype
+    if precision in (lax.Precision.HIGHEST, "highest", "float32"):
+        q, k, v = (t.astype(jnp.float32) for t in (q, k, v))
+
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    sc = scale if scale is not None else 1.0 / math.sqrt(D)
+    BQ, BK = min(block_q, _ceil_to(Tq, 8)), min(block_k, _ceil_to(Tk, 8))
+    Tqp, Tkp = _ceil_to(Tq, BQ), _ceil_to(Tk, BK)
+    cfg = _Cfg(bool(causal), float(sc), Tq, Tk, BQ, BK, _interpret())
+
+    q3 = _to_heads_major(q, B, Tq, H, D)
+    k3 = _to_heads_major(k, B, Tk, H, D)
+    v3 = _to_heads_major(v, B, Tk, H, D)
+    if Tqp != Tq:
+        q3 = jnp.pad(q3, ((0, 0), (0, Tqp - Tq), (0, 0)))
+    if Tkp != Tk:
+        k3 = jnp.pad(k3, ((0, 0), (0, Tkp - Tk), (0, 0)))
+        v3 = jnp.pad(v3, ((0, 0), (0, Tkp - Tk), (0, 0)))
+
+    o = _flash(cfg, q3, k3, v3)[:, :Tq]
+    return jnp.transpose(o.reshape(B, H, Tq, D), (0, 2, 1, 3)).astype(out_dtype)
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return -(-x // m) * m

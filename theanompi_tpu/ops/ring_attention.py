@@ -121,6 +121,7 @@ def ulysses_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     precision=None,
+    local_fn=None,
 ) -> jax.Array:
     """All-to-all sequence parallelism (DeepSpeed-Ulysses style; Jacobs
     et al. 2023, PAPERS.md) — the other canonical SP scheme next to
@@ -135,6 +136,12 @@ def ulysses_attention(
     Requires ``H % n == 0``. Peak memory is O(T_global^2) scores for the
     local heads — choose ring attention when T^2 dominates, Ulysses when
     head count is plentiful and ICI all-to-all is cheap (both are exact).
+
+    ``local_fn`` overrides the local per-head attention step — pass
+    :func:`theanompi_tpu.ops.pallas_attention.flash_attention` to run
+    the gathered-sequence step as the fused Pallas kernel (drops the
+    O(T^2) score materialization, keeping only the all-to-alls as the
+    SP cost).
     """
     n = lax.psum(1, axis_name)
     # scatter heads (axis 2) across the mesh, gather sequence (axis 1):
@@ -142,9 +149,13 @@ def ulysses_attention(
     qg = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     kg = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     vg = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
-    out = full_attention_reference(
-        qg, kg, vg, causal=causal, scale=scale, precision=precision
-    )
+    if local_fn is not None:
+        out = local_fn(qg, kg, vg, causal=causal, scale=scale,
+                       precision=precision)
+    else:
+        out = full_attention_reference(
+            qg, kg, vg, causal=causal, scale=scale, precision=precision
+        )
     # gather heads back, re-scatter the sequence
     return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
