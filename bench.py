@@ -104,40 +104,12 @@ def _measure_roundtrip(runner, state, x, y, trials=3):
 
 
 def _zoo_entry(name: str):
-    """(model_cls, single_chip_global_batch) for the benchable zoo.
+    """(model_cls, single_chip_global_batch) — the registry (and the
+    batch policy notes) live in theanompi_tpu.models.zoo, shared with
+    tools/op_profile.py."""
+    from theanompi_tpu.models.zoo import zoo_entry
 
-    Batch policy: AlexNet runs the reference workload's GLOBAL batch
-    (BASELINE config #2: 8 workers x 128 = 1024 — same SGD trajectory,
-    and a v5e only reaches full MXU utilization ~batch 1024); GoogLeNet
-    likewise (config #3: 32 workers x 32 = 1024). ResNet-50 uses config
-    #4's batch 256; VGG16/WRN use the largest power-of-two that fits one
-    chip's HBM comfortably."""
-    if name == "alexnet":
-        from theanompi_tpu.models.alex_net import AlexNet
-
-        return AlexNet, 1024
-    if name == "googlenet":
-        from theanompi_tpu.models.googlenet import GoogLeNet
-
-        # config #3's global batch is 32 x 32 = 1024, but the scanned
-        # multi-step program above batch 256 silently fails on the
-        # tunneled dev backend (single steps run fine at 1024; the scan
-        # returns without executing and trips the physics guard) —
-        # bench at 256 per chip until a directly-attached host says more
-        return GoogLeNet, 256
-    if name == "resnet50":
-        from theanompi_tpu.models.model_zoo.resnet50 import ResNet50
-
-        return ResNet50, 256
-    if name == "vgg16":
-        from theanompi_tpu.models.model_zoo.vgg import VGG16
-
-        return VGG16, 128
-    if name == "wrn":
-        from theanompi_tpu.models.model_zoo.wrn import WRN
-
-        return WRN, 1024
-    raise ValueError(f"unknown bench model {name!r}")
+    return zoo_entry(name)
 
 
 def bench_compute(steps: int = 20, trials: int = 3, model_name: str = "alexnet") -> dict:

@@ -1,0 +1,107 @@
+"""tools/op_profile.py — per-op TPU time tables from profiler traces
+(≙ the SURVEY.md §5.1 "comm/compute split from the XLA profile" clause).
+The parser is tested against a synthetic trace-viewer dump (device op
+track, container while-op, numbered instances); the CPU path (no device
+track) must degrade gracefully — real per-op tables need TPU captures."""
+
+import gzip
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from theanompi_tpu.tools.op_profile import (
+    format_table,
+    generalize,
+    op_table,
+)
+
+
+def _write_trace(tmp_path, events):
+    d = tmp_path / "plugins" / "profile" / "2026_01_01"
+    d.mkdir(parents=True)
+    with gzip.open(d / "vm.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    return str(tmp_path)
+
+
+def _meta(pid, pname, tid, tname):
+    return [
+        {"ph": "M", "pid": pid, "name": "process_name", "args": {"name": pname}},
+        {"ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
+         "args": {"name": tname}},
+    ]
+
+
+def _dev_op(name, ts, dur, pid=3, tid=9):
+    return {"ph": "X", "pid": pid, "tid": tid, "name": name, "ts": ts, "dur": dur}
+
+
+def test_generalize_collapses_instance_numbers():
+    assert generalize("convert_reduce_fusion.307") == "convert_reduce_fusion.#"
+    assert generalize("fusion.12.remat2") == "fusion.#.remat#"
+    assert generalize("while") == "while"
+
+
+def test_op_table_aggregates_and_drops_container(tmp_path):
+    events = _meta(3, "/device:TPU:0", 9, "XLA Ops")
+    events += _meta(7, "/host:CPU", 1, "python")
+    # container while op spanning the whole window
+    events.append(_dev_op("while.1", ts=0, dur=1000))
+    # two instances of the same generalized op + one other
+    events.append(_dev_op("conv_fusion.1", ts=0, dur=600))
+    events.append(_dev_op("conv_fusion.2", ts=600, dur=200))
+    events.append(_dev_op("reduce.9", ts=800, dur=200))
+    # host events must be ignored even with big durations
+    events.append(_dev_op("python_overhead", ts=0, dur=99999, pid=7, tid=1))
+    trace = _write_trace(tmp_path, events)
+
+    rows = op_table(trace, steps=2)
+    ops = {r["op"]: r for r in rows}
+    assert "while.#" not in ops, "container op must be dropped"
+    assert "python_overhead" not in ops, "host track must be ignored"
+    assert set(ops) == {"conv_fusion.#", "reduce.#"}
+    # 800us conv over 2 steps = 0.4 ms/step, 2 instances over 2 steps = 1/step
+    assert ops["conv_fusion.#"]["ms_per_step"] == pytest.approx(0.4)
+    assert ops["conv_fusion.#"]["count_per_step"] == pytest.approx(1.0)
+    assert ops["conv_fusion.#"]["share"] == pytest.approx(0.8)
+    assert rows[0]["op"] == "conv_fusion.#", "rows sorted by time"
+    txt = format_table(rows)
+    assert "conv_fusion.#" in txt and "80.0%" in txt
+
+
+def test_op_table_keeps_legit_dominant_op(tmp_path):
+    """An op that is 70% of the step but NOT window-spanning per instance
+    must survive the container filter."""
+    events = _meta(3, "/device:TPU:0", 9, "XLA Ops")
+    for i in range(10):
+        events.append(_dev_op(f"big_fusion.{i}", ts=100 * i, dur=70))
+        events.append(_dev_op(f"small.{i}", ts=100 * i + 70, dur=30))
+    trace = _write_trace(tmp_path, events)
+    rows = op_table(trace, steps=10)
+    ops = {r["op"]: r for r in rows}
+    assert ops["big_fusion.#"]["share"] == pytest.approx(0.7)
+
+
+def test_cpu_capture_degrades_gracefully(tmp_path):
+    """A REAL CPU-backend capture has no device 'XLA Ops' track: the
+    table is empty and format_table says why instead of crashing."""
+    f = jax.jit(lambda x: jnp.sin(x) @ x.T)
+    x = jnp.ones((64, 64))
+    np.asarray(f(x))
+    d = str(tmp_path / "trace")
+    jax.profiler.start_trace(d)
+    np.asarray(f(x))
+    jax.profiler.stop_trace()
+    rows = op_table(d)
+    assert rows == []
+    assert "CPU-only" in format_table(rows)
+
+
+def test_missing_trace_dir_raises(tmp_path):
+    with pytest.raises(FileNotFoundError, match="trace.json.gz"):
+        op_table(str(tmp_path))

@@ -41,15 +41,23 @@ def test_forward_matches_reference(causal, T, D, bq, bk):
                                atol=3e-6, rtol=1e-5)
 
 
-def test_cross_attention_unequal_lengths():
-    """Tq != Tk (non-causal cross attention), both ragged vs blocks."""
+@pytest.mark.parametrize("causal", [False, True])
+def test_cross_attention_unequal_lengths(causal, monkeypatch):
+    """Tq != Tk cross attention, both ragged vs blocks, causal included
+    (position-aligned-at-start convention) — and the TMPI_PALLAS=0
+    fallback must accept the same shapes (it used to build a [Tq, Tq]
+    tril mask and crash on causal Tq != Tk)."""
     r = np.random.RandomState(0)
     q = jnp.asarray(r.randn(2, 40, 2, 16), jnp.float32)
     k = jnp.asarray(r.randn(2, 72, 2, 16), jnp.float32)
     v = jnp.asarray(r.randn(2, 72, 2, 16), jnp.float32)
-    got = flash_attention(q, k, v, block_q=32, block_k=32)
-    want = full_attention_reference(q, k, v)
+    got = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+    want = full_attention_reference(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=3e-6, rtol=1e-5)
+    monkeypatch.setenv("TMPI_PALLAS", "0")
+    fb = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+    np.testing.assert_allclose(np.asarray(fb), np.asarray(want),
                                atol=3e-6, rtol=1e-5)
 
 

@@ -37,21 +37,23 @@ block this kernel exactly is (each device's ring hop folds one K/V
 shard — the same online-softmax recurrence, distributed).
 
 Measured (one TPU v5e, B=4 H=8 D=64 bf16, causal, grad step fwd+bwd,
-best-of-3 with the tunnel round-trip subtracted; experiments/results/
-flash_attention.json): T=2048 0.48 ms vs 2.29 ms unfused (**4.8x**);
-T=4096 2.23 ms vs 9.60 ms (**4.3x**; D=128: 4.4x); T=8192 the unfused
-path exhausts HBM on the 16 GB chip while flash runs in 5.73 ms. The
-``block_q=block_k=512`` defaults come from an on-chip sweep — 128x128
-blocks are only 1.4x over unfused (accumulator-rescale overhead
-dominates), 512-wide blocks reach ~5x; the causal block skip
-(:func:`_k_blocks_for`) is worth ~2x of that at large T.
+best-of-3 with the tunnel round-trip subtracted; authoritative clean
+fresh-process rows in experiments/results/flash_attention.json):
+T=2048 0.48 ms vs 2.29 ms unfused (**4.8x**); T=4096 2.23 ms vs
+9.60 ms (**4.3x**; D=128: 4.4x); T=8192 the unfused path exhausts HBM
+on the 16 GB chip while flash runs in 5.73 ms. An earlier same-protocol
+sweep in a warm process read 512x512 at 1.55 ms for the T=4096 row
+(~6x) — tunneled-chip run-to-run variance is ~40%, so treat the
+speedup as 4-6x. The ``block_q=block_k=512`` defaults come from that
+sweep: 128x128 blocks are only ~1.4x over unfused (accumulator-rescale
+overhead dominates), 512-wide blocks are 3-4x faster than 128-wide;
+the causal block skip (:func:`_k_blocks_for`) is worth ~2x at large T.
 """
 
 from __future__ import annotations
 
 import functools
 import math
-import os
 from typing import NamedTuple, Optional
 
 import jax
@@ -59,16 +61,10 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
+from theanompi_tpu.ops.pallas_util import interpret_mode as _interpret
+from theanompi_tpu.ops.pallas_util import use_pallas as _use_pallas
+
 _NEG = -1e30  # masked-logit sentinel (finite: keeps exp/max NaN-free)
-
-
-def _use_pallas() -> bool:
-    return os.environ.get("TMPI_PALLAS", "1") != "0"
-
-
-def _interpret() -> bool:
-    # native Mosaic lowering on TPU; interpreter elsewhere (CPU meshes)
-    return jax.default_backend() != "tpu"
 
 
 class _Cfg(NamedTuple):

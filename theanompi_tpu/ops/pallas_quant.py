@@ -19,21 +19,13 @@ already pads segments).
 
 from __future__ import annotations
 
-import os
-
 import jax
 import jax.numpy as jnp
 
+from theanompi_tpu.ops.pallas_util import interpret_mode as _interpret
+from theanompi_tpu.ops.pallas_util import use_pallas as _use_pallas
+
 _LANES = 128
-
-
-def _use_pallas() -> bool:
-    return os.environ.get("TMPI_PALLAS", "1") != "0"
-
-
-def _interpret() -> bool:
-    # native lowering on TPU; interpreter elsewhere (CPU test meshes)
-    return jax.default_backend() != "tpu"
 
 
 def _quant_kernel(x_ref, vals_ref, scale_ref):

@@ -1,0 +1,21 @@
+"""Shared gates for the Pallas kernel families (quantization,
+attention): one switch for the pure-jnp/unfused fallback
+(``TMPI_PALLAS=0``) and one for interpreter-vs-Mosaic lowering, so a
+policy change reaches every kernel at once."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def use_pallas() -> bool:
+    """False: modules route to their jnp/unfused fallbacks (same math)."""
+    return os.environ.get("TMPI_PALLAS", "1") != "0"
+
+
+def interpret_mode() -> bool:
+    """Native Mosaic lowering on TPU; the Pallas interpreter elsewhere
+    (CPU test meshes) — identical numerics either way."""
+    return jax.default_backend() != "tpu"

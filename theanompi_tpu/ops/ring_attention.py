@@ -164,13 +164,16 @@ def full_attention_reference(q, k, v, causal=False, scale=None, precision=None):
     """Plain full-softmax attention — the single-device oracle for tests
     and the local per-head kernel inside :func:`ulysses_attention`."""
     B, T, H, D = q.shape
+    Tk = k.shape[1]
     sc = scale if scale is not None else 1.0 / math.sqrt(D)
     s = jnp.einsum(
         "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32),
         precision=precision,
     ) * sc
     if causal:
-        mask = jnp.tril(jnp.ones((T, T), bool))
+        # position-aligned-at-start convention, valid for Tq != Tk too
+        # (matches pallas_attention's global row >= col mask)
+        mask = jnp.arange(T)[:, None] >= jnp.arange(Tk)[None, :]
         s = jnp.where(mask[None, None], s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum(
