@@ -87,6 +87,18 @@ def test_op_table_keeps_legit_dominant_op(tmp_path):
     assert ops["big_fusion.#"]["share"] == pytest.approx(0.7)
 
 
+def test_op_table_keeps_window_spanning_megakernel(tmp_path):
+    """A single instance spanning 90% of a one-step window is NOT a
+    container when the remaining ops cannot account for the window
+    (a wrapper's children fill it; a megakernel leaves it empty)."""
+    events = _meta(3, "/device:TPU:0", 9, "XLA Ops")
+    events.append(_dev_op("mega_fusion.1", ts=0, dur=900))
+    events.append(_dev_op("small.1", ts=900, dur=100))
+    trace = _write_trace(tmp_path, events)
+    ops = {r["op"]: r for r in op_table(trace, steps=1)}
+    assert ops["mega_fusion.#"]["share"] == pytest.approx(0.9)
+
+
 def test_cpu_capture_degrades_gracefully(tmp_path):
     """A REAL CPU-backend capture has no device 'XLA Ops' track: the
     table is empty and format_table says why instead of crashing."""

@@ -36,6 +36,7 @@ from theanompi_tpu.utils import (
     load_checkpoint,
     save_checkpoint,
 )
+from theanompi_tpu.utils.checkpoint import AsyncCheckpointer
 
 
 def run_training(
@@ -55,6 +56,7 @@ def run_training(
     save_dir: Optional[str] = None,
     ckpt_dir: Optional[str] = None,
     ckpt_every_epochs: int = 1,
+    async_checkpoint: bool = True,
     resume: bool = False,
     print_freq: int = 40,
     tensorboard: bool = False,
@@ -70,6 +72,12 @@ def run_training(
 
     The recipe is the model's own (reference: model-owned hyperparams,
     SURVEY.md §5.6); ``recipe_overrides`` is the session's override hook.
+
+    ``async_checkpoint`` (default True) writes epoch checkpoints on a
+    background thread overlapped with the next epoch's steps (reference
+    parity is the synchronous rank-0 save; SURVEY.md §5.4) — ordering,
+    durability-on-return, and the multi-host synchronous fallback are
+    handled by :class:`~theanompi_tpu.utils.checkpoint.AsyncCheckpointer`.
     """
     if model_cls is None:
         raise ValueError("model_cls is required")
@@ -306,6 +314,7 @@ def run_training(
             yield buf
 
     summary: dict = {"epochs": [], "rule": rule, "model": model.name}
+    ckpt_writer = AsyncCheckpointer() if (ckpt_dir and async_checkpoint) else None
     step_count = engine.get_step(state)
     # Mid-epoch resume (checkpoint written after a max_steps truncation):
     # fast-forward past the batches the restored step count already
@@ -423,14 +432,24 @@ def run_training(
                 summary["val"] = val_metrics
 
             if ckpt_dir and (epoch + 1) % ckpt_every_epochs == 0:
-                save_checkpoint(ckpt_dir, state, step_count, rng=rng)
+                if ckpt_writer is not None:
+                    # overlapped with the next epoch's steps; ordering +
+                    # durability enforced by the writer (drained in the
+                    # finally below before the summary returns)
+                    ckpt_writer.save(ckpt_dir, state, step_count, rng=rng)
+                else:
+                    save_checkpoint(ckpt_dir, state, step_count, rng=rng)
             rec.save()
             summary["epochs"].append(epoch)
             if max_steps and step_count >= max_steps:
                 break
 
     finally:
-        rec.close()
+        try:
+            if ckpt_writer is not None:
+                ckpt_writer.close()  # may re-raise a failed write
+        finally:
+            rec.close()  # trace + JSONL must close even then
     summary["steps"] = step_count
     summary["images_per_sec"] = (
         batch / rec.mean_time("step", 50) if rec.mean_time("step", 50) else 0.0

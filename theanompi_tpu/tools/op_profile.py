@@ -101,11 +101,15 @@ def op_table(trace_dir: str, steps: int = 1) -> list:
     wall = max(t1 - t0, 0.0)
     # drop container ops — a while/scan wrapper is one event spanning
     # (nearly) the whole device window, with all its children ALSO on
-    # the track; keeping both would double count
+    # the track; keeping both would double count. A wrapper is only a
+    # wrapper if the REST of the ops fill the window too (its children);
+    # a legitimately dominant megakernel leaves the rest of the window
+    # empty and must be kept.
+    grand = sum(agg.values())
     total = 0.0
     rows = []
     for name, dur in agg.items():
-        if wall and longest[name] >= 0.85 * wall:
+        if wall and longest[name] >= 0.85 * wall and (grand - dur) >= 0.7 * wall:
             continue
         total += dur
         rows.append((name, dur, cnt[name]))
@@ -176,18 +180,21 @@ def main(argv=None) -> int:
     ap.add_argument("--model", default="resnet50",
                     help="zoo model to capture+analyze (no --trace)")
     ap.add_argument("--batch", type=int, default=None)
-    ap.add_argument("--steps", type=int, default=5,
-                    help="fused steps in the capture window / divisor "
-                    "for an existing trace")
+    ap.add_argument("--steps", type=int, default=None,
+                    help="fused steps in the capture window (default 5) "
+                    "/ per-step divisor for --trace (default 1 — pass "
+                    "the real step count of the capture to get ms/step)")
     ap.add_argument("--top", type=int, default=20)
     args = ap.parse_args(argv)
 
     if args.trace:
         trace_dir = args.trace
+        steps = args.steps or 1
     else:
+        steps = args.steps or 5
         trace_dir = os.path.join("/tmp", f"tmpi_opprof_{args.model}")
-        capture_model_step(args.model, args.batch, args.steps, trace_dir)
-    rows = op_table(trace_dir, steps=args.steps)
+        capture_model_step(args.model, args.batch, steps, trace_dir)
+    rows = op_table(trace_dir, steps=steps)
     print(format_table(rows, top=args.top))
     return 0
 

@@ -188,6 +188,86 @@ _KEY_IMPL_BY_WIDTH = {2: "threefry2x32", 4: "rbg"}
 _KEY_WIDTH_BY_IMPL = {"threefry2x32": 2, "rbg": 4, "unsafe_rbg": 4}
 
 
+class AsyncCheckpointer:
+    """Checkpoint writes overlapped with training (beyond-parity: the
+    reference saved synchronously from rank 0 each epoch, stalling the
+    workers for the full serialize+write — SURVEY.md §5.4 "no async
+    checkpointing").
+
+    ``save()`` first takes a DEVICE-SIDE snapshot (an HBM->HBM copy of
+    every ``jax.Array`` leaf, ~ms) and hands that to a single background
+    thread for the host pull + write. The copy is what makes overlap
+    sound under buffer DONATION: every multi-device engine jits its step
+    with ``donate_argnums=(0,)``, so the next dispatched step marks the
+    live state's buffers deleted — a background ``device_get`` on the
+    originals would race it and crash ("Array has been deleted"); the
+    snapshot buffers are referenced only by the writer. Costs one
+    transient extra TrainState in HBM until the pull completes.
+    Semantics match :func:`save_checkpoint` (atomic tmp+rename, rank-0
+    writes, prune-to-keep), with orbax-style discipline:
+
+    - ONE save in flight: a new ``save()`` first waits for the previous
+      one, so checkpoints land in step order.
+    - worker errors don't vanish: they re-raise at the next ``save()`` /
+      ``wait()`` / ``close()``.
+    - ``close()`` drains the queue — call before reading "the latest
+      checkpoint" or letting the process exit.
+
+    Multi-host: leaves that are NOT fully addressable need cross-host
+    collectives to gather; those must stay on the thread that issues the
+    training step's collectives (two threads interleaving collectives
+    deadlock). Such saves transparently run synchronously instead.
+    """
+
+    def __init__(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._pool = ThreadPoolExecutor(1, thread_name_prefix="tmpi-ckpt")
+        self._pending = None
+
+    def save(
+        self,
+        directory: str,
+        state: PyTree,
+        step: int,
+        rng: Optional[jax.Array] = None,
+        keep: int = 3,
+    ) -> None:
+        self.wait()
+        leaves = jax.tree_util.tree_leaves(state)
+        if any(
+            isinstance(l, jax.Array) and not l.is_fully_addressable
+            for l in leaves
+        ):
+            # cross-host gather required -> synchronous, on this thread
+            save_checkpoint(directory, state, step, rng=rng, keep=keep)
+            return
+
+        def snap(leaf):
+            # new device buffer: immune to donation of the original
+            return jnp.copy(leaf) if isinstance(leaf, jax.Array) else leaf
+
+        state = jax.tree_util.tree_map(snap, state)
+        if rng is not None:
+            rng = snap(rng)
+        self._pending = self._pool.submit(
+            save_checkpoint, directory, state, step, rng, keep
+        )
+
+    def wait(self) -> None:
+        """Block until the in-flight save (if any) is durable; re-raises
+        its error here if it failed."""
+        if self._pending is not None:
+            pending, self._pending = self._pending, None
+            pending.result()
+
+    def close(self) -> None:
+        try:
+            self.wait()
+        finally:
+            self._pool.shutdown(wait=True)
+
+
 def wrap_saved_rng(raw: np.ndarray, impl: Optional[str] = None) -> jax.Array:
     """Turn a checkpoint's raw ``__rng__`` uint32 data back into a usable
     PRNG key, honoring the impl that WROTE it rather than the process
