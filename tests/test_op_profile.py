@@ -99,6 +99,22 @@ def test_op_table_keeps_window_spanning_megakernel(tmp_path):
     assert ops["mega_fusion.#"]["share"] == pytest.approx(0.9)
 
 
+def test_op_table_uses_one_device_on_multichip_traces(tmp_path):
+    """A multi-chip trace carries the same SPMD ops once per
+    '/device:TPU:n' process; summing across them would inflate
+    ms_per_step by the device count — the table must use ONE device."""
+    events = []
+    for pid in (3, 4):  # two devices
+        events += _meta(pid, f"/device:TPU:{pid - 3}", 9, "XLA Ops")
+        events.append(_dev_op("conv_fusion.1", ts=0, dur=600, pid=pid))
+        events.append(_dev_op("reduce.2", ts=600, dur=400, pid=pid))
+    trace = _write_trace(tmp_path, events)
+    rows = op_table(trace, steps=1)
+    ops = {r["op"]: r for r in rows}
+    assert ops["conv_fusion.#"]["ms_per_step"] == pytest.approx(0.6)
+    assert ops["conv_fusion.#"]["count_per_step"] == pytest.approx(1.0)
+
+
 def test_cpu_capture_degrades_gracefully(tmp_path):
     """A REAL CPU-backend capture has no device 'XLA Ops' track: the
     table is empty and format_table says why instead of crashing."""

@@ -37,7 +37,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from theanompi_tpu.ops.pallas_attention import flash_attention
+from theanompi_tpu.ops.pallas_attention import flash_attention, ring_flash_attention
 from theanompi_tpu.ops.ring_attention import (
     full_attention_reference,
     ring_attention,
@@ -67,20 +67,23 @@ def attention_block(blk, x, attn: str, sp_axis: Optional[str]):
         if attn == "flash":
             raise ValueError(
                 "attn='flash' is the fused LOCAL kernel; under sequence "
-                "parallelism pick attn='ring' (K/V rotation, unfused) or "
-                "attn='ulysses_flash' (all-to-all with the fused flash "
-                "local step) — plain attn='ulysses' is the unfused variant"
+                "parallelism pick attn='ring_flash' (K/V rotation, each "
+                "hop folded by the fused kernel) or attn='ulysses_flash' "
+                "(all-to-all with the fused local step) — 'ring'/'ulysses' "
+                "are their unfused variants"
             )
         sp_attn = {
             "ring": ring_attention,
+            "ring_flash": ring_flash_attention,
             "ulysses": ulysses_attention,
             "ulysses_flash": functools.partial(
                 ulysses_attention, local_fn=flash_attention
             ),
         }[attn]
         att = sp_attn(q, k, v, sp_axis, causal=True)
-    elif attn in ("flash", "ulysses_flash"):
-        # no SP axis: ulysses degenerates to its local step — the fused kernel
+    elif attn in ("flash", "ulysses_flash", "ring_flash"):
+        # no SP axis: both SP schemes degenerate to their local step —
+        # the fused kernel
         att = flash_attention(q, k, v, causal=True)
     else:
         att = full_attention_reference(q, k, v, causal=True)
@@ -135,6 +138,8 @@ class TransformerLM(NamedTuple):
 
     ``attn`` picks the attention scheme: ``"ring"`` (K/V rotation,
     O(T/n) memory under SP; plain full attention without an SP axis),
+    ``"ring_flash"`` (same ring, each hop folded by the fused Pallas
+    flash kernel — no per-hop score materialization either),
     ``"ulysses"`` (head<->sequence all-to-all; needs ``n_heads``
     divisible by the seq-axis size), ``"ulysses_flash"`` (same, with
     the local step fused via the Pallas flash kernel), or ``"flash"``

@@ -79,28 +79,42 @@ class _Cfg(NamedTuple):
     interpret: bool
 
 
-def _mask(cfg: _Cfg, i, j):
-    """[BQ, BK] validity of (query block i, key block j) in GLOBAL
-    positions: key padding masked always, lower-triangle when causal."""
-    row = i * cfg.BQ + lax.broadcasted_iota(jnp.int32, (cfg.BQ, cfg.BK), 0)
-    col = j * cfg.BK + lax.broadcasted_iota(jnp.int32, (cfg.BQ, cfg.BK), 1)
-    valid = col < cfg.Tk
+def _mask(cfg: _Cfg, i, j, q_off, k_off):
+    """[BQ, BK] validity of (query block i, key block j): key PADDING is
+    masked in local coordinates (padding is per-shard); the causal
+    triangle compares GLOBAL positions ``q_off + local`` vs ``k_off +
+    local`` — offsets are zero for single-shard use and ``rank * T``
+    under the ring."""
+    lrow = i * cfg.BQ + lax.broadcasted_iota(jnp.int32, (cfg.BQ, cfg.BK), 0)
+    lcol = j * cfg.BK + lax.broadcasted_iota(jnp.int32, (cfg.BQ, cfg.BK), 1)
+    valid = lcol < cfg.Tk
     if cfg.causal:
-        valid = valid & (row >= col)
+        valid = valid & ((q_off + lrow) >= (k_off + lcol))
     return valid
 
 
-def _k_blocks_for(cfg: _Cfg, i, nk):
+def _k_blocks_for(cfg: _Cfg, i, nk, q_off, k_off):
     """Last k-block index (exclusive) query block ``i`` touches: under
-    causal masking blocks strictly above the diagonal are all-masked and
-    skipped entirely — ~2x less work at large T."""
+    causal masking blocks strictly above the (global) diagonal are
+    all-masked and skipped entirely — ~2x less work at large T, and
+    whole fully-future K/V shards cost ~nothing under the ring."""
     if not cfg.causal:
         return nk
-    return jnp.minimum(nk, (i * cfg.BQ + cfg.BQ - 1) // cfg.BK + 1)
+    jmax = (q_off - k_off + i * cfg.BQ + cfg.BQ - 1) // cfg.BK + 1
+    return jnp.clip(jmax, 0, nk)
 
 
-def _fwd_kernel(cfg: _Cfg, q_ref, k_ref, v_ref, o_ref, lse_ref):
+def _q_block_start(cfg: _Cfg, j, q_off, k_off):
+    """First q-block index whose rows can (causally) see key block
+    ``j`` — the dkv-kernel mirror of :func:`_k_blocks_for`."""
+    if not cfg.causal:
+        return 0
+    return jnp.maximum(0, (k_off + j * cfg.BK - q_off) // cfg.BQ)
+
+
+def _fwd_kernel(cfg: _Cfg, qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref):
     i = pl.program_id(1)
+    q_off, k_off = qo_ref[0, 0], ko_ref[0, 0]
     q = q_ref[0]  # [BQ, D], input dtype
     D = q.shape[-1]
     nk = k_ref.shape[1] // cfg.BK
@@ -116,7 +130,7 @@ def _fwd_kernel(cfg: _Cfg, q_ref, k_ref, v_ref, o_ref, lse_ref):
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * cfg.scale
-        valid = _mask(cfg, i, j)
+        valid = _mask(cfg, i, j, q_off, k_off)
         s = jnp.where(valid, s, _NEG)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
@@ -128,16 +142,23 @@ def _fwd_kernel(cfg: _Cfg, q_ref, k_ref, v_ref, o_ref, lse_ref):
         )
         return acc, m_new, l
 
-    acc, m, l = lax.fori_loop(0, _k_blocks_for(cfg, i, nk), body, (acc0, m0, l0))
-    # causal guarantees key j=row is valid for every real row; padded
-    # rows still see all real keys (causal: keys <= row, row >= Tq-1),
-    # so l > 0 everywhere
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l)  # [BQ, 1]
+    acc, m, l = lax.fori_loop(
+        0, _k_blocks_for(cfg, i, nk, q_off, k_off), body, (acc0, m0, l0)
+    )
+    # l == 0 only for rows with no visible key at all — impossible
+    # single-shard (causal: the diagonal key is local), but routine for
+    # a ring hop whose whole K/V shard is in the causal future; the safe
+    # divisor yields o = 0 and an effectively -inf lse, which the ring
+    # merge weights to zero
+    l_safe = jnp.maximum(l, 1e-37)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)  # [BQ, 1]
 
 
-def _dq_kernel(cfg: _Cfg, q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, dq_ref):
+def _dq_kernel(cfg: _Cfg, qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref,
+               lse_ref, dsum_ref, dq_ref):
     i = pl.program_id(1)
+    q_off, k_off = qo_ref[0, 0], ko_ref[0, 0]
     q = q_ref[0]
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0]  # [BQ, 1]
@@ -150,7 +171,7 @@ def _dq_kernel(cfg: _Cfg, q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, dq_ref
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * cfg.scale
-        p = jnp.where(_mask(cfg, i, j), jnp.exp(s - lse), 0.0)
+        p = jnp.where(_mask(cfg, i, j, q_off, k_off), jnp.exp(s - lse), 0.0)
         dp = lax.dot_general(
             do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -161,14 +182,16 @@ def _dq_kernel(cfg: _Cfg, q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, dq_ref
         )
 
     dq = lax.fori_loop(
-        0, _k_blocks_for(cfg, i, nk), body, jnp.zeros(q.shape, jnp.float32)
+        0, _k_blocks_for(cfg, i, nk, q_off, k_off), body,
+        jnp.zeros(q.shape, jnp.float32),
     )
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    dq_ref[0] = dq  # f32: ring hops accumulate partials losslessly
 
 
-def _dkv_kernel(cfg: _Cfg, q_ref, do_ref, lse_ref, dsum_ref, k_ref, v_ref,
-                dk_ref, dv_ref):
+def _dkv_kernel(cfg: _Cfg, qo_ref, ko_ref, q_ref, do_ref, lse_ref, dsum_ref,
+                k_ref, v_ref, dk_ref, dv_ref):
     j = pl.program_id(1)
+    q_off, k_off = qo_ref[0, 0], ko_ref[0, 0]
     k = k_ref[0]
     v = v_ref[0]
     nq = q_ref.shape[1] // cfg.BQ
@@ -182,7 +205,9 @@ def _dkv_kernel(cfg: _Cfg, q_ref, do_ref, lse_ref, dsum_ref, k_ref, v_ref,
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * cfg.scale
-        p = jnp.where(_mask(cfg, i, j), jnp.exp(s - lse), 0.0)  # [BQ, BK]
+        p = jnp.where(
+            _mask(cfg, i, j, q_off, k_off), jnp.exp(s - lse), 0.0
+        )  # [BQ, BK]
         dv = dv + lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -201,112 +226,142 @@ def _dkv_kernel(cfg: _Cfg, q_ref, do_ref, lse_ref, dsum_ref, k_ref, v_ref,
     dv0 = jnp.zeros(v.shape, jnp.float32)
     # causal: query blocks strictly below this key block's diagonal see
     # none of it — start at the first overlapping block
-    i0 = (j * cfg.BK) // cfg.BQ if cfg.causal else 0
-    dk, dv = lax.fori_loop(i0, nq, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    dk, dv = lax.fori_loop(
+        _q_block_start(cfg, j, q_off, k_off), nq, body, (dk0, dv0)
+    )
+    dk_ref[0] = dk  # f32: ring hops accumulate partials losslessly
+    dv_ref[0] = dv
 
 
-def _fwd(cfg: _Cfg, q3, k3, v3):
-    """Padded [BH, T_pad, D] flash forward -> (o, lse)."""
+def _zero_offs():
+    z = jnp.zeros((1, 1), jnp.int32)
+    return z, z
+
+
+def _as_off(x) -> jax.Array:
+    return jnp.reshape(jnp.asarray(x, jnp.int32), (1, 1))
+
+
+def _smem_spec():
     from jax.experimental.pallas import tpu as pltpu
 
+    return pl.BlockSpec((1, 1), lambda b, i: (0, 0), memory_space=pltpu.SMEM)
+
+
+def _q_major(shape):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pl.BlockSpec(shape, lambda b, i: (b, i) + (0,) * (len(shape) - 2),
+                        memory_space=pltpu.VMEM)
+
+
+def _full(shape):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pl.BlockSpec(shape, lambda b, i: (b,) + (0,) * (len(shape) - 1),
+                        memory_space=pltpu.VMEM)
+
+
+def _fwd(cfg: _Cfg, q3, k3, v3, q_off, k_off):
+    """Padded [BH, T_pad, D] flash forward -> (o, lse[BH, T_pad, 1])."""
     BH, Tqp, D = q3.shape
     Tkp = k3.shape[1]
-    grid = (BH, Tqp // cfg.BQ)
-    kv_spec = pl.BlockSpec(
-        (1, Tkp, D), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM
-    )
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, cfg),
-        grid=grid,
+        grid=(BH, Tqp // cfg.BQ),
         in_specs=[
-            pl.BlockSpec((1, cfg.BQ, D), lambda b, i: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            kv_spec,
-            kv_spec,
+            _smem_spec(),                     # q_off
+            _smem_spec(),                     # k_off
+            _q_major((1, cfg.BQ, D)),         # q
+            _full((1, Tkp, D)),               # k
+            _full((1, Tkp, D)),               # v
         ],
         out_specs=(
-            pl.BlockSpec((1, cfg.BQ, D), lambda b, i: (b, i, 0),
-                         memory_space=pltpu.VMEM),
+            _q_major((1, cfg.BQ, D)),
             # [BH, Tqp, 1]: a trailing singleton lane keeps the block's
             # last-two dims Mosaic-legal ((BQ, 1) == (div 8, full dim))
-            pl.BlockSpec((1, cfg.BQ, 1), lambda b, i: (b, i, 0),
-                         memory_space=pltpu.VMEM),
+            _q_major((1, cfg.BQ, 1)),
         ),
         out_shape=(
             jax.ShapeDtypeStruct((BH, Tqp, D), q3.dtype),
             jax.ShapeDtypeStruct((BH, Tqp, 1), jnp.float32),
         ),
         interpret=cfg.interpret,
-    )(q3, k3, v3)
+    )(q_off, k_off, q3, k3, v3)
     return o, lse
 
 
-def _bwd(cfg: _Cfg, q3, k3, v3, o, lse, g):
-    from jax.experimental.pallas import tpu as pltpu
-
+def _dq_call(cfg: _Cfg, q3, k3, v3, g, lse, dsum, q_off, k_off):
+    """dq partial (f32) for one K/V shard, given the GLOBAL lse/dsum."""
     BH, Tqp, D = q3.shape
     Tkp = k3.shape[1]
-    # per-row sum(dO * O) — the softmax-gradient correction term
-    # (padded rows of g are zero, so their dsum is zero); [BH, Tqp, 1]
-    dsum = jnp.sum(
-        g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
-    )
-
-    def q_major(shape):
-        return pl.BlockSpec(shape, lambda b, i: (b, i) + (0,) * (len(shape) - 2),
-                            memory_space=pltpu.VMEM)
-
-    def full(shape):
-        return pl.BlockSpec(shape, lambda b, i: (b,) + (0,) * (len(shape) - 1),
-                            memory_space=pltpu.VMEM)
-
-    dq = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(_dq_kernel, cfg),
         grid=(BH, Tqp // cfg.BQ),
         in_specs=[
-            q_major((1, cfg.BQ, D)),          # q
-            full((1, Tkp, D)),                # k
-            full((1, Tkp, D)),                # v
-            q_major((1, cfg.BQ, D)),          # dO
-            q_major((1, cfg.BQ, 1)),          # lse
-            q_major((1, cfg.BQ, 1)),          # dsum
+            _smem_spec(), _smem_spec(),
+            _q_major((1, cfg.BQ, D)),         # q
+            _full((1, Tkp, D)),               # k
+            _full((1, Tkp, D)),               # v
+            _q_major((1, cfg.BQ, D)),         # dO
+            _q_major((1, cfg.BQ, 1)),         # lse
+            _q_major((1, cfg.BQ, 1)),         # dsum
         ],
-        out_specs=q_major((1, cfg.BQ, D)),
-        out_shape=jax.ShapeDtypeStruct((BH, Tqp, D), q3.dtype),
+        out_specs=_q_major((1, cfg.BQ, D)),
+        out_shape=jax.ShapeDtypeStruct((BH, Tqp, D), jnp.float32),
         interpret=cfg.interpret,
-    )(q3, k3, v3, g, lse, dsum)
+    )(q_off, k_off, q3, k3, v3, g, lse, dsum)
 
-    dk, dv = pl.pallas_call(
+
+def _dkv_call(cfg: _Cfg, q3, g, lse, dsum, k3, v3, q_off, k_off):
+    """(dk, dv) partials (f32) for one K/V shard vs these queries."""
+    BH, Tqp, D = q3.shape
+    Tkp = k3.shape[1]
+    return pl.pallas_call(
         functools.partial(_dkv_kernel, cfg),
         grid=(BH, Tkp // cfg.BK),
         in_specs=[
-            full((1, Tqp, D)),                # q
-            full((1, Tqp, D)),                # dO
-            full((1, Tqp, 1)),                # lse
-            full((1, Tqp, 1)),                # dsum
-            q_major((1, cfg.BK, D)),          # k block
-            q_major((1, cfg.BK, D)),          # v block
+            _smem_spec(), _smem_spec(),
+            _full((1, Tqp, D)),               # q
+            _full((1, Tqp, D)),               # dO
+            _full((1, Tqp, 1)),               # lse
+            _full((1, Tqp, 1)),               # dsum
+            _q_major((1, cfg.BK, D)),         # k block
+            _q_major((1, cfg.BK, D)),         # v block
         ],
-        out_specs=(q_major((1, cfg.BK, D)), q_major((1, cfg.BK, D))),
+        out_specs=(_q_major((1, cfg.BK, D)), _q_major((1, cfg.BK, D))),
         out_shape=(
-            jax.ShapeDtypeStruct((BH, Tkp, D), k3.dtype),
-            jax.ShapeDtypeStruct((BH, Tkp, D), v3.dtype),
+            jax.ShapeDtypeStruct((BH, Tkp, D), jnp.float32),
+            jax.ShapeDtypeStruct((BH, Tkp, D), jnp.float32),
         ),
         interpret=cfg.interpret,
-    )(q3, g, lse, dsum, k3, v3)
-    return dq, dk, dv
+    )(q_off, k_off, q3, g, lse, dsum, k3, v3)
+
+
+def _dsum_of(g, o):
+    """Per-row sum(dO * O) — the softmax-gradient correction term
+    (padded rows of g are zero, so their dsum is zero); [BH, Tqp, 1]."""
+    return jnp.sum(
+        g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+    )
+
+
+def _bwd(cfg: _Cfg, q3, k3, v3, o, lse, g):
+    q_off, k_off = _zero_offs()
+    dsum = _dsum_of(g, o)
+    dq = _dq_call(cfg, q3, k3, v3, g, lse, dsum, q_off, k_off)
+    dk, dv = _dkv_call(cfg, q3, g, lse, dsum, k3, v3, q_off, k_off)
+    return dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
 def _flash(cfg: _Cfg, q3, k3, v3):
-    o, _ = _fwd(cfg, q3, k3, v3)
+    o, _ = _fwd(cfg, q3, k3, v3, *_zero_offs())
     return o
 
 
 def _flash_vjp_fwd(cfg, q3, k3, v3):
-    o, lse = _fwd(cfg, q3, k3, v3)
+    o, lse = _fwd(cfg, q3, k3, v3, *_zero_offs())
     return o, (q3, k3, v3, o, lse)
 
 
@@ -319,6 +374,40 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 def _to_heads_major(x, B, T, H, D):
     return jnp.transpose(x, (0, 2, 1, 3)).reshape(B * H, T, D)
+
+
+def _prepare(q, k, v, causal, scale, precision, block_q, block_k):
+    """Shared prologue of the public entry points: precision upcast,
+    block sizing, heads-major reshape, padding. Returns
+    ``(cfg, q3, k3, v3, shape_meta)`` where shape_meta =
+    ``(B, Tq, H, D, out_dtype)`` for :func:`_finish`."""
+    out_dtype = q.dtype
+    if precision in (lax.Precision.HIGHEST, "highest", "float32"):
+        q, k, v = (t.astype(jnp.float32) for t in (q, k, v))
+
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    sc = scale if scale is not None else 1.0 / math.sqrt(D)
+    BQ, BK = min(block_q, _ceil_to(Tq, 8)), min(block_k, _ceil_to(Tk, 8))
+    Tqp, Tkp = _ceil_to(Tq, BQ), _ceil_to(Tk, BK)
+    cfg = _Cfg(bool(causal), float(sc), Tq, Tk, BQ, BK, _interpret())
+
+    q3 = _to_heads_major(q, B, Tq, H, D)
+    k3 = _to_heads_major(k, B, Tk, H, D)
+    v3 = _to_heads_major(v, B, Tk, H, D)
+    if Tqp != Tq:
+        q3 = jnp.pad(q3, ((0, 0), (0, Tqp - Tq), (0, 0)))
+    if Tkp != Tk:
+        k3 = jnp.pad(k3, ((0, 0), (0, Tkp - Tk), (0, 0)))
+        v3 = jnp.pad(v3, ((0, 0), (0, Tkp - Tk), (0, 0)))
+    return cfg, q3, k3, v3, (B, Tq, H, D, out_dtype)
+
+
+def _finish(o_padded, shape_meta):
+    """Shared epilogue: unpad, restore [B, Tq, H, D], original dtype."""
+    B, Tq, H, D, out_dtype = shape_meta
+    o = o_padded[:, :Tq]
+    return jnp.transpose(o.reshape(B, H, Tq, D), (0, 2, 1, 3)).astype(out_dtype)
 
 
 def flash_attention(
@@ -351,29 +440,147 @@ def flash_attention(
             q, k, v, causal=causal, scale=scale, precision=precision
         )
 
-    out_dtype = q.dtype
-    if precision in (lax.Precision.HIGHEST, "highest", "float32"):
-        q, k, v = (t.astype(jnp.float32) for t in (q, k, v))
-
-    B, Tq, H, D = q.shape
-    Tk = k.shape[1]
-    sc = scale if scale is not None else 1.0 / math.sqrt(D)
-    BQ, BK = min(block_q, _ceil_to(Tq, 8)), min(block_k, _ceil_to(Tk, 8))
-    Tqp, Tkp = _ceil_to(Tq, BQ), _ceil_to(Tk, BK)
-    cfg = _Cfg(bool(causal), float(sc), Tq, Tk, BQ, BK, _interpret())
-
-    q3 = _to_heads_major(q, B, Tq, H, D)
-    k3 = _to_heads_major(k, B, Tk, H, D)
-    v3 = _to_heads_major(v, B, Tk, H, D)
-    if Tqp != Tq:
-        q3 = jnp.pad(q3, ((0, 0), (0, Tqp - Tq), (0, 0)))
-    if Tkp != Tk:
-        k3 = jnp.pad(k3, ((0, 0), (0, Tkp - Tk), (0, 0)))
-        v3 = jnp.pad(v3, ((0, 0), (0, Tkp - Tk), (0, 0)))
-
-    o = _flash(cfg, q3, k3, v3)[:, :Tq]
-    return jnp.transpose(o.reshape(B, H, Tq, D), (0, 2, 1, 3)).astype(out_dtype)
+    cfg, q3, k3, v3, meta = _prepare(
+        q, k, v, causal, scale, precision, block_q, block_k
+    )
+    return _finish(_flash(cfg, q3, k3, v3), meta)
 
 
 def _ceil_to(x: int, m: int) -> int:
     return -(-x // m) * m
+
+
+# -- ring + flash: sequence-parallel attention with fused local folds -------
+
+
+class _RingCfg(NamedTuple):
+    cfg: _Cfg
+    axis: str
+
+
+def _ring_perm(n):
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def _ring_fwd_parts(rcfg: _RingCfg, q3, k3, v3):
+    """Distributed flash forward: each hop folds one K/V shard with the
+    fused kernel, producing a per-hop (o_j, lse_j); hops merge by the
+    logsumexp-rescale law. Exact (not approximate) global softmax."""
+    cfg, ax = rcfg.cfg, rcfg.axis
+    n = lax.psum(1, ax)
+    rank = lax.axis_index(ax)
+    BH, Tqp, D = q3.shape
+    q_off = _as_off(rank * cfg.Tq)
+
+    acc0 = jnp.zeros((BH, Tqp, D), jnp.float32)
+    m0 = jnp.full((BH, Tqp, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((BH, Tqp, 1), jnp.float32)
+    kv0 = jnp.stack([k3, v3])
+    perm = _ring_perm(n)
+
+    def hop(carry, t):
+        acc, m, l, kv = carry
+        src = jnp.mod(rank - t, n)
+        o_j, lse_j = _fwd(cfg, q3, kv[0], kv[1], q_off, _as_off(src * cfg.Tk))
+        # merge block j into the running (acc, m, l): a fully-masked hop
+        # has lse_j ~ -1e30 and o_j = 0, weighting to zero
+        m_new = jnp.maximum(m, lse_j)
+        w_old = jnp.exp(m - m_new)
+        w_new = jnp.exp(lse_j - m_new)
+        acc = acc * w_old + o_j.astype(jnp.float32) * w_new
+        l = l * w_old + w_new
+        kv = lax.ppermute(kv, ax, perm)
+        return (acc, m_new, l, kv), None
+
+    (acc, m, l, _), _ = lax.scan(hop, (acc0, m0, l0, kv0), jnp.arange(n))
+    l_safe = jnp.maximum(l, 1e-37)
+    o = (acc / l_safe).astype(q3.dtype)
+    lse = m + jnp.log(l_safe)
+    return o, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _ring_flash(rcfg: _RingCfg, q3, k3, v3):
+    return _ring_fwd_parts(rcfg, q3, k3, v3)[0]
+
+
+def _ring_flash_vjp_fwd(rcfg, q3, k3, v3):
+    o, lse = _ring_fwd_parts(rcfg, q3, k3, v3)
+    return o, (q3, k3, v3, o, lse)
+
+
+def _ring_flash_vjp_bwd(rcfg, res, g):
+    """Ring backward (Liu et al. blockwise formulation): dq accumulates
+    locally across hops; (dk, dv) partials travel WITH their K/V shard
+    (one extra ppermute pair per hop) and are home after the n-th
+    rotation. The per-hop kernels take the GLOBAL lse/dsum, so each
+    partial is exact — fp32 accumulation end to end."""
+    cfg, ax = rcfg.cfg, rcfg.axis
+    q3, k3, v3, o, lse = res
+    n = lax.psum(1, ax)
+    rank = lax.axis_index(ax)
+    dsum = _dsum_of(g, o)
+    q_off = _as_off(rank * cfg.Tq)
+    perm = _ring_perm(n)
+
+    dq0 = jnp.zeros(q3.shape, jnp.float32)
+    kv0 = jnp.stack([k3, v3])
+    dkv0 = jnp.zeros(kv0.shape, jnp.float32)
+
+    def hop(carry, t):
+        dq, kv, dkv = carry
+        src = jnp.mod(rank - t, n)
+        k_off = _as_off(src * cfg.Tk)
+        dq = dq + _dq_call(cfg, q3, kv[0], kv[1], g, lse, dsum, q_off, k_off)
+        dk_j, dv_j = _dkv_call(cfg, q3, g, lse, dsum, kv[0], kv[1], q_off, k_off)
+        dkv = dkv + jnp.stack([dk_j, dv_j])
+        kv = lax.ppermute(kv, ax, perm)
+        dkv = lax.ppermute(dkv, ax, perm)
+        return (dq, kv, dkv), None
+
+    (dq, _, dkv), _ = lax.scan(hop, (dq0, kv0, dkv0), jnp.arange(n))
+    return dq.astype(q3.dtype), dkv[0].astype(k3.dtype), dkv[1].astype(v3.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
+def ring_flash_attention(
+    q: jax.Array,  # [B, T_local, H, D] — this shard's queries
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    precision=None,
+    *,
+    block_q: int = 512,
+    block_k: int = 512,
+) -> jax.Array:
+    """Sequence-parallel ring attention whose per-hop fold IS the fused
+    flash kernel — the composition of
+    :func:`~theanompi_tpu.ops.ring_attention.ring_attention` (K/V
+    rotation over ``axis_name``, one ppermute per hop, O(T/n) memory)
+    with this module's Pallas kernels (no [T_local, T_local] score
+    materialization per hop either). Must run inside ``shard_map`` with
+    the sequence dim sharded over ``axis_name``; causal masking is in
+    GLOBAL position order via the kernels' offset scalars, and the
+    causal block skip makes fully-future K/V shards cost ~nothing.
+    Differentiable via a whole-ring custom VJP (backward rings the K/V
+    shards again, dk/dv partials traveling with them).
+
+    ``precision=HIGHEST`` upcasts tiles to fp32 as in
+    :func:`flash_attention`. ``TMPI_PALLAS=0`` falls back to the
+    unfused :func:`~theanompi_tpu.ops.ring_attention.ring_attention`.
+    """
+    if not _use_pallas():
+        from theanompi_tpu.ops.ring_attention import ring_attention
+
+        return ring_attention(
+            q, k, v, axis_name, causal=causal, scale=scale, precision=precision
+        )
+
+    cfg, q3, k3, v3, meta = _prepare(
+        q, k, v, causal, scale, precision, block_q, block_k
+    )
+    return _finish(_ring_flash(_RingCfg(cfg, axis_name), q3, k3, v3), meta)

@@ -80,6 +80,13 @@ def op_table(trace_dir: str, steps: int = 1) -> list:
         for e in events
         if e.get("ph") == "M" and e.get("name") == "thread_name"
     }
+    # one table = ONE device: on multi-chip traces every '/device:TPU:n'
+    # process carries (SPMD) copies of the same ops — summing them would
+    # inflate ms_per_step by the device count. Use the first device pid.
+    dev_pids = sorted(
+        p for p, name in pids.items() if name.startswith("/device:")
+    )
+    the_pid = dev_pids[0] if dev_pids else None
     agg: collections.Counter = collections.Counter()
     cnt: collections.Counter = collections.Counter()
     longest: collections.Counter = collections.Counter()
@@ -87,7 +94,7 @@ def op_table(trace_dir: str, steps: int = 1) -> list:
     for e in events:
         if e.get("ph") != "X":
             continue
-        if not pids.get(e["pid"], "").startswith("/device:"):
+        if e["pid"] != the_pid:
             continue
         if tids.get((e["pid"], e["tid"])) != "XLA Ops":
             continue
