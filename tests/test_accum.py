@@ -1,0 +1,160 @@
+"""Gradient accumulation (train.make_train_step(accum_steps=k)) —
+beyond-parity microbatching (the reference's per-GPU batch WAS its
+memory limit; SURVEY.md §2.1 has no equivalent). The accumulated step
+must reproduce the large-batch trajectory: mean-of-microbatch-gradients
+== full-batch gradient (exact for deterministic batch-independent
+models; up to batch-statistics differences with BatchNorm)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from theanompi_tpu import nn
+from theanompi_tpu.models.contract import Model, Recipe
+from theanompi_tpu.train import init_train_state, make_train_step
+
+
+class Tiny(Model):
+    """Conv + Dense, no dropout/BN: accumulation is bit-comparable."""
+
+    name = "tiny"
+
+    @classmethod
+    def default_recipe(cls):
+        return Recipe(
+            batch_size=24, n_epochs=1, optimizer="momentum",
+            opt_kwargs={"momentum": 0.9},
+            schedule="constant", sched_kwargs={"lr": 0.1},
+            input_shape=(8, 8, 3), num_classes=10, dataset="synthetic",
+        )
+
+    def build(self):
+        return nn.Sequential(
+            [
+                nn.Conv(8, 3, padding="SAME", name="c1"),
+                nn.Activation("relu"),
+                nn.Flatten(),
+                nn.Dense(10, name="fc"),
+            ],
+            name="tiny",
+        )
+
+
+class TinyBN(Tiny):
+    """Same with a BatchNorm: microbatch statistics differ from
+    full-batch statistics, so agreement is approximate."""
+
+    name = "tiny_bn"
+
+    def build(self):
+        return nn.Sequential(
+            [
+                nn.Conv(8, 3, padding="SAME", use_bias=False, name="c1"),
+                nn.BatchNorm(name="bn1"),
+                nn.Activation("relu"),
+                nn.Flatten(),
+                nn.Dense(10, name="fc"),
+            ],
+            name="tiny_bn",
+        )
+
+
+def _data(batch, seed=0):
+    r = np.random.RandomState(seed)
+    x = jnp.asarray(r.randn(batch, 8, 8, 3), jnp.float32)
+    y = jnp.asarray(r.randint(0, 10, batch), jnp.int32)
+    return x, y
+
+
+@pytest.mark.parametrize("k", [2, 3])
+def test_accum_exact_for_deterministic_model(k):
+    """sum-of-microbatch grads / k == full-batch grad to float tolerance
+    (softmax CE is a per-example mean; microbatches are equal-sized)."""
+    model = Tiny(Tiny.default_recipe())
+    x, y = _data(24)
+    state = init_train_state(model, jax.random.PRNGKey(0))
+    rng = jax.random.PRNGKey(1)
+    s_full, m_full = jax.jit(make_train_step(model))(state, x, y, rng)
+    s_acc, m_acc = jax.jit(make_train_step(model, accum_steps=k))(state, x, y, rng)
+    np.testing.assert_allclose(
+        float(m_acc["loss"]), float(m_full["loss"]), rtol=1e-6
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s_acc.params),
+        jax.tree_util.tree_leaves(s_full.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-5)
+    assert int(s_acc.step) == 1  # ONE optimizer update, not k
+
+
+def test_accum_close_with_batchnorm():
+    """With BN the normalization sees microbatch statistics — close to,
+    but not bit-equal with, the full-batch step; running stats advance
+    once per microbatch (the same stream k small steps would produce)."""
+    model = TinyBN(TinyBN.default_recipe())
+    x, y = _data(24, seed=3)
+    state = init_train_state(model, jax.random.PRNGKey(0))
+    rng = jax.random.PRNGKey(1)
+    s_full, _ = jax.jit(make_train_step(model))(state, x, y, rng)
+    s_acc, _ = jax.jit(make_train_step(model, accum_steps=2))(state, x, y, rng)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s_acc.params),
+        jax.tree_util.tree_leaves(s_full.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-2)
+
+
+def test_accum_rejects_indivisible_batch():
+    model = Tiny(Tiny.default_recipe())
+    x, y = _data(10)
+    state = init_train_state(model, jax.random.PRNGKey(0))
+    step = make_train_step(model, accum_steps=4)
+    with pytest.raises(ValueError, match="accum_steps"):
+        step(state, x, y, jax.random.PRNGKey(1))
+
+
+def test_accum_under_bsp_mesh(mesh8):
+    """accum_steps composes with the sharded BSP step: 8 devices x 3
+    microbatches each == the plain 8-device step on the same global
+    batch (deterministic model -> float tolerance)."""
+    from theanompi_tpu.parallel.bsp import make_bsp_train_step
+    from theanompi_tpu.parallel.mesh import put_global_batch
+
+    model = Tiny(Tiny.default_recipe())
+    x, y = _data(48, seed=5)
+    state = init_train_state(model, jax.random.PRNGKey(0))
+    xg = put_global_batch(mesh8, x)
+    yg = put_global_batch(mesh8, y)
+    plain = make_bsp_train_step(model, mesh8, donate=False)
+    accum = make_bsp_train_step(model, mesh8, donate=False, accum_steps=3)
+    s1, m1 = plain(state, xg, yg, jax.random.PRNGKey(1))
+    s2, m2 = accum(state, xg, yg, jax.random.PRNGKey(1))
+    np.testing.assert_allclose(float(m2["loss"]), float(m1["loss"]), rtol=1e-6)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s2.params),
+        jax.tree_util.tree_leaves(s1.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-5)
+
+
+@pytest.mark.slow
+def test_accum_trains_via_run_training():
+    from theanompi_tpu.launch.worker import run_training
+    from theanompi_tpu.models.cifar10 import Cifar10_model
+
+    out = run_training(
+        rule="bsp",
+        model_cls=Cifar10_model,
+        devices=8,
+        accum_steps=2,
+        n_epochs=2,
+        dataset="synthetic",
+        dataset_kwargs={"n_train": 128, "n_val": 32, "image_shape": [16, 16, 3]},
+        recipe_overrides={"batch_size": 32, "input_shape": (16, 16, 3)},
+        print_freq=0,
+    )
+    assert out["steps"] == 8 and out["val"]["loss"] < 3.0

@@ -42,6 +42,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "amortizes dispatch latency on directly-attached "
                         "hosts — measured HARMFUL on network-tunneled dev "
                         "chips, whose large single transfers stall)")
+    p.add_argument("--accum-steps", type=int, default=1,
+                   help="gradient accumulation: split each (per-device) "
+                        "batch into this many microbatches inside the step "
+                        "— large-batch SGD trajectory at small-batch "
+                        "activation memory")
     p.add_argument("--slices", type=int, default=None,
                    help="BSP over a 2-D (dcn, data) multi-slice mesh with this "
                         "many slices (pod-scale: allreduce rides ICI within a "
@@ -206,6 +211,7 @@ def main(argv=None) -> int:
         strategy=args.strategy,
         n_slices=args.slices,
         steps_per_dispatch=args.steps_per_dispatch,
+        accum_steps=args.accum_steps,
         n_epochs=args.epochs,
         max_steps=args.max_steps,
         dataset=args.dataset,

@@ -47,6 +47,7 @@ def run_training(
     strategy: str = "psum",
     n_slices: Optional[int] = None,
     steps_per_dispatch: int = 1,
+    accum_steps: int = 1,
     n_epochs: Optional[int] = None,
     max_steps: Optional[int] = None,
     dataset: Optional[str] = None,
@@ -207,6 +208,7 @@ def run_training(
         engine = BSPEngine(
             model, mesh, steps_per_epoch=steps_per_epoch, strategy=strategy,
             input_transform=input_transform, eval_views=eval_views,
+            accum_steps=accum_steps,
         )
     elif rule == "easgd":
         from theanompi_tpu.parallel.easgd import EASGDEngine
@@ -214,7 +216,7 @@ def run_training(
         engine = EASGDEngine(
             model, mesh, steps_per_epoch=steps_per_epoch,
             input_transform=input_transform, eval_views=eval_views,
-            **rule_kwargs,
+            accum_steps=accum_steps, **rule_kwargs,
         )
     else:
         from theanompi_tpu.parallel.gosgd import GOSGDEngine
@@ -222,7 +224,7 @@ def run_training(
         engine = GOSGDEngine(
             model, mesh, steps_per_epoch=steps_per_epoch,
             input_transform=input_transform, eval_views=eval_views,
-            **rule_kwargs,
+            accum_steps=accum_steps, **rule_kwargs,
         )
 
     # Multi-controller: this host produces only its slice of every

@@ -43,9 +43,12 @@ def make_bsp_train_step(
     axis_name=DATA_AXIS,
     donate: bool = True,
     input_transform=None,
+    accum_steps: int = 1,
 ):
     """Build the jitted BSP step: ``(state, images, labels, rng) ->
-    (state, metrics)`` over global arrays.
+    (state, metrics)`` over global arrays. ``accum_steps``: gradient
+    accumulation inside the step (see train.make_train_step) — the
+    per-DEVICE batch splits into that many microbatches.
 
     ``images``/``labels`` hold the GLOBAL batch (sharded or shardable
     along ``data``); ``state`` is replicated; ``rng`` is a single key —
@@ -70,7 +73,9 @@ def make_bsp_train_step(
         # backend donated buffers trigger a relayout-recompile and a
         # ~4x steady-state slowdown (measured), and the memory it would
         # save is not binding on one chip.
-        base = make_train_step(model, steps_per_epoch, input_transform=input_transform)
+        base = make_train_step(model, steps_per_epoch,
+                               input_transform=input_transform,
+                               accum_steps=accum_steps)
 
         def single_step(state, images, labels, rng):
             return base(state, images, labels, jax.random.fold_in(rng, 0))
@@ -79,7 +84,8 @@ def make_bsp_train_step(
 
     grad_sync = get_strategy(strategy, axis_name, n)
     base_step = make_train_step(
-        model, steps_per_epoch, grad_sync=grad_sync, input_transform=input_transform
+        model, steps_per_epoch, grad_sync=grad_sync,
+        input_transform=input_transform, accum_steps=accum_steps,
     )
 
     def sharded_step(state: TrainState, images, labels, rng):
@@ -115,6 +121,7 @@ def make_bsp_fused_step(
     strategy: str = "psum",
     axis_name=DATA_AXIS,
     input_transform=None,
+    accum_steps: int = 1,
 ):
     """``k`` BSP steps fused into ONE compiled program via ``lax.scan``
     over stacked batches ``[k, batch, ...]`` — one host dispatch (and one
@@ -138,7 +145,8 @@ def make_bsp_fused_step(
 
     if n == 1:
         base = make_train_step(
-            model, steps_per_epoch, input_transform=input_transform
+            model, steps_per_epoch, input_transform=input_transform,
+            accum_steps=accum_steps,
         )
 
         def single(state, images, labels, rngs):
@@ -150,7 +158,8 @@ def make_bsp_fused_step(
 
         return jax.jit(single)
     base_step = make_train_step(
-        model, steps_per_epoch, grad_sync=grad_sync, input_transform=input_transform
+        model, steps_per_epoch, grad_sync=grad_sync,
+        input_transform=input_transform, accum_steps=accum_steps,
     )
 
     def sharded_step(state: TrainState, images, labels, rngs):
@@ -197,6 +206,7 @@ class BSPEngine:
         axis_name=None,
         input_transform=None,
         eval_views: int = 1,
+        accum_steps: int = 1,
     ):
         if axis_name is None:
             from theanompi_tpu.parallel.mesh import batch_axes
@@ -207,6 +217,7 @@ class BSPEngine:
         self._build = dict(
             steps_per_epoch=steps_per_epoch, strategy=strategy,
             axis_name=axis_name, input_transform=input_transform,
+            accum_steps=accum_steps,
         )
         self._fused_step = None  # built lazily; jit retraces per group size
         self._step = make_bsp_train_step(model, mesh, **self._build)

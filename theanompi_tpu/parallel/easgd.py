@@ -84,6 +84,7 @@ class EASGDEngine:
         input_transform=None,
         eval_views: int = 1,
         group_size: int = 1,
+        accum_steps: int = 1,
     ):
         from theanompi_tpu.parallel.mesh import make_worker_group_mesh
 
@@ -99,7 +100,7 @@ class EASGDEngine:
         self.alpha = alpha if alpha is not None else 0.9 / self.n
         base_step = make_train_step(
             model, steps_per_epoch, grad_sync=grad_sync,
-            input_transform=input_transform,
+            input_transform=input_transform, accum_steps=accum_steps,
         )
         base_eval = make_eval_step(
             model, input_transform=input_transform, views=eval_views

@@ -91,6 +91,7 @@ class GOSGDEngine:
         input_transform=None,
         eval_views: int = 1,
         group_size: int = 1,
+        accum_steps: int = 1,
     ):
         from theanompi_tpu.parallel.mesh import make_worker_group_mesh
 
@@ -110,7 +111,7 @@ class GOSGDEngine:
         self._count: int | None = None
         base_step = make_train_step(
             model, steps_per_epoch, grad_sync=grad_sync,
-            input_transform=input_transform,
+            input_transform=input_transform, accum_steps=accum_steps,
         )
         base_eval = make_eval_step(
             model, input_transform=input_transform, views=eval_views

@@ -86,9 +86,21 @@ def make_train_step(
     grad_sync: Optional[GradSync] = None,
     loss_scale: float = 1.0,
     input_transform: Optional[Callable] = None,
+    accum_steps: int = 1,
 ):
     """Build the pure train step: ``(state, images, labels, rng) ->
     (state, metrics)``.
+
+    ``accum_steps > 1``: gradient accumulation — the (per-device) batch
+    is split into ``accum_steps`` microbatches folded through a
+    ``lax.scan``; gradients average across microbatches BEFORE the
+    exchanger sync and the single optimizer update, so the SGD
+    trajectory is the large-batch one while activation memory is that
+    of ``batch / accum_steps`` (beyond parity: the reference had no
+    microbatching — its per-GPU batch WAS the memory limit; here config
+    #5-scale global batches fit a handful of chips). BatchNorm batch
+    stats update sequentially per microbatch (same running-stat stream
+    as equally-sized small steps); metrics are microbatch means.
 
     ``steps_per_epoch`` converts the step counter to the schedule's unit
     when the recipe schedules by epoch (reference: ``adjust_hyperp(epoch)``
@@ -125,15 +137,53 @@ def make_train_step(
     """
     optimizer = model.optimizer()
     schedule_lr = make_schedule_fn(model, steps_per_epoch)
+    accum_steps = max(1, int(accum_steps))
+
+    def fwd_bwd(params, model_state, images, labels, rng):
+        loss, logits, new_model_state, grads = loss_and_grads(
+            model, params, model_state, images, labels, rng,
+            loss_scale=loss_scale,
+        )
+        metrics = {"loss": loss, **model.metrics(logits, labels)}
+        return new_model_state, grads, metrics
 
     def train_step(state: TrainState, images, labels, rng):
         if input_transform is not None:
             images = input_transform(images)
 
-        loss, logits, new_model_state, grads = loss_and_grads(
-            model, state.params, state.model_state, images, labels, rng,
-            loss_scale=loss_scale,
-        )
+        if accum_steps == 1:
+            new_model_state, grads, metrics = fwd_bwd(
+                state.params, state.model_state, images, labels, rng
+            )
+        else:
+            B = images.shape[0]
+            if B % accum_steps:
+                raise ValueError(
+                    f"(per-device) batch {B} must divide accum_steps="
+                    f"{accum_steps}"
+                )
+            xm = images.reshape(accum_steps, B // accum_steps, *images.shape[1:])
+            ym = labels.reshape(accum_steps, B // accum_steps, *labels.shape[1:])
+
+            def micro(carry, inp):
+                model_state, gsum = carry
+                x, y, idx = inp
+                model_state, grads, metrics = fwd_bwd(
+                    state.params, model_state, x, y, jax.random.fold_in(rng, idx)
+                )
+                gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
+                return (model_state, gsum), metrics
+
+            gzero = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, p.dtype), state.params
+            )
+            (new_model_state, gsum), ms = jax.lax.scan(
+                micro, (state.model_state, gzero),
+                (xm, ym, jnp.arange(accum_steps)),
+            )
+            grads = jax.tree_util.tree_map(lambda g: g / accum_steps, gsum)
+            metrics = jax.tree_util.tree_map(lambda m: jnp.mean(m, axis=0), ms)
+
         if grad_sync is not None:
             grads = grad_sync(grads)
 
@@ -141,7 +191,7 @@ def make_train_step(
         updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params, lr)
         new_params = apply_updates(state.params, updates)
 
-        metrics = {"loss": loss, "lr": lr, **model.metrics(logits, labels)}
+        metrics = {**metrics, "lr": lr}
         new_state = TrainState(new_params, new_model_state, new_opt_state, state.step + 1)
         return new_state, metrics
 
