@@ -1,0 +1,138 @@
+"""check_vma AD-semantics canary (round-3 verdict item 10).
+
+Every shard_map in this framework is pinned to ``check_vma=False``
+because the exchanger abstraction — "AD yields per-device local grads;
+an explicit collective (psum mean / ring / compressed ring) then
+produces the global gradient" — depends on classic pmap AD semantics:
+the transpose of a forward psum is itself a psum, so each device's
+backward returns d(sum over devices of local_loss)/d theta_local.
+
+Under ``check_vma=True`` (the modern default) the cotangent of a
+REPLICATED parameter arrives ALREADY globally summed (replicated across
+devices); an explicit exchanger psum would multiply by n. Migration is
+therefore mechanical — drop the collective, divide by the axis size —
+but it must happen everywhere at once (18 shard_maps across 6 files).
+See parallel/strategies.py "check_vma pin & migration plan".
+
+These tests fail LOUDLY if a JAX upgrade changes either behavior, which
+is the trigger to execute that plan. They also keep a working
+checked-mode BSP step as the migration prototype.
+
+Measured on jax 0.9.0 (re-verified whenever this file runs).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def data():
+    r = np.random.RandomState(0)
+    return (
+        jnp.asarray(r.randn(4).astype(np.float32)),        # w, replicated
+        r.randn(N, 4).astype(np.float32),                  # x, row per device
+    )
+
+
+def _local_loss(w, xs):
+    # contains a forward collective (cross-replica-BN shape): the
+    # transpose of this pmean is where the two semantics diverge
+    m = lax.pmean(jnp.mean(xs), "data")
+    return jnp.sum(w * (xs - m))
+
+
+def _per_device_grads(mesh, w, x, check_vma):
+    f = jax.shard_map(
+        lambda w, xs: jax.grad(_local_loss)(w, xs[0])[None],
+        mesh=mesh,
+        in_specs=(P(), P("data")),
+        out_specs=P("data"),
+        check_vma=check_vma,
+    )
+    return np.asarray(jax.jit(f)(w, jnp.asarray(x)))  # [N, 4]
+
+
+def test_unchecked_mode_gives_local_grads(mesh8, data):
+    """THE PIN: under check_vma=False each device's backward yields its
+    LOCAL contribution (here exactly x_i - mean(x)), so the exchanger's
+    psum-mean reconstructs the true global-mean gradient. If this fails
+    after a JAX upgrade, execute the migration plan in
+    parallel/strategies.py — every exchanger psum now double-counts."""
+    w, x = data
+    g = _per_device_grads(mesh8, w, x, check_vma=False)
+    m = x.mean()
+    assert not np.allclose(g[0], g[1]), (
+        "per-device grads came back identical under check_vma=False — "
+        "cotangents are arriving pre-summed (checked-mode semantics); "
+        "the exchanger psum-mean in parallel/strategies.py now "
+        "double-counts. Execute the migration plan in that module."
+    )
+    np.testing.assert_allclose(g, x - m, atol=1e-6, err_msg=(
+        "per-device grads are no longer the local contributions the "
+        "exchanger contract assumes (see parallel/strategies.py)"
+    ))
+    np.testing.assert_allclose(g.mean(0), (x - m).mean(0), atol=1e-6)
+
+
+def test_checked_mode_gives_summed_grads(mesh8, data):
+    """The OTHER side of the pin: under check_vma=True the replicated
+    param's cotangent arrives globally summed and replica-identical.
+    This is what makes the migration mechanical (drop the collective,
+    divide by n) — if THIS changes too, re-derive the plan."""
+    w, x = data
+    g = _per_device_grads(mesh8, w, x, check_vma=True)
+    m = x.mean()
+    assert np.allclose(g[0], g[1], atol=1e-6)
+    np.testing.assert_allclose(g[0], (x - m).sum(0), atol=1e-5)
+
+
+def test_checked_mode_bsp_prototype(mesh8):
+    """A WORKING check_vma=True BSP step (the migration target): grads
+    arrive pre-summed, the exchanger is division by the axis size, and
+    one SGD update matches the dense oracle exactly — including through
+    a forward cross-replica collective."""
+    r = np.random.RandomState(1)
+    w = jnp.asarray(r.randn(4, 3).astype(np.float32))
+    x = r.randn(2 * N, 4).astype(np.float32)
+    y = r.randint(0, 3, 2 * N).astype(np.int32)
+
+    def local_loss(w, xs, ys):
+        m = lax.pmean(jnp.mean(xs, 0), "data")  # cross-replica BN shape
+        logp = jax.nn.log_softmax((xs - m) @ w)
+        return -jnp.take_along_axis(logp, ys[:, None], 1).mean()
+
+    def checked_step(w, xs, ys):
+        g = jax.grad(local_loss)(w, xs, ys)
+        return w - 0.1 * (g / N)  # the checked-mode "exchanger"
+
+    w_new = np.asarray(
+        jax.jit(
+            jax.shard_map(
+                checked_step,
+                mesh=mesh8,
+                in_specs=(P(), P("data"), P("data")),
+                out_specs=P(),
+                check_vma=True,
+            )
+        )(w, jnp.asarray(x), jnp.asarray(y))
+    )
+
+    def dense(w):
+        m = jnp.mean(jnp.asarray(x), 0)
+        per_dev = []
+        for i in range(N):
+            xs = jnp.asarray(x[2 * i : 2 * i + 2])
+            ys = jnp.asarray(y[2 * i : 2 * i + 2])
+            logp = jax.nn.log_softmax((xs - m) @ w)
+            per_dev.append(-jnp.take_along_axis(logp, ys[:, None], 1).mean())
+        return jnp.mean(jnp.stack(per_dev))
+
+    w_oracle = np.asarray(w - 0.1 * jax.grad(dense)(w))
+    np.testing.assert_allclose(w_new, w_oracle, atol=1e-6)

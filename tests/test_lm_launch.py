@@ -1,0 +1,174 @@
+"""The launchable N-D parallelism paths: LM models through run_training.
+
+Round-3 verdict item #1: ZeRO/TP/SP/PP/EP must be reachable from the
+driver (CLI + run_training), not just from step-builder unit tests.
+These tests drive the REAL path — dataset registry, prefetch loader,
+recorder, checkpoint/resume — on the 8-device CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+from theanompi_tpu.launch.worker import run_training
+from theanompi_tpu.models.lm import LMRecipe, MoELMModel, TransformerLMModel
+
+TINY = dict(
+    batch_size=16,
+    n_epochs=20,
+    d_model=32,
+    n_heads=4,
+    n_layers=1,
+    d_ff=64,
+    input_shape=(32,),
+    num_classes=32,
+    sched_kwargs={"lr": 3e-3},
+)
+DATA = dict(n_train=64, n_val=16)
+
+
+def _run(max_steps=8, **kw):
+    return run_training(
+        model_cls=TransformerLMModel,
+        devices=8,
+        recipe_overrides=TINY,
+        dataset_kwargs=DATA,
+        max_steps=max_steps,
+        print_freq=1000,
+        **kw,
+    )
+
+
+def test_lm_dp_through_bsp_engine():
+    """Dense LM under the plain BSP rule: the contract surface carries
+    token batches through the classifier-shaped machinery."""
+    s = _run(rule="bsp")
+    assert s["steps"] == 8
+    assert np.isfinite(s["val"]["loss"])
+
+
+def test_lm_dp_tp_sp_with_resume(tmp_path):
+    """dp x tp x sp through run_training, with a checkpointed resume
+    continuing the step count exactly (verdict done-criterion)."""
+    ckpt = str(tmp_path / "ck")
+    s1 = run_training(
+        model_cls=TransformerLMModel,
+        devices=8,
+        tp=2,
+        sp=2,
+        recipe_overrides=TINY,
+        dataset_kwargs=DATA,
+        max_steps=3,
+        ckpt_dir=ckpt,
+        ckpt_every_epochs=1,
+        async_checkpoint=False,
+        print_freq=1000,
+    )
+    assert s1["steps"] == 3
+    assert np.isfinite(s1["val"]["loss"])
+    s2 = run_training(
+        model_cls=TransformerLMModel,
+        devices=8,
+        tp=2,
+        sp=2,
+        recipe_overrides=TINY,
+        dataset_kwargs=DATA,
+        max_steps=4,
+        n_epochs=2,
+        ckpt_dir=ckpt,
+        resume=True,
+        print_freq=1000,
+    )
+    assert s2["steps"] == 4  # resumed from 3, ran one more
+
+
+def test_lm_learns_markov_structure():
+    """The synthetic Markov stream is learnable: training reduces val
+    loss well below the uniform-vocab entropy."""
+    s = _run(rule="bsp", max_steps=40, n_epochs=10)
+    assert s["val"]["loss"] < 0.9 * np.log(TINY["num_classes"])
+
+
+@pytest.mark.slow
+def test_lm_pipeline_launch():
+    s = run_training(
+        model_cls=TransformerLMModel,
+        devices=8,
+        pp=2,
+        microbatches=4,
+        recipe_overrides={**TINY, "n_layers": 2},
+        dataset_kwargs=DATA,
+        max_steps=8,
+        print_freq=1000,
+    )
+    assert s["steps"] == 8
+    assert np.isfinite(s["val"]["loss"])
+
+
+@pytest.mark.slow
+def test_lm_expert_launch():
+    s = run_training(
+        model_cls=MoELMModel,
+        devices=8,
+        expert=4,
+        sp=2,
+        recipe_overrides={**TINY, "n_experts": 4},
+        dataset_kwargs=DATA,
+        max_steps=4,
+        print_freq=1000,
+    )
+    assert s["steps"] == 4
+    assert np.isfinite(s["val"]["loss"])
+
+
+@pytest.mark.slow
+def test_zero1_launch_with_resume(tmp_path):
+    """--zero 1 through the driver on a CNN model, resume included."""
+    from theanompi_tpu.models.cifar10 import Cifar10_model
+
+    ckpt = str(tmp_path / "ck")
+    kw = dict(
+        model_cls=Cifar10_model,
+        devices=8,
+        zero=1,
+        recipe_overrides={"batch_size": 16},
+        dataset="synthetic",
+        dataset_kwargs={"n_train": 64, "n_val": 16, "image_shape": (16, 16, 3)},
+        print_freq=1000,
+        ckpt_dir=ckpt,
+        async_checkpoint=False,
+    )
+    s1 = run_training(max_steps=3, **{**kw, "recipe_overrides": {
+        "batch_size": 16, "input_shape": (16, 16, 3)}})
+    assert s1["steps"] == 3
+    s2 = run_training(max_steps=5, n_epochs=3, resume=True, **{
+        **kw, "recipe_overrides": {
+            "batch_size": 16, "input_shape": (16, 16, 3)}})
+    assert s2["steps"] == 5
+
+
+def test_nd_flag_validation():
+    with pytest.raises(ValueError, match="BSP rule only"):
+        _run(rule="easgd", tp=2)
+    with pytest.raises(ValueError, match="LM model"):
+        from theanompi_tpu.models.cifar10 import Cifar10_model
+
+        run_training(model_cls=Cifar10_model, devices=8, tp=2,
+                     dataset="synthetic", max_steps=1)
+    with pytest.raises(ValueError, match="expert"):
+        _run(expert=2)  # dense model + --expert
+    with pytest.raises(ValueError, match="plain BSP only"):
+        _run(tp=2, zero=1)
+
+
+def test_lm_text_dataset():
+    """Byte-level windows over the repo's own docs feed the same path."""
+    s = run_training(
+        model_cls=TransformerLMModel,
+        devices=8,
+        dataset="lm_text",
+        recipe_overrides={**TINY, "num_classes": 256},
+        dataset_kwargs={},
+        max_steps=2,
+        print_freq=1000,
+    )
+    assert s["steps"] == 2

@@ -51,6 +51,25 @@ def build_parser() -> argparse.ArgumentParser:
                    help="BSP over a 2-D (dcn, data) multi-slice mesh with this "
                         "many slices (pod-scale: allreduce rides ICI within a "
                         "slice, DCN across)")
+    p.add_argument("--zero", type=int, default=0, choices=[0, 1],
+                   help="BSP with ZeRO-1: optimizer state sharded over the "
+                        "data axis (psum_scatter grads -> segment update -> "
+                        "all_gather params; same wire volume as allreduce)")
+    p.add_argument("--tp", type=int, default=1,
+                   help="LM models: Megatron tensor-parallel axis size "
+                        "(heads/FFN/vocab sharded; one psum per sub-block)")
+    p.add_argument("--sp", type=int, default=1,
+                   help="LM models: sequence-parallel axis size (ring or "
+                        "Ulysses attention per the recipe's attn=)")
+    p.add_argument("--pp", type=int, default=1,
+                   help="LM models: GPipe pipeline stages (layers sharded; "
+                        "microbatches stream via ppermute)")
+    p.add_argument("--expert", type=int, default=1,
+                   help="MoELMModel: expert-parallel axis size (Switch-MoE "
+                        "all-to-all dispatch; doubles as the batch axis)")
+    p.add_argument("--microbatches", type=int, default=None,
+                   help="with --pp: microbatch count per step (default = pp; "
+                        "bubble fraction is (pp-1)/(M+pp-1))")
     p.add_argument("--epochs", type=int, default=None, help="override recipe n_epochs")
     p.add_argument("--max-steps", type=int, default=None)
     p.add_argument("--batch-size", type=int, default=None, help="override recipe batch")
@@ -212,6 +231,12 @@ def main(argv=None) -> int:
         n_slices=args.slices,
         steps_per_dispatch=args.steps_per_dispatch,
         accum_steps=args.accum_steps,
+        tp=args.tp,
+        sp=args.sp,
+        pp=args.pp,
+        expert=args.expert,
+        microbatches=args.microbatches,
+        zero=args.zero,
         n_epochs=args.epochs,
         max_steps=args.max_steps,
         dataset=args.dataset,

@@ -11,3 +11,4 @@ does the same without process gymnastics).
 
 from theanompi_tpu.data.datasets import Dataset, get_dataset  # noqa: F401
 from theanompi_tpu.data import imagenet as _imagenet  # noqa: F401  (registers datasets)
+from theanompi_tpu.data import lm as _lm  # noqa: F401  (registers LM datasets)

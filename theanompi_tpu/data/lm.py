@@ -1,0 +1,126 @@
+"""Language-model token datasets.
+
+BEYOND-PARITY EXTENSION: the reference is a CNN framework with image
+pipelines only (SURVEY.md §5.7 — no sequence dimension anywhere). The
+transformer stack (models/transformer.py) needs token streams; these
+classes provide them through the SAME ``Dataset`` interface the image
+pipelines use (``train_epoch``/``val_epoch``/``n_train_batches``), so
+the training driver, prefetch loader, recorder, and checkpointing apply
+unchanged.
+
+Conventions: an "image" is a token window ``[T] int32``; ``image_shape``
+is ``(T,)`` and ``n_classes`` is the vocabulary size. Labels ARE the
+token window itself (the model computes shifted next-token targets
+internally), so batches are ``(tokens, tokens)`` pairs sharing one
+array.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from theanompi_tpu.data.datasets import Dataset, register_dataset
+
+
+class LMSynthetic_data(Dataset):
+    """Deterministic synthetic token stream with LEARNABLE structure: a
+    seeded order-1 Markov chain where each symbol has ``branching``
+    likely successors (uniform over them, with ``noise`` probability of
+    a uniform-random symbol). A transformer reduces next-token loss well
+    below the unigram entropy iff it actually learns the transition
+    table — the LM analogue of ``Synthetic_data``'s class-means fixture
+    (SURVEY.md §4(d): seeded fake data for CI/mesh tests)."""
+
+    name = "lm_synthetic"
+
+    def __init__(
+        self,
+        seq_len: int = 128,
+        vocab: int = 64,
+        n_train: int = 512,
+        n_val: int = 64,
+        branching: int = 4,
+        noise: float = 0.05,
+        seed: int = 1234,
+    ):
+        self.image_shape = (seq_len,)
+        self.n_classes = vocab
+        rng = np.random.RandomState(seed)
+        # transition table: symbol -> `branching` successors
+        succ = np.stack(
+            [rng.choice(vocab, size=branching, replace=False) for _ in range(vocab)]
+        )
+
+        def chain(n_windows, salt):
+            r = np.random.RandomState(seed + salt)
+            n_tok = n_windows * seq_len
+            out = np.empty(n_tok, np.int32)
+            s = r.randint(vocab)
+            for i in range(n_tok):
+                out[i] = s
+                if r.rand() < noise:
+                    s = r.randint(vocab)
+                else:
+                    s = succ[s, r.randint(branching)]
+            return out.reshape(n_windows, seq_len)
+
+        self.x_train = chain(n_train, 1)
+        self.x_val = chain(n_val, 2)
+        self.y_train = self.x_train  # targets = the window itself (shifted in-model)
+        self.y_val = self.x_val
+
+
+class LMText_data(Dataset):
+    """Byte-level LM windows over a real text file — zero-download real
+    data (the repo's own docs by default), the LM counterpart of
+    ``Digits_data``. Text bytes are concatenated and cut into
+    non-overlapping ``seq_len`` windows; split train/val by a held-out
+    TAIL fraction (time-ordered split, no leakage)."""
+
+    name = "lm_text"
+
+    DEFAULT_FILES = ("README.md", "SURVEY.md", "PARITY.md", "BASELINE.md")
+
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        seq_len: int = 128,
+        val_frac: float = 0.1,
+    ):
+        if path:
+            paths = [path]
+        else:
+            root = os.path.dirname(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            )
+            paths = [
+                p for f in self.DEFAULT_FILES
+                if os.path.exists(p := os.path.join(root, f))
+            ]
+            if not paths:
+                raise FileNotFoundError(
+                    "lm_text: no default corpus files found; pass "
+                    "dataset_kwargs={'path': <textfile>}"
+                )
+        blob = b"".join(open(p, "rb").read() for p in paths)
+        toks = np.frombuffer(blob, np.uint8).astype(np.int32)
+        n_win = len(toks) // seq_len
+        if n_win < 8:
+            raise ValueError(
+                f"corpus too small: {len(toks)} bytes < 8 windows of {seq_len}"
+            )
+        wins = toks[: n_win * seq_len].reshape(n_win, seq_len)
+        n_val = max(1, int(n_win * val_frac))
+        self.image_shape = (seq_len,)
+        self.n_classes = 256
+        self.x_train = wins[: n_win - n_val]
+        self.x_val = wins[n_win - n_val :]
+        self.y_train = self.x_train
+        self.y_val = self.x_val
+
+
+register_dataset("lm_synthetic", LMSynthetic_data)
+register_dataset("lm_text", LMText_data)

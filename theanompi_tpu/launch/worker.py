@@ -48,6 +48,14 @@ def run_training(
     n_slices: Optional[int] = None,
     steps_per_dispatch: int = 1,
     accum_steps: int = 1,
+    # N-D parallelism axes (BSP rule only; LM models — parallel/nd.py):
+    tp: int = 1,
+    sp: int = 1,
+    pp: int = 1,
+    expert: int = 1,
+    microbatches: Optional[int] = None,
+    # ZeRO-1 optimizer-state sharding (BSP rule only; parallel/zero.py)
+    zero: int = 0,
     n_epochs: Optional[int] = None,
     max_steps: Optional[int] = None,
     dataset: Optional[str] = None,
@@ -111,16 +119,116 @@ def run_training(
         else:
             dataset_kwargs.setdefault("crop", recipe.input_shape[0])
         dataset_kwargs.setdefault("n_classes", recipe.num_classes)
+    elif dataset in ("lm_synthetic", "lm_text"):
+        # token datasets default to the MODEL's sequence length / vocab
+        dataset_kwargs.setdefault("seq_len", recipe.input_shape[0])
+        if dataset == "lm_synthetic":
+            dataset_kwargs.setdefault("vocab", recipe.num_classes)
     rule = rule.lower()
-    if n_slices and n_slices > 1:
+    fuse = max(1, int(steps_per_dispatch))
+    tp, sp, pp, expert = int(tp), int(sp), int(pp), int(expert)
+    zero = int(zero or 0)
+    nd_active = max(tp, sp, pp, expert) > 1
+    if nd_active or zero:
+        what = "--tp/--sp/--pp/--expert" if nd_active else "--zero"
         if rule != "bsp":
+            raise ValueError(f"{what} compose with the BSP rule only")
+        if strategy != "psum":
+            raise ValueError(f"{what} use the in-step psum sync (strategy 'psum')")
+        if n_slices and n_slices > 1:
+            raise ValueError(f"{what} do not compose with --slices yet")
+        if accum_steps != 1 or fuse > 1:
             raise ValueError(
-                "multi-slice (dcn, data) meshes support the BSP rule; "
-                "EASGD/GoSGD map workers onto a single axis"
+                f"{what} do not compose with --accum-steps/--steps-per-dispatch yet"
             )
-        from theanompi_tpu.parallel.mesh import make_multislice_mesh
+        if rule_kwargs:
+            raise ValueError(f"{what} got unexpected options {sorted(rule_kwargs)}")
+    if nd_active and zero:
+        raise ValueError("--zero composes with plain BSP only (ND shards "
+                         "optimizer state per its own param specs already)")
+    if microbatches is not None and pp <= 1:
+        raise ValueError("--microbatches requires --pp (GPipe microbatching)")
+    if nd_active:
+        if not getattr(model, "is_lm", False):
+            raise ValueError(
+                "--tp/--sp/--pp/--expert need an LM model "
+                "(theanompi_tpu.models.lm TransformerLMModel / MoELMModel); "
+                f"{model_cls.__name__} is classifier-shaped"
+            )
+        if (expert > 1) != bool(getattr(model, "is_moe", False)):
+            raise ValueError(
+                "--expert N trains MoELMModel (Switch-MoE); dense "
+                "TransformerLMModel uses --tp/--sp/--pp"
+                if expert > 1
+                else "MoELMModel trains via --expert N"
+            )
+    if n_slices and n_slices > 1:
+        if rule == "bsp":
+            from theanompi_tpu.parallel.mesh import make_multislice_mesh
 
-        mesh = make_multislice_mesh(devices, n_slices=n_slices)
+            mesh = make_multislice_mesh(devices, n_slices=n_slices)
+        else:
+            # EASGD/GoSGD across slices (BASELINE config #4's pod shape:
+            # worker groups inside a slice, async exchange over DCN):
+            # the engine builds the (worker, data) mesh itself — hand it
+            # the flat slice-major device list + the slice count to
+            # validate group/slice alignment (make_worker_group_mesh)
+            mesh = make_mesh(devices)
+            rule_kwargs["n_slices"] = n_slices
+    elif nd_active:
+        # ND mesh: exactly the active axes, data-major (slice-major
+        # device order comes from make_mesh; collectives over the
+        # trailing axes stay densest on ICI)
+        base = make_mesh(devices)
+        devs = np.asarray(base.devices).reshape(-1)
+        from jax.sharding import Mesh as _Mesh
+
+        from theanompi_tpu.parallel.nd import DP_AXIS, SP_AXIS, TP_AXIS
+
+        if expert > 1:
+            from theanompi_tpu.models.moe import EXPERT_AXIS
+
+            if tp > 1 or pp > 1:
+                raise ValueError(
+                    "--expert composes with --sp only (the expert axis "
+                    "is also the batch axis; tp/pp are not implemented "
+                    "for the MoE branch)"
+                )
+            if len(devs) != expert * sp:
+                raise ValueError(
+                    f"--expert {expert} --sp {sp} needs exactly "
+                    f"{expert * sp} devices (expert is also the batch "
+                    f"axis), got {len(devs)}"
+                )
+            names = (EXPERT_AXIS,) + ((SP_AXIS,) if sp > 1 else ())
+            shape = (expert,) + ((sp,) if sp > 1 else ())
+            nd_axes = dict(ep_axis=EXPERT_AXIS,
+                           sp_axis=SP_AXIS if sp > 1 else None)
+        elif pp > 1:
+            if tp > 1 or sp > 1:
+                raise ValueError("--pp composes with data parallelism only")
+            if len(devs) % pp:
+                raise ValueError(f"{len(devs)} devices do not divide --pp {pp}")
+            dp = len(devs) // pp
+            names = ("pipe",) + ((DP_AXIS,) if dp > 1 else ())
+            shape = (pp,) + ((dp,) if dp > 1 else ())
+            nd_axes = dict(pipe_axis="pipe",
+                           dp_axis=DP_AXIS if dp > 1 else None,
+                           microbatches=microbatches)
+        else:
+            if len(devs) % (tp * sp):
+                raise ValueError(
+                    f"{len(devs)} devices do not divide --tp {tp} x --sp {sp}"
+                )
+            dp = len(devs) // (tp * sp)
+            names = (DP_AXIS,) + ((TP_AXIS,) if tp > 1 else ()) + (
+                (SP_AXIS,) if sp > 1 else ()
+            )
+            shape = (dp,) + ((tp,) if tp > 1 else ()) + ((sp,) if sp > 1 else ())
+            nd_axes = dict(dp_axis=DP_AXIS,
+                           tp_axis=TP_AXIS if tp > 1 else None,
+                           sp_axis=SP_AXIS if sp > 1 else None)
+        mesh = _Mesh(devs.reshape(shape), names)
     else:
         mesh = make_mesh(devices)
     n_dev = mesh.devices.size
@@ -141,7 +249,6 @@ def run_training(
         )
     if rule in per_worker_rules and strategy != "psum":
         raise ValueError("strategy applies to the BSP rule only")
-    fuse = max(1, int(steps_per_dispatch))
     if fuse > 1 and rule != "bsp":
         raise ValueError(
             "steps_per_dispatch > 1 fuses the allreduce-inside BSP step; "
@@ -182,11 +289,28 @@ def run_training(
         )
     n_epochs = n_epochs if n_epochs is not None else recipe.n_epochs
 
-    if batch % n_dev:
-        raise ValueError(f"global batch {batch} not divisible by {n_dev} devices")
     vbatch = recipe.val_batch_size or batch
-    if vbatch % n_dev:
-        raise ValueError(f"val batch {vbatch} not divisible by {n_dev} devices")
+    if nd_active:
+        # tokens shard P(batch_axis, seq_axis); seq divides sp, batch
+        # divides the batch axis x (for pipelines) the microbatch count
+        T = recipe.input_shape[0]
+        if sp > 1 and T % sp:
+            raise ValueError(f"sequence length {T} not divisible by --sp {sp}")
+        batch_div = expert if expert > 1 else (
+            (microbatches or pp) * max(1, n_dev // pp) if pp > 1
+            else n_dev // (tp * sp)
+        )
+        for name, b in (("batch", batch), ("val batch", vbatch)):
+            if batch_div and b % batch_div:
+                raise ValueError(
+                    f"global {name} {b} not divisible by {batch_div} "
+                    "(batch-axis devices x microbatches)"
+                )
+    else:
+        if batch % n_dev:
+            raise ValueError(f"global batch {batch} not divisible by {n_dev} devices")
+        if vbatch % n_dev:
+            raise ValueError(f"val batch {vbatch} not divisible by {n_dev} devices")
 
     # Device-side normalization (dataset opt-in): the loader ships
     # compact uint8 batches and (x - mean) * scale fuses into the
@@ -202,7 +326,20 @@ def run_training(
         def input_transform(x):
             return (x.astype(jnp.float32) - mean_c) * scale_c
 
-    if rule == "bsp":
+    if nd_active:
+        from theanompi_tpu.parallel.nd import NDEngine
+
+        engine = NDEngine(
+            model, mesh, steps_per_epoch=steps_per_epoch, **nd_axes,
+        )
+    elif zero:
+        from theanompi_tpu.parallel.zero import ZeroEngine
+
+        engine = ZeroEngine(
+            model, mesh, steps_per_epoch=steps_per_epoch,
+            input_transform=input_transform, eval_views=eval_views,
+        )
+    elif rule == "bsp":
         from theanompi_tpu.parallel.bsp import BSPEngine
 
         engine = BSPEngine(
@@ -230,6 +367,11 @@ def run_training(
     # Multi-controller: this host produces only its slice of every
     # global batch (reference: per-rank loader feed, lib/proc_load_mpi.py)
     n_proc = jax.process_count()
+    if n_proc > 1 and nd_active:
+        raise NotImplementedError(
+            "--tp/--sp/--pp/--expert under multi-controller launch is not "
+            "wired yet (the ND placement path is single-controller)"
+        )
     part = host_local_batch_slice(mesh, batch) if n_proc > 1 else None
     vpart = host_local_batch_slice(mesh, vbatch) if n_proc > 1 else None
     if n_proc > 1 and (batch % n_proc or vbatch % n_proc):
@@ -289,12 +431,19 @@ def run_training(
             start_epoch = engine.get_step(state) // steps_per_epoch
             print(f"resumed from {path} at step {engine.get_step(state)}", flush=True)
 
-    def place(b):
-        # global rows inferred per array (local rows x process_count):
-        # x and y may carry different row counts (10-crop val ships
-        # views x batch image rows against batch label rows)
-        x, y = b
-        return (put_global_batch(mesh, x), put_global_batch(mesh, y))
+    if hasattr(engine, "place_batch"):
+        # engine-owned placement (ND engines: tokens shard over
+        # (batch, seq) axes / microbatch-major — not the leading-dim-
+        # only layout put_global_batch assumes)
+        def place(b):
+            return engine.place_batch(*b)
+    else:
+        def place(b):
+            # global rows inferred per array (local rows x process_count):
+            # x and y may carry different row counts (10-crop val ships
+            # views x batch image rows against batch label rows)
+            x, y = b
+            return (put_global_batch(mesh, x), put_global_batch(mesh, y))
 
     def place_group(group):
         # fused dispatch: stack g host batches -> ONE [g, batch, ...]
@@ -449,10 +598,30 @@ def run_training(
     finally:
         try:
             if ckpt_writer is not None:
-                ckpt_writer.close()  # may re-raise a failed write
+                # may re-raise a failed background write — but never let
+                # that replace a training exception already propagating
+                # (the original would survive only as __context__)
+                import sys
+
+                if sys.exc_info()[0] is not None:
+                    try:
+                        ckpt_writer.close()
+                    except Exception as e:  # noqa: BLE001
+                        print(
+                            f"checkpoint writer failed during error "
+                            f"unwinding (suppressed): {e!r}",
+                            flush=True,
+                        )
+                else:
+                    ckpt_writer.close()
         finally:
             rec.close()  # trace + JSONL must close even then
     summary["steps"] = step_count
+    # device-truth step counter (host-fetched AFTER training): the host
+    # loop counts dispatches, the device counts executions — a tunneled
+    # backend that silently drops work (tools/repro_tunnel_fault.py)
+    # shows up as a mismatch here
+    summary["device_steps"] = engine.get_step(state)
     summary["images_per_sec"] = (
         batch / rec.mean_time("step", 50) if rec.mean_time("step", 50) else 0.0
     )

@@ -1,0 +1,149 @@
+"""Zoo-registered transformer LM models — the launchable face of the
+N-D parallelism stack.
+
+BEYOND-PARITY EXTENSION (SURVEY.md §5.7: the reference has no attention
+anywhere). :class:`TransformerLMModel` wraps
+:class:`theanompi_tpu.models.transformer.TransformerLM` in the standard
+``Model`` contract, so the SAME drivers that run the CNN zoo run an LM:
+
+- ``tmpi BSP 8 theanompi_tpu.models.lm TransformerLMModel`` — plain
+  data-parallel LM training through BSPEngine (and EASGD/GoSGD work the
+  same way: the sync rules never look inside the model).
+- ``tmpi BSP 8 ... --tp 2 --sp 2`` — the CLI's mesh flags route to
+  :class:`theanompi_tpu.parallel.nd.NDEngine`, which trains with
+  Megatron tensor sharding, ring/Ulysses sequence parallelism, GPipe
+  pipelining (``--pp``), or Switch-MoE expert parallelism (``--expert``,
+  with :class:`MoELMModel`).
+
+Token batches come from the ``lm_synthetic`` / ``lm_text`` datasets
+(data/lm.py): "images" are token windows ``[B, T] int32`` and labels are
+the same windows (next-token targets are computed in-model, shifted —
+the target of position t is the token at t+1; the final position is
+masked).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from theanompi_tpu.models.contract import Model, Recipe
+from theanompi_tpu.models.transformer import (
+    TransformerLM,
+    next_token_loss,
+    softmax_nll,
+)
+
+
+@dataclasses.dataclass
+class LMRecipe(Recipe):
+    """Recipe with the LM architecture knobs. ``input_shape`` is
+    ``(seq_len,)`` and ``num_classes`` the vocabulary size (mirroring
+    the image recipes so the driver's shape checks apply unchanged)."""
+
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 256
+    # "ring" = exact full attention locally, ring K/V rotation under SP;
+    # "flash"/"ring_flash"/"ulysses"/"ulysses_flash" per TransformerLM
+    attn: str = "ring"
+    remat: bool = False
+    # MoE knobs (MoELMModel only)
+    n_experts: int = 8
+    capacity_factor: float = 1.25
+    aux_weight: float = 0.01
+
+
+class TransformerLMModel(Model):
+    """Dense decoder-only LM under the zoo contract. ``self.arch`` is
+    the functional :class:`TransformerLM`; the parallel engines
+    (``NDEngine``) reach through to it for tp/sp/pp sharding, while the
+    plain contract surface below serves the DP/EASGD/GoSGD paths."""
+
+    name = "transformer_lm"
+    is_lm = True
+    is_moe = False
+
+    def __init__(self, recipe: LMRecipe | None = None):
+        self.recipe = recipe or self.default_recipe()
+        r = self.recipe
+        self.arch = TransformerLM(
+            vocab=r.num_classes,
+            d_model=r.d_model,
+            n_heads=r.n_heads,
+            n_layers=r.n_layers,
+            d_ff=r.d_ff,
+            max_len=r.input_shape[0],
+            attn=r.attn,
+            remat=r.remat,
+        )
+
+    @classmethod
+    def default_recipe(cls) -> LMRecipe:
+        return LMRecipe(
+            batch_size=32,
+            n_epochs=5,
+            optimizer="adam",
+            schedule="constant",
+            sched_kwargs={"lr": 1e-3},
+            lr_unit="step",
+            input_shape=(128,),
+            num_classes=64,
+            dataset="lm_synthetic",
+        )
+
+    # -- contract surface (DP / async-rule path) ------------------------
+    def init(self, key):
+        return self.arch.init(key), {}
+
+    def apply(self, params, state, tokens, *, train: bool = False, rng=None):
+        del train, rng  # no dropout in this LM
+        return self.arch.forward(params, tokens.astype(jnp.int32)), state
+
+    def loss(self, logits, labels):
+        # labels ARE the token window [B, T]; shifted targets in-model
+        return next_token_loss(labels.astype(jnp.int32), None, softmax_nll(logits))
+
+    def metrics(self, logits, labels) -> dict:
+        labels = labels.astype(jnp.int32)
+        preds = jnp.argmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+        err = jnp.mean((preds != labels[:, 1:]).astype(jnp.float32))
+        return {"error": err}
+
+
+class MoELMModel(TransformerLMModel):
+    """Switch-MoE LM. Trains via ``--expert N`` (expert-parallel
+    NDEngine path, which uses ``arch.loss`` including the load-balance
+    auxiliary); the plain contract path is blocked because the aux loss
+    cannot flow through ``loss(logits, labels)``."""
+
+    name = "moe_lm"
+    is_moe = True
+
+    def __init__(self, recipe: LMRecipe | None = None):
+        from theanompi_tpu.models.moe import MoETransformerLM
+
+        self.recipe = recipe or self.default_recipe()
+        r = self.recipe
+        self.arch = MoETransformerLM(
+            vocab=r.num_classes,
+            d_model=r.d_model,
+            n_heads=r.n_heads,
+            n_layers=r.n_layers,
+            d_ff=r.d_ff,
+            max_len=r.input_shape[0],
+            n_experts=r.n_experts,
+            capacity_factor=r.capacity_factor,
+            aux_weight=r.aux_weight,
+            attn=r.attn,
+        )
+
+    def apply(self, params, state, tokens, *, train: bool = False, rng=None):
+        raise ValueError(
+            "MoELMModel trains expert-parallel only (tmpi BSP ... --expert N); "
+            "for plain data parallelism use TransformerLMModel — the Switch "
+            "load-balance auxiliary loss cannot flow through the classifier "
+            "contract's loss(logits, labels)"
+        )

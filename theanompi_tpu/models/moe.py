@@ -157,6 +157,34 @@ class MoETransformerLM(NamedTuple):
         }
 
 
+def ep_spec_setup(
+    model: MoETransformerLM,
+    mesh: Mesh,
+    ep_axis: str,
+    sp_axis: Optional[str],
+):
+    """Shared mesh/shape validation + sharding-spec construction for the
+    expert-parallel step builders (:func:`make_ep_train_step` and the
+    launchable ``parallel.nd.NDEngine``). Returns ``(axes, n_total,
+    param_specs)``."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    axes = [a for a in (ep_axis, sp_axis) if a is not None]
+    for a in axes:
+        if a not in sizes:
+            raise ValueError(f"axis {a!r} not in mesh axes {mesh.axis_names}")
+    nep = sizes[ep_axis]
+    if model.n_experts % nep:
+        raise ValueError(
+            f"n_experts={model.n_experts} must divide the {ep_axis!r} "
+            f"axis size {nep}"
+        )
+    validate_ulysses_heads(model, sp_axis, sizes, model.n_heads)
+    n_total = 1
+    for a in axes:
+        n_total *= sizes[a]
+    return axes, n_total, model.ep_param_specs(ep_axis)
+
+
 def make_ep_train_step(
     model: MoETransformerLM,
     mesh: Mesh,
@@ -173,22 +201,7 @@ def make_ep_train_step(
     Gradient sync follows the universal spec rule (transformer.py):
     expert shards carry their own full contribution, replicated leaves
     psum across both axes."""
-    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-    axes = [a for a in (ep_axis, sp_axis) if a is not None]
-    for a in axes:
-        if a not in sizes:
-            raise ValueError(f"axis {a!r} not in mesh axes {mesh.axis_names}")
-    nep = sizes[ep_axis]
-    if model.n_experts % nep:
-        raise ValueError(
-            f"n_experts={model.n_experts} must divide the {ep_axis!r} "
-            f"axis size {nep}"
-        )
-    validate_ulysses_heads(model, sp_axis, sizes, model.n_heads)
-    n_total = 1
-    for a in axes:
-        n_total *= sizes[a]
-    param_specs = model.ep_param_specs(ep_axis)
+    axes, n_total, param_specs = ep_spec_setup(model, mesh, ep_axis, sp_axis)
 
     def body(params, tokens):
         loss, grads = jax.value_and_grad(model.loss)(

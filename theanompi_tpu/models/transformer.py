@@ -414,6 +414,48 @@ def make_sp_train_step(model: TransformerLM, mesh: Mesh, lr: float = 1e-2):
     return make_nd_train_step(model, mesh, lr=lr, sp_axis=SEQ_AXIS)
 
 
+def nd_spec_setup(
+    model: TransformerLM,
+    mesh: Mesh,
+    dp_axis: Optional[str],
+    tp_axis: Optional[str],
+    sp_axis: Optional[str],
+):
+    """Shared mesh/shape validation + sharding-spec construction for the
+    dense N-D step builders (:func:`make_nd_train_step` and the
+    launchable ``parallel.nd.NDEngine``). Returns ``(axes, n_total,
+    param_specs)``."""
+    axes = [a for a in (dp_axis, tp_axis, sp_axis) if a is not None]
+    if not axes:
+        raise ValueError("need at least one of dp_axis/tp_axis/sp_axis")
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    for a in axes:
+        if a not in sizes:
+            raise ValueError(f"axis {a!r} not in mesh axes {mesh.axis_names}")
+    if tp_axis:
+        ntp = sizes[tp_axis]
+        if model.n_heads % ntp or model.d_ff % ntp or model.vocab % ntp:
+            raise ValueError(
+                f"n_heads/d_ff/vocab ({model.n_heads}/{model.d_ff}/"
+                f"{model.vocab}) must divide the {tp_axis!r} axis size {ntp}"
+            )
+    validate_ulysses_heads(
+        model, sp_axis, sizes, model.n_heads // (sizes[tp_axis] if tp_axis else 1)
+    )
+    n_total = 1
+    for a in axes:
+        n_total *= sizes[a]
+    param_specs = (
+        model.tp_param_specs(tp_axis)
+        if tp_axis
+        else jax.tree_util.tree_map(
+            lambda _: P(),
+            jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0))),
+        )
+    )
+    return axes, n_total, param_specs
+
+
 def make_nd_train_step(
     model: TransformerLM,
     mesh: Mesh,
@@ -455,32 +497,10 @@ def make_nd_train_step(
     objective to the mean). The dp-only case reduces to BSP's classic
     psum-mean.
     """
-    axes = [a for a in (dp_axis, tp_axis, sp_axis) if a is not None]
-    if not axes:
-        raise ValueError("need at least one of dp_axis/tp_axis/sp_axis")
-    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-    for a in axes:
-        if a not in sizes:
-            raise ValueError(f"axis {a!r} not in mesh axes {mesh.axis_names}")
-    if tp_axis:
-        ntp = sizes[tp_axis]
-        if model.n_heads % ntp or model.d_ff % ntp or model.vocab % ntp:
-            raise ValueError(
-                f"n_heads/d_ff/vocab ({model.n_heads}/{model.d_ff}/"
-                f"{model.vocab}) must divide the {tp_axis!r} axis size {ntp}"
-            )
-    validate_ulysses_heads(
-        model, sp_axis, sizes, model.n_heads // (sizes[tp_axis] if tp_axis else 1)
+    axes, n_total, param_specs = nd_spec_setup(
+        model, mesh, dp_axis, tp_axis, sp_axis
     )
-    n_total = 1
-    for a in axes:
-        n_total *= sizes[a]
     init_fn = lambda: model.init(jax.random.PRNGKey(0))  # noqa: E731
-    param_specs = (
-        model.tp_param_specs(tp_axis)
-        if tp_axis
-        else jax.tree_util.tree_map(lambda _: P(), jax.eval_shape(init_fn))
-    )
 
     def body(params, tokens):
         loss, grads = jax.value_and_grad(model.loss)(

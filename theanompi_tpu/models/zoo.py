@@ -4,14 +4,16 @@ batch). Shared by the root ``bench.py`` harness and
 
 Batch policy: AlexNet runs the reference workload's GLOBAL batch
 (BASELINE config #2: 8 workers x 128 = 1024 — same SGD trajectory, and
-a v5e only reaches full MXU utilization ~batch 1024); GoogLeNet's
-config #3 global batch is likewise 1024, but the scanned multi-step
-program above batch 256 silently fails on the tunneled dev backend
-(single steps run fine at 1024; the scan returns without executing and
-trips bench.py's physics guard) — bench at 256 per chip until a
-directly-attached host says more. ResNet-50 uses config #4's batch 256;
-VGG16/WRN use the largest power-of-two that fits one chip's HBM
-comfortably."""
+a v5e only reaches full MXU utilization ~batch 1024); GoogLeNet runs
+config #3's global batch 1024 — round 3 capped it at 256 because the
+scanned multi-step program silently no-opped above that on the
+tunneled dev backend, but the round-4 re-test (2026-07-30, jax 0.9.0:
+8-step scan at batch 512 AND 1024, step counter 8/8, losses finite,
+~4.2k img/s) shows the backend fault is gone; bench.py now carries a
+hard executed-work assertion either way, and
+tools/repro_tunnel_fault.py is the probe to re-run if it ever trips.
+ResNet-50 uses config #4's batch 256; VGG16/WRN use the largest
+power-of-two that fits one chip's HBM comfortably."""
 
 from __future__ import annotations
 
@@ -26,7 +28,7 @@ def zoo_entry(name: str):
     if name == "googlenet":
         from theanompi_tpu.models.googlenet import GoogLeNet
 
-        return GoogLeNet, 256
+        return GoogLeNet, 1024
     if name == "resnet50":
         from theanompi_tpu.models.model_zoo.resnet50 import ResNet50
 

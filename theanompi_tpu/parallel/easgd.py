@@ -85,12 +85,16 @@ class EASGDEngine:
         eval_views: int = 1,
         group_size: int = 1,
         accum_steps: int = 1,
+        n_slices: Optional[int] = None,
     ):
         from theanompi_tpu.parallel.mesh import make_worker_group_mesh
 
         self.model = model
         self.group_size = g = max(1, int(group_size))
-        mesh, gspec, grad_sync = make_worker_group_mesh(mesh, g)
+        # n_slices: validate the pod topology split — groups (per-step
+        # psum) inside a slice, the worker axis (every-avg_freq elastic
+        # exchange) across slices; see make_worker_group_mesh
+        mesh, gspec, grad_sync = make_worker_group_mesh(mesh, g, n_slices=n_slices)
         ax = mesh.axis_names[0] if g > 1 else axis_name
         bspec_ = gspec if g > 1 else P(ax)
         self.mesh = mesh

@@ -92,12 +92,15 @@ class GOSGDEngine:
         eval_views: int = 1,
         group_size: int = 1,
         accum_steps: int = 1,
+        n_slices: "int | None" = None,
     ):
         from theanompi_tpu.parallel.mesh import make_worker_group_mesh
 
         self.model = model
         self.group_size = g = max(1, int(group_size))
-        mesh, gspec, grad_sync = make_worker_group_mesh(mesh, g)
+        # n_slices: pod topology validation (groups inside a slice, the
+        # gossip ppermute across slices) — see make_worker_group_mesh
+        mesh, gspec, grad_sync = make_worker_group_mesh(mesh, g, n_slices=n_slices)
         if g > 1:
             axis_name = mesh.axis_names[0]
         bspec = gspec if g > 1 else P(axis_name)

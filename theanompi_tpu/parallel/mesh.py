@@ -144,23 +144,63 @@ def make_multislice_mesh(
     return Mesh(arr, (DCN_AXIS, DATA_AXIS))
 
 
-def make_worker_group_mesh(mesh: Mesh, group_size: int):
+def make_worker_group_mesh(mesh: Mesh, group_size: int,
+                           n_slices: Optional[int] = None):
     """Reshape a 1-D mesh for async-rule worker groups: ``(worker,
     data)`` rows are workers, columns the chips data-parallel WITHIN one
     worker. Returns ``(mesh2d, batch_spec, grad_sync)`` — the shared
     construction for EASGD/GoSGD group mode (a group must behave as ONE
-    bigger worker: BSP psum inside, worker-axis collectives across)."""
+    bigger worker: BSP psum inside, worker-axis collectives across).
+
+    **Slice awareness** (BASELINE config #4 at pod scale — e.g. 16
+    workers x 16 chips over multiple slices): devices are slice-major
+    (the canonical ``make_mesh`` order), so with ``group_size`` dividing
+    the per-slice chip count every group row sits INSIDE one slice — the
+    per-step group psum rides ICI — while the worker axis spans slices,
+    putting the cheap every-``avg_freq`` elastic/gossip collectives on
+    DCN. The reference built the same split with NCCL-in-node /
+    MPI-across-nodes (SURVEY.md §3.3, §5.8). ``n_slices`` simulates the
+    slice boundaries on hardware without ``slice_index`` metadata (CPU
+    meshes / carving one physical slice); with real metadata the
+    physical boundaries are validated instead.
+    """
     from jax.sharding import PartitionSpec
 
     from theanompi_tpu.parallel.strategies import get_strategy
 
     g = max(1, int(group_size))
-    n_dev = mesh.devices.size
+    devs = list(np.asarray(mesh.devices).reshape(-1))
+    n_dev = len(devs)
     if n_dev % g:
         raise ValueError(f"{n_dev} devices do not divide into groups of {g}")
     if g == 1:
         return mesh, None, None
-    mesh2d = Mesh(mesh.devices.reshape(n_dev // g, g), (WORKER_AXIS, DATA_AXIS))
+    devs = _slice_major(devs)
+    slice_ids = [getattr(d, "slice_index", 0) for d in devs]
+    if n_slices is not None and n_slices > 1:
+        if n_dev % n_slices:
+            raise ValueError(
+                f"{n_dev} devices do not divide into {n_slices} slices"
+            )
+        per_slice = n_dev // n_slices
+        if len(set(slice_ids)) <= 1:
+            # no (or uniform) hardware metadata: impose virtual slice ids
+            slice_ids = [i // per_slice for i in range(n_dev)]
+    if len(set(slice_ids)) > 1:
+        # every group row must be single-slice: a group straddling
+        # slices would put its PER-STEP data-axis psum on DCN, defeating
+        # the topology split (workers exchange rarely; groups every step)
+        for w in range(n_dev // g):
+            row = {slice_ids[w * g + i] for i in range(g)}
+            if len(row) > 1:
+                raise ValueError(
+                    f"worker group {w} would span slices {sorted(row)}: "
+                    f"group_size {g} must divide the per-slice chip count "
+                    f"({n_dev} devices / {len(set(slice_ids))} slices)"
+                )
+    mesh2d = Mesh(
+        np.array(devs).reshape(n_dev // g, g), (WORKER_AXIS, DATA_AXIS)
+    )
     return (
         mesh2d,
         PartitionSpec((WORKER_AXIS, DATA_AXIS)),

@@ -1,0 +1,253 @@
+"""NDEngine — the launchable N-D parallelism rule engine.
+
+BEYOND-PARITY EXTENSION. Round 3 left tensor/sequence/pipeline/expert
+parallelism as a *library* (``make_nd_train_step`` etc.) reachable only
+from tests; this engine gives them the same driver protocol the sync
+rules use (``init_state`` / ``train_step`` / ``eval_step`` /
+``place_batch``), so ``launch/worker.py::run_training`` — recorder,
+prefetch loader, checkpointing, resume, CLI — drives an LM sharded over
+any of:
+
+- ``dp`` (data axis) x ``tp`` (Megatron tensor axis) x ``sp`` (ring /
+  Ulysses sequence axis) for the dense :class:`TransformerLMModel`;
+- ``pipe`` (GPipe pipeline axis, microbatched) x ``dp``;
+- ``expert`` (Switch-MoE all-to-all axis, doubling as the batch axis)
+  x ``sp`` for :class:`MoELMModel`.
+
+CLI: ``tmpi BSP 8 theanompi_tpu.models.lm TransformerLMModel --tp 2
+--sp 2`` (see cli.py). The engine owns batch *placement* because its
+token sharding — ``P(dp, sp)``, or microbatch-major ``[M, B, T]`` for
+pipelines — differs from the image engines' leading-dim-only layout.
+
+Gradient sync follows the universal spec rule
+(models/transformer.py::sync_grads_by_spec) under ``check_vma=False``
+(see train.make_train_step's AD-semantics note); the optimizer, LR
+schedule, and step counter mirror ``train.make_train_step`` so recipes
+and checkpoints behave identically across engines.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from theanompi_tpu.models.transformer import (
+    nd_spec_setup,
+    opt_state_specs,
+    sync_grads_by_spec,
+)
+from theanompi_tpu.ops.optimizers import apply_updates
+from theanompi_tpu.train import make_schedule_fn
+
+PyTree = Any
+
+# canonical axis names for the launchable ND meshes (the mesh builder in
+# launch/worker.py uses these; tests may use their own)
+DP_AXIS = "data"
+TP_AXIS = "model"
+SP_AXIS = "seq"
+
+
+class NDTrainState(NamedTuple):
+    """Params + optimizer state + step. ``params`` leaves are sharded
+    per the engine's param specs (tp/pipe/expert sharding or
+    replicated); ``opt_state`` accumulators shard exactly like their
+    parameters (transformer.py::opt_state_specs)."""
+
+    params: PyTree
+    opt_state: PyTree
+    step: jax.Array
+
+
+class NDEngine:
+    """Driver-protocol engine over the N-D parallel LM step builders.
+
+    Exactly one of three branches is active:
+
+    - dense ND: any of ``dp_axis``/``tp_axis``/``sp_axis``
+    - pipeline: ``pipe_axis`` (+ optional ``dp_axis``); tokens are
+      reshaped host-side to microbatch-major ``[M, B/M, T]``
+    - expert:   ``ep_axis`` (+ optional ``sp_axis``); the expert axis
+      is also the batch axis
+    """
+
+    name = "nd"
+    exchange_every = 0
+
+    def __init__(
+        self,
+        model,
+        mesh: Mesh,
+        *,
+        steps_per_epoch: int = 1,
+        dp_axis: Optional[str] = None,
+        tp_axis: Optional[str] = None,
+        sp_axis: Optional[str] = None,
+        ep_axis: Optional[str] = None,
+        pipe_axis: Optional[str] = None,
+        microbatches: Optional[int] = None,
+        donate: bool = True,
+    ):
+        if not hasattr(model, "arch"):
+            raise ValueError(
+                f"NDEngine needs an LM model exposing .arch (models/lm.py); "
+                f"got {type(model).__name__}"
+            )
+        arch = model.arch
+        self.model = model
+        self.mesh = mesh
+        self.microbatches = None
+        opt = model.optimizer()
+        schedule_lr = make_schedule_fn(model, steps_per_epoch)
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+
+        if pipe_axis is not None:
+            if ep_axis or tp_axis or sp_axis:
+                raise ValueError(
+                    "the pipeline branch composes with dp only "
+                    "(pipe x tp/sp/expert is not implemented)"
+                )
+            from theanompi_tpu.parallel.pipeline import (
+                make_pipeline_loss,
+                pipeline_param_specs,
+                stack_pipeline_params,
+                validate_pp_mesh,
+            )
+
+            axes, n_total = validate_pp_mesh(arch, mesh, pipe_axis, dp_axis)
+            param_specs = pipeline_param_specs(pipe_axis)
+            loss_fn = make_pipeline_loss(arch, pipe_axis)
+            init_params = lambda key: stack_pipeline_params(arch.init(key))  # noqa: E731
+            self.microbatches = int(microbatches or sizes[pipe_axis])
+            tok_spec = P(None, dp_axis)  # [M, B, T]: M replicated, B on dp
+            batch_axes = (dp_axis,) if dp_axis else ()
+        elif ep_axis is not None:
+            if tp_axis or dp_axis:
+                raise ValueError(
+                    "the expert branch's expert axis IS the batch axis "
+                    "(composes with sp only; tp/dp are not implemented)"
+                )
+            from theanompi_tpu.models.moe import ep_spec_setup
+
+            axes, n_total, param_specs = ep_spec_setup(arch, mesh, ep_axis, sp_axis)
+            loss_fn = lambda p, t: arch.loss(p, t, sp_axis, ep_axis=ep_axis)  # noqa: E731
+            init_params = arch.init
+            tok_spec = P(ep_axis, sp_axis)
+            batch_axes = (ep_axis,)
+        else:
+            axes, n_total, param_specs = nd_spec_setup(
+                arch, mesh, dp_axis, tp_axis, sp_axis
+            )
+            loss_fn = lambda p, t: arch.loss(p, t, sp_axis, tp_axis=tp_axis)  # noqa: E731
+            init_params = arch.init
+            tok_spec = P(dp_axis, sp_axis)
+            batch_axes = (dp_axis,) if dp_axis else ()
+
+        opt_template = jax.eval_shape(
+            lambda: opt.init(jax.eval_shape(init_params, jax.random.PRNGKey(0)))
+        )
+        opt_specs = opt_state_specs(opt_template, param_specs)
+        state_specs = NDTrainState(param_specs, opt_specs, P())
+        self._state_specs = state_specs
+        self._init_params = init_params
+        self._opt = opt
+        self._tok_sharding = NamedSharding(mesh, tok_spec)
+
+        def sharded_step(state: NDTrainState, tokens, rng):
+            del rng  # no dropout in the LM stack; kept for protocol parity
+            loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
+            grads = sync_grads_by_spec(grads, param_specs, axes, n_total)
+            for a in batch_axes:
+                loss = lax.pmean(loss, a)  # report the global batch mean
+            lr = schedule_lr(state.step)
+            updates, new_opt = opt.update(grads, state.opt_state, state.params, lr)
+            new_params = apply_updates(state.params, updates)
+            return (
+                NDTrainState(new_params, new_opt, state.step + 1),
+                {"loss": loss, "lr": lr},
+            )
+
+        self._step = jax.jit(
+            jax.shard_map(
+                sharded_step,
+                mesh=mesh,
+                in_specs=(state_specs, tok_spec, P()),
+                out_specs=(state_specs, P()),
+                check_vma=False,
+            ),
+            donate_argnums=(0,) if donate else (),
+        )
+
+        def sharded_eval(state: NDTrainState, tokens):
+            loss = loss_fn(state.params, tokens)
+            for a in batch_axes:
+                loss = lax.pmean(loss, a)
+            return {"loss": loss}
+
+        self._eval = jax.jit(
+            jax.shard_map(
+                sharded_eval,
+                mesh=mesh,
+                in_specs=(state_specs, tok_spec),
+                out_specs=P(),
+                check_vma=False,
+            )
+        )
+
+    # -- driver protocol ------------------------------------------------
+    def init_state(self, rng) -> NDTrainState:
+        params = jax.jit(self._init_params)(rng)
+        state = NDTrainState(
+            params, jax.jit(self._opt.init)(params), jnp.zeros((), jnp.int32)
+        )
+        shardings = jax.tree_util.tree_map(
+            lambda spec: NamedSharding(self.mesh, spec),
+            self._state_specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        return jax.device_put(state, shardings)
+
+    def place_batch(self, x, y):
+        """Host tokens ``[B, T]`` -> device, sharded per the engine's
+        token spec (microbatch-major for pipelines). Returns the SAME
+        device array for x and y (labels are the tokens; zero extra
+        transfer)."""
+        del y  # labels ARE the tokens
+        x = np.asarray(x)
+        if self.microbatches is not None:
+            M = self.microbatches
+            if x.shape[0] % M:
+                raise ValueError(
+                    f"global batch {x.shape[0]} must be divisible by "
+                    f"microbatches={M}"
+                )
+            x = x.reshape(M, x.shape[0] // M, *x.shape[1:])
+        t = jax.device_put(x, self._tok_sharding)
+        return t, t
+
+    def train_step(self, state, tokens, labels, rng):
+        del labels
+        return self._step(state, tokens, rng)
+
+    def fused_train_step(self, state, images, labels, rngs):
+        raise NotImplementedError(
+            "steps_per_dispatch > 1 is not supported by the ND engine yet"
+        )
+
+    def exchange(self, state):
+        return state
+
+    def eval_step(self, state, tokens, labels):
+        del labels
+        return self._eval(state, tokens)
+
+    def get_step(self, state) -> int:
+        from theanompi_tpu.parallel.mesh import first_local_value
+
+        return int(first_local_value(state.step))

@@ -97,21 +97,10 @@ def _apply_stage(blocks_local, x):
     return h
 
 
-def make_pp_train_step(
-    model: TransformerLM,
-    mesh: Mesh,
-    lr: float = 1e-2,
-    *,
-    pipe_axis: str = PIPE_AXIS,
-    dp_axis: Optional[str] = None,
-    optimizer=None,
-):
-    """Jitted pipeline-parallel train step ``(stacked_params, tokens) ->
-    (stacked_params, loss)`` (or over ``(params, opt_state)`` with
-    ``optimizer``). ``tokens [M, B, T]`` is microbatch-major — build it
-    by reshaping the global batch; ``B`` is sharded over ``dp_axis`` if
-    given. Params use :func:`stack_pipeline_params`'s layout.
-    """
+def validate_pp_mesh(model: TransformerLM, mesh: Mesh, pipe_axis: str,
+                     dp_axis: Optional[str]):
+    """Shared mesh/shape validation for the pipeline step builders.
+    Returns ``(axes, n_total)``."""
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     if pipe_axis not in sizes:
         raise ValueError(f"axis {pipe_axis!r} not in mesh axes {mesh.axis_names}")
@@ -127,7 +116,14 @@ def make_pp_train_step(
     n_total = 1
     for a in axes:
         n_total *= sizes[a]
-    param_specs = pipeline_param_specs(pipe_axis)
+    return axes, n_total
+
+
+def make_pipeline_loss(model: TransformerLM, pipe_axis: str = PIPE_AXIS):
+    """``(stacked_params, tokens [M, B, T]) -> loss`` — the GPipe
+    schedule as one differentiable function (runs inside shard_map).
+    Shared by :func:`make_pp_train_step` and the launchable
+    ``parallel.nd.NDEngine`` pipeline branch."""
 
     def pipeline_loss(params, tokens):
         M, B, T = tokens.shape
@@ -166,6 +162,28 @@ def make_pp_train_step(
         local = jnp.sum(nll * valid) / jnp.sum(valid)
         # only the last stage computed real logits; broadcast its loss
         return lax.psum(jnp.where(rank == n - 1, local, 0.0), pipe_axis)
+
+    return pipeline_loss
+
+
+def make_pp_train_step(
+    model: TransformerLM,
+    mesh: Mesh,
+    lr: float = 1e-2,
+    *,
+    pipe_axis: str = PIPE_AXIS,
+    dp_axis: Optional[str] = None,
+    optimizer=None,
+):
+    """Jitted pipeline-parallel train step ``(stacked_params, tokens) ->
+    (stacked_params, loss)`` (or over ``(params, opt_state)`` with
+    ``optimizer``). ``tokens [M, B, T]`` is microbatch-major — build it
+    by reshaping the global batch; ``B`` is sharded over ``dp_axis`` if
+    given. Params use :func:`stack_pipeline_params`'s layout.
+    """
+    axes, n_total = validate_pp_mesh(model, mesh, pipe_axis, dp_axis)
+    param_specs = pipeline_param_specs(pipe_axis)
+    pipeline_loss = make_pipeline_loss(model, pipe_axis)
 
     def body(params, tokens):
         loss, grads = jax.value_and_grad(pipeline_loss)(params, tokens)

@@ -20,6 +20,28 @@ ring variants the single buffer is what makes segmentation work.
 Strategy names keep the reference's config vocabulary as aliases:
 ``ar``/``cudaaware``/``nccl32`` -> psum, ``asa32`` -> ring,
 ``asa16``/``nccl16`` -> ring_bf16 / psum_bf16.
+
+check_vma pin & migration plan
+------------------------------
+Every shard_map in this framework passes ``check_vma=False``, because
+the whole strategy abstraction assumes classic pmap AD semantics: the
+transpose of a forward psum is a psum, so each device's backward yields
+its LOCAL gradient contribution and the strategy's explicit collective
+completes the global mean. Under ``check_vma=True`` (the modern
+default) the cotangent of a replicated parameter arrives ALREADY
+globally summed — running any strategy here on top of that would
+multiply by the axis size. Both behaviors are pinned by a canary
+(tests/test_check_vma_canary.py, measured on jax 0.9.0) that fails
+loudly if a JAX upgrade changes either side.
+
+Migration (executed when the canary trips, or deliberately): in checked
+mode the exchanger degenerates to ``g / axis_size`` with NO collective
+for the psum family — a working checked-mode BSP step lives in the
+canary file as the prototype. The explicit ring/compressed strategies
+do not survive the migration as gradient SYNCS (AD already summed), but
+remain useful as weight-exchange collectives (EASGD/GoSGD param
+averaging) and would move there. The migration must flip all shard_maps
+at once — grep ``check_vma=False``; a mixed tree double-counts.
 """
 
 from __future__ import annotations

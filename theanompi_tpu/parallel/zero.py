@@ -187,3 +187,60 @@ def make_zero1_train_step(
         donate_argnums=(0,) if donate else (),
     )
     return init_state, train_step
+
+
+class ZeroEngine:
+    """Driver-protocol wrapper over the ZeRO-1 step, so ``tmpi BSP ...
+    --zero 1`` runs optimizer-state-sharded training through the same
+    ``run_training`` loop (recorder, loader, checkpoint/resume) as plain
+    BSP. Eval reuses the BSP eval step on a view of the state WITHOUT
+    the sharded accumulators (params/BN state are replicated), so no
+    gather is paid per validation batch."""
+
+    name = "zero1"
+    exchange_every = 0
+
+    def __init__(
+        self,
+        model: Model,
+        mesh: Mesh,
+        steps_per_epoch: int = 1,
+        input_transform=None,
+        eval_views: int = 1,
+    ):
+        from theanompi_tpu.parallel.bsp import make_bsp_eval_step
+
+        self.model = model
+        self.mesh = mesh
+        self._init, self._step = make_zero1_train_step(
+            model, mesh, steps_per_epoch=steps_per_epoch,
+            input_transform=input_transform,
+        )
+        self._eval = make_bsp_eval_step(
+            model, mesh, input_transform=input_transform, eval_views=eval_views,
+        )
+
+    def init_state(self, rng) -> ZeroTrainState:
+        return self._init(rng)
+
+    def train_step(self, state, images, labels, rng):
+        return self._step(state, images, labels, rng)
+
+    def fused_train_step(self, state, images, labels, rngs):
+        raise NotImplementedError(
+            "steps_per_dispatch > 1 is not supported by the ZeRO engine yet"
+        )
+
+    def exchange(self, state):
+        return state
+
+    def eval_step(self, state, images, labels):
+        from theanompi_tpu.train import TrainState
+
+        view = TrainState(state.params, state.model_state, (), state.step)
+        return self._eval(view, images, labels)
+
+    def get_step(self, state) -> int:
+        from theanompi_tpu.parallel.mesh import first_local_value
+
+        return int(first_local_value(state.step))

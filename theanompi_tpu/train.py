@@ -159,8 +159,8 @@ def make_train_step(
             B = images.shape[0]
             if B % accum_steps:
                 raise ValueError(
-                    f"(per-device) batch {B} must divide accum_steps="
-                    f"{accum_steps}"
+                    f"per-device batch {B} must be divisible by "
+                    f"accum_steps={accum_steps}"
                 )
             xm = images.reshape(accum_steps, B // accum_steps, *images.shape[1:])
             ym = labels.reshape(accum_steps, B // accum_steps, *labels.shape[1:])
@@ -171,17 +171,25 @@ def make_train_step(
                 model_state, grads, metrics = fwd_bwd(
                     state.params, model_state, x, y, jax.random.fold_in(rng, idx)
                 )
-                gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
+                # Accumulate in fp32 regardless of param dtype: repeated
+                # bf16 additions across microbatches would drift from the
+                # large-batch trajectory this mode promises.
+                gsum = jax.tree_util.tree_map(
+                    lambda a, g: a + g.astype(jnp.float32), gsum, grads
+                )
                 return (model_state, gsum), metrics
 
             gzero = jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape, p.dtype), state.params
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params
             )
             (new_model_state, gsum), ms = jax.lax.scan(
                 micro, (state.model_state, gzero),
                 (xm, ym, jnp.arange(accum_steps)),
             )
-            grads = jax.tree_util.tree_map(lambda g: g / accum_steps, gsum)
+            grads = jax.tree_util.tree_map(
+                lambda g, p: (g / accum_steps).astype(p.dtype),
+                gsum, state.params,
+            )
             metrics = jax.tree_util.tree_map(lambda m: jnp.mean(m, axis=0), ms)
 
         if grad_sync is not None:

@@ -1,0 +1,205 @@
+"""Minimal repro for two tunneled-backend faults the bench works around.
+
+Round-3 verdict ("What's weak" #3) asked for a dedicated repro instead
+of scattered notes. Both faults were observed ONLY on the axon-tunneled
+dev chip (JAX platform 'axon', one TPU v5e behind a network tunnel);
+neither reproduces on CPU or is expected on directly-attached TPU hosts.
+Findings are written to TUNNEL_FAULT.md at the repo root by the round-4
+investigation; re-run this script whenever the backend stack changes.
+
+Fault A — "silent scan": a jitted ``lax.scan`` over a conv-model train
+step stops executing above a batch-size threshold: the call returns
+promptly, but a step counter carried through the scan does not advance
+(fetched AFTER the call — this is not a sync artifact, the work never
+happened). Single (unscanned) steps at the same batch execute fine.
+First seen on GoogLeNet at batch > 256 (models/zoo.py note).
+
+Fault B — "block_until_ready no-op": after an AOT
+``jitted.lower(...).compile().cost_analysis()`` call on the SAME
+function object, ``jax.block_until_ready`` on subsequent dispatch
+results returns in ~2 ms while an actual host fetch still takes the
+full step time; results are numerically correct. Timing loops that
+trust block_until_ready then report impossible throughput (bench.py's
+physics guard catches this; ``_measure_roundtrip`` is the fallback).
+
+Usage::
+
+    python tools/repro_tunnel_fault.py            # both, default sizes
+    python tools/repro_tunnel_fault.py --fault a --batches 128,256,512
+    python tools/repro_tunnel_fault.py --fault b
+
+Prints one JSON line per probe and a final verdict line per fault.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _conv_step(channels: int = 64, depth: int = 3):
+    """A small conv train-step stand-in: enough MXU work per step to
+    distinguish execution from a no-op, no framework machinery."""
+    import jax
+    import jax.numpy as jnp
+
+    def init(key):
+        ks = jax.random.split(key, depth + 1)
+        params = {
+            f"w{i}": 0.1 * jax.random.normal(
+                ks[i], (3, 3, channels if i else 3, channels), jnp.float32
+            )
+            for i in range(depth)
+        }
+        params["head"] = 0.1 * jax.random.normal(ks[-1], (channels, 10))
+        return params
+
+    def loss_fn(params, x, y):
+        h = x
+        for i in range(depth):
+            h = jax.nn.relu(
+                jax.lax.conv_general_dilated(
+                    h, params[f"w{i}"], (1, 1), "SAME",
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                )
+            )
+        logits = h.mean(axis=(1, 2)) @ params["head"]
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()
+
+    def step(params, x, y):
+        l, g = jax.value_and_grad(loss_fn)(params, x, y)
+        return jax.tree_util.tree_map(lambda p, gi: p - 0.01 * gi, params, g), l
+
+    return init, step
+
+
+def probe_fault_a(batches, k: int = 8) -> bool:
+    """Scan k steps with a counter in the carry; fetch the counter after
+    the call. Returns True if the fault reproduced at any batch."""
+    import jax
+    import jax.numpy as jnp
+
+    init, step = _conv_step()
+    hit = False
+    for batch in batches:
+        @jax.jit
+        def scan_k(params, x, y):
+            def body(carry, _):
+                params, count = carry
+                params, l = step(params, x, y)
+                return (params, count + 1), l
+
+            (params, count), losses = jax.lax.scan(
+                body, (params, jnp.zeros((), jnp.int32)), None, length=k
+            )
+            return params, count, losses
+
+        params = init(jax.random.PRNGKey(0))
+        r = np.random.RandomState(0)
+        x = jnp.asarray(r.randn(batch, 32, 32, 3), jnp.float32)
+        y = jnp.asarray(r.randint(0, 10, batch), jnp.int32)
+        t0 = time.perf_counter()
+        params, count, losses = scan_k(params, x, y)
+        # fetch AFTER the call: a no-op scan cannot fake this
+        count_v = int(np.asarray(count))
+        last_loss = float(np.asarray(losses)[-1])
+        dt = time.perf_counter() - t0
+        ok = bool(count_v == k and np.isfinite(last_loss))
+        hit = hit or not ok
+        print(json.dumps({
+            "fault": "a", "batch": batch, "scan_len": k,
+            "counter": count_v, "expected": k,
+            "last_loss": last_loss, "wall_s": round(dt, 3),
+            "executed": ok,
+        }), flush=True)
+    return hit
+
+
+def probe_fault_b(batch: int = 256, trials: int = 4) -> bool:
+    """Time block_until_ready before and after an AOT cost_analysis call
+    on the same jitted function; compare with the true fetch time."""
+    import jax
+    import jax.numpy as jnp
+
+    init, step = _conv_step(channels=128, depth=4)
+    jstep = jax.jit(step)
+    params = init(jax.random.PRNGKey(0))
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.randn(batch, 32, 32, 3), jnp.float32)
+    y = jnp.asarray(r.randint(0, 10, batch), jnp.int32)
+
+    def timed(tag):
+        rows = []
+        for t in range(trials):
+            t0 = time.perf_counter()
+            p2, l = jstep(params, x, y)
+            jax.block_until_ready(l)
+            t_block = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            lv = float(np.asarray(l))
+            t_fetch = time.perf_counter() - t1
+            rows.append((t_block, t_fetch, lv))
+        t_block = float(np.median([r0 for r0, _, _ in rows]))
+        t_fetch = float(np.median([r1 for _, r1, _ in rows]))
+        print(json.dumps({
+            "fault": "b", "phase": tag, "batch": batch,
+            "block_ms": round(1000 * t_block, 2),
+            "post_block_fetch_ms": round(1000 * t_fetch, 2),
+            "loss": rows[-1][2],
+        }), flush=True)
+        return t_block, t_fetch
+
+    jstep(params, x, y)  # warmup compile
+    pre_block, pre_fetch = timed("before_cost_analysis")
+
+    t0 = time.perf_counter()
+    ca = jstep.lower(params, x, y).compile().cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    print(json.dumps({
+        "fault": "b", "phase": "cost_analysis",
+        "flops": float(ca.get("flops", 0.0)),
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }), flush=True)
+
+    post_block, post_fetch = timed("after_cost_analysis")
+    # fault signature: block time collapses while the post-block fetch
+    # (which must wait for the real result) inflates to cover the work
+    hit = post_block < 0.5 * pre_block and post_fetch > 4 * pre_fetch
+    return hit
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--fault", choices=["a", "b", "both"], default="both")
+    ap.add_argument("--batches", default="128,256,512,1024")
+    ap.add_argument("--scan-len", type=int, default=8)
+    args = ap.parse_args()
+
+    import jax
+
+    dev = jax.devices()[0]
+    print(json.dumps({
+        "platform": dev.platform, "device_kind": dev.device_kind,
+        "jax": jax.__version__,
+    }), flush=True)
+
+    rc = 0
+    if args.fault in ("a", "both"):
+        batches = [int(b) for b in args.batches.split(",")]
+        hit = probe_fault_a(batches, k=args.scan_len)
+        print(json.dumps({"fault": "a", "verdict": "REPRODUCED" if hit else "not reproduced"}), flush=True)
+        rc |= int(hit)
+    if args.fault in ("b", "both"):
+        hit = probe_fault_b()
+        print(json.dumps({"fault": "b", "verdict": "REPRODUCED" if hit else "not reproduced"}), flush=True)
+        rc |= int(hit) << 1
+    return 0  # informational: exit code stays 0 so CI can run it
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
