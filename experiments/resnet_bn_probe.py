@@ -41,13 +41,28 @@ from theanompi_tpu.utils.flops import compiled_flops, peak_flops
 STEPS = 8
 
 
-def patched_apply(fast_stats: bool, bf16_norm: bool):
+def patched_apply(fast_stats: bool, bf16_norm: bool, variadic: bool = False):
     """Build a BatchNorm.apply variant; closure over the flags."""
 
     def apply(self, params, state, x, *, train=False, rng=None):
         reduce_axes = tuple(range(x.ndim - 1))
         if train:
-            if fast_stats:
+            if variadic:
+                # ONE pass for both moments: the profiler shows 104
+                # convert_reduce fusions/step = 2 separate reduces per
+                # BN, each re-reading the activation from HBM; a
+                # variadic lax.reduce computes (sum x, sum x^2) in a
+                # single sweep
+                xf = x.astype(jnp.float32)
+                n = 1
+                for a in reduce_axes:
+                    n *= x.shape[a]
+                s, s2 = lax.reduce(
+                    (xf, xf * xf), (jnp.float32(0), jnp.float32(0)),
+                    lambda a, b: (a[0] + b[0], a[1] + b[1]), reduce_axes
+                )
+                mean, mean_sq = s / n, s2 / n
+            elif fast_stats:
                 mean = jnp.mean(x, axis=reduce_axes, dtype=jnp.float32)
                 mean_sq = jnp.mean(
                     jnp.square(x.astype(jnp.float32)), axis=reduce_axes
@@ -80,9 +95,10 @@ def patched_apply(fast_stats: bool, bf16_norm: bool):
     return apply
 
 
-def measure(batch: int, fast_stats: bool, bf16_norm: bool) -> dict:
+def measure(batch: int, fast_stats: bool, bf16_norm: bool,
+            variadic: bool = False) -> dict:
     orig = nn.BatchNorm.apply
-    nn.BatchNorm.apply = patched_apply(fast_stats, bf16_norm)
+    nn.BatchNorm.apply = patched_apply(fast_stats, bf16_norm, variadic)
     try:
         model = ResNet50(ResNet50.default_recipe().replace(batch_size=batch))
         single = jax.jit(make_train_step(model))
@@ -107,6 +123,7 @@ def measure(batch: int, fast_stats: bool, bf16_norm: bool) -> dict:
         mfu = (flops * STEPS / best / peak) if (flops and peak) else None
         return {
             "batch": batch, "fast_stats": fast_stats, "bf16_norm": bf16_norm,
+            "variadic": variadic,
             "img_s": round(img_s, 1), "step_ms": round(1000 * best / STEPS, 2),
             "mfu": round(mfu, 4) if mfu else None,
         }
@@ -117,14 +134,16 @@ def measure(batch: int, fast_stats: bool, bf16_norm: bool) -> dict:
 def main():
     dev = jax.devices()[0]
     rows = {}
-    for name, (batch, fast, bnorm) in {
-        "baseline": (256, False, False),
-        "dtype_reduce": (256, True, False),
-        "bf16_norm": (256, True, True),
-        "batch512": (512, False, False),
-        "combo512": (512, True, True),
+    for name, (batch, fast, bnorm, var) in {
+        "baseline": (256, False, False, False),
+        "dtype_reduce": (256, True, False, False),
+        "bf16_norm": (256, True, True, False),
+        "batch512": (512, False, False, False),
+        "combo512": (512, True, True, False),
+        "variadic": (256, False, False, True),
+        "variadic_bf16norm": (256, False, True, True),
     }.items():
-        rows[name] = measure(batch, fast, bnorm)
+        rows[name] = measure(batch, fast, bnorm, var)
         print(json.dumps({name: rows[name]}), flush=True)
     out = {
         "device": dev.device_kind, "steps": STEPS, "variants": rows,

@@ -21,7 +21,9 @@ pytestmark = pytest.mark.slow
 _TINY = [
     "--dataset", "synthetic",
     "--dataset-arg", "n_train=32",
-    "--dataset-arg", "n_val=16",
+    # n_val must cover EASGD's 8x4=32 global val batch: the driver now
+    # REFUSES configs whose val loop would silently run zero batches
+    "--dataset-arg", "n_val=32",
     "--epochs", "1",
     "--print-freq", "0",
 ]

@@ -90,6 +90,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="also emit TensorBoard scalars under <save-dir>/tb "
                         "(soft dependency on tensorboardX)")
     p.add_argument("--ckpt-dir", default=None)
+    p.add_argument("--ckpt-sharded", action="store_true",
+                   help="per-host sharded checkpoints (each controller "
+                        "writes only its shards — no cross-host gather or "
+                        "rank-0 memory spike; restore works under any "
+                        "process count)")
     p.add_argument("--resume", action="store_true")
     p.add_argument("--print-freq", type=int, default=40)
     p.add_argument("--profile-dir", default=None,
@@ -245,6 +250,7 @@ def main(argv=None) -> int:
         seed=args.seed,
         save_dir=args.save_dir,
         ckpt_dir=args.ckpt_dir,
+        sharded_ckpt=args.ckpt_sharded,
         resume=args.resume,
         print_freq=args.print_freq,
         tensorboard=args.tensorboard,

@@ -117,6 +117,22 @@ class Synthetic_data(Dataset):
         self.x_val, self.y_val = make(n_val, 2)
 
 
+def crop_mirror_augment(
+    x: np.ndarray, rng: np.random.RandomState, pad: int = 4
+) -> np.ndarray:
+    """Vectorized random crop from ``pad``-px reflect padding + mirror —
+    the WRN/CIFAR recipe's train augmentation (reference:
+    ``models/data/utils.py`` crop/mirror funcs)."""
+    n, h, w, _ = x.shape
+    padded = np.pad(x, [(0, 0), (pad, pad), (pad, pad), (0, 0)], mode="reflect")
+    offs = rng.randint(0, 2 * pad + 1, size=(n, 2))
+    flips = rng.rand(n) < 0.5
+    rows = offs[:, 0, None] + np.arange(h)  # (n, h)
+    cols = offs[:, 1, None] + np.arange(w)  # (n, w)
+    cols = np.where(flips[:, None], cols[:, ::-1], cols)
+    return padded[np.arange(n)[:, None, None], rows[:, :, None], cols[:, None, :]]
+
+
 class Cifar10_data(Dataset):
     """Real CIFAR-10 from the standard python-pickle batches.
 
@@ -180,15 +196,7 @@ class Cifar10_data(Dataset):
         )
 
     def augment(self, x: np.ndarray, rng: np.random.RandomState) -> np.ndarray:
-        # vectorized random 32x32 crop from 4-px reflect pad + mirror
-        n, h, w, _ = x.shape
-        padded = np.pad(x, [(0, 0), (4, 4), (4, 4), (0, 0)], mode="reflect")
-        offs = rng.randint(0, 9, size=(n, 2))
-        flips = rng.rand(n) < 0.5
-        rows = offs[:, 0, None] + np.arange(h)  # (n, h)
-        cols = offs[:, 1, None] + np.arange(w)  # (n, w)
-        cols = np.where(flips[:, None], cols[:, ::-1], cols)
-        return padded[np.arange(n)[:, None, None], rows[:, :, None], cols[:, None, :]]
+        return crop_mirror_augment(x, rng)
 
 
 class Digits_data(Dataset):
@@ -207,7 +215,20 @@ class Digits_data(Dataset):
 
     name = "digits"
 
-    def __init__(self, size: int = 16, val_frac: float = 0.2, seed: int = 0):
+    def __init__(
+        self,
+        size: int = 16,
+        val_frac: float = 0.2,
+        seed: int = 0,
+        augment_crop: bool = False,
+        ten_crop_val: bool = False,
+    ):
+        """``augment_crop``: the WRN/CIFAR recipe's train augmentation
+        (random crop from 4-px reflect pad + mirror). ``ten_crop_val``:
+        the AlexNet-era 10-crop val protocol (4 corners + center of a
+        2-px reflect-padded image, each mirrored; the eval step averages
+        logits over views) — together these exercise the FULL model-zoo
+        recipe path on real data with zero downloads."""
         try:
             from sklearn.datasets import load_digits
         except ImportError as e:
@@ -237,6 +258,37 @@ class Digits_data(Dataset):
         std = self.x_train.std() + 1e-7
         self.x_train = (self.x_train - mean) / std
         self.x_val = (self.x_val - mean) / std
+        self.augment_crop = augment_crop
+        self.val_views = 10 if ten_crop_val else 1
+
+    def augment(self, x: np.ndarray, rng: np.random.RandomState) -> np.ndarray:
+        return crop_mirror_augment(x, rng) if self.augment_crop else x
+
+    def val_epoch(
+        self, batch_size: int, part: Optional[slice] = None
+    ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        if self.val_views == 1:
+            yield from super().val_epoch(batch_size, part=part)
+            return
+        # 10-crop: view-major rows per image (driver ships views x batch
+        # image rows against batch label rows; eval averages over views)
+        s = self.image_shape[0]
+        for i in range(self.n_val_batches(batch_size)):
+            sl = slice(i * batch_size, (i + 1) * batch_size)
+            x, y = self.x_val[sl], self.y_val[sl]
+            if part is not None:
+                x, y = x[part], y[part]
+            padded = np.pad(x, [(0, 0), (2, 2), (2, 2), (0, 0)], mode="reflect")
+            h = padded.shape[1]
+            oys = [0, 0, h - s, h - s, (h - s) // 2]
+            oxs = [0, h - s, 0, h - s, (h - s) // 2]
+            views = []
+            for oy, ox in zip(oys, oxs):
+                v = padded[:, oy : oy + s, ox : ox + s]
+                views.append(v)
+                views.append(v[:, :, ::-1])
+            out = np.stack(views, axis=1).reshape(-1, s, s, x.shape[-1])
+            yield np.ascontiguousarray(out), y
 
 
 _REGISTRY = {

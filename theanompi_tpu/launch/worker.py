@@ -36,7 +36,7 @@ from theanompi_tpu.utils import (
     load_checkpoint,
     save_checkpoint,
 )
-from theanompi_tpu.utils.checkpoint import AsyncCheckpointer
+from theanompi_tpu.utils.checkpoint import AsyncCheckpointer, save_checkpoint_sharded
 
 
 def run_training(
@@ -66,8 +66,10 @@ def run_training(
     ckpt_dir: Optional[str] = None,
     ckpt_every_epochs: int = 1,
     async_checkpoint: bool = True,
+    sharded_ckpt: bool = False,
     resume: bool = False,
     print_freq: int = 40,
+    run_name: Optional[str] = None,
     tensorboard: bool = False,
     prefetch_depth: int = 2,
     return_recorder: bool = False,
@@ -311,6 +313,15 @@ def run_training(
             raise ValueError(f"global batch {batch} not divisible by {n_dev} devices")
         if vbatch % n_dev:
             raise ValueError(f"val batch {vbatch} not divisible by {n_dev} devices")
+    if data.n_val and vbatch > data.n_val:
+        # n_val_batches() would be 0: the val loop would yield NOTHING
+        # and summary['val'] silently never set (this exact failure
+        # shipped in an early n=64 experiment run)
+        raise ValueError(
+            f"val batch {vbatch} exceeds the dataset's {data.n_val} val "
+            "examples — validation would silently run zero batches "
+            "(set recipe val_batch_size or enlarge the val split)"
+        )
 
     # Device-side normalization (dataset opt-in): the loader ships
     # compact uint8 batches and (x - mean) * scale fuses into the
@@ -385,7 +396,10 @@ def run_training(
         # files are written by the rank-0 controller only (reference:
         # rank-0 recorder save); console prints keep their rank prefix
         save_dir=save_dir if jax.process_index() == 0 else None,
-        run_name=f"{model.name}_{rule}",
+        # run_name override: committed experiments name artifacts after
+        # the EXPERIMENT, not the model class (round-3 weak item 6:
+        # results/digits_bsp/ held files named cifar10_bsp.jsonl)
+        run_name=run_name or f"{model.name}_{rule}",
         tensorboard=tensorboard,
     )
     if profile_dir and jax.process_index() == 0:
@@ -396,6 +410,7 @@ def run_training(
     rng = jax.random.PRNGKey(seed)
     state = engine.init_state(rng)
     start_epoch = 0
+    summary_resumed_from = None
     if resume and ckpt_dir:
         path = latest_checkpoint(ckpt_dir)
         if n_proc > 1:
@@ -429,6 +444,7 @@ def run_training(
                 # pre-rbg-default threefry checkpoint keeps resuming
                 rng = saved_rng
             start_epoch = engine.get_step(state) // steps_per_epoch
+            summary_resumed_from = engine.get_step(state)
             print(f"resumed from {path} at step {engine.get_step(state)}", flush=True)
 
     if hasattr(engine, "place_batch"):
@@ -464,8 +480,15 @@ def run_training(
         if buf:  # epoch remainder: a smaller fused program (cached)
             yield buf
 
-    summary: dict = {"epochs": [], "rule": rule, "model": model.name}
-    ckpt_writer = AsyncCheckpointer() if (ckpt_dir and async_checkpoint) else None
+    summary: dict = {"epochs": [], "rule": rule, "model": model.name,
+                     "resumed_from_step": summary_resumed_from}
+    # sharded_ckpt: per-host shard files, no cross-host gather / rank-0
+    # memory spike; restorable under any process count (SURVEY.md §5.4)
+    ckpt_writer = (
+        AsyncCheckpointer(sharded=sharded_ckpt)
+        if (ckpt_dir and async_checkpoint) else None
+    )
+    sync_save = save_checkpoint_sharded if sharded_ckpt else save_checkpoint
     step_count = engine.get_step(state)
     # Mid-epoch resume (checkpoint written after a max_steps truncation):
     # fast-forward past the batches the restored step count already
@@ -589,7 +612,7 @@ def run_training(
                     # finally below before the summary returns)
                     ckpt_writer.save(ckpt_dir, state, step_count, rng=rng)
                 else:
-                    save_checkpoint(ckpt_dir, state, step_count, rng=rng)
+                    sync_save(ckpt_dir, state, step_count, rng=rng)
             rec.save()
             summary["epochs"].append(epoch)
             if max_steps and step_count >= max_steps:

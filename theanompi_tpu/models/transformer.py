@@ -436,8 +436,9 @@ def nd_spec_setup(
         ntp = sizes[tp_axis]
         if model.n_heads % ntp or model.d_ff % ntp or model.vocab % ntp:
             raise ValueError(
+                f"the {tp_axis!r} axis size {ntp} must divide each of "
                 f"n_heads/d_ff/vocab ({model.n_heads}/{model.d_ff}/"
-                f"{model.vocab}) must divide the {tp_axis!r} axis size {ntp}"
+                f"{model.vocab})"
             )
     validate_ulysses_heads(
         model, sp_axis, sizes, model.n_heads // (sizes[tp_axis] if tp_axis else 1)

@@ -301,6 +301,31 @@ class BatchNorm(Layer):
     ``axis_name``: if set and the layer runs inside a mapped axis
     (``shard_map``/``pmap``), batch stats are averaged across replicas
     with ``lax.pmean`` — cross-replica BN for small per-device batches.
+
+    Performance note (round-4 probe, experiments/resnet_bn_probe.py, TPU
+    v5e, ResNet-50 batch 256, 8-step fused runs): the BN statistic
+    sweeps are ~51% of the train step (op_profile: 104
+    ``convert_reduce_fusion``s ≈ one fused two-moment pass per BN per
+    direction), and they are already near bandwidth-optimal — ~7 GB of
+    activation re-reads/step at an effective ~700 GB/s. Measured and
+    REJECTED alternatives:
+
+    - ``dtype=f32`` reduction args instead of an explicit upcast:
+      2370.7 vs 2370.4 img/s — XLA already fuses the convert (no-op).
+    - variadic ``lax.reduce`` computing (Σx, Σx²) in one declared pass:
+      334.9 img/s, 7.1x SLOWER — XLA:TPU lowers generic variadic
+      reduce as scalar code; the moments were already sibling-fused.
+    - batch 512: 2343 img/s (-1%) — the sweeps scale with the batch.
+
+    ADOPTED: normalize sweep computed in bf16 when x is bf16 (scale/
+    offset still derived in fp32): 2403 vs 2370 img/s (+1.4%), MFU
+    0.2905. The residual gap to MXU-bound MFU is the cost of two-pass
+    BN itself — removing it needs stats fused into the producer conv's
+    epilogue, which XLA does not expose; a Pallas conv is not worth
+    losing the MXU conv emitters for (the LRN matmul precedent,
+    measured at theanompi_tpu/nn/layers.py LRN, does not transfer:
+    LRN replaced a bandwidth-bound op with a matmul, BN's reduce IS
+    already minimal traffic).
     """
 
     def __init__(
@@ -342,6 +367,14 @@ class BatchNorm(Layer):
             mean, var = state["mean"], state["var"]
             new_state = state
         inv = lax.rsqrt(var + self.eps) * params["scale"]
+        if x.dtype == jnp.bfloat16:
+            # bf16 normalize sweep (+1.4% measured, docstring table):
+            # per-channel constants derived in fp32, the big elementwise
+            # pass reads/writes bf16 only
+            y = (x - mean.astype(x.dtype)) * inv.astype(x.dtype) + params[
+                "bias"
+            ].astype(x.dtype)
+            return y, new_state
         y = (x.astype(jnp.float32) - mean) * inv + params["bias"]
         return y.astype(x.dtype), new_state
 

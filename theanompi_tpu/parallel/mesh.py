@@ -173,15 +173,18 @@ def make_worker_group_mesh(mesh: Mesh, group_size: int,
     n_dev = len(devs)
     if n_dev % g:
         raise ValueError(f"{n_dev} devices do not divide into groups of {g}")
+    if n_slices is not None and n_slices > 1 and n_dev % n_slices:
+        # validate the slice count even for ungrouped workers (g == 1),
+        # so `tmpi EASGD --slices 3` fails like BSP's multislice path
+        # does instead of silently ignoring the topology claim
+        raise ValueError(
+            f"{n_dev} devices do not divide into {n_slices} slices"
+        )
     if g == 1:
         return mesh, None, None
     devs = _slice_major(devs)
     slice_ids = [getattr(d, "slice_index", 0) for d in devs]
     if n_slices is not None and n_slices > 1:
-        if n_dev % n_slices:
-            raise ValueError(
-                f"{n_dev} devices do not divide into {n_slices} slices"
-            )
         per_slice = n_dev // n_slices
         if len(set(slice_ids)) <= 1:
             # no (or uniform) hardware metadata: impose virtual slice ids

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 PyTree = Any
 
 _CKPT_RE = re.compile(r"ckpt_(\d+)\.npz$")
+_SHARD_RE = re.compile(r"ckpt_(\d+)\.proc(\d+)of(\d+)\.npz$")
 
 
 def _pull_to_host(leaf) -> np.ndarray:
@@ -104,6 +105,7 @@ def save_checkpoint(
             os.unlink(tmp)
         raise
     _prune(directory, keep)
+    _prune_sharded(directory, keep)  # a dir toggled from --ckpt-sharded
     return path
 
 
@@ -117,26 +119,235 @@ def _prune(directory: str, keep: int) -> None:
         os.unlink(os.path.join(directory, f))
 
 
+# --------------------------------------------------------------------------
+# per-host sharded checkpoints (SURVEY.md §5.4 "written per-host for
+# sharded arrays"; round-3 verdict item 8)
+# --------------------------------------------------------------------------
+
+
+def _norm_index(index, shape) -> tuple:
+    """Normalize a shard's index (tuple of slices) to ((start, stop), ...)."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start, stop, step = sl.indices(dim)
+        if step != 1:
+            raise ValueError(f"strided shard index {sl} unsupported")
+        out.append((start, stop))
+    return tuple(out)
+
+
+def save_checkpoint_sharded(
+    directory: str,
+    state: PyTree,
+    step: int,
+    rng: Optional[jax.Array] = None,
+    keep: int = 3,
+) -> Optional[str]:
+    """Per-host sharded save: each process writes ONLY the shards it
+    holds — no cross-host gather and no rank-0 host-memory spike, unlike
+    :func:`save_checkpoint` (which pulls every leaf to one host; fine at
+    138M params, a ceiling for ZeRO-sharded or pod-scale states).
+
+    Layout: ``ckpt_{step}.proc{k}of{n}.npz`` per process. Array keys are
+    ``{leafpath}::s{j}`` with a ``__meta__`` JSON entry recording, per
+    leaf, the global shape/dtype and each saved shard's index bounds.
+    Each unique shard is written by exactly ONE process (the
+    minimum-process owner, decided from ``global_shards`` metadata — no
+    communication). Restore (:func:`load_checkpoint`, which dispatches on
+    the filename) reassembles full arrays from the complete file set
+    under ANY process count — reshard-on-restore is the caller's normal
+    device_put. A set missing any of its n files is ignored by
+    :func:`latest_checkpoint` (atomicity without barriers: per-file
+    tmp+rename, completeness by counting).
+    """
+    import json as _json
+
+    n_proc = jax.process_count()
+    me = jax.process_index()
+    flat: dict[str, np.ndarray] = {}
+    meta: dict[str, Any] = {"leaves": {}, "step": int(step)}
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(state)[0]
+    for path, leaf in leaves_with_paths:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        if not isinstance(leaf, jax.Array):
+            if me == 0:  # host scalars/numpy: rank 0 records them whole
+                arr = np.asarray(leaf)
+                flat[f"{key}::s0"] = arr
+                meta["leaves"][key] = {
+                    "shape": list(arr.shape), "dtype": str(arr.dtype),
+                    "shards": [{"bounds": [[0, d] for d in arr.shape], "file": 0}],
+                }
+            continue
+        shape = leaf.shape
+        # owner = minimum process holding each unique shard index
+        owners: dict[tuple, int] = {}
+        for sh in leaf.global_shards:
+            b = _norm_index(sh.index, shape)
+            p = sh.device.process_index
+            owners[b] = min(owners.get(b, p), p)
+        entry = {"shape": list(shape), "dtype": str(leaf.dtype), "shards": []}
+        mine = {}
+        for sh in leaf.addressable_shards:
+            b = _norm_index(sh.index, shape)
+            if owners[b] == me and b not in mine:
+                mine[b] = np.asarray(sh.data)
+        for j, (b, arr) in enumerate(sorted(mine.items())):
+            flat[f"{key}::s{len(entry['shards'])}"] = arr
+            entry["shards"].append({"bounds": [list(x) for x in b], "file": me})
+        # every process records the SAME leaf catalogue structure for its
+        # own shards only; load merges catalogues across files
+        meta["leaves"][key] = entry
+    if rng is not None and me == 0:
+        if jnp.issubdtype(getattr(rng, "dtype", None), jax.dtypes.prng_key):
+            meta["rng_impl"] = str(jax.random.key_impl(rng))
+            flat["__rng__"] = np.asarray(jax.device_get(jax.random.key_data(rng)))
+        else:
+            raw = np.asarray(jax.device_get(rng))
+            impl = jax.config.jax_default_prng_impl
+            width = raw.shape[-1] if raw.ndim else None
+            if width != _KEY_WIDTH_BY_IMPL.get(impl):
+                impl = _KEY_IMPL_BY_WIDTH.get(width)
+            meta["rng_impl"] = impl
+            flat["__rng__"] = raw
+    flat["__meta__"] = np.asarray(_json.dumps(meta))
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"ckpt_{step}.proc{me}of{n_proc}.npz")
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **flat)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    _prune_sharded(directory, keep)
+    if jax.process_index() == 0:
+        _prune(directory, keep)  # a dir toggled from single-file saves
+    return path
+
+
+def _sharded_sets(directory: str) -> dict[int, list[str]]:
+    """step -> sorted COMPLETE file sets (all n present); incomplete
+    sets (a host died mid-save) are excluded."""
+    by_step: dict[int, dict[int, tuple[int, str]]] = {}
+    for f in os.listdir(directory):
+        if m := _SHARD_RE.search(f):
+            step, k, n = int(m.group(1)), int(m.group(2)), int(m.group(3))
+            by_step.setdefault(step, {})[k] = (n, f)
+    out = {}
+    for step, files in by_step.items():
+        n = next(iter(files.values()))[0]
+        if len(files) == n and all(v[0] == n for v in files.values()):
+            out[step] = [
+                os.path.join(directory, files[k][1]) for k in range(n)
+            ]
+    return out
+
+
+def _prune_sharded(directory: str, keep: int) -> None:
+    if not keep:
+        return
+    sets = _sharded_sets(directory)
+    for step in sorted(sets)[:-keep]:
+        for f in sets[step]:
+            try:
+                os.unlink(f)
+            except FileNotFoundError:
+                pass
+
+
+def _load_sharded(path: str, state_template: PyTree):
+    """Reassemble a sharded set from its proc-0 member path."""
+    import json as _json
+
+    m = _SHARD_RE.search(os.path.basename(path))
+    if not m:
+        raise ValueError(f"{path!r} is not a sharded checkpoint member")
+    directory = os.path.dirname(path) or "."
+    step = int(m.group(1))
+    files = _sharded_sets(directory).get(step)
+    if files is None:
+        raise FileNotFoundError(
+            f"sharded checkpoint set for step {step} in {directory} is "
+            "incomplete (a host's file is missing)"
+        )
+    datas = [np.load(f) for f in files]
+    metas = [_json.loads(str(d["__meta__"])) for d in datas]
+    # merged catalogue: leaf -> (shape, dtype, [(bounds, file_idx, key)])
+    catalogue: dict[str, Any] = {}
+    for fi, meta in enumerate(metas):
+        for key, entry in meta["leaves"].items():
+            cat = catalogue.setdefault(
+                key, {"shape": tuple(entry["shape"]), "dtype": entry["dtype"],
+                      "pieces": []}
+            )
+            for j, sh in enumerate(entry["shards"]):
+                cat["pieces"].append((sh["bounds"], fi, f"{key}::s{j}"))
+    rng = None
+    if "__rng__" in datas[0].files:
+        rng = wrap_saved_rng(datas[0]["__rng__"], impl=metas[0].get("rng_impl"))
+
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(state_template)
+    new_leaves = []
+    for p, leaf in leaves_with_paths:
+        key = "/".join(str(getattr(q, "key", getattr(q, "idx", q))) for q in p)
+        if key not in catalogue:
+            raise KeyError(
+                f"sharded checkpoint step {step} is missing {key!r} — "
+                f"structure mismatch (available: {sorted(catalogue)[:8]}...)"
+            )
+        cat = catalogue[key]
+        want_shape = tuple(getattr(leaf, "shape", np.shape(leaf)))
+        want_dtype = getattr(leaf, "dtype", None) or np.result_type(leaf)
+        if cat["shape"] != want_shape:
+            raise ValueError(
+                f"checkpoint leaf {key!r} has shape {cat['shape']}, "
+                f"expected {want_shape}"
+            )
+        full = np.empty(cat["shape"], dtype=cat["dtype"])
+        filled = 0
+        for bounds, fi, akey in cat["pieces"]:
+            sl = tuple(slice(b[0], b[1]) for b in bounds)
+            piece = datas[fi][akey]
+            full[sl] = piece
+            filled += piece.size
+        if filled < full.size:
+            raise ValueError(
+                f"checkpoint leaf {key!r}: shards cover {filled} of "
+                f"{full.size} elements — incomplete save"
+            )
+        new_leaves.append(full.astype(want_dtype))
+    return jax.tree_util.tree_unflatten(treedef, new_leaves), rng
+
+
 def checkpoint_step(path: Optional[str]) -> int:
     """The step number encoded in a checkpoint filename; -1 for None
     (used to compare resume decisions across controller processes)."""
     if path is None:
         return -1
-    m = _CKPT_RE.search(os.path.basename(path))
+    base = os.path.basename(path)
+    m = _SHARD_RE.search(base) or _CKPT_RE.search(base)
     if not m:
         raise ValueError(f"{path!r} is not a checkpoint path")
     return int(m.group(1))
 
 
 def latest_checkpoint(directory: str) -> Optional[str]:
+    """Newest restorable checkpoint: single-file ``ckpt_N.npz`` or a
+    COMPLETE per-host sharded set (returned as its proc-0 member path;
+    ``load_checkpoint`` dispatches on the name)."""
     if not os.path.isdir(directory):
         return None
-    ckpts = sorted(
-        (int(m.group(1)), f)
-        for f in os.listdir(directory)
-        if (m := _CKPT_RE.search(f))
-    )
-    return os.path.join(directory, ckpts[-1][1]) if ckpts else None
+    best_step, best_path = -1, None
+    for f in os.listdir(directory):
+        if m := _CKPT_RE.search(f):
+            if int(m.group(1)) > best_step:
+                best_step, best_path = int(m.group(1)), os.path.join(directory, f)
+    for step, files in _sharded_sets(directory).items():
+        if step > best_step:
+            best_step, best_path = step, files[0]
+    return best_path
 
 
 def load_checkpoint(
@@ -151,7 +362,14 @@ def load_checkpoint(
     A structure mismatch (renamed layer, different optimizer) raises
     KeyError naming the missing entry, rather than silently reinitializing
     — resume must be exact or explicit.
+
+    Dispatches on the filename: per-host sharded sets
+    (``ckpt_N.procKofM.npz``, :func:`save_checkpoint_sharded`) are
+    reassembled from ALL member files — restorable under any process
+    count.
     """
+    if _SHARD_RE.search(os.path.basename(path)):
+        return _load_sharded(path, state_template)
     data = np.load(path)
     rng = None
     if "__rng__" in data.files:
@@ -219,11 +437,16 @@ class AsyncCheckpointer:
     deadlock). Such saves transparently run synchronously instead.
     """
 
-    def __init__(self):
+    def __init__(self, sharded: bool = False):
         from concurrent.futures import ThreadPoolExecutor
 
         self._pool = ThreadPoolExecutor(1, thread_name_prefix="tmpi-ckpt")
         self._pending = None
+        # per-host sharded writes touch only ADDRESSABLE shards, so they
+        # are collective-free and async-safe even in multi-host runs —
+        # the gather-to-rank-0 sync fallback below applies to the
+        # single-file format only
+        self._sharded = bool(sharded)
 
     def save(
         self,
@@ -234,14 +457,16 @@ class AsyncCheckpointer:
         keep: int = 3,
     ) -> None:
         self.wait()
-        leaves = jax.tree_util.tree_leaves(state)
-        if any(
-            isinstance(l, jax.Array) and not l.is_fully_addressable
-            for l in leaves
-        ):
-            # cross-host gather required -> synchronous, on this thread
-            save_checkpoint(directory, state, step, rng=rng, keep=keep)
-            return
+        save_fn = save_checkpoint_sharded if self._sharded else save_checkpoint
+        if not self._sharded:
+            leaves = jax.tree_util.tree_leaves(state)
+            if any(
+                isinstance(l, jax.Array) and not l.is_fully_addressable
+                for l in leaves
+            ):
+                # cross-host gather required -> synchronous, on this thread
+                save_checkpoint(directory, state, step, rng=rng, keep=keep)
+                return
 
         def snap(leaf):
             # new device buffer: immune to donation of the original
@@ -251,7 +476,7 @@ class AsyncCheckpointer:
         if rng is not None:
             rng = snap(rng)
         self._pending = self._pool.submit(
-            save_checkpoint, directory, state, step, rng, keep
+            save_fn, directory, state, step, rng, keep
         )
 
     def wait(self) -> None:

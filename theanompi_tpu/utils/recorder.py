@@ -161,11 +161,13 @@ class Recorder:
         self.history["val"].append(rec)
         self._emit("val", rec)
         loss = rec.get("loss", float("nan"))
-        err = rec.get("error", float("nan"))
-        top5 = rec.get("top5_error")
-        msg = f"[rank {self.rank}] epoch {epoch} val: loss={loss:.4f} err={err:.4f}"
-        if top5 is not None:
-            msg += f" top5_err={top5:.4f}"
+        msg = f"[rank {self.rank}] epoch {epoch} val: loss={loss:.4f}"
+        # print only the metrics the engine produced (LM engines report
+        # loss only; classifiers add error/top5)
+        if "error" in rec:
+            msg += f" err={rec['error']:.4f}"
+        if "top5_error" in rec:
+            msg += f" top5_err={rec['top5_error']:.4f}"
         print(msg, flush=True)
 
     # -- epoch accounting ----------------------------------------------------
