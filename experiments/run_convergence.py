@@ -160,7 +160,7 @@ def exp_wrn() -> list[dict]:
     6): WRN-16-4 on digits with the WRN recipe's augmentation (random
     crop from reflect pad + mirror), step-decay LR schedule, 10-crop
     multi-view validation, and a checkpointed MID-RUN resume — phase 1
-    stops at step 40 of 100, phase 2 resumes from its checkpoint and
+    stops at step 44 of 110, phase 2 resumes from its checkpoint and
     completes. Converged = final 10-crop val error <= 8%."""
     os.makedirs(RESULTS, exist_ok=True)
     ck = os.path.join(RESULTS, "wrn_digits_ckpt")
@@ -204,9 +204,9 @@ def exp_rules_scale() -> list[dict]:
     """Async-rule convergence at n=32 and n=64 workers (round-3 verdict
     item 7): the gang-scheduled EASGD/GoSGD redesigns' documented law
     divergence is most at risk at high worker counts (BASELINE config #5
-    is 64 workers). Same synthetic task as exp_rules, per-worker batch 8,
-    same per-worker batch 16 / lr / 320-step budget as the committed
-    n=8 curves (exp_rules), so the trend vs n is directly comparable;
+    is 64 workers). Same synthetic task, per-worker batch 16, lr, and
+    320-step budget as the committed n=8 curves (exp_rules), so the
+    trend vs n is directly comparable;
     BSP at the same global images/step is the reference point."""
     os.makedirs(RESULTS, exist_ok=True)
     runs = []

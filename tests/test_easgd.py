@@ -7,19 +7,19 @@ import jax.numpy as jnp
 import numpy as np
 
 from theanompi_tpu.data import get_dataset
-from theanompi_tpu.models.model_zoo.wrn import WRN_16_4
 from theanompi_tpu.parallel.easgd import EASGDEngine
 from theanompi_tpu.parallel.mesh import put_global_batch
+from tinymodel import TinyCNN
 
 
 def _model(batch=64):
-    recipe = WRN_16_4.default_recipe().replace(
+    recipe = TinyCNN.default_recipe().replace(
         batch_size=batch,
         dataset="synthetic",
         input_shape=(16, 16, 3),
         sched_kwargs={"lr": 0.05, "boundaries": [10**9]},
     )
-    return WRN_16_4(recipe)
+    return TinyCNN(recipe)
 
 
 def _batch(model, n=64):
@@ -98,7 +98,7 @@ def test_easgd_via_run_training(tmp_path):
 
     summary = run_training(
         rule="easgd",
-        model_cls=WRN_16_4,
+        model_cls=TinyCNN,
         devices=8,
         n_epochs=2,
         avg_freq=2,

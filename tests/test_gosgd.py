@@ -7,19 +7,19 @@ import numpy as np
 import pytest
 
 from theanompi_tpu.data import get_dataset
-from theanompi_tpu.models.model_zoo.wrn import WRN_16_4
 from theanompi_tpu.parallel.gosgd import GOSGDEngine
 from theanompi_tpu.parallel.mesh import put_global_batch
+from tinymodel import TinyCNN
 
 
 def _model(batch=64, lr=0.05):
-    recipe = WRN_16_4.default_recipe().replace(
+    recipe = TinyCNN.default_recipe().replace(
         batch_size=batch,
         dataset="synthetic",
         input_shape=(16, 16, 3),
         sched_kwargs={"lr": lr, "boundaries": [10**9]},
     )
-    return WRN_16_4(recipe)
+    return TinyCNN(recipe)
 
 
 def _batch(model, n=64):
@@ -186,7 +186,7 @@ def test_gosgd_via_run_training():
 
     summary = run_training(
         rule="gosgd",
-        model_cls=WRN_16_4,
+        model_cls=TinyCNN,
         devices=8,
         n_epochs=2,
         p_push=0.5,
@@ -226,14 +226,14 @@ def test_gosgd_rule_kwargs_guard():
 
     with pytest.raises(ValueError, match="apply to EASGD/GoSGD"):
         run_training(
-            rule="bsp", model_cls=WRN_16_4, devices=8, avg_freq=4,
+            rule="bsp", model_cls=TinyCNN, devices=8, avg_freq=4,
             dataset="synthetic",
             dataset_kwargs={"n_train": 32, "n_val": 16, "image_shape": (16, 16, 3)},
             recipe_overrides={"batch_size": 32, "input_shape": (16, 16, 3)},
         )
     with pytest.raises(ValueError, match="BSP rule only"):
         run_training(
-            rule="gosgd", model_cls=WRN_16_4, devices=8, strategy="asa16",
+            rule="gosgd", model_cls=TinyCNN, devices=8, strategy="asa16",
             dataset="synthetic",
             dataset_kwargs={"n_train": 32, "n_val": 16, "image_shape": (16, 16, 3)},
             recipe_overrides={"batch_size": 32, "input_shape": (16, 16, 3)},

@@ -2,6 +2,7 @@
 (reference flow: SURVEY.md §3.1)."""
 
 import json
+import os
 
 import pytest
 
@@ -9,8 +10,10 @@ from theanompi_tpu import BSP
 from theanompi_tpu.cli import main as tmpi_main
 from theanompi_tpu.launch.session import resolve_model
 from theanompi_tpu.launch.worker import run_training
-from theanompi_tpu.models.model_zoo.wrn import WRN_16_4
+from tinymodel import TinyCNN
 
+
+_TINYMODEL_PY = os.path.join(os.path.dirname(__file__), "tinymodel.py")
 
 _TINY = dict(
     recipe_overrides={
@@ -27,7 +30,7 @@ _TINY = dict(
 def test_run_training_bsp_end_to_end(tmp_path):
     summary = run_training(
         rule="bsp",
-        model_cls=WRN_16_4,
+        model_cls=TinyCNN,
         devices=8,
         n_epochs=2,
         save_dir=str(tmp_path),
@@ -38,13 +41,13 @@ def test_run_training_bsp_end_to_end(tmp_path):
     assert summary["images_per_sec"] > 0
     assert "val" in summary and "error" in summary["val"]
     # recorder JSONL + checkpoint written
-    assert (tmp_path / "wrn_16_4_bsp.jsonl").exists()
+    assert (tmp_path / "tinycnn_bsp.jsonl").exists()
     assert any(f.name.startswith("ckpt_") for f in (tmp_path / "ckpt").iterdir())
 
 
 @pytest.mark.slow
 def test_run_training_resume(tmp_path):
-    kw = dict(rule="bsp", model_cls=WRN_16_4, devices=8, ckpt_dir=str(tmp_path / "c"), **_TINY)
+    kw = dict(rule="bsp", model_cls=TinyCNN, devices=8, ckpt_dir=str(tmp_path / "c"), **_TINY)
     run_training(n_epochs=1, **kw)
     summary = run_training(n_epochs=2, resume=True, **kw)
     assert summary["steps"] == 4  # resumed at 2, trained 2 more
@@ -54,10 +57,10 @@ def test_run_training_errors():
     with pytest.raises(ValueError, match="model_cls"):
         run_training(rule="bsp")
     with pytest.raises(ValueError, match="unknown rule"):
-        run_training(rule="fancy", model_cls=WRN_16_4, **_TINY)
+        run_training(rule="fancy", model_cls=TinyCNN, **_TINY)
     with pytest.raises(ValueError, match="not divisible"):
         run_training(
-            rule="bsp", model_cls=WRN_16_4, devices=8,
+            rule="bsp", model_cls=TinyCNN, devices=8,
             recipe_overrides={"batch_size": 12, "input_shape": (16, 16, 3)},
             dataset="synthetic", dataset_kwargs={"n_train": 24, "n_val": 12, "image_shape": (16, 16, 3)},
         )
@@ -67,8 +70,8 @@ def test_session_api_background_and_wait():
     rule = BSP()
     rule.init(
         devices=8,
-        modelfile="theanompi_tpu.models.model_zoo.wrn",
-        modelclass="WRN_16_4",
+        modelfile=_TINYMODEL_PY,
+        modelclass="TinyCNN",
         n_epochs=1,
         **_TINY,
     )
@@ -80,8 +83,8 @@ def test_session_api_background_and_wait():
     # runtime failure inside the background thread surfaces at wait()
     rule2 = BSP()
     rule2.init(
-        modelfile="theanompi_tpu.models.model_zoo.wrn",
-        modelclass="WRN_16_4",
+        modelfile=_TINYMODEL_PY,
+        modelclass="TinyCNN",
         dataset="no_such_dataset",
     )
     with pytest.raises(ValueError, match="unknown dataset"):
@@ -102,7 +105,7 @@ def test_tmpi_cli(tmp_path, capsys):
     rc = tmpi_main(
         [
             "BSP", "8",
-            "theanompi_tpu.models.model_zoo.wrn", "WRN_16_4",
+            _TINYMODEL_PY, "TinyCNN",
             "--synthetic", "--max-steps", "2", "--epochs", "1",
             "--batch-size", "32", "--print-freq", "0",
         ]
@@ -126,7 +129,7 @@ def test_profile_trace_capture(tmp_path):
     # 2 steps/epoch (64/32): the capture window [2, 4) spans epochs,
     # which profile_tick must handle (global step, not per-epoch)
     run_training(
-        rule="bsp", model_cls=WRN_16_4, max_steps=8, n_epochs=4,
+        rule="bsp", model_cls=TinyCNN, max_steps=8, n_epochs=4,
         profile_dir=str(prof), profile_steps=2, **_TINY,
     )
     produced = list(prof.rglob("*.xplane.pb")) + list(prof.rglob("*.trace.json.gz"))

@@ -46,6 +46,7 @@ def test_lm_dp_through_bsp_engine():
     assert np.isfinite(s["val"]["loss"])
 
 
+@pytest.mark.slow
 def test_lm_dp_tp_sp_with_resume(tmp_path):
     """dp x tp x sp through run_training, with a checkpointed resume
     continuing the step count exactly (verdict done-criterion)."""
@@ -102,6 +103,57 @@ def test_lm_pipeline_launch():
     )
     assert s["steps"] == 8
     assert np.isfinite(s["val"]["loss"])
+
+
+@pytest.mark.slow
+def test_lm_interleaved_pipeline_launch():
+    """--pp-interleave through the full driver: virtual stages, grouped
+    microbatches, schedule report attached to the engine."""
+    s = run_training(
+        model_cls=TransformerLMModel,
+        devices=8,
+        pp=2,
+        microbatches=4,
+        pp_interleave=2,
+        recipe_overrides={**TINY, "n_layers": 4},
+        dataset_kwargs=DATA,
+        max_steps=4,
+        print_freq=1000,
+    )
+    assert s["steps"] == 4
+    assert np.isfinite(s["val"]["loss"])
+
+
+def test_pp_interleave_flag_validation():
+    with pytest.raises(ValueError, match="pp-interleave requires --pp"):
+        _run(pp_interleave=2)
+
+
+def test_pipeline_layout_guard(tmp_path):
+    """Interleaved stacking permutes layers with identical leaf shapes —
+    the sidecar must refuse a cross-layout resume instead of letting
+    load_checkpoint silently permute the model."""
+    import os
+
+    from theanompi_tpu.launch.worker import pipeline_layout_guard
+
+    d = str(tmp_path / "ck")
+    pipeline_layout_guard(d, 4, 2, resume=False)  # writes the sidecar
+    pipeline_layout_guard(d, 4, 2, resume=True)  # matching resume: ok
+    with pytest.raises(ValueError, match="stack layout"):
+        pipeline_layout_guard(d, 4, 1, resume=True)  # interleave mismatch
+    with pytest.raises(ValueError, match="stack layout"):
+        pipeline_layout_guard(d, 2, 2, resume=True)  # stage-count mismatch
+    # plain GPipe stacking is layout-invariant across --pp: a legacy dir
+    # with no sidecar resumes fine at interleave=1 (any pp), but an
+    # interleaved resume against it is refused
+    legacy = str(tmp_path / "legacy")
+    os.makedirs(legacy)
+    pipeline_layout_guard(legacy, 8, 1, resume=True)
+    legacy2 = str(tmp_path / "legacy2")
+    os.makedirs(legacy2)
+    with pytest.raises(ValueError, match="stack layout"):
+        pipeline_layout_guard(legacy2, 4, 2, resume=True)
 
 
 @pytest.mark.slow

@@ -58,7 +58,11 @@ def test_switch_moe_routes_and_drops():
     assert float(tight.dropped_frac) > 0.0
 
 
-@pytest.mark.parametrize("sp", [False, True], ids=["ep", "ep-sp"])
+@pytest.mark.parametrize(
+    "sp",
+    [pytest.param(False, id="ep", marks=pytest.mark.slow),
+     pytest.param(True, id="ep-sp")],
+)
 def test_ep_step_matches_dense_oracle(sp):
     """One SGD step with experts sharded over the mesh (and optionally
     the sequence sharded too) reproduces the dense single-device step at

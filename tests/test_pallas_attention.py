@@ -113,6 +113,7 @@ def test_fallback_env_matches(monkeypatch):
     )
 
 
+@pytest.mark.slow
 def test_transformer_flash_matches_dense():
     """TransformerLM(attn='flash') loss AND grads == the default local
     full-attention path on identical params (no SP axis)."""
@@ -259,6 +260,7 @@ def test_ring_flash_grads_match_dense_oracle(mesh8):
         )
 
 
+@pytest.mark.slow
 def test_transformer_ring_flash_matches_ring(mesh8):
     """TransformerLM(attn='ring_flash') == attn='ring' (unfused) on the
     same params over the 8-way seq mesh — loss and one SGD step."""

@@ -14,6 +14,7 @@ from theanompi_tpu.parallel import make_mesh
 from theanompi_tpu.parallel.pipeline import (
     PIPE_AXIS,
     make_pp_train_step,
+    pipeline_schedule_report,
     stack_pipeline_params,
     unstack_pipeline_params,
 )
@@ -53,7 +54,10 @@ def test_stack_unstack_roundtrip():
 
 
 @pytest.mark.parametrize(
-    "n_pipe,dp", [(4, None), (8, None), (4, 2)], ids=["pp4", "pp8", "pp4-dp2"]
+    "n_pipe,dp",
+    [(4, None), pytest.param(8, None, marks=pytest.mark.slow),
+     pytest.param(4, 2, marks=pytest.mark.slow)],
+    ids=["pp4", "pp8", "pp4-dp2"],
 )
 def test_pp_step_matches_dense_oracle(n_pipe, dp):
     """One SGD step through the pipeline schedule (microbatches
@@ -84,6 +88,67 @@ def test_pp_step_matches_dense_oracle(n_pipe, dp):
         jax.tree_util.tree_leaves(got), jax.tree_util.tree_leaves(want_params)
     ):
         np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=3e-4)
+
+
+@pytest.mark.parametrize(
+    "n_pipe,v,n_layers",
+    [(2, 2, 4), pytest.param(4, 2, 8, marks=pytest.mark.slow)],
+    ids=["pp2x2", "pp4x2"],
+)
+def test_interleaved_pp_matches_dense_oracle(n_pipe, v, n_layers):
+    """The Megatron-style interleaved schedule (virtual stages looping
+    the ring, wraparound ppermute) is numerically the SAME program:
+    one SGD step == the dense oracle step."""
+    model = _model(n_layers=n_layers)
+    params = model.init(jax.random.PRNGKey(0))
+    stacked = stack_pipeline_params(params, n_stages=n_pipe, interleave=v)
+    toks = _data(M=2 * n_pipe)  # two groups of n
+    mesh = make_mesh(n_pipe, axis_names=(PIPE_AXIS,))
+    step = make_pp_train_step(model, mesh, lr=LR, interleave=v)
+    new_stacked, loss = step(stacked, toks)
+    want_params, want_loss = _oracle_step(model, params, toks)
+    np.testing.assert_allclose(float(loss), float(want_loss), atol=1e-5)
+    got = unstack_pipeline_params(
+        jax.tree_util.tree_map(np.asarray, new_stacked),
+        model.n_layers, n_stages=n_pipe, interleave=v,
+    )
+    for g, w in zip(
+        jax.tree_util.tree_leaves(got), jax.tree_util.tree_leaves(want_params)
+    ):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=3e-4)
+
+
+def test_interleaved_stack_roundtrip():
+    model = _model(n_layers=8)
+    params = model.init(jax.random.PRNGKey(0))
+    rt = unstack_pipeline_params(
+        stack_pipeline_params(params, n_stages=2, interleave=2),
+        model.n_layers, n_stages=2, interleave=2,
+    )
+    for a, b in zip(jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(rt)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # the permutation is NOT the identity (layers really are round-robin;
+    # compare a randomly-initialized leaf — norm weights init identical)
+    st_plain = stack_pipeline_params(params)
+    st_il = stack_pipeline_params(params, n_stages=2, interleave=2)
+    assert not np.allclose(
+        np.asarray(st_plain["blocks"]["qkv"]), np.asarray(st_il["blocks"]["qkv"])
+    )
+
+
+def test_schedule_report_bubble_shrinks_by_interleave():
+    plain = pipeline_schedule_report(4, 8)
+    il = pipeline_schedule_report(4, 8, interleave=4)
+    assert plain["ticks"] == 8 + 4 - 1
+    assert il["ticks"] == 2 * 4 * 4 + 4 - 1
+    np.testing.assert_allclose(plain["bubble_fraction"], 3 / 11)
+    np.testing.assert_allclose(il["bubble_fraction"], 3 / 35)
+    # headline law: bubble ~ (n-1)/(M*v + n - 1)
+    assert il["bubble_fraction"] < plain["bubble_fraction"] / 2.5
+    # strict <10%: M > 9(n-1)/v -> 28 plain; 7 -> rounded to a group of 4
+    assert plain["suggested_microbatches"] == 28
+    assert pipeline_schedule_report(4, 28)["bubble_fraction"] < 0.1
+    assert il["suggested_microbatches"] == 8
 
 
 def test_pp_step_validates():

@@ -9,7 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from theanompi_tpu.models.cifar10 import Cifar10_model
+from tinymodel import TinyCNN
 from theanompi_tpu.train import TrainState, init_train_state, make_train_step
 from theanompi_tpu.utils import (
     Recorder,
@@ -20,8 +20,8 @@ from theanompi_tpu.utils import (
 
 
 def _state():
-    model = Cifar10_model(
-        Cifar10_model.default_recipe().replace(batch_size=8, input_shape=(16, 16, 3))
+    model = TinyCNN(
+        TinyCNN.default_recipe().replace(batch_size=8, input_shape=(16, 16, 3))
     )
     return model, init_train_state(model, jax.random.PRNGKey(0))
 
@@ -237,11 +237,11 @@ def test_run_training_async_checkpoint_resume(tmp_path):
     """run_training's default async path writes a resumable checkpoint
     that the sync loader restores exactly (driver-level integration)."""
     from theanompi_tpu.launch.worker import run_training
-    from theanompi_tpu.models.cifar10 import Cifar10_model
+    from tinymodel import TinyCNN
 
     kw = dict(
         rule="bsp",
-        model_cls=Cifar10_model,
+        model_cls=TinyCNN,
         devices=1,
         dataset="synthetic",
         dataset_kwargs={"n_train": 32, "n_val": 16, "image_shape": [16, 16, 3]},

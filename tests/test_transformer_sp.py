@@ -87,6 +87,7 @@ def test_sp_training_learns():
     assert last < 0.7, f"SP training failed to learn: {first} -> {last}"
 
 
+@pytest.mark.slow
 def test_remat_step_matches_plain():
     """remat=True (per-block jax.checkpoint) must be a pure memory/FLOPs
     trade: identical loss and updated params, through the full SP step

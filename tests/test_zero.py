@@ -9,7 +9,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as Pspec
 
-from theanompi_tpu.models.cifar10 import Cifar10_model
+from tinymodel import TinyCNN
 from theanompi_tpu.parallel import make_mesh
 from theanompi_tpu.parallel.strategies import get_strategy
 from theanompi_tpu.parallel.zero import make_zero1_train_step
@@ -17,8 +17,8 @@ from theanompi_tpu.train import init_train_state, make_train_step
 
 
 def _model(optimizer):
-    return Cifar10_model(
-        Cifar10_model.default_recipe().replace(
+    return TinyCNN(
+        TinyCNN.default_recipe().replace(
             batch_size=64,
             input_shape=(16, 16, 3),
             optimizer=optimizer,
@@ -101,6 +101,7 @@ def test_zero1_validates_axis():
         make_zero1_train_step(model, mesh, axis_name="nope")
 
 
+@pytest.mark.slow
 def test_zero1_syncs_batchnorm_state():
     """A BatchNorm model's running stats must come out identical on
     every device (pmean'd across the axis, like parallel/bsp.py) — the

@@ -35,19 +35,40 @@ def _count(params):
 def test_param_counts_match_papers(name, expected_m, tol):
     model_cls = get_model(name)
     model = model_cls()
-    params, _ = model.init(jax.random.PRNGKey(0))
+    # abstract init: shapes only, no compile/materialization
+    params, _ = jax.eval_shape(model.init, jax.random.PRNGKey(0))
     count_m = _count(params) / 1e6
     assert abs(count_m - expected_m) < tol, f"{name}: {count_m:.2f}M vs {expected_m}M"
 
 
-@pytest.mark.slow
 def test_googlenet_param_count():
     """GoogLeNet: ~7M in the main network (aux heads add ~6M, train-only)."""
     model = GoogLeNet()
-    params, _ = model.init(jax.random.PRNGKey(0))
+    params, _ = jax.eval_shape(model.init, jax.random.PRNGKey(0))
     main = {k: v for k, v in params.items() if not k.startswith("aux")}
     assert abs(_count(main) / 1e6 - 6.99) < 0.15
     assert _count(params) / 1e6 > 9  # aux heads present
+
+
+def test_inception_fused_front_matches_branches():
+    """The MXU-shaping rewrite (b1/b3r/b5r 1x1 convs computed as ONE
+    conv, then split — models/googlenet.py Inception.apply) is exact:
+    identical to applying the four branches independently."""
+    from theanompi_tpu.models.googlenet import Inception
+
+    inc = Inception(8, 4, 8, 4, 8, 8, name="t")
+    params, state = inc.init(jax.random.PRNGKey(0), (2, 8, 8, 16))
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 8, 8, 16), jnp.float32)
+    got, _ = inc.apply(params, state, x)
+    want = jnp.concatenate(
+        [
+            br.apply(params[bn], state.get(bn, {}), x)[0]
+            for bn, br in inc.branches.items()
+        ],
+        axis=-1,
+    )
+    assert got.shape == want.shape == (2, 8, 8, 8 + 8 + 8 + 8)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
 
 
 # -- smoke: one train step at reduced input sizes ---------------------------
@@ -93,6 +114,7 @@ def test_googlenet_smoke_step_with_aux():
     assert isinstance(out, tuple) and len(out) == 3
 
 
+@pytest.mark.slow
 def test_vgg16_smoke_step():
     _smoke(VGG16, (64, 64, 3))
 

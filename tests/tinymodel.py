@@ -1,0 +1,30 @@
+"""Minimal CNN for sync-rule/engine tests.
+
+The exchanger algebra (EASGD elastic updates, GoSGD share-weight
+merges, BSP allreduce) is model-independent, so these tests don't need
+a realistic network — they need the cheapest model that still has a
+multi-leaf param pytree and a real loss. A 1-conv net compiles several
+times faster than the WRN CI variant on the single-CPU test host,
+which is what keeps the fast tier inside its budget (round-4 re-tier).
+"""
+
+from theanompi_tpu import nn
+from theanompi_tpu.models.cifar10 import Cifar10_model
+from theanompi_tpu.nn import init as initializers
+
+
+class TinyCNN(Cifar10_model):
+    name = "tinycnn"
+
+    def build(self):
+        he = initializers.he_normal()
+        return nn.Sequential(
+            [
+                nn.Conv(8, 3, padding="SAME", w_init=he, name="conv1"),
+                nn.Activation("relu"),
+                nn.Pool(2, stride=2, mode="max"),
+                nn.Flatten(),
+                nn.Dense(self.recipe.num_classes, name="softmax"),
+            ],
+            name="tiny_cnn",
+        )

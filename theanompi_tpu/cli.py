@@ -16,6 +16,7 @@ Usage::
 from __future__ import annotations
 
 import argparse
+import ast
 import json
 import sys
 
@@ -70,6 +71,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--microbatches", type=int, default=None,
                    help="with --pp: microbatch count per step (default = pp; "
                         "bubble fraction is (pp-1)/(M+pp-1))")
+    p.add_argument("--pp-interleave", type=int, default=1,
+                   help="with --pp: virtual stages per device (Megatron "
+                        "interleaved schedule; bubble shrinks to "
+                        "(pp-1)/(M*v+pp-1); layers must divide pp*v)")
     p.add_argument("--epochs", type=int, default=None, help="override recipe n_epochs")
     p.add_argument("--max-steps", type=int, default=None)
     p.add_argument("--batch-size", type=int, default=None, help="override recipe batch")
@@ -207,7 +212,11 @@ def main(argv=None) -> int:
             try:
                 out[k] = json.loads(v)
             except json.JSONDecodeError:
-                out[k] = v
+                try:
+                    # accept Python literals too: input_shape=(16,16,3)
+                    out[k] = ast.literal_eval(v)
+                except (ValueError, SyntaxError):
+                    out[k] = v
         return out
 
     dataset_kwargs = parse_kv(args.dataset_arg, "--dataset-arg")
@@ -241,6 +250,7 @@ def main(argv=None) -> int:
         pp=args.pp,
         expert=args.expert,
         microbatches=args.microbatches,
+        pp_interleave=args.pp_interleave,
         zero=args.zero,
         n_epochs=args.epochs,
         max_steps=args.max_steps,

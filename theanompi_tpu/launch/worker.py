@@ -39,6 +39,44 @@ from theanompi_tpu.utils import (
 from theanompi_tpu.utils.checkpoint import AsyncCheckpointer, save_checkpoint_sharded
 
 
+def pipeline_layout_guard(
+    ckpt_dir: str, pp: int, pp_interleave: int, resume: bool
+) -> None:
+    """Interleaved pipeline stacking PERMUTES layers on the stacked axis
+    (parallel/pipeline.py::stack_pipeline_params), and every layout
+    produces identical leaf shapes — so a checkpoint written under one
+    ``--pp/--pp-interleave`` would silently load layer-permuted under
+    another. A ``pipeline_layout.json`` sidecar records the stacking
+    layout; resume refuses a mismatch loudly. Plain GPipe stacking
+    (interleave=1) is layout-invariant across ``--pp``, so only the
+    interleaved case pins the stage count."""
+    import json as _json
+
+    path = os.path.join(ckpt_dir, "pipeline_layout.json")
+    current = {
+        "interleave": int(pp_interleave),
+        "n_stages": int(pp) if pp_interleave > 1 else None,
+    }
+    if resume:
+        stored = {"interleave": 1, "n_stages": None}
+        if os.path.exists(path):
+            with open(path) as f:
+                stored = _json.load(f)
+        if (stored.get("interleave", 1), stored.get("n_stages")) != (
+            current["interleave"], current["n_stages"]
+        ):
+            raise ValueError(
+                f"checkpoints in {ckpt_dir!r} use pipeline stack layout "
+                f"{stored} but this run requests {current} — resuming "
+                "would silently permute transformer layers; rerun with "
+                "the matching --pp/--pp-interleave (or a fresh ckpt-dir)"
+            )
+    if jax.process_index() == 0:
+        os.makedirs(ckpt_dir, exist_ok=True)
+        with open(path, "w") as f:
+            _json.dump(current, f)
+
+
 def run_training(
     rule: str = "bsp",
     model_cls: type[Model] = None,
@@ -54,6 +92,7 @@ def run_training(
     pp: int = 1,
     expert: int = 1,
     microbatches: Optional[int] = None,
+    pp_interleave: int = 1,
     # ZeRO-1 optimizer-state sharding (BSP rule only; parallel/zero.py)
     zero: int = 0,
     n_epochs: Optional[int] = None,
@@ -111,6 +150,11 @@ def run_training(
     model = model_cls(recipe)
 
     dataset = dataset or recipe.dataset
+    if dataset == "synthetic" and getattr(model, "is_lm", False):
+        # `tmpi ... --synthetic` on an LM means "synthetic tokens", not
+        # float image batches (which would crash tracing the embedding
+        # lookup with a float indexer)
+        dataset = "lm_synthetic"
     dataset_kwargs = dict(dataset_kwargs or {})
     if dataset in ("synthetic", "imagenet_synthetic"):
         # Synthetic stand-ins default to the MODEL's shapes, so
@@ -150,6 +194,8 @@ def run_training(
                          "optimizer state per its own param specs already)")
     if microbatches is not None and pp <= 1:
         raise ValueError("--microbatches requires --pp (GPipe microbatching)")
+    if pp_interleave > 1 and pp <= 1:
+        raise ValueError("--pp-interleave requires --pp (virtual stages)")
     if nd_active:
         if not getattr(model, "is_lm", False):
             raise ValueError(
@@ -216,7 +262,8 @@ def run_training(
             shape = (pp,) + ((dp,) if dp > 1 else ())
             nd_axes = dict(pipe_axis="pipe",
                            dp_axis=DP_AXIS if dp > 1 else None,
-                           microbatches=microbatches)
+                           microbatches=microbatches,
+                           pp_interleave=pp_interleave)
         else:
             if len(devs) % (tp * sp):
                 raise ValueError(
@@ -411,6 +458,8 @@ def run_training(
     state = engine.init_state(rng)
     start_epoch = 0
     summary_resumed_from = None
+    if ckpt_dir and pp > 1:
+        pipeline_layout_guard(ckpt_dir, pp, pp_interleave, resume)
     if resume and ckpt_dir:
         path = latest_checkpoint(ckpt_dir)
         if n_proc > 1:

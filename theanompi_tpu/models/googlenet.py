@@ -43,6 +43,7 @@ class Inception(Layer):
 
     def __init__(self, c1, c3r, c3, c5r, c5, cp, name="incept"):
         self.name = name
+        self.c1, self.c3r, self.c5r = c1, c3r, c5r
         self.b1 = nn.Sequential(_conv_relu(c1, 1, name="b1"), name="b1")
         self.b3 = nn.Sequential(
             _conv_relu(c3r, 1, name="b3r") + _conv_relu(c3, 3, name="b3"), name="b3"
@@ -62,16 +63,54 @@ class Inception(Layer):
         for k, (bname, branch) in zip(keys, self.branches.items()):
             p, s = branch.init(k, in_shape)
             params[bname] = p
+            if s and bname != "bp":
+                # the fused apply below does not thread state through the
+                # b1/b3/b5 tails — fail at build time, not silently, if a
+                # stateful layer (BatchNorm) ever lands in those branches
+                raise NotImplementedError(
+                    f"Inception branch {bname!r} carries layer state "
+                    f"({list(s)}); the fused-front apply only threads "
+                    "state for the pool branch"
+                )
             if s:
                 state[bname] = s
         return params, state
 
     def apply(self, params, state, x, *, train=False, rng=None):
-        outs = []
-        for bname, branch in self.branches.items():
-            y, _ = branch.apply(params[bname], state.get(bname, {}), x, train=train, rng=rng)
-            outs.append(y)
-        return jnp.concatenate(outs, axis=-1), state
+        # TPU MXU shaping: the b1 / b3-reduce / b5-reduce 1x1 convs all
+        # read the SAME input, and their output channels are small
+        # (16..208) — run them as ONE conv with c1+c3r+c5r outputs so
+        # the matmul fills 128-wide MXU tiles instead of three
+        # fragments, then split. Same math (concat of weights along
+        # HWIO's O axis == concat of the three convs), same param tree.
+        p1 = params["b1"][self.b1._keys[0]]
+        p3r = params["b3"][self.b3._keys[0]]
+        p5r = params["b5"][self.b5._keys[0]]
+        w = jnp.concatenate([p1["w"], p3r["w"], p5r["w"]], axis=-1)
+        b = jnp.concatenate([p1["b"], p3r["b"], p5r["b"]], axis=-1)
+        y = jax.lax.conv_general_dilated(
+            x, w.astype(x.dtype), (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        y = jax.nn.relu(y + b.astype(y.dtype))
+        y1 = y[..., : self.c1]
+        y3r = y[..., self.c1 : self.c1 + self.c3r]
+        y5r = y[..., self.c1 + self.c3r :]
+
+        def _tail(branch, bname, h):
+            # remaining layers of the branch (conv 3x3/5x5 + relu)
+            for lname, layer in zip(branch._keys[2:], branch.layers[2:]):
+                h, _ = layer.apply(
+                    params[bname].get(lname, {}), {}, h, train=train, rng=rng
+                )
+            return h
+
+        y3 = _tail(self.b3, "b3", y3r)
+        y5 = _tail(self.b5, "b5", y5r)
+        yp, _ = self.bp.apply(
+            params["bp"], state.get("bp", {}), x, train=train, rng=rng
+        )
+        return jnp.concatenate([y1, y3, y5, yp], axis=-1), state
 
     def out_shape(self, in_shape):
         n, h, w, _ = in_shape

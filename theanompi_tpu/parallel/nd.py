@@ -92,6 +92,7 @@ class NDEngine:
         ep_axis: Optional[str] = None,
         pipe_axis: Optional[str] = None,
         microbatches: Optional[int] = None,
+        pp_interleave: int = 1,
         donate: bool = True,
     ):
         if not hasattr(model, "arch"):
@@ -103,6 +104,7 @@ class NDEngine:
         self.model = model
         self.mesh = mesh
         self.microbatches = None
+        self.schedule = None  # pipeline branch: schedule_report dict
         opt = model.optimizer()
         schedule_lr = make_schedule_fn(model, steps_per_epoch)
         sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
@@ -116,15 +118,36 @@ class NDEngine:
             from theanompi_tpu.parallel.pipeline import (
                 make_pipeline_loss,
                 pipeline_param_specs,
+                pipeline_schedule_report,
                 stack_pipeline_params,
                 validate_pp_mesh,
             )
 
-            axes, n_total = validate_pp_mesh(arch, mesh, pipe_axis, dp_axis)
+            axes, n_total = validate_pp_mesh(
+                arch, mesh, pipe_axis, dp_axis, pp_interleave
+            )
             param_specs = pipeline_param_specs(pipe_axis)
-            loss_fn = make_pipeline_loss(arch, pipe_axis)
-            init_params = lambda key: stack_pipeline_params(arch.init(key))  # noqa: E731
-            self.microbatches = int(microbatches or sizes[pipe_axis])
+            loss_fn = make_pipeline_loss(arch, pipe_axis, pp_interleave)
+            n_pipe = sizes[pipe_axis]
+            init_params = lambda key: stack_pipeline_params(  # noqa: E731
+                arch.init(key), n_stages=n_pipe, interleave=pp_interleave
+            )
+            self.microbatches = int(microbatches or n_pipe)
+            if pp_interleave > 1 and self.microbatches % n_pipe:
+                raise ValueError(
+                    f"--pp-interleave needs --microbatches "
+                    f"({self.microbatches}) in groups of --pp ({n_pipe})"
+                )
+            self.schedule = pipeline_schedule_report(
+                n_pipe, self.microbatches, pp_interleave
+            )
+            print(
+                f"[nd] pipeline schedule: {self.schedule['ticks']} ticks, "
+                f"bubble {self.schedule['bubble_fraction']:.1%} "
+                f"(interleave={pp_interleave}; suggest >= "
+                f"{self.schedule['suggested_microbatches']} microbatches "
+                f"for <10%)"
+            )
             tok_spec = P(None, dp_axis)  # [M, B, T]: M replicated, B on dp
             batch_axes = (dp_axis,) if dp_axis else ()
         elif ep_axis is not None:

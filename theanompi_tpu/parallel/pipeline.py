@@ -18,6 +18,18 @@ Embedding runs on stage 0, head + loss on the last stage; both weight
 tensors are replicated (their gradients arrive via the universal
 spec-sync rule — transformer.py::sync_grads_by_spec). Composes with
 data parallelism on a 2-D ``(pipe, data)`` mesh.
+
+``interleave=v`` switches to the Megatron-style interleaved schedule:
+each device owns ``v`` non-contiguous layer chunks (device ``d`` holds
+global stages ``d, d+n, …, d+(v-1)n``), microbatches stream in groups
+of ``n`` and loop around the device ring ``v`` times (the ppermute ring
+gains its wraparound edge), and the fill/drain bubble shrinks by the
+factor ``v``: fraction ``(n-1)/(M·v + n - 1)``. The schedule is fully
+static and collision-free — device ``d`` processes chunk ``c`` of
+microbatch ``g·n + r`` exactly at tick ``g·n·v + c·n + r + d`` — so it
+stays one differentiable ``lax.scan`` and the backward pass is still
+pure AD. :func:`pipeline_schedule_report` quantifies the tradeoff and
+recommends microbatch counts.
 """
 
 from __future__ import annotations
@@ -40,24 +52,84 @@ from theanompi_tpu.ops.ring_attention import full_attention_reference
 PIPE_AXIS = "pipe"
 
 
-def stack_pipeline_params(params):
+def _interleave_order(n_layers: int, n_stages: int, interleave: int):
+    """Stacking order for the interleaved layout: device ``d``'s shard
+    must hold its ``v`` chunks contiguously — chunk ``c`` of device
+    ``d`` is global stage ``c·n + d``, i.e. layers
+    ``[(c·n+d)·Lc, (c·n+d+1)·Lc)`` with ``Lc = L/(n·v)``."""
+    lc = n_layers // (n_stages * interleave)
+    order = []
+    for d in range(n_stages):
+        for c in range(interleave):
+            base = (c * n_stages + d) * lc
+            order.extend(range(base, base + lc))
+    return order
+
+
+def stack_pipeline_params(params, *, n_stages: int = 0, interleave: int = 1):
     """Convert TransformerLM params (list of per-layer block dicts) to
     the pipeline layout: block leaves stacked on a leading layer dim
-    (shardable over the pipe axis); other leaves unchanged."""
-    blocks = jax.tree_util.tree_map(
-        lambda *xs: jnp.stack(xs), *params["blocks"]
-    )
+    (shardable over the pipe axis); other leaves unchanged. With
+    ``interleave > 1`` the layers are permuted so each device's shard
+    holds its ``v`` round-robin chunks (pass the mesh's ``n_stages``)."""
+    layers = params["blocks"]
+    if interleave > 1:
+        order = _interleave_order(len(layers), n_stages, interleave)
+        layers = [layers[i] for i in order]
+    blocks = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *layers)
     return {k: (blocks if k == "blocks" else v) for k, v in params.items()}
 
 
-def unstack_pipeline_params(stacked, n_layers: int):
+def unstack_pipeline_params(stacked, n_layers: int, *, n_stages: int = 0,
+                            interleave: int = 1):
     """Inverse of :func:`stack_pipeline_params` (for checkpoint interop
     and test oracles)."""
-    blocks = [
+    layers = [
         jax.tree_util.tree_map(lambda x: x[i], stacked["blocks"])
         for i in range(n_layers)
     ]
-    return {k: (blocks if k == "blocks" else v) for k, v in stacked.items()}
+    if interleave > 1:
+        order = _interleave_order(n_layers, n_stages, interleave)
+        inv = [0] * n_layers
+        for pos, src in enumerate(order):
+            inv[src] = pos
+        layers = [layers[inv[i]] for i in range(n_layers)]
+    return {k: (layers if k == "blocks" else v) for k, v in stacked.items()}
+
+
+def pipeline_schedule_report(n_stages: int, microbatches: int,
+                             interleave: int = 1) -> dict:
+    """Analytic schedule accounting (the numbers the scan actually
+    executes — tick counts are exact, not asymptotic):
+
+    - plain GPipe (``interleave=1``): ``M + n - 1`` ticks of one full
+      stage each; bubble fraction ``(n-1)/(M+n-1)``.
+    - interleaved: ``⌈M/n⌉·n·v + n - 1`` ticks of one CHUNK
+      (``1/v`` stage) each; bubble fraction ``(n-1)/(⌈M/n⌉·n·v+n-1)``.
+
+    ``suggested_microbatches`` is the smallest M keeping the bubble
+    under 10%.
+    """
+    n, m, v = n_stages, microbatches, interleave
+    if v == 1:
+        ticks, work = m + n - 1, m
+    else:
+        groups = -(-m // n)
+        ticks, work = groups * n * v + n - 1, m * v
+    bubble = (ticks - work) / ticks
+    # bubble < 10% (strict): (n-1)/(M·v + n - 1) < 0.1  =>  M > 9(n-1)/v
+    suggest = max(n, 9 * (n - 1) // v + 1)
+    if v > 1:
+        suggest = -(-suggest // n) * n  # groups of n
+    return {
+        "n_stages": n,
+        "microbatches": m,
+        "interleave": v,
+        "ticks": ticks,
+        "tick_fraction_of_stage": 1.0 / v,
+        "bubble_fraction": bubble,
+        "suggested_microbatches": suggest,
+    }
 
 
 def pipeline_param_specs(pipe_axis: str = PIPE_AXIS):
@@ -98,7 +170,7 @@ def _apply_stage(blocks_local, x):
 
 
 def validate_pp_mesh(model: TransformerLM, mesh: Mesh, pipe_axis: str,
-                     dp_axis: Optional[str]):
+                     dp_axis: Optional[str], interleave: int = 1):
     """Shared mesh/shape validation for the pipeline step builders.
     Returns ``(axes, n_total)``."""
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
@@ -107,10 +179,12 @@ def validate_pp_mesh(model: TransformerLM, mesh: Mesh, pipe_axis: str,
     if dp_axis is not None and dp_axis not in sizes:
         raise ValueError(f"axis {dp_axis!r} not in mesh axes {mesh.axis_names}")
     n_pipe = sizes[pipe_axis]
-    if model.n_layers % n_pipe:
+    if interleave < 1:
+        raise ValueError(f"interleave={interleave} must be >= 1")
+    if model.n_layers % (n_pipe * interleave):
         raise ValueError(
-            f"n_layers={model.n_layers} must divide the {pipe_axis!r} "
-            f"axis size {n_pipe}"
+            f"the {pipe_axis!r} axis size x interleave = "
+            f"{n_pipe}x{interleave} must divide n_layers={model.n_layers}"
         )
     axes = [pipe_axis] + ([dp_axis] if dp_axis else [])
     n_total = 1
@@ -119,11 +193,28 @@ def validate_pp_mesh(model: TransformerLM, mesh: Mesh, pipe_axis: str,
     return axes, n_total
 
 
-def make_pipeline_loss(model: TransformerLM, pipe_axis: str = PIPE_AXIS):
-    """``(stacked_params, tokens [M, B, T]) -> loss`` — the GPipe
-    schedule as one differentiable function (runs inside shard_map).
-    Shared by :func:`make_pp_train_step` and the launchable
+def make_pipeline_loss(model: TransformerLM, pipe_axis: str = PIPE_AXIS,
+                       interleave: int = 1):
+    """``(stacked_params, tokens [M, B, T]) -> loss`` — the pipeline
+    schedule (GPipe, or Megatron-interleaved when ``interleave > 1``)
+    as one differentiable function (runs inside shard_map). Shared by
+    :func:`make_pp_train_step` and the launchable
     ``parallel.nd.NDEngine`` pipeline branch."""
+
+    def _head_loss(params, outs, tokens, rank, n):
+        logits = outs @ params["head"]  # [M, B, T, V]
+        targets = jnp.concatenate([tokens[:, :, 1:], tokens[:, :, :1]], axis=-1)
+        valid = jnp.broadcast_to(
+            (jnp.arange(tokens.shape[-1]) < tokens.shape[-1] - 1).astype(
+                jnp.float32
+            ),
+            tokens.shape,
+        )
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        local = jnp.sum(nll * valid) / jnp.sum(valid)
+        # only the last stage computed real logits; broadcast its loss
+        return lax.psum(jnp.where(rank == n - 1, local, 0.0), pipe_axis)
 
     def pipeline_loss(params, tokens):
         M, B, T = tokens.shape
@@ -151,19 +242,59 @@ def make_pipeline_loss(model: TransformerLM, pipe_axis: str = PIPE_AXIS):
             return (y, outs), None
 
         (_, outs), _ = lax.scan(tick, (act0, outs0), jnp.arange(M + n - 1))
+        return _head_loss(params, outs, tokens, rank, n)
 
-        logits = outs @ params["head"]  # [M, B, T, V]
-        targets = jnp.concatenate([tokens[:, :, 1:], tokens[:, :, :1]], axis=-1)
-        valid = jnp.broadcast_to(
-            (jnp.arange(T) < T - 1).astype(jnp.float32), tokens.shape
+    def interleaved_loss(params, tokens):
+        # Schedule (see module docstring): device d runs chunk c of
+        # microbatch m = g*n + r at tick g*n*v + c*n + r + d; the ring
+        # hop INCLUDING the (n-1)->0 wraparound edge carries an
+        # activation from chunk c's last device to chunk c+1's first.
+        # Collision-free: two pairs (m,j),(m',j') with the same device
+        # and tick need j-j' = (m'-m)*n*v + k*n with |j-j'| < n*v —
+        # forcing m'=m. Fill/drain bubble: n-1 CHUNK-ticks.
+        M, B, T = tokens.shape
+        n = lax.psum(1, pipe_axis)
+        rank = lax.axis_index(pipe_axis)
+        v = interleave
+        if M % n:
+            raise ValueError(
+                f"interleaved pipeline needs microbatches ({M}) in "
+                f"groups of the stage count ({n})"
+            )
+        G = M // n
+        ring = [(i, (i + 1) % n) for i in range(n)]
+
+        emb = params["tok_emb"][tokens] + params["pos_emb"][jnp.arange(T)][None, None]
+        outs0 = jnp.zeros((M, B, T, model.d_model))
+        act0 = jnp.zeros((B, T, model.d_model))
+        # local shard [L/n, ...] -> [v, Lc, ...]: chunk-major per device
+        blocks = jax.tree_util.tree_map(
+            lambda x: x.reshape(v, x.shape[0] // v, *x.shape[1:]),
+            params["blocks"],
         )
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        local = jnp.sum(nll * valid) / jnp.sum(valid)
-        # only the last stage computed real logits; broadcast its loss
-        return lax.psum(jnp.where(rank == n - 1, local, 0.0), pipe_axis)
 
-    return pipeline_loss
+        def tick(carry, t):
+            act, outs = carry
+            act_in = lax.ppermute(act, pipe_axis, ring)
+            s = jnp.clip(t - rank, 0, G * n * v - 1)
+            in_range = (t >= rank) & (t - rank < G * n * v)
+            u = s % (n * v)
+            c = u // n
+            m = (s // (n * v)) * n + u % n
+            inject = (rank == 0) & (c == 0)
+            x = jnp.where(inject, emb[m], act_in)
+            chunk = jax.tree_util.tree_map(lambda x_: x_[c], blocks)
+            y = _apply_stage(chunk, x)
+            take = in_range & (rank == n - 1) & (c == v - 1)
+            sel = (jnp.arange(M) == m)[:, None, None, None]
+            outs = jnp.where(take & sel, y[None], outs)
+            return (y, outs), None
+
+        total = G * n * v + n - 1
+        (_, outs), _ = lax.scan(tick, (act0, outs0), jnp.arange(total))
+        return _head_loss(params, outs, tokens, rank, n)
+
+    return pipeline_loss if interleave == 1 else interleaved_loss
 
 
 def make_pp_train_step(
@@ -174,16 +305,19 @@ def make_pp_train_step(
     pipe_axis: str = PIPE_AXIS,
     dp_axis: Optional[str] = None,
     optimizer=None,
+    interleave: int = 1,
 ):
     """Jitted pipeline-parallel train step ``(stacked_params, tokens) ->
     (stacked_params, loss)`` (or over ``(params, opt_state)`` with
     ``optimizer``). ``tokens [M, B, T]`` is microbatch-major — build it
     by reshaping the global batch; ``B`` is sharded over ``dp_axis`` if
-    given. Params use :func:`stack_pipeline_params`'s layout.
+    given. Params use :func:`stack_pipeline_params`'s layout (pass the
+    same ``interleave``/``n_stages`` to it when ``interleave > 1``).
     """
-    axes, n_total = validate_pp_mesh(model, mesh, pipe_axis, dp_axis)
+    axes, n_total = validate_pp_mesh(model, mesh, pipe_axis, dp_axis, interleave)
     param_specs = pipeline_param_specs(pipe_axis)
-    pipeline_loss = make_pipeline_loss(model, pipe_axis)
+    pipeline_loss = make_pipeline_loss(model, pipe_axis, interleave)
+    n_stages = dict(zip(mesh.axis_names, mesh.devices.shape))[pipe_axis]
 
     def body(params, tokens):
         loss, grads = jax.value_and_grad(pipeline_loss)(params, tokens)
@@ -195,5 +329,8 @@ def make_pp_train_step(
     tok_spec = P(None, dp_axis) if dp_axis else P()
     return build_spec_step(
         body, mesh, param_specs, tok_spec, lr, optimizer,
-        lambda: stack_pipeline_params(model.init(jax.random.PRNGKey(0))),
+        lambda: stack_pipeline_params(
+            model.init(jax.random.PRNGKey(0)),
+            n_stages=n_stages, interleave=interleave,
+        ),
     )
