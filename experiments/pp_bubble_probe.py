@@ -120,12 +120,13 @@ def main():
                 f"step={dt * 1e3:8.1f}ms  per-ub={dt / M * 1e3:6.1f}ms"
             )
 
-    # claim 2 (measured): amortization — per-microbatch time at M=32
-    # must undercut M=4 for GPipe (bubble 3/35 vs 3/7); and the
-    # interleaved schedule at M=4 must beat GPipe's M=4 bubble overhead
-    # (same work, 3/19 vs 3/7 bubble) once per-tick overhead is small.
+    # claim 2 (measured AND asserted): per-microbatch time at M=32 must
+    # undercut M=4 for GPipe (bubble 3/35 vs 3/7) — if the mesh timing
+    # ever stops showing the amortization, the probe fails instead of
+    # committing a result that contradicts the claim.
     by = {(row["interleave"], row["microbatches"]): row for row in rows}
     amort = by[(1, 4)]["seconds_per_microbatch"] / by[(1, 32)]["seconds_per_microbatch"]
+    assert amort > 1.0, f"GPipe bubble amortization not observed: {amort:.2f}x"
     out = {
         "note": (
             "8-way virtual CPU mesh, 4-stage pipeline over a "

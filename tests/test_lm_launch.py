@@ -154,6 +154,16 @@ def test_pipeline_layout_guard(tmp_path):
     os.makedirs(legacy2)
     with pytest.raises(ValueError, match="stack layout"):
         pipeline_layout_guard(legacy2, 4, 2, resume=True)
+    # a FRESH run into a dir holding differently-laid-out checkpoints is
+    # refused too — overwriting the sidecar would let a later --resume
+    # pair it with the old permuted checkpoints
+    np.save(os.path.join(d, "x.npy"), np.zeros(1))  # not a checkpoint
+    pipeline_layout_guard(d, 2, 2, resume=False)  # empty of ckpts: ok
+    pipeline_layout_guard(d, 4, 2, resume=False)  # restore layout 4x2
+    open(os.path.join(d, "ckpt_5.npz"), "wb").close()
+    with pytest.raises(ValueError, match="already holds checkpoints"):
+        pipeline_layout_guard(d, 2, 2, resume=False)
+    pipeline_layout_guard(d, 4, 2, resume=False)  # matching: fine
 
 
 @pytest.mark.slow

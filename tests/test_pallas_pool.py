@@ -1,0 +1,129 @@
+"""Pallas 3x3/stride-1 max pool (ops/pallas_pool.py — measured-and-
+rejected as a default, kept as TMPI_PALLAS_POOL=1 opt-in): forward vs
+reduce_window, eq-mask backward vs select-and-scatter on tie-free
+input, all-maxima tie semantics (Theano's DownsampleFactorMaxGrad
+convention), and the nn.Pool routing rules. Kernels run in the Pallas
+interpreter here (ops/pallas_util.py) — identical numerics to the
+Mosaic lowering."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from theanompi_tpu.nn.layers import Pool
+from theanompi_tpu.ops.pallas_pool import maxpool3x3_s1, routable
+
+
+@pytest.fixture(autouse=True)
+def _opt_in(monkeypatch):
+    monkeypatch.setenv("TMPI_PALLAS_POOL", "1")
+
+
+def _tie_free(shape, seed=0):
+    """Random input with all-distinct values (so both tie conventions
+    agree): a shuffled permutation of distinct floats."""
+    r = np.random.RandomState(seed)
+    vals = np.arange(np.prod(shape), dtype=np.float32)
+    r.shuffle(vals)
+    return jnp.asarray(vals.reshape(shape) / vals.size)
+
+
+def _xla_pool(x):
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 1, 1, 1), "SAME"
+    )
+
+
+@pytest.mark.parametrize("shape", [(2, 8, 8, 16), (3, 7, 5, 130)])
+def test_forward_matches_reduce_window(shape):
+    x = _tie_free(shape)
+    np.testing.assert_array_equal(
+        np.asarray(maxpool3x3_s1(x)), np.asarray(_xla_pool(x))
+    )
+
+
+@pytest.mark.parametrize("shape", [(2, 8, 8, 16), (3, 7, 5, 130)])
+def test_backward_matches_sas_without_ties(shape):
+    """On tie-free input the eq-mask gradient IS select-and-scatter's."""
+    x = _tie_free(shape, seed=1)
+
+    def loss_ours(x):
+        return jnp.sum(maxpool3x3_s1(x) ** 2)
+
+    def loss_xla(x):
+        return jnp.sum(_xla_pool(x) ** 2)
+
+    got = jax.grad(loss_ours)(x)
+    want = jax.grad(loss_xla)(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+
+def test_backward_ties_distribute_to_all_maxima():
+    """A window of equal values sends the output cotangent to EVERY
+    maximal position (Theano semantics) — select-and-scatter would pick
+    one winner. Constant input: every 3x3 window is an all-way tie, so
+    dx[p] = sum of g over the windows containing p = the pool of g's
+    window-count map."""
+    x = jnp.ones((1, 4, 4, 1))
+    g = jnp.asarray(np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1))
+    _, vjp = jax.vjp(maxpool3x3_s1, x)
+    (dx,) = vjp(g)
+    want = lax.reduce_window(g, 0.0, lax.add, (1, 3, 3, 1), (1, 1, 1, 1), "SAME")
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(want), atol=1e-6)
+
+
+def test_bf16_roundtrip():
+    x = _tie_free((2, 6, 6, 8)).astype(jnp.bfloat16)
+    y = maxpool3x3_s1(x)
+    assert y.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(_xla_pool(x)))
+
+
+def test_pool_layer_routes_and_matches():
+    """nn.Pool(3, stride=1, padding=1, mode='max') — the inception pool
+    branch signature — must route here AND agree with the XLA path on
+    value + tie-free gradient."""
+    x = _tie_free((2, 8, 8, 16), seed=2)
+    pool = Pool(3, stride=1, padding=1, mode="max")
+    assert routable(pool.window, pool.stride, pool.padding, x)
+
+    y, _ = pool.apply({}, {}, x)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(_xla_pool(x)))
+
+    def loss(x):
+        y, _ = pool.apply({}, {}, x)
+        return jnp.sum(y ** 2)
+
+    got = jax.grad(loss)(x)
+    want = jax.grad(lambda x: jnp.sum(_xla_pool(x) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+
+def test_routing_rules(monkeypatch):
+    x = jnp.zeros((2, 8, 8, 4))
+    monkeypatch.setenv("TMPI_PALLAS_POOL", "0")
+    assert not routable((3, 3), (1, 1), "SAME", x)  # default: XLA s-a-s
+    monkeypatch.setenv("TMPI_PALLAS_POOL", "1")
+    assert routable((3, 3), (1, 1), "SAME", x)
+    assert routable((3, 3), (1, 1), 1, x)
+    assert not routable((3, 3), (2, 2), "SAME", x)  # strided: XLA path
+    assert not routable((2, 2), (1, 1), "SAME", x)  # wrong window
+    assert not routable((3, 3), (1, 1), "VALID", x)  # not SAME-equivalent
+    assert not routable((3, 3), (1, 1), 0, x)
+    big = jax.ShapeDtypeStruct((1, 128, 128, 4), jnp.float32)
+    assert not routable((3, 3), (1, 1), "SAME", big)  # beyond whole-map VMEM
+
+
+def test_jnp_fallback_same_semantics(monkeypatch):
+    """TMPI_PALLAS=0 routes to the jnp eq-mask fallback — same values,
+    same tie semantics."""
+    monkeypatch.setenv("TMPI_PALLAS", "0")
+    x = jnp.ones((1, 4, 4, 1))
+    g = jnp.asarray(np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1))
+    _, vjp = jax.vjp(maxpool3x3_s1, x)
+    (dx,) = vjp(g)
+    want = lax.reduce_window(g, 0.0, lax.add, (1, 3, 3, 1), (1, 1, 1, 1), "SAME")
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(want), atol=1e-6)

@@ -57,19 +57,31 @@ def pipeline_layout_guard(
         "interleave": int(pp_interleave),
         "n_stages": int(pp) if pp_interleave > 1 else None,
     }
-    if resume:
-        stored = {"interleave": 1, "n_stages": None}
-        if os.path.exists(path):
-            with open(path) as f:
-                stored = _json.load(f)
-        if (stored.get("interleave", 1), stored.get("n_stages")) != (
-            current["interleave"], current["n_stages"]
-        ):
+    stored = {"interleave": 1, "n_stages": None}
+    if os.path.exists(path):
+        with open(path) as f:
+            stored = _json.load(f)
+    mismatch = (stored.get("interleave", 1), stored.get("n_stages")) != (
+        current["interleave"], current["n_stages"]
+    )
+    if resume and mismatch:
+        raise ValueError(
+            f"checkpoints in {ckpt_dir!r} use pipeline stack layout "
+            f"{stored} but this run requests {current} — resuming "
+            "would silently permute transformer layers; rerun with "
+            "the matching --pp/--pp-interleave (or a fresh ckpt-dir)"
+        )
+    if not resume and mismatch:
+        from theanompi_tpu.utils.checkpoint import latest_checkpoint
+
+        if latest_checkpoint(ckpt_dir) is not None:
+            # refusing here (not just overwriting the sidecar) is what
+            # keeps a LATER --resume from pairing the rewritten sidecar
+            # with the old differently-permuted checkpoints
             raise ValueError(
-                f"checkpoints in {ckpt_dir!r} use pipeline stack layout "
-                f"{stored} but this run requests {current} — resuming "
-                "would silently permute transformer layers; rerun with "
-                "the matching --pp/--pp-interleave (or a fresh ckpt-dir)"
+                f"{ckpt_dir!r} already holds checkpoints with pipeline "
+                f"stack layout {stored}; this run requests {current} — "
+                "use a fresh --ckpt-dir (or delete the old checkpoints)"
             )
     if jax.process_index() == 0:
         os.makedirs(ckpt_dir, exist_ok=True)

@@ -168,14 +168,23 @@ class Pool(Layer):
         sh, sw = self.stride
         dims, strides = (1, kh, kw, 1), (1, sh, sw, 1)
         if self.mode == "max":
-            # NOTE: AD of reduce_window-max lowers to select-and-scatter
-            # (~2.5 ms/step on AlexNet's 55x55 map at batch 1024, v5e).
-            # A Theano-style eq-mask custom backward (g * (x == y) summed
-            # over the k x k shifted windows) was tried in two
-            # formulations (per-offset pads; one framed buffer + static
-            # slices) and BOTH measured ~2x slower end-to-end — XLA does
-            # not fuse the 9-way accumulation over these map sizes.
-            # Keeping the native lowering is the measured optimum.
+            # NOTE: AD of reduce_window-max lowers to select-and-scatter,
+            # and that IS the measured optimum on v5e for NHWC. The
+            # Theano-style eq-mask backward was tried three ways and all
+            # lost: plain jnp in two formulations (~2x slower end-to-end;
+            # round-4 re-measurement 135 ms vs ~3 ms for one batch-1024
+            # 28x28x480 stride-1 pool — XLA won't fuse the 9-way
+            # accumulation), and a register-resident Pallas kernel
+            # (ops/pallas_pool.py: GoogLeNet 5094 -> 2472 img/s — NHWC
+            # puts W on the sublane dim so shifted reads are misaligned
+            # shuffles, and the custom call is a fusion barrier; full
+            # analysis in that module's docstring). The Pallas kernel
+            # stays as an opt-in (TMPI_PALLAS_POOL=1) with Theano's
+            # all-maxima tie semantics.
+            from theanompi_tpu.ops import pallas_pool
+
+            if pallas_pool.routable(self.window, self.stride, self.padding, x):
+                return pallas_pool.maxpool3x3_s1(x), state
             y = lax.reduce_window(
                 x, -jnp.inf, lax.max, dims, strides, self._pad_arg()
             )

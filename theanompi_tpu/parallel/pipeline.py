@@ -57,6 +57,11 @@ def _interleave_order(n_layers: int, n_stages: int, interleave: int):
     must hold its ``v`` chunks contiguously — chunk ``c`` of device
     ``d`` is global stage ``c·n + d``, i.e. layers
     ``[(c·n+d)·Lc, (c·n+d+1)·Lc)`` with ``Lc = L/(n·v)``."""
+    if n_stages < 1:
+        raise ValueError(
+            f"interleave={interleave} needs the mesh's n_stages "
+            f"(got {n_stages})"
+        )
     lc = n_layers // (n_stages * interleave)
     order = []
     for d in range(n_stages):
