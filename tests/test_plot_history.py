@@ -1,0 +1,47 @@
+"""Plot helper (tools/plot_history.py — reference L8 ``show.py`` role):
+JSONL parsing, run discovery, and a headless end-to-end render."""
+
+import json
+import os
+
+import pytest
+
+from theanompi_tpu.tools.plot_history import discover, load_jsonl, main
+
+
+def _write_run(d, name, steps=6, epochs=2):
+    os.makedirs(d, exist_ok=True)
+    p = os.path.join(d, f"{name}.jsonl")
+    with open(p, "w") as f:
+        for s in range(1, steps + 1):
+            f.write(json.dumps({
+                "kind": "train", "step": s, "loss": 2.0 / s, "error": 0.5,
+                "lr": 0.1, "images_per_sec": 100.0 + s,
+            }) + "\n")
+        for e in range(epochs):
+            f.write(json.dumps({
+                "kind": "val", "epoch": e, "loss": 1.0 / (e + 1),
+                "error": 0.0, "top5_error": 0.0,
+            }) + "\n")
+    return p
+
+
+def test_load_and_discover(tmp_path):
+    p = _write_run(str(tmp_path / "runA"), "runA")
+    h = load_jsonl(p)
+    assert h["train"]["step"] == [1, 2, 3, 4, 5, 6]
+    assert len(h["val"]["epoch"]) == 2
+    runs = discover([str(tmp_path / "runA")])
+    assert runs == {"runA": p}
+    with pytest.raises(FileNotFoundError, match="no \\*.jsonl"):
+        discover([str(tmp_path)])  # dir without jsonl files
+
+
+def test_end_to_end_png(tmp_path):
+    _write_run(str(tmp_path / "a"), "a")
+    _write_run(str(tmp_path / "b"), "b")
+    out = str(tmp_path / "out.png")
+    rc = main([str(tmp_path / "a"), str(tmp_path / "b"), "-o", out,
+               "--smooth", "2"])
+    assert rc == 0
+    assert os.path.getsize(out) > 10_000  # a real rendered figure

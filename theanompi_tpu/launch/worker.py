@@ -51,6 +51,7 @@ def pipeline_layout_guard(
     (interleave=1) is layout-invariant across ``--pp``, so only the
     interleaved case pins the stage count."""
     import json as _json
+    import tempfile
 
     path = os.path.join(ckpt_dir, "pipeline_layout.json")
     current = {
@@ -59,8 +60,20 @@ def pipeline_layout_guard(
     }
     stored = {"interleave": 1, "n_stages": None}
     if os.path.exists(path):
-        with open(path) as f:
-            stored = _json.load(f)
+        try:
+            with open(path) as f:
+                stored = _json.load(f)
+        except (ValueError, OSError):
+            # unreadable sidecar: only fatal if there are checkpoints it
+            # was supposed to describe
+            if latest_checkpoint(ckpt_dir) is not None:
+                raise ValueError(
+                    f"{path!r} is unreadable but {ckpt_dir!r} holds "
+                    "checkpoints whose pipeline stack layout it should "
+                    "record — delete the checkpoints (or restore the "
+                    "sidecar) before reusing this dir"
+                )
+            stored = current  # nothing at stake; rewrite below
     mismatch = (stored.get("interleave", 1), stored.get("n_stages")) != (
         current["interleave"], current["n_stages"]
     )
@@ -71,22 +84,24 @@ def pipeline_layout_guard(
             "would silently permute transformer layers; rerun with "
             "the matching --pp/--pp-interleave (or a fresh ckpt-dir)"
         )
-    if not resume and mismatch:
-        from theanompi_tpu.utils.checkpoint import latest_checkpoint
-
-        if latest_checkpoint(ckpt_dir) is not None:
-            # refusing here (not just overwriting the sidecar) is what
-            # keeps a LATER --resume from pairing the rewritten sidecar
-            # with the old differently-permuted checkpoints
-            raise ValueError(
-                f"{ckpt_dir!r} already holds checkpoints with pipeline "
-                f"stack layout {stored}; this run requests {current} — "
-                "use a fresh --ckpt-dir (or delete the old checkpoints)"
-            )
+    if not resume and mismatch and latest_checkpoint(ckpt_dir) is not None:
+        # refusing here (not just overwriting the sidecar) is what
+        # keeps a LATER --resume from pairing the rewritten sidecar
+        # with the old differently-permuted checkpoints
+        raise ValueError(
+            f"{ckpt_dir!r} already holds checkpoints with pipeline "
+            f"stack layout {stored}; this run requests {current} — "
+            "use a fresh --ckpt-dir (or delete the old checkpoints)"
+        )
     if jax.process_index() == 0:
         os.makedirs(ckpt_dir, exist_ok=True)
-        with open(path, "w") as f:
-            _json.dump(current, f)
+        if current["interleave"] > 1:
+            fd, tmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".tmp")
+            with os.fdopen(fd, "w") as f:
+                _json.dump(current, f)
+            os.replace(tmp, path)  # atomic: no truncated sidecar
+        elif os.path.exists(path):
+            os.remove(path)  # back to the layout-invariant default
 
 
 def run_training(
@@ -470,7 +485,9 @@ def run_training(
     state = engine.init_state(rng)
     start_epoch = 0
     summary_resumed_from = None
-    if ckpt_dir and pp > 1:
+    if ckpt_dir:
+        # validates for EVERY rule (a fresh non-pipeline run must not
+        # clobber an interleaved dir either); writes/clears the sidecar
         pipeline_layout_guard(ckpt_dir, pp, pp_interleave, resume)
     if resume and ckpt_dir:
         path = latest_checkpoint(ckpt_dir)

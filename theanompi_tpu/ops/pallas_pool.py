@@ -129,7 +129,6 @@ def _tiles(N, H, W, C, itemsize):
 
 def _pallas_fwd(x):
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     N, H, W, C = x.shape
     bb, bc = _tiles(N, H, W, C, x.dtype.itemsize)
@@ -146,7 +145,6 @@ def _pallas_fwd(x):
 
 def _pallas_bwd(x, y, g):
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     N, H, W, C = x.shape
     bb, bc = _tiles(N, H, W, C, x.dtype.itemsize)

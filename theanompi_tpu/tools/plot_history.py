@@ -1,0 +1,141 @@
+"""Plot Recorder histories — the reference's repo-root plot helper,
+TPU-native edition.
+
+Reference: the ``show.py``-style script next to ``lib/recorder.py``
+(SURVEY.md §1 L8 / §5.1): it loaded the recorder's pickled history and
+plotted cost/error curves for one or more runs. Same contract here,
+over the Recorder's JSONL stream (``<save_dir>/<run>.jsonl``,
+`utils/recorder.py`): train loss + LR per step, val loss/error per
+epoch, and images/sec — for any number of runs on shared axes, so
+sync-rule comparisons (the reference's main use: BSP vs EASGD curves)
+are one command:
+
+    python -m theanompi_tpu.tools.plot_history experiments/results/bsp \\
+        experiments/results/easgd -o rules.png
+
+Accepts run directories (every ``*.jsonl`` inside) or ``.jsonl`` files.
+Headless-safe (Agg backend); ``--show`` opens a window where a display
+exists.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def load_jsonl(path: str) -> dict:
+    """Split one Recorder JSONL into train/val series."""
+    train: dict[str, list] = {"step": [], "loss": [], "error": [],
+                              "lr": [], "images_per_sec": []}
+    val: dict[str, list] = {"epoch": [], "loss": [], "error": []}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            if row.get("kind") == "train":
+                for k in train:
+                    if k in row:
+                        train[k].append(row[k])
+            elif row.get("kind") == "val":
+                for k in val:
+                    if k in row:
+                        val[k].append(row[k])
+    return {"train": train, "val": val}
+
+
+def discover(paths: list[str]) -> dict[str, str]:
+    """``{label: jsonl_path}`` from a mix of dirs and files."""
+    runs: dict[str, str] = {}
+    for p in paths:
+        if os.path.isdir(p):
+            found = sorted(glob.glob(os.path.join(p, "*.jsonl")))
+            if not found:
+                raise FileNotFoundError(f"no *.jsonl under {p!r}")
+            for f in found:
+                label = os.path.basename(os.path.dirname(f)) or \
+                    os.path.splitext(os.path.basename(f))[0]
+                if len(found) > 1:
+                    label = os.path.splitext(os.path.basename(f))[0]
+                runs[label] = f
+        else:
+            runs[os.path.splitext(os.path.basename(p))[0]] = p
+    return runs
+
+
+def plot(runs: dict[str, str], out: str, show: bool = False,
+         smooth: int = 1) -> str:
+    import matplotlib
+
+    if not show:
+        matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    def smoothed(xs, ys, k):
+        if k <= 1 or len(ys) < k:
+            return xs, ys
+        acc, out_x, out_y = 0.0, [], []
+        for i, y in enumerate(ys):
+            acc += y
+            if i >= k:
+                acc -= ys[i - k]
+            if i >= k - 1:
+                out_x.append(xs[i])
+                out_y.append(acc / k)
+        return out_x, out_y
+
+    fig, axes = plt.subplots(2, 2, figsize=(11, 7))
+    (ax_loss, ax_val), (ax_ips, ax_lr) = axes
+    for label, path in runs.items():
+        h = load_jsonl(path)
+        t, v = h["train"], h["val"]
+        if t["step"] and t["loss"]:
+            ax_loss.plot(*smoothed(t["step"], t["loss"], smooth), label=label)
+        if v["epoch"]:
+            # presence, not truthiness: an all-zero error series (a run
+            # that reached 0% val error) is still the error curve
+            key = "error" if len(v["error"]) == len(v["epoch"]) else "loss"
+            ax_val.plot(v["epoch"], v[key], marker="o", label=f"{label} ({key})")
+        if t["step"] and t["images_per_sec"]:
+            ax_ips.plot(*smoothed(t["step"], t["images_per_sec"], smooth),
+                        label=label)
+        if t["step"] and t["lr"]:
+            ax_lr.plot(t["step"][: len(t["lr"])], t["lr"], label=label)
+    ax_loss.set(title="train loss", xlabel="step")
+    ax_val.set(title="validation", xlabel="epoch")
+    ax_ips.set(title="throughput (images/sec)", xlabel="step")
+    ax_lr.set(title="learning rate", xlabel="step")
+    for ax in (ax_loss, ax_val, ax_ips, ax_lr):
+        ax.grid(True, alpha=0.3)
+        if ax.lines:
+            ax.legend(fontsize=8)
+    fig.tight_layout()
+    fig.savefig(out, dpi=120)
+    if show:
+        plt.show()
+    plt.close(fig)
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("paths", nargs="+",
+                   help="run directories or .jsonl files to plot together")
+    p.add_argument("-o", "--out", default="history.png")
+    p.add_argument("--smooth", type=int, default=1,
+                   help="moving-average window over train-series points")
+    p.add_argument("--show", action="store_true")
+    args = p.parse_args(argv)
+    runs = discover(args.paths)
+    out = plot(runs, args.out, show=args.show, smooth=args.smooth)
+    print(f"wrote {out} ({len(runs)} run{'s' if len(runs) != 1 else ''})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
