@@ -33,8 +33,16 @@ def test_load_and_discover(tmp_path):
     assert len(h["val"]["epoch"]) == 2
     runs = discover([str(tmp_path / "runA")])
     assert runs == {"runA": p}
+    os.makedirs(str(tmp_path / "empty_dir"))
     with pytest.raises(FileNotFoundError, match="no \\*.jsonl"):
-        discover([str(tmp_path)])  # dir without jsonl files
+        discover([str(tmp_path / "empty_dir")])  # dir without jsonl files
+
+
+def test_discover_disambiguates_same_basename(tmp_path):
+    pa = _write_run(str(tmp_path / "expA"), "run")
+    pb = _write_run(str(tmp_path / "expB"), "run")
+    runs = discover([pa, pb])
+    assert len(runs) == 2 and set(runs.values()) == {pa, pb}
 
 
 def test_end_to_end_png(tmp_path):

@@ -59,21 +59,25 @@ def pipeline_layout_guard(
         "n_stages": int(pp) if pp_interleave > 1 else None,
     }
     stored = {"interleave": 1, "n_stages": None}
-    if os.path.exists(path):
-        try:
-            with open(path) as f:
-                stored = _json.load(f)
-        except (ValueError, OSError):
-            # unreadable sidecar: only fatal if there are checkpoints it
-            # was supposed to describe
-            if latest_checkpoint(ckpt_dir) is not None:
-                raise ValueError(
-                    f"{path!r} is unreadable but {ckpt_dir!r} holds "
-                    "checkpoints whose pipeline stack layout it should "
-                    "record — delete the checkpoints (or restore the "
-                    "sidecar) before reusing this dir"
-                )
-            stored = current  # nothing at stake; rewrite below
+    try:
+        # open directly (no exists() pre-check): rank 0 may legitimately
+        # remove a stale sidecar while another rank is here, and a
+        # vanished file is the layout-invariant default, not corruption
+        with open(path) as f:
+            stored = _json.load(f)
+    except FileNotFoundError:
+        pass
+    except (ValueError, OSError):
+        # unreadable sidecar: only fatal if there are checkpoints it
+        # was supposed to describe
+        if latest_checkpoint(ckpt_dir) is not None:
+            raise ValueError(
+                f"{path!r} is unreadable but {ckpt_dir!r} holds "
+                "checkpoints whose pipeline stack layout it should "
+                "record — delete the checkpoints (or restore the "
+                "sidecar) before reusing this dir"
+            )
+        stored = current  # nothing at stake; rewrite below
     mismatch = (stored.get("interleave", 1), stored.get("n_stages")) != (
         current["interleave"], current["n_stages"]
     )
@@ -210,9 +214,11 @@ def run_training(
             raise ValueError(f"{what} use the in-step psum sync (strategy 'psum')")
         if n_slices and n_slices > 1:
             raise ValueError(f"{what} do not compose with --slices yet")
-        if accum_steps != 1 or fuse > 1:
+        if accum_steps != 1:
+            raise ValueError(f"{what} do not compose with --accum-steps yet")
+        if fuse > 1 and zero:
             raise ValueError(
-                f"{what} do not compose with --accum-steps/--steps-per-dispatch yet"
+                "--zero does not compose with --steps-per-dispatch yet"
             )
         if rule_kwargs:
             raise ValueError(f"{what} got unexpected options {sorted(rule_kwargs)}")
@@ -541,7 +547,10 @@ def run_training(
 
     def place_group(group):
         # fused dispatch: stack g host batches -> ONE [g, batch, ...]
-        # transfer (dim 0 replicated, dim 1 sharded)
+        # transfer (dim 0 replicated, dim 1 sharded); ND engines own the
+        # stacked layout (token specs / microbatch-major)
+        if hasattr(engine, "place_group"):
+            return engine.place_group(group)
         from theanompi_tpu.parallel.mesh import put_stacked_batches
 
         xs = np.stack([b[0] for b in group])

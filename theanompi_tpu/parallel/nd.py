@@ -180,7 +180,12 @@ class NDEngine:
         self._state_specs = state_specs
         self._init_params = init_params
         self._opt = opt
+        self._tok_spec = tok_spec
         self._tok_sharding = NamedSharding(mesh, tok_spec)
+        # fused dispatch: group dim replicated ahead of the token spec
+        self._stacked_sharding = NamedSharding(mesh, P(None, *tok_spec))
+        self._donate = donate
+        self._fused = None
 
         def sharded_step(state: NDTrainState, tokens, rng):
             del rng  # no dropout in the LM stack; kept for protocol parity
@@ -196,6 +201,7 @@ class NDEngine:
                 {"loss": loss, "lr": lr},
             )
 
+        self._sharded_step_fn = sharded_step
         self._step = jax.jit(
             jax.shard_map(
                 sharded_step,
@@ -236,21 +242,30 @@ class NDEngine:
         )
         return jax.device_put(state, shardings)
 
+    def _split_microbatches(self, x, axis: int):
+        """Reshape the batch dim at ``axis`` to microbatch-major
+        ``[M, B/M]`` (no-op for non-pipeline engines) — the ONE place
+        the pipeline host layout is defined, shared by the per-step and
+        fused placement paths."""
+        if self.microbatches is None:
+            return x
+        M = self.microbatches
+        if x.shape[axis] % M:
+            raise ValueError(
+                f"global batch {x.shape[axis]} must be divisible by "
+                f"microbatches={M}"
+            )
+        return x.reshape(
+            *x.shape[:axis], M, x.shape[axis] // M, *x.shape[axis + 1:]
+        )
+
     def place_batch(self, x, y):
         """Host tokens ``[B, T]`` -> device, sharded per the engine's
         token spec (microbatch-major for pipelines). Returns the SAME
         device array for x and y (labels are the tokens; zero extra
         transfer)."""
         del y  # labels ARE the tokens
-        x = np.asarray(x)
-        if self.microbatches is not None:
-            M = self.microbatches
-            if x.shape[0] % M:
-                raise ValueError(
-                    f"global batch {x.shape[0]} must be divisible by "
-                    f"microbatches={M}"
-                )
-            x = x.reshape(M, x.shape[0] // M, *x.shape[1:])
+        x = self._split_microbatches(np.asarray(x), axis=0)
         t = jax.device_put(x, self._tok_sharding)
         return t, t
 
@@ -258,10 +273,47 @@ class NDEngine:
         del labels
         return self._step(state, tokens, rng)
 
-    def fused_train_step(self, state, images, labels, rngs):
-        raise NotImplementedError(
-            "steps_per_dispatch > 1 is not supported by the ND engine yet"
+    def place_group(self, group):
+        """Fused dispatch: stack ``g`` host token batches into ONE
+        ``[g, ...]`` transfer sharded per the engine's token spec (group
+        dim replicated; microbatch-major per batch for pipelines)."""
+        xs = np.stack([np.asarray(b[0]) for b in group])
+        t = jax.device_put(
+            self._split_microbatches(xs, axis=1), self._stacked_sharding
         )
+        return t, t
+
+    def fused_train_step(self, state, tokens_g, labels_g, rngs):
+        """``g`` steps in ONE compiled program (``lax.scan`` over the
+        stacked group — same dispatch-amortization as
+        ``parallel/bsp.py::make_bsp_fused_step``); per-step keys stacked
+        ``[g]``, metrics returned stacked. Jit recompiles per distinct
+        group size (the driver produces at most the configured k plus an
+        epoch remainder)."""
+        del labels_g
+        if self._fused is None:
+            step_fn = self._sharded_step_fn
+
+            def sharded_fused(state, toks_g, rngs):
+                def body(st, inp):
+                    toks, r = inp
+                    return step_fn(st, toks, r)
+
+                return lax.scan(body, state, (toks_g, rngs))
+
+            self._fused = jax.jit(
+                jax.shard_map(
+                    sharded_fused,
+                    mesh=self.mesh,
+                    in_specs=(
+                        self._state_specs, P(None, *self._tok_spec), P()
+                    ),
+                    out_specs=(self._state_specs, P()),
+                    check_vma=False,
+                ),
+                donate_argnums=(0,) if self._donate else (),
+            )
+        return self._fused(state, tokens_g, rngs)
 
     def exchange(self, state):
         return state

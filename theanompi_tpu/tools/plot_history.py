@@ -50,8 +50,20 @@ def load_jsonl(path: str) -> dict:
 
 
 def discover(paths: list[str]) -> dict[str, str]:
-    """``{label: jsonl_path}`` from a mix of dirs and files."""
+    """``{label: jsonl_path}`` from a mix of dirs and files. Duplicate
+    labels are disambiguated with the parent dir (two ``run.jsonl``
+    inputs must both appear, not silently overwrite each other)."""
     runs: dict[str, str] = {}
+
+    def add(label: str, f: str):
+        if label in runs and runs[label] != f:
+            label = f"{os.path.basename(os.path.dirname(f))}/{label}"
+            i = 2
+            base = label
+            while label in runs:
+                label, i = f"{base}#{i}", i + 1
+        runs[label] = f
+
     for p in paths:
         if os.path.isdir(p):
             found = sorted(glob.glob(os.path.join(p, "*.jsonl")))
@@ -62,9 +74,9 @@ def discover(paths: list[str]) -> dict[str, str]:
                     os.path.splitext(os.path.basename(f))[0]
                 if len(found) > 1:
                     label = os.path.splitext(os.path.basename(f))[0]
-                runs[label] = f
+                add(label, f)
         else:
-            runs[os.path.splitext(os.path.basename(p))[0]] = p
+            add(os.path.splitext(os.path.basename(p))[0], p)
     return runs
 
 
