@@ -95,6 +95,70 @@ def test_fused_rejected_for_async_rules():
         )
 
 
+def test_zero_fused_matches_per_step():
+    """ZeroEngine fused dispatch (round 4): a fused group of 2 == two
+    sequential ZeRO-1 steps with the same keys."""
+    import jax.numpy as jnp
+
+    from tinymodel import TinyCNN
+    from theanompi_tpu.parallel import make_mesh
+    from theanompi_tpu.parallel.mesh import put_global_batch, put_stacked_batches
+    from theanompi_tpu.parallel.zero import ZeroEngine
+
+    model = TinyCNN(
+        TinyCNN.default_recipe().replace(
+            batch_size=16, input_shape=(16, 16, 3),
+            sched_kwargs={"lr": 0.05, "boundaries": [10**9]},
+        )
+    )
+    mesh = make_mesh(8)
+    eng = ZeroEngine(model, mesh)
+    r = np.random.RandomState(0)
+    xs = r.randn(2, 16, 16, 16, 3).astype(np.float32)
+    ys = r.randint(0, 10, (2, 16)).astype(np.int32)
+    k1, k2 = jax.random.PRNGKey(1), jax.random.PRNGKey(2)
+
+    s = eng.init_state(jax.random.PRNGKey(0))
+    s, m1 = eng.train_step(
+        s, put_global_batch(mesh, xs[0]), put_global_batch(mesh, ys[0]), k1
+    )
+    s, m2 = eng.train_step(
+        s, put_global_batch(mesh, xs[1]), put_global_batch(mesh, ys[1]), k2
+    )
+
+    sf = eng.init_state(jax.random.PRNGKey(0))
+    sf, mf = eng.fused_train_step(
+        sf, put_stacked_batches(mesh, xs), put_stacked_batches(mesh, ys),
+        jnp.stack([k1, k2]),
+    )
+    np.testing.assert_allclose(
+        np.asarray(mf["loss"]),
+        [float(m1["loss"]), float(m2["loss"])], rtol=1e-5,
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s.params), jax.tree_util.tree_leaves(sf.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_zero_fused_via_driver():
+    from tinymodel import TinyCNN
+
+    out = run_training(
+        rule="bsp", model_cls=TinyCNN, devices=8, zero=1,
+        steps_per_dispatch=2, max_steps=3,
+        dataset="synthetic",
+        dataset_kwargs={"n_train": 96, "n_val": 32, "image_shape": [16, 16, 3]},
+        recipe_overrides={
+            "batch_size": 16, "input_shape": (16, 16, 3),
+            "sched_kwargs": {"lr": 0.05, "boundaries": [10**9]},
+        },
+        print_freq=0,
+    )
+    assert out["steps"] == 3
+    assert np.isfinite(out["val"]["loss"])
+
+
 def test_nd_fused_matches_per_step():
     """NDEngine fused dispatch (round 4): a fused group of 2 == two
     sequential train_step calls with the same keys, for a dp x tp LM."""

@@ -43,6 +43,15 @@ def test_discover_disambiguates_same_basename(tmp_path):
     pb = _write_run(str(tmp_path / "expB"), "run")
     runs = discover([pa, pb])
     assert len(runs) == 2 and set(runs.values()) == {pa, pb}
+    # BOTH labels carry the distinguishing dir, not just the second one
+    labels = sorted(runs)
+    assert any("expA" in l for l in labels) and any("expB" in l for l in labels)
+    # identically-named parents still come apart (a/ckpt vs b/ckpt)
+    p1 = _write_run(str(tmp_path / "a" / "ckpt"), "r")
+    p2 = _write_run(str(tmp_path / "b" / "ckpt"), "r")
+    runs2 = discover([p1, p2])
+    assert set(runs2.values()) == {p1, p2}
+    assert any("a/ckpt" in l for l in runs2) and any("b/ckpt" in l for l in runs2)
 
 
 def test_end_to_end_png(tmp_path):

@@ -216,10 +216,6 @@ def run_training(
             raise ValueError(f"{what} do not compose with --slices yet")
         if accum_steps != 1:
             raise ValueError(f"{what} do not compose with --accum-steps yet")
-        if fuse > 1 and zero:
-            raise ValueError(
-                "--zero does not compose with --steps-per-dispatch yet"
-            )
         if rule_kwargs:
             raise ValueError(f"{what} got unexpected options {sorted(rule_kwargs)}")
     if nd_active and zero:

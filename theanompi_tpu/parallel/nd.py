@@ -292,26 +292,11 @@ class NDEngine:
         epoch remainder)."""
         del labels_g
         if self._fused is None:
-            step_fn = self._sharded_step_fn
+            from theanompi_tpu.parallel.fused import fuse_sharded_step
 
-            def sharded_fused(state, toks_g, rngs):
-                def body(st, inp):
-                    toks, r = inp
-                    return step_fn(st, toks, r)
-
-                return lax.scan(body, state, (toks_g, rngs))
-
-            self._fused = jax.jit(
-                jax.shard_map(
-                    sharded_fused,
-                    mesh=self.mesh,
-                    in_specs=(
-                        self._state_specs, P(None, *self._tok_spec), P()
-                    ),
-                    out_specs=(self._state_specs, P()),
-                    check_vma=False,
-                ),
-                donate_argnums=(0,) if self._donate else (),
+            self._fused = fuse_sharded_step(
+                self._sharded_step_fn, self.mesh, self._state_specs,
+                (P(None, *self._tok_spec), P()), self._donate,
             )
         return self._fused(state, tokens_g, rngs)
 

@@ -65,6 +65,7 @@ def make_zero1_train_step(
     steps_per_epoch: int = 1,
     input_transform: Optional[Callable] = None,
     donate: bool = True,
+    fused: bool = False,
 ):
     """Build ``(init_state, train_step)`` for ZeRO-1 BSP training over
     ``mesh``'s ``axis_name``.
@@ -73,6 +74,9 @@ def make_zero1_train_step(
     sharded). ``train_step(state, x, y, rng) -> (state, metrics)`` with
     ``x``/``y`` sharded over the axis (the global batch, exactly like
     parallel/bsp.py). ``optimizer`` defaults to the model recipe's.
+    With ``fused=True`` the returned step instead takes stacked
+    ``[g, batch, ...]`` groups + ``[g]`` keys and scans ``g`` sub-steps
+    in one program (``steps_per_dispatch``; metrics stacked).
     """
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     if axis_name not in sizes:
@@ -172,6 +176,16 @@ def make_zero1_train_step(
             metrics,
         )
 
+    if fused:
+        # fused dispatch: lax.scan over stacked [g, batch, ...] groups,
+        # same amortization as make_bsp_fused_step (stacked metrics out)
+        from theanompi_tpu.parallel.fused import fuse_sharded_step
+
+        return init_state, fuse_sharded_step(
+            sharded_step, mesh, state_specs,
+            (P(None, axis_name), P(None, axis_name), P()), donate,
+        )
+
     train_step = jax.jit(
         jax.shard_map(
             sharded_step,
@@ -216,6 +230,9 @@ class ZeroEngine:
             model, mesh, steps_per_epoch=steps_per_epoch,
             input_transform=input_transform,
         )
+        self._build = dict(steps_per_epoch=steps_per_epoch,
+                           input_transform=input_transform)
+        self._fused = None
         self._eval = make_bsp_eval_step(
             model, mesh, input_transform=input_transform, eval_views=eval_views,
         )
@@ -227,9 +244,13 @@ class ZeroEngine:
         return self._step(state, images, labels, rng)
 
     def fused_train_step(self, state, images, labels, rngs):
-        raise NotImplementedError(
-            "steps_per_dispatch > 1 is not supported by the ZeRO engine yet"
-        )
+        """``g`` ZeRO steps in one program (stacked batches + keys, like
+        make_bsp_fused_step); jit recompiles per distinct group size."""
+        if self._fused is None:
+            _, self._fused = make_zero1_train_step(
+                self.model, self.mesh, fused=True, **self._build
+            )
+        return self._fused(state, images, labels, rngs)
 
     def exchange(self, state):
         return state

@@ -50,20 +50,11 @@ def load_jsonl(path: str) -> dict:
 
 
 def discover(paths: list[str]) -> dict[str, str]:
-    """``{label: jsonl_path}`` from a mix of dirs and files. Duplicate
-    labels are disambiguated with the parent dir (two ``run.jsonl``
-    inputs must both appear, not silently overwrite each other)."""
-    runs: dict[str, str] = {}
-
-    def add(label: str, f: str):
-        if label in runs and runs[label] != f:
-            label = f"{os.path.basename(os.path.dirname(f))}/{label}"
-            i = 2
-            base = label
-            while label in runs:
-                label, i = f"{base}#{i}", i + 1
-        runs[label] = f
-
+    """``{label: jsonl_path}`` from a mix of dirs and files. Labels that
+    collide (two ``run.jsonl`` inputs, or identically-named dirs) are
+    ALL relabeled with the shortest path suffix that tells them apart —
+    every requested run appears, unambiguously."""
+    pairs: list[tuple[str, str]] = []
     for p in paths:
         if os.path.isdir(p):
             found = sorted(glob.glob(os.path.join(p, "*.jsonl")))
@@ -74,9 +65,30 @@ def discover(paths: list[str]) -> dict[str, str]:
                     os.path.splitext(os.path.basename(f))[0]
                 if len(found) > 1:
                     label = os.path.splitext(os.path.basename(f))[0]
-                add(label, f)
+                pairs.append((label, f))
         else:
-            add(os.path.splitext(os.path.basename(p))[0], p)
+            pairs.append((os.path.splitext(os.path.basename(p))[0], p))
+
+    def suffix(f: str, k: int) -> str:
+        parts = os.path.normpath(os.path.abspath(f)).split(os.sep)
+        return "/".join(parts[-k:])
+
+    from collections import Counter
+
+    counts = Counter(lbl for lbl, _ in pairs)
+    runs: dict[str, str] = {}
+    for lbl, f in pairs:
+        if counts[lbl] > 1:
+            peers = [g for l2, g in pairs if l2 == lbl and g != f]
+            k = 2
+            while any(suffix(g, k) == suffix(f, k) for g in peers):
+                k += 1
+            lbl = suffix(f, k)
+        if lbl in runs and runs[lbl] != f:  # same path listed twice etc.
+            base, i = lbl, 2
+            while lbl in runs:
+                lbl, i = f"{base}#{i}", i + 1
+        runs[lbl] = f
     return runs
 
 
