@@ -5,10 +5,11 @@ could only be tested on a real multi-GPU MPI cluster. Here every
 collective/exchanger/sync-rule test runs on a real 8-way mesh emulated
 on host CPU, so distributed semantics are unit-testable in CI.
 
-Tier budget (round 4, single-CPU host): ``pytest -m "not slow"`` = 191
-tests, ~148 s with a warm compilation cache (~256 s on a fresh
-checkout, where every XLA compile is cold); the full suite adds the
-``slow``-marked compile-heavy integration/oracle tests. Keep new
+Tier budget (round 4, single-CPU host): ``pytest -m "not slow"`` ~= 205
+tests in ~148 s with a warm compilation cache (~5 min on a fresh
+checkout, where every XLA compile is cold); the full suite (~260 tests)
+adds the ``slow``-marked compile-heavy integration/oracle tests,
+~21 min warm. Keep new
 fast-tier tests on TinyCNN-sized models (tests/tinymodel.py) — the
 budget is compile-bound, not compute-bound.
 """

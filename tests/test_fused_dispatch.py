@@ -87,12 +87,89 @@ def test_fused_max_steps_exact():
     assert out["steps"] == 5
 
 
-def test_fused_rejected_for_async_rules():
-    with pytest.raises(ValueError, match="steps_per_dispatch"):
-        run_training(
-            seed=0, steps_per_dispatch=2,
-            **{**_KW, "rule": "gosgd"},
+def _async_oracle(engine_cls, mesh_n, g_steps, exchange_boundary, **eng_kw):
+    """Fused group of ``g_steps`` == the per-step driver sequence
+    (train_step + engine-cadenced exchange/gossip), from the same
+    state/keys/data. ``exchange_boundary``: call .exchange() every k
+    steps like the driver (EASGD); 0 = rule exchanges inside its step
+    (GoSGD)."""
+    import jax.numpy as jnp
+
+    from tinymodel import TinyCNN
+    from theanompi_tpu.parallel import make_mesh
+    from theanompi_tpu.parallel.mesh import put_global_batch, put_stacked_batches
+
+    model = TinyCNN(
+        TinyCNN.default_recipe().replace(
+            batch_size=8, input_shape=(16, 16, 3),
+            sched_kwargs={"lr": 0.05, "boundaries": [10**9]},
         )
+    )
+    mesh = make_mesh(mesh_n)
+    eng = engine_cls(model, mesh, **eng_kw)
+    r = np.random.RandomState(0)
+    xs = r.randn(g_steps, 8 * mesh_n, 16, 16, 3).astype(np.float32)
+    ys = r.randint(0, 10, (g_steps, 8 * mesh_n)).astype(np.int32)
+    keys = [jax.random.PRNGKey(10 + i) for i in range(g_steps)]
+
+    s = eng.init_state(jax.random.PRNGKey(0))
+    seq_losses = []
+    for i in range(g_steps):
+        s, m = eng.train_step(
+            s, put_global_batch(mesh, xs[i]), put_global_batch(mesh, ys[i]),
+            keys[i],
+        )
+        seq_losses.append(float(m["loss"]))
+        if exchange_boundary and (i + 1) % exchange_boundary == 0:
+            s = eng.exchange(s)
+
+    eng2 = engine_cls(model, mesh, **eng_kw)
+    sf = eng2.init_state(jax.random.PRNGKey(0))
+    sf, mf = eng2.fused_train_step(
+        sf, put_stacked_batches(mesh, xs), put_stacked_batches(mesh, ys),
+        jnp.stack(keys),
+    )
+    np.testing.assert_allclose(np.asarray(mf["loss"]), seq_losses, rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(jax.device_get(s)),
+        jax.tree_util.tree_leaves(jax.device_get(sf)),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+
+
+def test_easgd_fused_matches_per_step_with_exchange():
+    """4 fused EASGD steps with avg_freq=2 == the per-step sequence
+    including BOTH elastic exchanges (the cond fires at steps 2 and 4)."""
+    from theanompi_tpu.parallel.easgd import EASGDEngine
+
+    _async_oracle(EASGDEngine, 4, 4, exchange_boundary=2, avg_freq=2)
+
+
+def test_gosgd_fused_matches_per_step_gossip_cadence():
+    """4 fused GoSGD steps with gossip_every=2 == the per-step sequence
+    (gossip at substeps 2 and 4, local-only at 1 and 3)."""
+    from theanompi_tpu.parallel.gosgd import GOSGDEngine
+
+    _async_oracle(GOSGDEngine, 4, 4, exchange_boundary=0,
+                  p_push=0.9, gossip_every=2)
+
+
+def test_easgd_fused_via_driver():
+    from tinymodel import TinyCNN
+
+    out = run_training(
+        rule="easgd", model_cls=TinyCNN, devices=8, avg_freq=2,
+        steps_per_dispatch=2, max_steps=4, n_epochs=4,
+        dataset="synthetic",
+        dataset_kwargs={"n_train": 64, "n_val": 32, "image_shape": [16, 16, 3]},
+        recipe_overrides={
+            "batch_size": 4, "input_shape": (16, 16, 3),
+            "sched_kwargs": {"lr": 0.05, "boundaries": [10**9]},
+        },
+        print_freq=0,
+    )
+    assert out["steps"] == 4
+    assert np.isfinite(out["val"]["loss"])
 
 
 def test_zero_fused_matches_per_step():

@@ -54,6 +54,16 @@ def test_discover_disambiguates_same_basename(tmp_path):
     assert any("a/ckpt" in l for l in runs2) and any("b/ckpt" in l for l in runs2)
 
 
+def test_discover_same_file_two_spellings_is_one_run(tmp_path, monkeypatch):
+    """'expA/run.jsonl' and './expA/run.jsonl' are ONE run (this case
+    previously hung looking for a distinguishing suffix that cannot
+    exist)."""
+    p = _write_run(str(tmp_path / "expA"), "run")
+    monkeypatch.chdir(tmp_path)
+    runs = discover([p, os.path.join(".", "expA", "run.jsonl")])
+    assert len(runs) == 1 and list(runs.values()) == [p]
+
+
 def test_end_to_end_png(tmp_path):
     _write_run(str(tmp_path / "a"), "a")
     _write_run(str(tmp_path / "b"), "b")

@@ -327,11 +327,9 @@ def run_training(
         )
     if rule in per_worker_rules and strategy != "psum":
         raise ValueError("strategy applies to the BSP rule only")
-    if fuse > 1 and rule != "bsp":
-        raise ValueError(
-            "steps_per_dispatch > 1 fuses the allreduce-inside BSP step; "
-            "EASGD/GoSGD exchange between host steps"
-        )
+    # fuse>1 works for every rule: BSP scans allreduce-inside steps;
+    # EASGD embeds its elastic exchange at the avg_freq boundaries
+    # inside the scan; GoSGD ships per-substep gossip-cadence flags
     # Async-rule worker groups: each worker = group_size chips, so the
     # worker count (and the global batch multiplier) is n_dev / group_size
     # (bsp with group_size already raised above)

@@ -135,6 +135,10 @@ class EASGDEngine:
             metrics = lax.pmean(metrics, all_axes)
             return state._replace(workers=workers), metrics
 
+        self._sharded_step_fn = sharded_step
+        self._state_spec = EASGDState(P(ax), P(), P())
+        self._bspec = bspec
+        self._fused = None
         self._step = jax.jit(
             jax.shard_map(
                 sharded_step,
@@ -163,6 +167,7 @@ class EASGDEngine:
             )
             return EASGDState(workers, center, center_ms)
 
+        self._sharded_exchange_fn = sharded_exchange
         self._exchange = jax.jit(
             jax.shard_map(
                 sharded_exchange,
@@ -207,6 +212,36 @@ class EASGDEngine:
 
     def train_step(self, state, images, labels, rng):
         return self._step(state, images, labels, rng)
+
+    def fused_train_step(self, state, images, labels, rngs):
+        """``g`` local steps in ONE program, with the elastic exchange
+        embedded at the exact ``avg_freq`` boundaries the per-step
+        driver would hit (``lax.cond`` on the in-program step counter) —
+        identical trajectory, one dispatch. The driver must NOT call
+        ``exchange()`` around fused groups; the recorder's comm bracket
+        is subsumed into the step (documented tradeoff of fusion)."""
+        if self._fused is None:
+            from theanompi_tpu.parallel.fused import fuse_sharded_step
+
+            freq = self.avg_freq
+            step_fn = self._sharded_step_fn
+            exchange_fn = self._sharded_exchange_fn
+
+            def step_and_maybe_exchange(st, x, y, r):
+                st, metrics = step_fn(st, x, y, r)
+                # workers.step is the stacked [1] per-worker counter;
+                # it matches the driver's step_count after each step
+                st = lax.cond(
+                    st.workers.step[0] % freq == 0,
+                    exchange_fn, lambda s: s, st,
+                )
+                return st, metrics
+
+            self._fused = fuse_sharded_step(
+                step_and_maybe_exchange, self.mesh, self._state_spec,
+                (P(None, *self._bspec), P(None, *self._bspec), P()), True,
+            )
+        return self._fused(state, images, labels, rngs)
 
     def exchange(self, state):
         return self._exchange(state)

@@ -159,32 +159,46 @@ class GOSGDEngine:
             acc_share = keep_share + received[-1]
             return unravel(acc / acc_share), acc_share
 
-        def make_sharded_step(with_gossip: bool):
-            def sharded_step(state: GOSGDState, images, labels, rng):
-                local = jax.tree_util.tree_map(lambda v: v[0], state.workers)
-                a_local = state.alpha[0]
-                step_rng, gossip_rng = jax.random.split(rng)
-                from theanompi_tpu.parallel.mesh import fold_linear_index
+        def sharded_step_flag(state: GOSGDState, images, labels, rng,
+                              with_gossip):
+            """``with_gossip`` may be a static Python bool (the cond
+            folds at trace time — the per-step jit variants) or a traced
+            bool (the fused scan decides per substep)."""
+            local = jax.tree_util.tree_map(lambda v: v[0], state.workers)
+            a_local = state.alpha[0]
+            step_rng, gossip_rng = jax.random.split(rng)
+            from theanompi_tpu.parallel.mesh import fold_linear_index
 
-                step_rng = fold_linear_index(step_rng, all_axes, mesh)
-                new_local, metrics = base_step(local, images, labels, step_rng)
-                if g > 1:
-                    # group-replicated worker: average BN stats within
-                    # the group (grads were already psummed)
-                    new_local = new_local._replace(
-                        model_state=lax.pmean(new_local.model_state, DATA_AXIS)
-                    )
-                a_new = a_local
-                if with_gossip:
-                    merged, a_new = gossip(new_local.params, a_local, gossip_rng)
-                    new_local = new_local._replace(params=merged)
-                metrics = lax.pmean(metrics, all_axes)
-                return (
-                    GOSGDState(
-                        jax.tree_util.tree_map(lambda v: v[None], new_local), a_new[None]
-                    ),
-                    metrics,
+            step_rng = fold_linear_index(step_rng, all_axes, mesh)
+            new_local, metrics = base_step(local, images, labels, step_rng)
+            if g > 1:
+                # group-replicated worker: average BN stats within
+                # the group (grads were already psummed)
+                new_local = new_local._replace(
+                    model_state=lax.pmean(new_local.model_state, DATA_AXIS)
                 )
+            merged, a_new = lax.cond(
+                with_gossip,
+                lambda: gossip(new_local.params, a_local, gossip_rng),
+                lambda: (new_local.params, a_local),
+            )
+            new_local = new_local._replace(params=merged)
+            metrics = lax.pmean(metrics, all_axes)
+            return (
+                GOSGDState(
+                    jax.tree_util.tree_map(lambda v: v[None], new_local), a_new[None]
+                ),
+                metrics,
+            )
+
+        self._sharded_step_flag = sharded_step_flag
+        self._state_spec = GOSGDState(P(ax), P(ax))
+        self._bspec = bspec
+        self._fused = None
+
+        def make_sharded_step(with_gossip: bool):
+            def sharded_step(state, images, labels, rng):
+                return sharded_step_flag(state, images, labels, rng, with_gossip)
 
             return jax.jit(
                 jax.shard_map(
@@ -252,6 +266,33 @@ class GOSGDEngine:
             else self._step_local
         )
         return step(state, images, labels, rng)
+
+    def fused_train_step(self, state, images, labels, rngs):
+        """``g`` local-SGD-plus-gossip steps in ONE program; each
+        substep's gossip decision follows the same ``gossip_every``
+        cadence the per-step path applies (substep counters shipped as
+        a stacked operand, uniform across devices so the in-cond
+        collective cannot diverge)."""
+        if self._count is None:
+            self._count = self.get_step(state)
+        g_steps = int(images.shape[0])
+        counts = jnp.arange(1, g_steps + 1, dtype=jnp.int32) + self._count
+        self._count += g_steps
+        if self._fused is None:
+            from theanompi_tpu.parallel.fused import fuse_sharded_step
+
+            every = self.gossip_every
+            flag_fn = self._sharded_step_flag
+
+            def substep(st, x, y, r, count):
+                return flag_fn(st, x, y, r, count % every == 0)
+
+            self._fused = fuse_sharded_step(
+                substep, self.mesh, self._state_spec,
+                (P(None, *self._bspec), P(None, *self._bspec), P(), P()),
+                True,
+            )
+        return self._fused(state, images, labels, rngs, counts)
 
     def exchange(self, state):
         return state

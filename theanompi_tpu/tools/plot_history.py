@@ -75,16 +75,29 @@ def discover(paths: list[str]) -> dict[str, str]:
 
     from collections import Counter
 
-    counts = Counter(lbl for lbl, _ in pairs)
-    runs: dict[str, str] = {}
+    # identity is the resolved path: the same file listed under two
+    # spellings ('expA/x.jsonl' and './expA/x.jsonl') is ONE run, and
+    # only genuinely different files count as peers to disambiguate
+    seen: set = set()
+    uniq = []
     for lbl, f in pairs:
+        key = os.path.abspath(f)
+        if key not in seen:
+            seen.add(key)
+            uniq.append((lbl, f))
+    counts = Counter(lbl for lbl, _ in uniq)
+    runs: dict[str, str] = {}
+    for lbl, f in uniq:
         if counts[lbl] > 1:
-            peers = [g for l2, g in pairs if l2 == lbl and g != f]
+            peers = [
+                g for l2, g in uniq
+                if l2 == lbl and os.path.abspath(g) != os.path.abspath(f)
+            ]
             k = 2
             while any(suffix(g, k) == suffix(f, k) for g in peers):
                 k += 1
             lbl = suffix(f, k)
-        if lbl in runs and runs[lbl] != f:  # same path listed twice etc.
+        if lbl in runs:  # safety net: never drop a requested run
             base, i = lbl, 2
             while lbl in runs:
                 lbl, i = f"{base}#{i}", i + 1
