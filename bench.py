@@ -186,8 +186,15 @@ def bench_compute(steps: int = 20, trials: int = 3, model_name: str = "alexnet")
     rng = np.random.RandomState(0)
     ishape = tuple(model.recipe.input_shape)
     ncls = model.recipe.num_classes
-    x = put_global_batch(mesh, jnp.asarray(rng.randn(batch, *ishape), jnp.float32))
-    y = put_global_batch(mesh, jnp.asarray(rng.randint(0, ncls, batch), jnp.int32))
+    is_lm = bool(getattr(model, "is_lm", False))
+    if is_lm:
+        # token batches: x IS the label stream (next-token objective)
+        toks = rng.randint(0, ncls, (batch, *ishape)).astype(np.int32)
+        x = put_global_batch(mesh, jnp.asarray(toks))
+        y = x
+    else:
+        x = put_global_batch(mesh, jnp.asarray(rng.randn(batch, *ishape), jnp.float32))
+        y = put_global_batch(mesh, jnp.asarray(rng.randint(0, ncls, batch), jnp.int32))
     args = (state, x, y, jax.random.PRNGKey(1))
 
     # XLA's cost analysis counts a scan body ONCE regardless of trip
@@ -231,6 +238,13 @@ def bench_compute(steps: int = 20, trials: int = 3, model_name: str = "alexnet")
         "mfu": round(flops_s / peak, 4) if (flops_s and peak) else None,
         "batch": batch,
     }
+    if is_lm:
+        seq_len = ishape[0]
+        result["unit"] = "sequences/sec"
+        result["seq_len"] = seq_len
+        result["tokens_per_sec"] = round(img_s * seq_len, 1)
+        # TransformerLM computes in f32; peak is bf16 — conservative MFU
+        result["mfu_note"] = "f32 compute vs bf16 peak (conservative)"
     return result
 
 
@@ -407,7 +421,8 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--mode", choices=["compute", "e2e", "scaling"], default="compute")
     ap.add_argument("--model", default="alexnet",
-                    choices=["alexnet", "googlenet", "resnet50", "vgg16", "wrn"],
+                    choices=["alexnet", "googlenet", "resnet50", "vgg16", "wrn",
+                             "transformer_lm"],
                     help="compute mode: which zoo model to benchmark "
                          "(the driver contract stays the AlexNet default)")
     ap.add_argument("--steps", type=int, default=None)

@@ -50,6 +50,19 @@ def test_googlenet_param_count():
     assert _count(params) / 1e6 > 9  # aux heads present
 
 
+def test_transformer_lm_136m_registered_and_sized():
+    """The benchable LM config (beyond-parity throughput row): 136M
+    params, resolvable from the model registry and the bench zoo."""
+    from theanompi_tpu.models.zoo import zoo_entry
+
+    cls = get_model("transformer_lm_136m")
+    model = cls()
+    params, _ = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    assert abs(_count(params) / 1e6 - 136.1) < 1.0
+    bench_cls, batch = zoo_entry("transformer_lm")
+    assert bench_cls is cls and batch >= 4
+
+
 def test_inception_fused_front_matches_branches():
     """The MXU-shaping rewrite (b1/b3r/b5r 1x1 convs computed as ONE
     conv, then split — models/googlenet.py Inception.apply) is exact:

@@ -38,11 +38,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "psum_bf16 or reference names ar|asa32|asa16|nccl32|"
                         "nccl16)")
     p.add_argument("--steps-per-dispatch", type=int, default=1,
-                   help="BSP: fuse this many steps into one compiled dispatch "
-                        "(one H2D transfer + one host dispatch per group; "
-                        "amortizes dispatch latency on directly-attached "
-                        "hosts — measured HARMFUL on network-tunneled dev "
-                        "chips, whose large single transfers stall)")
+                   help="fuse this many steps into one compiled dispatch "
+                        "(one H2D transfer + one host dispatch per group) — "
+                        "works for every rule: EASGD embeds its avg_freq "
+                        "exchange in the scan, GoSGD keeps its gossip "
+                        "cadence per substep; amortizes dispatch latency on "
+                        "directly-attached hosts — measured HARMFUL on "
+                        "network-tunneled dev chips, whose large single "
+                        "transfers stall")
     p.add_argument("--accum-steps", type=int, default=1,
                    help="gradient accumulation: split each (per-device) "
                         "batch into this many microbatches inside the step "

@@ -24,6 +24,9 @@ MODEL_REGISTRY = {
     "googlenet": ("theanompi_tpu.models.googlenet", "GoogLeNet"),
     "vgg16": ("theanompi_tpu.models.model_zoo.vgg", "VGG16"),
     "resnet50": ("theanompi_tpu.models.model_zoo.resnet50", "ResNet50"),
+    "transformer_lm": ("theanompi_tpu.models.lm", "TransformerLMModel"),
+    "transformer_lm_136m": ("theanompi_tpu.models.lm", "TransformerLM_136M"),
+    "moe_lm": ("theanompi_tpu.models.lm", "MoELMModel"),
 }
 
 

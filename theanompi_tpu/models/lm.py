@@ -147,3 +147,37 @@ class MoELMModel(TransformerLMModel):
             "load-balance auxiliary loss cannot flow through the classifier "
             "contract's loss(logits, labels)"
         )
+
+
+class TransformerLM_136M(TransformerLMModel):
+    """GPT-2-small-scale benchable config (~136M params): the
+    single-chip throughput row for the beyond-parity LM stack
+    (``python bench.py --model transformer_lm``). 12 layers x d=768,
+    T=1024, 32k vocab, fused Pallas flash attention; f32 compute
+    (TransformerLM has no bf16 path yet — the reported MFU is measured
+    against the bf16 peak and therefore CONSERVATIVE, see bench.py).
+    Sized so TWO full f32 states (params + adam m/v) fit one v5e
+    alongside the un-sharded 32k-vocab logits: the bench runner cannot
+    donate its input state (it re-times from the same state), so a
+    350M config OOMs."""
+
+    name = "transformer_lm_136m"
+
+    @classmethod
+    def default_recipe(cls) -> LMRecipe:
+        return LMRecipe(
+            batch_size=8,
+            n_epochs=1,
+            optimizer="adam",
+            schedule="constant",
+            sched_kwargs={"lr": 3e-4},
+            lr_unit="step",
+            input_shape=(1024,),
+            num_classes=32768,
+            dataset="lm_synthetic",
+            d_model=768,
+            n_heads=12,
+            n_layers=12,
+            d_ff=3072,
+            attn="flash",
+        )

@@ -41,4 +41,10 @@ def zoo_entry(name: str):
         from theanompi_tpu.models.model_zoo.wrn import WRN
 
         return WRN, 1024
+    if name == "transformer_lm":
+        # beyond-parity LM row: ~136M params, T=1024, flash attention;
+        # batch in SEQUENCES (bench reports tokens/sec alongside)
+        from theanompi_tpu.models.lm import TransformerLM_136M
+
+        return TransformerLM_136M, 8
     raise ValueError(f"unknown bench model {name!r}")

@@ -177,11 +177,22 @@ class GOSGDEngine:
                 new_local = new_local._replace(
                     model_state=lax.pmean(new_local.model_state, DATA_AXIS)
                 )
-            merged, a_new = lax.cond(
-                with_gossip,
-                lambda: gossip(new_local.params, a_local, gossip_rng),
-                lambda: (new_local.params, a_local),
-            )
+            if isinstance(with_gossip, bool):
+                # static flag (the per-step jit variants): keep the
+                # no-gossip program genuinely collective-free — lax.cond
+                # stages BOTH branches even for a concrete predicate
+                # (verified), which would put a dead ppermute switch in
+                # the local step and lean on XLA to simplify it out
+                merged, a_new = (
+                    gossip(new_local.params, a_local, gossip_rng)
+                    if with_gossip else (new_local.params, a_local)
+                )
+            else:
+                merged, a_new = lax.cond(
+                    with_gossip,
+                    lambda: gossip(new_local.params, a_local, gossip_rng),
+                    lambda: (new_local.params, a_local),
+                )
             new_local = new_local._replace(params=merged)
             metrics = lax.pmean(metrics, all_axes)
             return (
