@@ -167,6 +167,32 @@ def test_pipeline_layout_guard(tmp_path):
 
 
 @pytest.mark.slow
+def test_interleaved_resume_refused_without_sidecar(tmp_path):
+    """Deleting pipeline_layout.json (or copying ckpt files into a fresh
+    dir) must NOT allow a cross-layout resume: the layout is embedded in
+    the checkpoint metadata and cross-checked at load."""
+    import os
+
+    ckpt = str(tmp_path / "ck")
+    kw = dict(
+        model_cls=TransformerLMModel,
+        devices=8,
+        pp=2,
+        microbatches=4,
+        recipe_overrides={**TINY, "n_layers": 4},
+        dataset_kwargs=DATA,
+        ckpt_dir=ckpt,
+        ckpt_every_epochs=1,
+        async_checkpoint=False,
+        print_freq=1000,
+    )
+    run_training(max_steps=2, pp_interleave=2, **kw)
+    os.remove(os.path.join(ckpt, "pipeline_layout.json"))
+    with pytest.raises(ValueError, match="embeds pipeline stack layout"):
+        run_training(max_steps=3, pp_interleave=1, resume=True, **kw)
+
+
+@pytest.mark.slow
 def test_lm_expert_launch():
     s = run_training(
         model_cls=MoELMModel,

@@ -87,6 +87,32 @@ def test_checkpoint_roundtrip_and_resume(tmp_path):
     assert int(s2.step) == 2
 
 
+def test_checkpoint_extra_meta_roundtrip(tmp_path):
+    """extra_meta embeds in the file itself (not a sidecar): the
+    pipeline stack layout must survive copying ckpt_*.npz alone."""
+    from theanompi_tpu.utils.checkpoint import (
+        read_checkpoint_meta,
+        save_checkpoint_sharded,
+    )
+
+    _, state = _state()
+    meta = {"pipeline_layout": {"interleave": 2, "n_stages": 4}}
+    path = save_checkpoint(str(tmp_path), state, 3, extra_meta=meta)
+    assert read_checkpoint_meta(path) == meta
+    # plain save without meta: empty dict, not an error
+    path2 = save_checkpoint(str(tmp_path), state, 4)
+    assert read_checkpoint_meta(path2) == {}
+    # the state itself still loads (the __usermeta__ key is not a leaf)
+    _, template = _state()
+    restored, _ = load_checkpoint(path, template)
+    assert int(restored.step) == int(state.step)
+    # sharded format carries it too
+    spath = save_checkpoint_sharded(
+        str(tmp_path / "sh"), state, 5, extra_meta=meta
+    )
+    assert read_checkpoint_meta(spath) == meta
+
+
 def test_checkpoint_prune_and_latest(tmp_path):
     _, state = _state()
     for s in (1, 2, 3, 4):

@@ -39,9 +39,18 @@ from theanompi_tpu.utils import (
 from theanompi_tpu.utils.checkpoint import AsyncCheckpointer, save_checkpoint_sharded
 
 
+def _layout_mismatch(a: dict, b: dict) -> bool:
+    """One comparator for pipeline stack layout dicts, shared by the
+    sidecar pre-flight check and the in-checkpoint embedded check so the
+    two defenses can never silently diverge."""
+    return (a.get("interleave", 1), a.get("n_stages")) != (
+        b.get("interleave", 1), b.get("n_stages")
+    )
+
+
 def pipeline_layout_guard(
     ckpt_dir: str, pp: int, pp_interleave: int, resume: bool
-) -> None:
+) -> dict:
     """Interleaved pipeline stacking PERMUTES layers on the stacked axis
     (parallel/pipeline.py::stack_pipeline_params), and every layout
     produces identical leaf shapes — so a checkpoint written under one
@@ -49,7 +58,13 @@ def pipeline_layout_guard(
     another. A ``pipeline_layout.json`` sidecar records the stacking
     layout; resume refuses a mismatch loudly. Plain GPipe stacking
     (interleave=1) is layout-invariant across ``--pp``, so only the
-    interleaved case pins the stage count."""
+    interleaved case pins the stage count.
+
+    The sidecar is the fast pre-flight check only — the layout is ALSO
+    embedded in each checkpoint's metadata (``extra_meta``) and
+    cross-checked at load, so checkpoints copied without the sidecar
+    still refuse to resume layer-permuted. Returns the current layout
+    dict for that embedding."""
     import json as _json
     import tempfile
 
@@ -78,9 +93,7 @@ def pipeline_layout_guard(
                 "sidecar) before reusing this dir"
             )
         stored = current  # nothing at stake; rewrite below
-    mismatch = (stored.get("interleave", 1), stored.get("n_stages")) != (
-        current["interleave"], current["n_stages"]
-    )
+    mismatch = _layout_mismatch(stored, current)
     if resume and mismatch:
         raise ValueError(
             f"checkpoints in {ckpt_dir!r} use pipeline stack layout "
@@ -106,6 +119,7 @@ def pipeline_layout_guard(
             os.replace(tmp, path)  # atomic: no truncated sidecar
         elif os.path.exists(path):
             os.remove(path)  # back to the layout-invariant default
+    return current
 
 
 def run_training(
@@ -485,10 +499,12 @@ def run_training(
     state = engine.init_state(rng)
     start_epoch = 0
     summary_resumed_from = None
+    layout_meta = None
     if ckpt_dir:
         # validates for EVERY rule (a fresh non-pipeline run must not
         # clobber an interleaved dir either); writes/clears the sidecar
-        pipeline_layout_guard(ckpt_dir, pp, pp_interleave, resume)
+        layout = pipeline_layout_guard(ckpt_dir, pp, pp_interleave, resume)
+        layout_meta = {"pipeline_layout": layout}
     if resume and ckpt_dir:
         path = latest_checkpoint(ckpt_dir)
         if n_proc > 1:
@@ -515,6 +531,22 @@ def run_training(
                     "for --resume)"
                 )
         if path:
+            from theanompi_tpu.utils.checkpoint import read_checkpoint_meta
+
+            saved_layout = read_checkpoint_meta(path).get("pipeline_layout")
+            if saved_layout is not None and layout_meta is not None and (
+                _layout_mismatch(saved_layout, layout_meta["pipeline_layout"])
+            ):
+                # defense in depth vs a deleted/absent sidecar: the
+                # checkpoint itself knows the stack layout it was saved
+                # under (every layout has identical leaf shapes, so a
+                # mismatch would otherwise load silently layer-permuted)
+                raise ValueError(
+                    f"checkpoint {path!r} embeds pipeline stack layout "
+                    f"{saved_layout} but this run requests "
+                    f"{layout_meta['pipeline_layout']} — rerun with the "
+                    "matching --pp/--pp-interleave"
+                )
             restored, saved_rng = load_checkpoint(path, state)
             state = jax.tree_util.tree_map(jnp.asarray, restored)
             if saved_rng is not None:
@@ -563,6 +595,10 @@ def run_training(
 
     summary: dict = {"epochs": [], "rule": rule, "model": model.name,
                      "resumed_from_step": summary_resumed_from}
+    # images shipped per dispatch ('step' timing bracket) — fused
+    # dispatches carry g x batch, so throughput must be computed from
+    # this ledger, not batch / mean_time (which undercounts g-fold)
+    dispatch_images: list[int] = []
     # sharded_ckpt: per-host shard files, no cross-host gather / rank-0
     # memory spike; restorable under any process count (SURVEY.md §5.4)
     ckpt_writer = (
@@ -625,11 +661,19 @@ def run_training(
                     rec.end("step", sync=metrics["loss"])
                     step_count += g
                     epoch_steps += g
-                    rec.train_metrics(
-                        step_count,
-                        {k: v.mean() for k, v in metrics.items()},
-                        n_images=batch * g,
-                    )
+                    dispatch_images.append(batch * g)
+                    # one JSONL row PER SUBSTEP from the stacked metrics,
+                    # so fused runs yield the same-resolution loss/LR
+                    # curves as per-step runs of the same config
+                    # (trajectories are bit-identical); the group's
+                    # throughput is attributed to its final row
+                    mh = {k: np.asarray(v) for k, v in metrics.items()}
+                    for i in range(g):
+                        rec.train_metrics(
+                            step_count - g + i + 1,
+                            {k: a[i] for k, a in mh.items()},
+                            n_images=batch * g if i == g - 1 else 0,
+                        )
                     rec.start("wait")
                     if max_steps and step_count >= max_steps:
                         loader.close()
@@ -655,6 +699,7 @@ def run_training(
                     rec.end("step", sync=metrics["loss"])
                     step_count += 1
                     epoch_steps += 1
+                    dispatch_images.append(batch)
                     # periodic exchange (EASGD avg_freq; reference: worker
                     # loop calling exchanger.exchange() — recorded as 'comm')
                     if engine.exchange_every and step_count % engine.exchange_every == 0:
@@ -691,9 +736,11 @@ def run_training(
                     # overlapped with the next epoch's steps; ordering +
                     # durability enforced by the writer (drained in the
                     # finally below before the summary returns)
-                    ckpt_writer.save(ckpt_dir, state, step_count, rng=rng)
+                    ckpt_writer.save(ckpt_dir, state, step_count, rng=rng,
+                                     extra_meta=layout_meta)
                 else:
-                    sync_save(ckpt_dir, state, step_count, rng=rng)
+                    sync_save(ckpt_dir, state, step_count, rng=rng,
+                              extra_meta=layout_meta)
             rec.save()
             summary["epochs"].append(epoch)
             if max_steps and step_count >= max_steps:
@@ -726,8 +773,11 @@ def run_training(
     # backend that silently drops work (tools/repro_tunnel_fault.py)
     # shows up as a mismatch here
     summary["device_steps"] = engine.get_step(state)
+    k_recent = min(50, len(dispatch_images))
+    t_recent = rec.mean_time("step", k_recent)
     summary["images_per_sec"] = (
-        batch / rec.mean_time("step", 50) if rec.mean_time("step", 50) else 0.0
+        (sum(dispatch_images[-k_recent:]) / k_recent) / t_recent
+        if (k_recent and t_recent) else 0.0
     )
     if return_recorder:
         summary["recorder"] = rec

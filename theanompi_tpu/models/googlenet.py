@@ -56,6 +56,18 @@ class Inception(Layer):
             name="bp",
         )
         self.branches = {"b1": self.b1, "b3": self.b3, "b5": self.b5, "bp": self.bp}
+        # The fused-front apply slices each branch at the end of its
+        # leading conv+relu pair; pin that structural assumption HERE so
+        # a change to _conv_relu's composition fails at build time, not
+        # by silently misaligning the tail slicing below.
+        self._front_len = len(_conv_relu(1, 1))
+        for bname in ("b1", "b3", "b5"):
+            branch = self.branches[bname]
+            if not isinstance(branch.layers[0], nn.Conv):
+                raise AssertionError(
+                    f"Inception fused front expects branch {bname!r} to "
+                    f"start with a Conv; got {type(branch.layers[0]).__name__}"
+                )
 
     def init(self, key, in_shape):
         params, state = {}, {}
@@ -63,6 +75,12 @@ class Inception(Layer):
         for k, (bname, branch) in zip(keys, self.branches.items()):
             p, s = branch.init(k, in_shape)
             params[bname] = p
+            if bname != "bp" and not {"w", "b"} <= set(p[branch._keys[0]]):
+                raise AssertionError(
+                    f"Inception fused front expects branch {bname!r}'s "
+                    f"leading conv params to carry 'w'/'b'; got "
+                    f"{sorted(p[branch._keys[0]])}"
+                )
             if s and bname != "bp":
                 # the fused apply below does not thread state through the
                 # b1/b3/b5 tails — fail at build time, not silently, if a
@@ -98,8 +116,10 @@ class Inception(Layer):
         y5r = y[..., self.c1 + self.c3r :]
 
         def _tail(branch, bname, h):
-            # remaining layers of the branch (conv 3x3/5x5 + relu)
-            for lname, layer in zip(branch._keys[2:], branch.layers[2:]):
+            # remaining layers of the branch (conv 3x3/5x5 + relu); the
+            # split point is _conv_relu's OWN length, asserted in __init__
+            fl = self._front_len
+            for lname, layer in zip(branch._keys[fl:], branch.layers[fl:]):
                 h, _ = layer.apply(
                     params[bname].get(lname, {}), {}, h, train=train, rng=rng
                 )

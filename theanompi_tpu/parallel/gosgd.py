@@ -270,13 +270,17 @@ class GOSGDEngine:
     def train_step(self, state, images, labels, rng):
         if self._count is None:  # resumed state: derive from the step counter
             self._count = self.get_step(state)
-        self._count += 1
+        nxt = self._count + 1
         step = (
             self._step_gossip
-            if self._count % self.gossip_every == 0
+            if nxt % self.gossip_every == 0
             else self._step_local
         )
-        return step(state, images, labels, rng)
+        out = step(state, images, labels, rng)
+        # advance only after the dispatch succeeds: a raise (OOM on a new
+        # shape) must not shift the gossip cadence off the applied steps
+        self._count = nxt
+        return out
 
     def fused_train_step(self, state, images, labels, rngs):
         """``g`` local-SGD-plus-gossip steps in ONE program; each
@@ -288,7 +292,6 @@ class GOSGDEngine:
             self._count = self.get_step(state)
         g_steps = int(images.shape[0])
         counts = jnp.arange(1, g_steps + 1, dtype=jnp.int32) + self._count
-        self._count += g_steps
         if self._fused is None:
             from theanompi_tpu.parallel.fused import fuse_sharded_step
 
@@ -303,7 +306,12 @@ class GOSGDEngine:
                 (P(None, *self._bspec), P(None, *self._bspec), P(), P()),
                 True,
             )
-        return self._fused(state, images, labels, rngs, counts)
+        out = self._fused(state, images, labels, rngs, counts)
+        # advance only after the fused dispatch returns: a raise (OOM on
+        # a new trimmed-group shape) must not permanently shift the
+        # gossip cadence off the actually-applied steps
+        self._count += g_steps
+        return out
 
     def exchange(self, state):
         return state

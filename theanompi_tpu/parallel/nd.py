@@ -141,13 +141,14 @@ class NDEngine:
             self.schedule = pipeline_schedule_report(
                 n_pipe, self.microbatches, pp_interleave
             )
-            print(
-                f"[nd] pipeline schedule: {self.schedule['ticks']} ticks, "
-                f"bubble {self.schedule['bubble_fraction']:.1%} "
-                f"(interleave={pp_interleave}; suggest >= "
-                f"{self.schedule['suggested_microbatches']} microbatches "
-                f"for <10%)"
-            )
+            if jax.process_index() == 0:  # once per pod, not per host
+                print(
+                    f"[nd] pipeline schedule: {self.schedule['ticks']} ticks, "
+                    f"bubble {self.schedule['bubble_fraction']:.1%} "
+                    f"(interleave={pp_interleave}; suggest >= "
+                    f"{self.schedule['suggested_microbatches']} microbatches "
+                    f"for <10%)"
+                )
             tok_spec = P(None, dp_axis)  # [M, B, T]: M replicated, B on dp
             batch_axes = (dp_axis,) if dp_axis else ()
         elif ep_axis is not None:

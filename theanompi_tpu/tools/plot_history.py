@@ -30,7 +30,8 @@ import sys
 def load_jsonl(path: str) -> dict:
     """Split one Recorder JSONL into train/val series."""
     train: dict[str, list] = {"step": [], "loss": [], "error": [],
-                              "lr": [], "images_per_sec": []}
+                              "lr": [], "images_per_sec": [],
+                              "ips_step": []}
     val: dict[str, list] = {"epoch": [], "loss": [], "error": []}
     with open(path) as f:
         for line in f:
@@ -42,6 +43,11 @@ def load_jsonl(path: str) -> dict:
                 for k in train:
                     if k in row:
                         train[k].append(row[k])
+                # throughput is SPARSE under fused dispatch (one reading
+                # per dispatch, on the group's final substep row): pair
+                # it with its own step axis, never the full step list
+                if "images_per_sec" in row and "step" in row:
+                    train["ips_step"].append(row["step"])
             elif row.get("kind") == "val":
                 for k in val:
                     if k in row:
@@ -138,8 +144,8 @@ def plot(runs: dict[str, str], out: str, show: bool = False,
             # that reached 0% val error) is still the error curve
             key = "error" if len(v["error"]) == len(v["epoch"]) else "loss"
             ax_val.plot(v["epoch"], v[key], marker="o", label=f"{label} ({key})")
-        if t["step"] and t["images_per_sec"]:
-            ax_ips.plot(*smoothed(t["step"], t["images_per_sec"], smooth),
+        if t["ips_step"] and t["images_per_sec"]:
+            ax_ips.plot(*smoothed(t["ips_step"], t["images_per_sec"], smooth),
                         label=label)
         if t["step"] and t["lr"]:
             ax_lr.plot(t["step"][: len(t["lr"])], t["lr"], label=label)

@@ -60,12 +60,23 @@ def save_checkpoint(
     step: int,
     rng: Optional[jax.Array] = None,
     keep: int = 3,
+    extra_meta: Optional[dict] = None,
 ) -> Optional[str]:
     """Atomically write ``ckpt_{step}.npz``; prune to the newest ``keep``.
     COLLECTIVE in multi-host runs: every process must call it (sharded
     leaves are gathered cross-host), then only process 0 writes; returns
-    the path (or None on non-writer processes)."""
+    the path (or None on non-writer processes).
+
+    ``extra_meta`` (JSON-serializable dict) is embedded in the file and
+    readable via :func:`read_checkpoint_meta` — the driver records the
+    pipeline stack layout here so a checkpoint copied into a fresh dir
+    (without its ``pipeline_layout.json`` sidecar) still refuses to load
+    layer-permuted."""
     flat = _flatten_with_paths(state)
+    if extra_meta:
+        import json as _json
+
+        flat["__usermeta__"] = np.asarray(_json.dumps(extra_meta))
     if rng is not None:
         # record WHICH impl produced the key data: width alone is
         # ambiguous (rbg and unsafe_rbg share width 4 but derive
@@ -142,6 +153,7 @@ def save_checkpoint_sharded(
     step: int,
     rng: Optional[jax.Array] = None,
     keep: int = 3,
+    extra_meta: Optional[dict] = None,
 ) -> Optional[str]:
     """Per-host sharded save: each process writes ONLY the shards it
     holds — no cross-host gather and no rank-0 host-memory spike, unlike
@@ -166,6 +178,10 @@ def save_checkpoint_sharded(
     me = jax.process_index()
     flat: dict[str, np.ndarray] = {}
     meta: dict[str, Any] = {"leaves": {}, "step": int(step)}
+    if extra_meta:
+        # every member file carries it: read_checkpoint_meta must work
+        # from any process's file under any later process count
+        meta["user"] = extra_meta
     leaves_with_paths = jax.tree_util.tree_flatten_with_path(state)[0]
     for path, leaf in leaves_with_paths:
         key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
@@ -321,6 +337,22 @@ def _load_sharded(path: str, state_template: PyTree):
     return jax.tree_util.tree_unflatten(treedef, new_leaves), rng
 
 
+def read_checkpoint_meta(path: str) -> dict:
+    """The ``extra_meta`` dict embedded at save time (empty dict if the
+    checkpoint predates the field). Dispatches on the filename like
+    :func:`load_checkpoint`; for per-host sharded sets any member file
+    carries the meta, so the given member alone suffices."""
+    import json as _json
+
+    data = np.load(path)
+    if _SHARD_RE.search(os.path.basename(path)):
+        meta = _json.loads(str(data["__meta__"]))
+        return meta.get("user", {})
+    if "__usermeta__" in data.files:
+        return _json.loads(str(data["__usermeta__"]))
+    return {}
+
+
 def checkpoint_step(path: Optional[str]) -> int:
     """The step number encoded in a checkpoint filename; -1 for None
     (used to compare resume decisions across controller processes)."""
@@ -455,6 +487,7 @@ class AsyncCheckpointer:
         step: int,
         rng: Optional[jax.Array] = None,
         keep: int = 3,
+        extra_meta: Optional[dict] = None,
     ) -> None:
         self.wait()
         save_fn = save_checkpoint_sharded if self._sharded else save_checkpoint
@@ -465,7 +498,8 @@ class AsyncCheckpointer:
                 for l in leaves
             ):
                 # cross-host gather required -> synchronous, on this thread
-                save_checkpoint(directory, state, step, rng=rng, keep=keep)
+                save_checkpoint(directory, state, step, rng=rng, keep=keep,
+                                extra_meta=extra_meta)
                 return
 
         def snap(leaf):
@@ -476,7 +510,7 @@ class AsyncCheckpointer:
         if rng is not None:
             rng = snap(rng)
         self._pending = self._pool.submit(
-            save_fn, directory, state, step, rng, keep
+            save_fn, directory, state, step, rng, keep, extra_meta
         )
 
     def wait(self) -> None:
