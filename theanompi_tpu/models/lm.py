@@ -78,6 +78,7 @@ class TransformerLMModel(Model):
             max_len=r.input_shape[0],
             attn=r.attn,
             remat=r.remat,
+            dtype=r.compute_dtype,
         )
 
     @classmethod
@@ -138,6 +139,7 @@ class MoELMModel(TransformerLMModel):
             capacity_factor=r.capacity_factor,
             aux_weight=r.aux_weight,
             attn=r.attn,
+            dtype=r.compute_dtype,
         )
 
     def apply(self, params, state, tokens, *, train: bool = False, rng=None):
@@ -153,9 +155,10 @@ class TransformerLM_136M(TransformerLMModel):
     """GPT-2-small-scale benchable config (~136M params): the
     single-chip throughput row for the beyond-parity LM stack
     (``python bench.py --model transformer_lm``). 12 layers x d=768,
-    T=1024, 32k vocab, fused Pallas flash attention; f32 compute
-    (TransformerLM has no bf16 path yet — the reported MFU is measured
-    against the bf16 peak and therefore CONSERVATIVE, see bench.py).
+    T=1024, 32k vocab, fused Pallas flash attention; bf16 compute
+    (params stored fp32, matmuls/activations bf16 with fp32 softmax
+    statistics — transformer.py::cast_block_params), so the reported
+    MFU is measured against the bf16 peak the math actually runs at.
     Sized so TWO full f32 states (params + adam m/v) fit one v5e
     alongside the un-sharded 32k-vocab logits: the bench runner cannot
     donate its input state (it re-times from the same state), so a
@@ -175,6 +178,7 @@ class TransformerLM_136M(TransformerLMModel):
             input_shape=(1024,),
             num_classes=32768,
             dataset="lm_synthetic",
+            compute_dtype=jnp.bfloat16,
             d_model=768,
             n_heads=12,
             n_layers=12,

@@ -27,6 +27,7 @@ from theanompi_tpu.models.transformer import (
     _rms,
     attention_block,
     build_spec_step,
+    cast_block_params,
     next_token_loss,
     softmax_nll,
     sync_grads_by_spec,
@@ -54,6 +55,10 @@ class MoETransformerLM(NamedTuple):
     capacity_factor: float = 1.25
     aux_weight: float = 0.01
     attn: str = "ring"
+    # compute dtype (see transformer.py::cast_block_params): params
+    # stored fp32, matmul weights cast at use; the router gate and all
+    # softmax/norm statistics stay fp32
+    dtype: Any = jnp.float32
 
     def init(self, key: jax.Array) -> PyTree:
         ks = jax.random.split(key, 3 + 5 * self.n_layers)
@@ -98,11 +103,14 @@ class MoETransformerLM(NamedTuple):
             pos = lax.axis_index(sp_axis) * T + jnp.arange(T)
         else:
             pos = jnp.arange(T)
-        x = params["tok_emb"][tokens] + params["pos_emb"][pos][None]
+        x = (params["tok_emb"][tokens] + params["pos_emb"][pos][None]).astype(
+            self.dtype
+        )
 
         aux_total = jnp.zeros(())
         drop_total = jnp.zeros(())
         for blk in params["blocks"]:
+            blk = cast_block_params(blk, self.dtype)
             x = x + attention_block(blk, x, self.attn, sp_axis)
 
             hin = _rms(x, blk["ln2"])
@@ -115,10 +123,16 @@ class MoETransformerLM(NamedTuple):
                 capacity_factor=self.capacity_factor,
                 stats_axes=(ep_axis, sp_axis),  # global over every token shard
             )
-            x = x + y.reshape(B, T, self.d_model)
+            # the gate scale promotes y to f32; return the residual
+            # stream to the compute dtype
+            x = x + y.reshape(B, T, self.d_model).astype(self.dtype)
             aux_total = aux_total + stats.aux_loss
             drop_total = drop_total + stats.dropped_frac
-        return x @ params["head"], aux_total, drop_total / self.n_layers
+        return (
+            x @ params["head"].astype(self.dtype),
+            aux_total,
+            drop_total / self.n_layers,
+        )
 
     def loss(
         self,

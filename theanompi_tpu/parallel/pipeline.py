@@ -45,6 +45,7 @@ from theanompi_tpu.models.transformer import (
     TransformerLM,
     _rms,
     build_spec_step,
+    cast_block_params,
     sync_grads_by_spec,
 )
 from theanompi_tpu.ops.ring_attention import full_attention_reference
@@ -156,10 +157,11 @@ _BLOCK_TEMPLATE = {
 }
 
 
-def _apply_stage(blocks_local, x):
+def _apply_stage(blocks_local, x, dtype=jnp.float32):
     """Scan this device's stacked layers over the activation."""
 
     def body(h, blk):
+        blk = cast_block_params(blk, dtype)
         hin = _rms(h, blk["ln1"])
         qkv = jnp.einsum("btd,dchk->btchk", hin, blk["qkv"])
         att = full_attention_reference(
@@ -207,7 +209,7 @@ def make_pipeline_loss(model: TransformerLM, pipe_axis: str = PIPE_AXIS,
     ``parallel.nd.NDEngine`` pipeline branch."""
 
     def _head_loss(params, outs, tokens, rank, n):
-        logits = outs @ params["head"]  # [M, B, T, V]
+        logits = outs @ params["head"].astype(model.dtype)  # [M, B, T, V]
         targets = jnp.concatenate([tokens[:, :, 1:], tokens[:, :, :1]], axis=-1)
         valid = jnp.broadcast_to(
             (jnp.arange(tokens.shape[-1]) < tokens.shape[-1] - 1).astype(
@@ -215,7 +217,8 @@ def make_pipeline_loss(model: TransformerLM, pipe_axis: str = PIPE_AXIS,
             ),
             tokens.shape,
         )
-        logp = jax.nn.log_softmax(logits, axis=-1)
+        # fp32 softmax statistics (logits may be bf16)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
         local = jnp.sum(nll * valid) / jnp.sum(valid)
         # only the last stage computed real logits; broadcast its loss
@@ -229,17 +232,20 @@ def make_pipeline_loss(model: TransformerLM, pipe_axis: str = PIPE_AXIS,
 
         # stage-0 inputs for every microbatch (other ranks' copies are
         # dead code XLA keeps cheap; grads gate on rank 0 via the where)
-        emb = params["tok_emb"][tokens] + params["pos_emb"][jnp.arange(T)][None, None]
+        emb = (
+            params["tok_emb"][tokens]
+            + params["pos_emb"][jnp.arange(T)][None, None]
+        ).astype(model.dtype)
 
-        outs0 = jnp.zeros((M, B, T, model.d_model))
-        act0 = jnp.zeros((B, T, model.d_model))
+        outs0 = jnp.zeros((M, B, T, model.d_model), model.dtype)
+        act0 = jnp.zeros((B, T, model.d_model), model.dtype)
 
         def tick(carry, t):
             act, outs = carry
             act_in = lax.ppermute(act, pipe_axis, fwd_perm)
             inject = emb[jnp.clip(t, 0, M - 1)]
             x = jnp.where(rank == 0, inject, act_in)
-            y = _apply_stage(params["blocks"], x)
+            y = _apply_stage(params["blocks"], x, model.dtype)
             m = t - (n - 1)
             take = (m >= 0) & (m < M) & (rank == n - 1)
             sel = (jnp.arange(M) == jnp.clip(m, 0, M - 1))[:, None, None, None]
@@ -269,9 +275,12 @@ def make_pipeline_loss(model: TransformerLM, pipe_axis: str = PIPE_AXIS,
         G = M // n
         ring = [(i, (i + 1) % n) for i in range(n)]
 
-        emb = params["tok_emb"][tokens] + params["pos_emb"][jnp.arange(T)][None, None]
-        outs0 = jnp.zeros((M, B, T, model.d_model))
-        act0 = jnp.zeros((B, T, model.d_model))
+        emb = (
+            params["tok_emb"][tokens]
+            + params["pos_emb"][jnp.arange(T)][None, None]
+        ).astype(model.dtype)
+        outs0 = jnp.zeros((M, B, T, model.d_model), model.dtype)
+        act0 = jnp.zeros((B, T, model.d_model), model.dtype)
         # local shard [L/n, ...] -> [v, Lc, ...]: chunk-major per device
         blocks = jax.tree_util.tree_map(
             lambda x: x.reshape(v, x.shape[0] // v, *x.shape[1:]),
@@ -289,7 +298,7 @@ def make_pipeline_loss(model: TransformerLM, pipe_axis: str = PIPE_AXIS,
             inject = (rank == 0) & (c == 0)
             x = jnp.where(inject, emb[m], act_in)
             chunk = jax.tree_util.tree_map(lambda x_: x_[c], blocks)
-            y = _apply_stage(chunk, x)
+            y = _apply_stage(chunk, x, model.dtype)
             take = in_range & (rank == n - 1) & (c == v - 1)
             sel = (jnp.arange(M) == m)[:, None, None, None]
             outs = jnp.where(take & sel, y[None], outs)

@@ -169,8 +169,16 @@ def capture_model_step(model_name: str, batch: Optional[int], steps: int,
     b = model.recipe.batch_size
     state = init_train_state(model, jax.random.PRNGKey(0))
     r = np.random.RandomState(0)
-    x = jnp.asarray(r.randn(b, *model.recipe.input_shape), jnp.float32)
-    y = jnp.asarray(r.randint(0, model.recipe.num_classes, b), jnp.int32)
+    if getattr(model, "is_lm", False):
+        # token windows: x IS the label stream (next-token objective)
+        x = jnp.asarray(
+            r.randint(0, model.recipe.num_classes,
+                      (b, *model.recipe.input_shape)), jnp.int32
+        )
+        y = x
+    else:
+        x = jnp.asarray(r.randn(b, *model.recipe.input_shape), jnp.float32)
+        y = jnp.asarray(r.randint(0, model.recipe.num_classes, b), jnp.int32)
     runner = jax.jit(make_multi_step(make_train_step(model), steps))
     out = runner(state, x, y, jax.random.PRNGKey(1))
     np.asarray(out[1]["loss"])  # compile + warm outside the window
