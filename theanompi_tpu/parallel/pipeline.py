@@ -44,11 +44,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 from theanompi_tpu.models.transformer import (
     TransformerLM,
     _rms,
+    _vocab_sharded_nll,
+    attention_block,
     build_spec_step,
     cast_block_params,
     sync_grads_by_spec,
 )
-from theanompi_tpu.ops.ring_attention import full_attention_reference
 
 PIPE_AXIS = "pipe"
 
@@ -138,16 +139,31 @@ def pipeline_schedule_report(n_stages: int, microbatches: int,
     }
 
 
-def pipeline_param_specs(pipe_axis: str = PIPE_AXIS):
+def pipeline_param_specs(pipe_axis: str = PIPE_AXIS,
+                         tp_axis: Optional[str] = None):
     """Specs for the stacked layout: the layer dim sharded over pipe,
-    embeddings/head replicated."""
+    embeddings/head replicated. With ``tp_axis``, each stage's blocks
+    are ALSO Megatron-sharded within the stage (heads / d_ff / vocab —
+    the stacked-layout shift of :meth:`TransformerLM.tp_param_specs`):
+    the standard large-LM pp x tp layout."""
+    if tp_axis is None:
+        blk = jax.tree_util.tree_map(lambda _: P(pipe_axis), _BLOCK_TEMPLATE)
+        head = P()
+    else:
+        blk = {
+            "qkv": P(pipe_axis, None, None, tp_axis, None),  # heads
+            "proj": P(pipe_axis, tp_axis, None, None),       # heads (row)
+            "mlp_in": P(pipe_axis, None, tp_axis),           # d_ff cols
+            "mlp_out": P(pipe_axis, tp_axis, None),          # d_ff rows
+            "ln1": P(pipe_axis),
+            "ln2": P(pipe_axis),
+        }
+        head = P(None, tp_axis)                              # vocab cols
     return {
         "tok_emb": P(),
         "pos_emb": P(),
-        "head": P(),
-        "blocks": jax.tree_util.tree_map(
-            lambda _: P(pipe_axis), _BLOCK_TEMPLATE
-        ),
+        "head": head,
+        "blocks": blk,
     }
 
 
@@ -157,34 +173,41 @@ _BLOCK_TEMPLATE = {
 }
 
 
-def _apply_stage(blocks_local, x, dtype=jnp.float32):
-    """Scan this device's stacked layers over the activation."""
+def _apply_stage(blocks_local, x, dtype=jnp.float32,
+                 tp_axis: Optional[str] = None):
+    """Scan this device's stacked layers over the activation. With
+    ``tp_axis`` each layer's heads/FFN arrive stage-locally Megatron-
+    sharded: one psum after the attention projection and one after the
+    FFN out-projection per layer (the same two collectives as the dense
+    TP forward — models/transformer.py::TransformerLM.forward)."""
 
     def body(h, blk):
         blk = cast_block_params(blk, dtype)
-        hin = _rms(h, blk["ln1"])
-        qkv = jnp.einsum("btd,dchk->btchk", hin, blk["qkv"])
-        att = full_attention_reference(
-            qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], causal=True
-        )
-        h = h + jnp.einsum("bthk,hkd->btd", att, blk["proj"])
+        delta = attention_block(blk, h, "ring", None)  # local full attn
+        if tp_axis is not None:
+            delta = lax.psum(delta, tp_axis)  # row-parallel proj
+        h = h + delta
         hin = _rms(h, blk["ln2"])
-        h = h + jax.nn.gelu(hin @ blk["mlp_in"]) @ blk["mlp_out"]
-        return h, None
+        delta = jax.nn.gelu(hin @ blk["mlp_in"]) @ blk["mlp_out"]
+        if tp_axis is not None:
+            delta = lax.psum(delta, tp_axis)  # row-parallel mlp_out
+        return h + delta, None
 
     h, _ = lax.scan(body, x, blocks_local)
     return h
 
 
 def validate_pp_mesh(model: TransformerLM, mesh: Mesh, pipe_axis: str,
-                     dp_axis: Optional[str], interleave: int = 1):
+                     dp_axis: Optional[str], interleave: int = 1,
+                     tp_axis: Optional[str] = None):
     """Shared mesh/shape validation for the pipeline step builders.
     Returns ``(axes, n_total)``."""
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     if pipe_axis not in sizes:
         raise ValueError(f"axis {pipe_axis!r} not in mesh axes {mesh.axis_names}")
-    if dp_axis is not None and dp_axis not in sizes:
-        raise ValueError(f"axis {dp_axis!r} not in mesh axes {mesh.axis_names}")
+    for a in (dp_axis, tp_axis):
+        if a is not None and a not in sizes:
+            raise ValueError(f"axis {a!r} not in mesh axes {mesh.axis_names}")
     n_pipe = sizes[pipe_axis]
     if interleave < 1:
         raise ValueError(f"interleave={interleave} must be >= 1")
@@ -193,7 +216,15 @@ def validate_pp_mesh(model: TransformerLM, mesh: Mesh, pipe_axis: str,
             f"the {pipe_axis!r} axis size x interleave = "
             f"{n_pipe}x{interleave} must divide n_layers={model.n_layers}"
         )
-    axes = [pipe_axis] + ([dp_axis] if dp_axis else [])
+    if tp_axis is not None:
+        ntp = sizes[tp_axis]
+        if model.n_heads % ntp or model.d_ff % ntp or model.vocab % ntp:
+            raise ValueError(
+                f"the {tp_axis!r} axis size {ntp} must divide each of "
+                f"n_heads/d_ff/vocab ({model.n_heads}/{model.d_ff}/"
+                f"{model.vocab})"
+            )
+    axes = [pipe_axis] + [a for a in (dp_axis, tp_axis) if a]
     n_total = 1
     for a in axes:
         n_total *= sizes[a]
@@ -201,15 +232,17 @@ def validate_pp_mesh(model: TransformerLM, mesh: Mesh, pipe_axis: str,
 
 
 def make_pipeline_loss(model: TransformerLM, pipe_axis: str = PIPE_AXIS,
-                       interleave: int = 1):
+                       interleave: int = 1, tp_axis: Optional[str] = None):
     """``(stacked_params, tokens [M, B, T]) -> loss`` — the pipeline
     schedule (GPipe, or Megatron-interleaved when ``interleave > 1``)
     as one differentiable function (runs inside shard_map). Shared by
     :func:`make_pp_train_step` and the launchable
-    ``parallel.nd.NDEngine`` pipeline branch."""
+    ``parallel.nd.NDEngine`` pipeline branch. With ``tp_axis``, each
+    stage's compute is Megatron-sharded within the stage and the head
+    is vocab-sharded with the distributed softmax cross-entropy."""
 
     def _head_loss(params, outs, tokens, rank, n):
-        logits = outs @ params["head"].astype(model.dtype)  # [M, B, T, V]
+        logits = outs @ params["head"].astype(model.dtype)  # [M, B, T, V(/tp)]
         targets = jnp.concatenate([tokens[:, :, 1:], tokens[:, :, :1]], axis=-1)
         valid = jnp.broadcast_to(
             (jnp.arange(tokens.shape[-1]) < tokens.shape[-1] - 1).astype(
@@ -217,9 +250,15 @@ def make_pipeline_loss(model: TransformerLM, pipe_axis: str = PIPE_AXIS,
             ),
             tokens.shape,
         )
-        # fp32 softmax statistics (logits may be bf16)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        if tp_axis is not None:
+            # vocab-sharded logits: Megatron parallel CE (full logits
+            # never exist); the tp collectives run uniformly on every
+            # pipe rank (SPMD), the pipe mask below picks the real one
+            nll = _vocab_sharded_nll(logits, targets, tp_axis)
+        else:
+            # fp32 softmax statistics (logits may be bf16)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
         local = jnp.sum(nll * valid) / jnp.sum(valid)
         # only the last stage computed real logits; broadcast its loss
         return lax.psum(jnp.where(rank == n - 1, local, 0.0), pipe_axis)
@@ -245,7 +284,7 @@ def make_pipeline_loss(model: TransformerLM, pipe_axis: str = PIPE_AXIS,
             act_in = lax.ppermute(act, pipe_axis, fwd_perm)
             inject = emb[jnp.clip(t, 0, M - 1)]
             x = jnp.where(rank == 0, inject, act_in)
-            y = _apply_stage(params["blocks"], x, model.dtype)
+            y = _apply_stage(params["blocks"], x, model.dtype, tp_axis)
             m = t - (n - 1)
             take = (m >= 0) & (m < M) & (rank == n - 1)
             sel = (jnp.arange(M) == jnp.clip(m, 0, M - 1))[:, None, None, None]
@@ -298,7 +337,7 @@ def make_pipeline_loss(model: TransformerLM, pipe_axis: str = PIPE_AXIS,
             inject = (rank == 0) & (c == 0)
             x = jnp.where(inject, emb[m], act_in)
             chunk = jax.tree_util.tree_map(lambda x_: x_[c], blocks)
-            y = _apply_stage(chunk, x, model.dtype)
+            y = _apply_stage(chunk, x, model.dtype, tp_axis)
             take = in_range & (rank == n - 1) & (c == v - 1)
             sel = (jnp.arange(M) == m)[:, None, None, None]
             outs = jnp.where(take & sel, y[None], outs)
@@ -318,6 +357,7 @@ def make_pp_train_step(
     *,
     pipe_axis: str = PIPE_AXIS,
     dp_axis: Optional[str] = None,
+    tp_axis: Optional[str] = None,
     optimizer=None,
     interleave: int = 1,
 ):
@@ -327,10 +367,13 @@ def make_pp_train_step(
     by reshaping the global batch; ``B`` is sharded over ``dp_axis`` if
     given. Params use :func:`stack_pipeline_params`'s layout (pass the
     same ``interleave``/``n_stages`` to it when ``interleave > 1``).
-    """
-    axes, n_total = validate_pp_mesh(model, mesh, pipe_axis, dp_axis, interleave)
-    param_specs = pipeline_param_specs(pipe_axis)
-    pipeline_loss = make_pipeline_loss(model, pipe_axis, interleave)
+    With ``tp_axis``, stages are internally Megatron-sharded
+    (pp x tp (x dp) — the standard large-LM layout)."""
+    axes, n_total = validate_pp_mesh(
+        model, mesh, pipe_axis, dp_axis, interleave, tp_axis
+    )
+    param_specs = pipeline_param_specs(pipe_axis, tp_axis)
+    pipeline_loss = make_pipeline_loss(model, pipe_axis, interleave, tp_axis)
     n_stages = dict(zip(mesh.axis_names, mesh.devices.shape))[pipe_axis]
 
     def body(params, tokens):
