@@ -18,14 +18,32 @@ iteration — forward, backward, gradient allreduce, update — is ONE
 
 from __future__ import annotations
 
+import os
+
 import jax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from theanompi_tpu.models.contract import Model
 from theanompi_tpu.parallel.mesh import DATA_AXIS
-from theanompi_tpu.parallel.strategies import get_strategy
+from theanompi_tpu.parallel.strategies import checked_mode_strategy, get_strategy
 from theanompi_tpu.train import TrainState, init_train_state, make_eval_step, make_train_step
+
+
+def _checked_vma() -> bool:
+    """Module switch executing the check_vma migration plan for the BSP
+    engine (parallel/strategies.py "check_vma pin & migration plan"):
+    ``TMPI_CHECKED_VMA=1`` builds every BSP shard_map with
+    ``check_vma=True`` and swaps the exchanger for its checked-mode form
+    (division by the axis size — AD already summed the cotangents).
+    Measured outcome (round 5, jax 0.9.0, 8-device CPU mesh): the full
+    BSP oracle suite passes identically both ways, single-step params
+    agree to float epsilon, forward cross-replica collectives (BN pmean)
+    included — see tests/test_bsp.py::TestCheckedVmaBSP. Default stays
+    classic semantics: the OTHER engines (easgd/gosgd/nd/zero/fused
+    strategies) still assume local-grad AD, and the plan requires the
+    flip to land everywhere at once."""
+    return os.environ.get("TMPI_CHECKED_VMA", "") == "1"
 
 
 def _axes_tuple(axis_name) -> tuple:
@@ -82,7 +100,11 @@ def make_bsp_train_step(
 
         return jax.jit(single_step)
 
-    grad_sync = get_strategy(strategy, axis_name, n)
+    checked = _checked_vma()
+    grad_sync = (
+        checked_mode_strategy(strategy, axis_name, n) if checked
+        else get_strategy(strategy, axis_name, n)
+    )
     base_step = make_train_step(
         model, steps_per_epoch, grad_sync=grad_sync,
         input_transform=input_transform, accum_steps=accum_steps,
@@ -101,15 +123,17 @@ def make_bsp_train_step(
         metrics = lax.pmean(metrics, axis_name)
         return new_state, metrics
 
-    # check_vma=False: the exchanger abstraction requires classic pmap AD
-    # semantics (psum transpose = identity) — see make_train_step's note.
+    # check_vma=False by default: the exchanger abstraction requires
+    # classic pmap AD semantics (psum transpose = psum) — see
+    # make_train_step's note. TMPI_CHECKED_VMA=1 flips this engine to
+    # the migrated checked-mode semantics (_checked_vma docstring).
     spec = P(axes)  # P accepts a 1-tuple identically to the bare name
     mapped = jax.shard_map(
         sharded_step,
         mesh=mesh,
         in_specs=(P(), spec, spec, P()),
         out_specs=(P(), P()),
-        check_vma=False,
+        check_vma=checked,
     )
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
@@ -141,7 +165,11 @@ def make_bsp_fused_step(
     n = 1
     for a in axes:
         n *= mesh.shape[a]
-    grad_sync = get_strategy(strategy, axis_name, n)  # also validates the name
+    checked = _checked_vma()
+    grad_sync = (  # also validates the name
+        checked_mode_strategy(strategy, axis_name, n) if checked
+        else get_strategy(strategy, axis_name, n)
+    )
 
     if n == 1:
         base = make_train_step(
@@ -185,7 +213,7 @@ def make_bsp_fused_step(
         mesh=mesh,
         in_specs=(P(), spec, spec, P()),
         out_specs=(P(), P()),
-        check_vma=False,
+        check_vma=checked,
     )
     return jax.jit(mapped, donate_argnums=(0,))
 
@@ -275,6 +303,6 @@ def make_bsp_eval_step(
         mesh=mesh,
         in_specs=(P(), spec, spec),
         out_specs=P(),
-        check_vma=False,
+        check_vma=_checked_vma(),
     )
     return jax.jit(mapped)

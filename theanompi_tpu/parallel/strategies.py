@@ -249,6 +249,29 @@ _ALIASES = {
 }
 
 
+def checked_mode_strategy(name: str, axis_name, axis_size: int) -> Strategy:
+    """The ``check_vma=True`` exchanger (migration plan above, executed
+    for the BSP engine in round 5 — ``parallel/bsp.py::_checked_vma``):
+    AD already delivers the replicated-param cotangent globally SUMMED,
+    so the psum family degenerates to division by the axis size with no
+    collective. The explicit ring/compressed strategies have no wire to
+    compress in this mode (there is no exchanger collective at all) and
+    are refused — per the plan they survive only as weight-exchange
+    collectives (EASGD/GoSGD averaging)."""
+    del axis_name
+    key = _ALIASES.get(name, name)
+    if key in ("psum", "psum_bf16"):
+        return lambda grads: jax.tree_util.tree_map(
+            lambda g: g / axis_size, grads
+        )
+    raise ValueError(
+        f"strategy {name!r} has no checked-mode (check_vma=True) gradient-"
+        "sync form: AD already summed the cotangents, so there is no "
+        "exchanger collective to segment or compress — use 'psum', or run "
+        "the classic semantics (TMPI_CHECKED_VMA unset)"
+    )
+
+
 def get_strategy(name: str, axis_name, axis_size: int) -> Strategy:
     """``axis_name`` may be a tuple of mesh axes (multi-slice BSP): the
     psum family reduces over all of them (XLA lowers ICI-then-DCN); the
