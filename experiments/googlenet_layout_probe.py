@@ -1,0 +1,285 @@
+"""GoogLeNet layout experiments (round-4 verdict item 6).
+
+The round-5 op profile (tools/op_profile.py, v5e, batch 1024, step ~202
+ms) localizes the MFU floor: pooling is ~35% of the step
+(select-and-scatter backward 17.9% + reduce_window-max forward fusions
+~14% + pad_maximum ~3%), generic conv/elementwise fusions 46%, LRN 3.7%
+— and concatenate is INVISIBLE (copy/slice ops ~1.5% total), so the
+"concat-free inception output" hypothesis is rejected by measurement
+before any rewrite: there is no concat time to recover.
+
+This probe measures the two remaining verdict hypotheses:
+
+1. **batch 2048 (and 512)** — full-model fused-step throughput vs the
+   committed batch-1024 row (pool/BN-style sweeps scale with batch, but
+   bigger batches can fill the MXU better on the small-channel convs);
+2. **channels-major trunk** — the dominant stride-1 3x3 max pool and a
+   full inception module (convs + pool + concat), forward+backward, in
+   NHWC vs NCHW, with and without entry/exit transposes. If C-major
+   wins at the module level, the trunk rewrite is justified; if it
+   loses, this probe is the committed measured-and-rejected evidence.
+
+Writes results/googlenet_layout.json. Run on the real chip:
+
+    python experiments/googlenet_layout_probe.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+RESULTS = os.path.join(HERE, "results")
+
+
+_LAT = None
+
+
+def _latency() -> float:
+    """Median host<->device round trip (~115 ms through the tunnel) —
+    subtracted from every fetch-synced measurement below."""
+    global _LAT
+    if _LAT is None:
+        ts = []
+        for i in range(5):
+            t0 = time.perf_counter()
+            float(jnp.sum(jnp.ones(()) * i))
+            ts.append(time.perf_counter() - t0)
+        _LAT = float(np.median(ts))
+    return _LAT
+
+
+def _median_time(fn, *args, trials=3):
+    """Fetch-synced wall clock: ``fn`` must return a SCALAR; syncing is
+    an actual host fetch of it (on the tunneled chip block_until_ready
+    can return without blocking — bench.py documents the fault — so a
+    dispatch-timed 'measurement' reads ~100x too fast; the first probe
+    revision measured a 192 MB pool fwd+bwd at 0.09 ms, beyond the HBM
+    read bound, exactly that failure). The separately measured round
+    trip is subtracted."""
+    lat = _latency()
+    val = float(np.asarray(fn(*args)).sum())
+    assert np.isfinite(val), "probe program produced non-finite output"
+    ts = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        float(np.asarray(fn(*args)).sum())
+        ts.append(time.perf_counter() - t0 - lat)
+    med = float(np.median(ts))
+    if med < 4 * lat:
+        # the work window must dominate latency jitter or the number is
+        # noise — callers loop the op inside the program to get there
+        raise RuntimeError(
+            f"probe window {med*1e3:.1f} ms < 4x round-trip "
+            f"{lat*1e3:.1f} ms: raise the in-program repeat count"
+        )
+    return med
+
+
+def _measure_scaled(build, k0: int = 256):
+    """Per-op time via an in-program ``lax.scan`` of ``k`` repetitions
+    (input varied per iteration to defeat CSE); ``k`` escalates until
+    the window dominates the tunnel round trip."""
+    k = k0
+    while True:
+        try:
+            return _median_time(build(k)) / k
+        except RuntimeError:
+            if k >= 8192:
+                raise
+            k *= 4
+
+
+def full_model(batch: int, steps: int = 8) -> dict:
+    """Fused-step throughput for the whole GoogLeNet at ``batch`` —
+    same construction as bench.py compute mode (single chip); synced by
+    fetching the stacked losses (8 steps x ~200 ms dominates the
+    round trip), executed-work-checked via the device step counter."""
+    from theanompi_tpu.models.googlenet import GoogLeNet
+    from theanompi_tpu.train import init_train_state, make_multi_step, make_train_step
+
+    model = GoogLeNet(GoogLeNet.default_recipe().replace(batch_size=batch))
+    runner = jax.jit(make_multi_step(make_train_step(model), steps))
+    state = init_train_state(model, jax.random.PRNGKey(0))
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.randn(batch, 224, 224, 3), jnp.float32)
+    y = jnp.asarray(r.randint(0, 1000, batch), jnp.int32)
+    t = _median_time(
+        lambda: runner(state, x, y, jax.random.PRNGKey(1))[1]["loss"]
+    )
+    got = int(np.asarray(
+        runner(state, x, y, jax.random.PRNGKey(1))[0].step
+    ))
+    assert got == steps, f"executed {got} != {steps}"
+    return {"batch": batch, "img_s": round(steps * batch / t, 1),
+            "step_ms": round(1000 * t / steps, 2)}
+
+
+def _pool_fwd_bwd(layout: str, B=256, H=28, W=28, C=480):
+    """Stride-1 3x3 SAME max pool fwd+bwd — the op family carrying ~35%
+    of the GoogLeNet step — in NHWC vs NCHW."""
+    r = np.random.RandomState(0)
+    if layout == "NHWC":
+        x = jnp.asarray(r.randn(B, H, W, C), jnp.bfloat16)
+        dims, strides = (1, 3, 3, 1), (1, 1, 1, 1)
+        pad = ((0, 0), (1, 1), (1, 1), (0, 0))
+    else:
+        x = jnp.asarray(r.randn(B, C, H, W), jnp.bfloat16)
+        dims, strides = (1, 1, 3, 3), (1, 1, 1, 1)
+        pad = ((0, 0), (0, 0), (1, 1), (1, 1))
+
+    def loss(x):
+        y = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pad)
+        return jnp.sum(y.astype(jnp.float32) ** 2)
+
+    def build(k):
+        @jax.jit
+        def run():
+            def body(acc, i):
+                g = jax.grad(loss)(x + i.astype(x.dtype))
+                return acc + jnp.sum(g.astype(jnp.float32)), None
+
+            acc, _ = lax.scan(body, jnp.float32(0.0),
+                              jnp.arange(k, dtype=jnp.int32))
+            return acc
+
+        return run
+
+    return _measure_scaled(build)
+
+
+def _inception_fwd_bwd(layout: str, B=256, H=28, W=28, Cin=480,
+                       transpose_io: bool = False):
+    """One inception-4a-shaped module (1x1 / 1x1-3x3 / 1x1-5x5 /
+    pool-1x1, concat) fwd+bwd in NHWC vs NCHW. ``transpose_io`` adds
+    the entry/exit transposes a C-major TRUNK would amortize away —
+    both numbers are reported so the trunk-level decision is honest."""
+    c1, c3r, c3, c5r, c5, cp = 192, 96, 208, 16, 48, 64
+    r = np.random.RandomState(0)
+    nhwc = layout == "NHWC"
+    dn = ("NHWC", "HWIO", "NHWC") if nhwc else ("NCHW", "OIHW", "NCHW")
+
+    def mk(shape):
+        return jnp.asarray(0.05 * r.randn(*shape), jnp.bfloat16)
+
+    if nhwc:
+        ws = {
+            "w1": mk((1, 1, Cin, c1)), "w3r": mk((1, 1, Cin, c3r)),
+            "w3": mk((3, 3, c3r, c3)), "w5r": mk((1, 1, Cin, c5r)),
+            "w5": mk((5, 5, c5r, c5)), "wp": mk((1, 1, Cin, cp)),
+        }
+    else:
+        ws = {
+            "w1": mk((c1, Cin, 1, 1)), "w3r": mk((c3r, Cin, 1, 1)),
+            "w3": mk((c3, c3r, 3, 3)), "w5r": mk((c5r, Cin, 1, 1)),
+            "w5": mk((c5, c5r, 5, 5)), "wp": mk((cp, Cin, 1, 1)),
+        }
+    x = jnp.asarray(
+        r.randn(*(B, H, W, Cin) if nhwc or transpose_io else (B, Cin, H, W)),
+        jnp.bfloat16,
+    )
+    caxis = 3 if nhwc else 1
+    if nhwc:
+        dims, strides = (1, 3, 3, 1), (1, 1, 1, 1)
+        pad = ((0, 0), (1, 1), (1, 1), (0, 0))
+    else:
+        dims, strides = (1, 1, 3, 3), (1, 1, 1, 1)
+        pad = ((0, 0), (0, 0), (1, 1), (1, 1))
+
+    def conv(h, w):
+        return jax.nn.relu(
+            lax.conv_general_dilated(h, w, (1, 1), "SAME",
+                                     dimension_numbers=dn)
+        )
+
+    def loss(ws, x):
+        if not nhwc and transpose_io:
+            x = jnp.transpose(x, (0, 3, 1, 2))  # entry transpose
+        y1 = conv(x, ws["w1"])
+        y3 = conv(conv(x, ws["w3r"]), ws["w3"])
+        y5 = conv(conv(x, ws["w5r"]), ws["w5"])
+        yp = conv(
+            lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pad),
+            ws["wp"],
+        )
+        out = jnp.concatenate([y1, y3, y5, yp], axis=caxis)
+        if not nhwc and transpose_io:
+            out = jnp.transpose(out, (0, 2, 3, 1))  # exit transpose
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    def build(k):
+        @jax.jit
+        def run():
+            def body(acc, i):
+                g = jax.grad(loss)(ws, x + i.astype(x.dtype))
+                return acc + sum(
+                    jnp.sum(l.astype(jnp.float32))
+                    for l in jax.tree_util.tree_leaves(g)
+                ), None
+
+            acc, _ = lax.scan(body, jnp.float32(0.0),
+                              jnp.arange(k, dtype=jnp.int32))
+            return acc
+
+        return run
+
+    return _measure_scaled(build)
+
+
+def main() -> int:
+    os.makedirs(RESULTS, exist_ok=True)
+    path = os.path.join(RESULTS, "googlenet_layout.json")
+    out = {"device": jax.devices()[0].device_kind}
+
+    def flush():
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+
+    out["pool_3x3s1_ms"] = {
+        "NHWC": round(1000 * _pool_fwd_bwd("NHWC"), 2),
+        "NCHW": round(1000 * _pool_fwd_bwd("NCHW"), 2),
+        "shape": "[256, 28, 28, 480] bf16, fwd+bwd",
+    }
+    print("pool:", out["pool_3x3s1_ms"], flush=True)
+    flush()
+
+    out["inception_4ash_ms"] = {
+        "NHWC": round(1000 * _inception_fwd_bwd("NHWC"), 2),
+        "NCHW_resident": round(1000 * _inception_fwd_bwd("NCHW"), 2),
+        "NCHW_transposed_io": round(
+            1000 * _inception_fwd_bwd("NCHW", transpose_io=True), 2
+        ),
+        "shape": "[256, 28, 28, 480] bf16 in, 512 out, fwd+bwd",
+    }
+    print("inception:", out["inception_4ash_ms"], flush=True)
+    flush()
+
+    out["full_model"] = []
+    for batch in (512, 1024, 2048):
+        try:
+            out["full_model"].append(full_model(batch))
+        except Exception as e:  # OOM at 2048 IS a measured result
+            out["full_model"].append(
+                {"batch": batch, "error": type(e).__name__,
+                 "detail": str(e).splitlines()[0][:120]}
+            )
+        print("full model:", out["full_model"][-1], flush=True)
+        flush()
+
+    print(json.dumps({"name": "googlenet_layout", "done": True}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

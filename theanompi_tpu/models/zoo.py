@@ -4,14 +4,15 @@ batch). Shared by the root ``bench.py`` harness and
 
 Batch policy: AlexNet runs the reference workload's GLOBAL batch
 (BASELINE config #2: 8 workers x 128 = 1024 — same SGD trajectory, and
-a v5e only reaches full MXU utilization ~batch 1024); GoogLeNet runs
-config #3's global batch 1024 — round 3 capped it at 256 because the
-scanned multi-step program silently no-opped above that on the
-tunneled dev backend, but the round-4 re-test (2026-07-30, jax 0.9.0:
-8-step scan at batch 512 AND 1024, step counter 8/8, losses finite,
-~4.2k img/s) shows the backend fault is gone; bench.py now carries a
-hard executed-work assertion either way, and
-tools/repro_tunnel_fault.py is the probe to re-run if it ever trips.
+a v5e only reaches full MXU utilization ~batch 1024); GoogLeNet uses
+512 — the round-5 batch sweep (experiments/results/
+googlenet_layout.json: 5547/5630/5118 img/s at 256/512/1024, OOM at
+2048) puts the single-chip knee at 512; its step is ~35% max-pool
+sweeps that scale with batch, so past the knee extra batch only grows
+the bandwidth-bound work. (Config #3's global 1024 is a 32-WORKER
+batch — at pod scale each chip sees 32 rows; the single-chip row's
+batch is a free throughput parameter, and the earlier 1024 reading
+5134.9 img/s is retained in the committed sweep for comparison.)
 ResNet-50 uses config #4's batch 256; VGG16/WRN use the largest
 power-of-two that fits one chip's HBM comfortably."""
 
@@ -28,7 +29,7 @@ def zoo_entry(name: str):
     if name == "googlenet":
         from theanompi_tpu.models.googlenet import GoogLeNet
 
-        return GoogLeNet, 1024
+        return GoogLeNet, 512
     if name == "resnet50":
         from theanompi_tpu.models.model_zoo.resnet50 import ResNet50
 
