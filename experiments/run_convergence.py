@@ -410,13 +410,19 @@ def _write_time_to_accuracy(threshold: float = 0.05) -> None:
     step whose epoch-val error is <= ``threshold`` (and the final val
     error), per rule and worker count — the reference's own framing for
     comparing sync rules (BASELINE.md 'EASGD vs BSP')."""
+    import glob as _glob
+
     panel = {}
     for d in sorted(os.listdir(RESULTS)):
         run_dir = os.path.join(RESULTS, d)
-        jsonl = os.path.join(run_dir, d + ".jsonl")
-        if not (d.split("_")[0] in ("bsp", "easgd", "gosgd")
-                and os.path.isfile(jsonl)):
+        if d.split("_")[0] not in ("bsp", "easgd", "gosgd"):
             continue
+        # the run's single recorder JSONL, whatever its run_name (the
+        # n=8 baselines predate run_name and carry cifar10_<rule>.jsonl)
+        files = _glob.glob(os.path.join(run_dir, "*.jsonl"))
+        if len(files) != 1:
+            continue
+        jsonl = files[0]
         vals, last_step = [], 0
         with open(jsonl) as f:
             for line in f:

@@ -144,6 +144,28 @@ def test_pp_two_controllers_sharded_checkpoint(tmp_path):
     assert latest_checkpoint(str(tmp_path / "ckpt")) is not None
 
 
+def test_expert_two_controllers(tmp_path):
+    """Switch-MoE expert parallelism across controller processes: the
+    expert axis (also the batch axis) spans the 2-process world, so the
+    all-to-all token dispatch crosses hosts and each host feeds its
+    contiguous half of the batch (NDEngine.host_batch_part)."""
+    argv = [
+        "-m", "theanompi_tpu.cli", "BSP", "2",
+        "theanompi_tpu.models.lm", "MoELMModel",
+        "--expert", "2", "--epochs", "1",
+        "--save-dir", str(tmp_path),
+        "--recipe-arg", "n_experts=2",
+        *_LM_TINY,
+    ]
+    codes = spawn_local(2, argv, devices_per_proc=1, timeout=600)
+    assert codes == [0, 0], f"controller exit codes {codes}"
+    jsonl = list(tmp_path.glob("*.jsonl"))
+    assert len(jsonl) == 1  # rank-0 recorder only
+    events = [json.loads(l) for l in jsonl[0].read_text().splitlines()]
+    assert any(e["kind"] == "val" for e in events)
+    assert all(e["loss"] > 0 for e in events if e["kind"] == "train")
+
+
 def test_spawn_local_propagates_failure(tmp_path):
     codes = spawn_local(
         2,

@@ -185,3 +185,37 @@ class TransformerLM_136M(TransformerLMModel):
             d_ff=3072,
             attn="flash",
         )
+
+
+class TransformerLM_350M(TransformerLMModel):
+    """GPT-2-medium-scale benchable config (~360M params): 24 layers x
+    d=1024, T=1024, 32k vocab, fused Pallas flash attention, bf16
+    compute, per-block remat (activation memory, not weights, is what
+    remains after donation). This size only fits one v5e because the
+    bench runner DONATES and threads the train state through its timed
+    trials for this row (``bench.py --model transformer_lm_350m``) —
+    without donation two full f32 states (params + adam m/v ~ 4.3 GB)
+    coexist and OOM, which is why the 136M row was the round-4 cap."""
+
+    name = "transformer_lm_350m"
+
+    @classmethod
+    def default_recipe(cls) -> LMRecipe:
+        return LMRecipe(
+            batch_size=8,
+            n_epochs=1,
+            optimizer="adam",
+            schedule="constant",
+            sched_kwargs={"lr": 3e-4},
+            lr_unit="step",
+            input_shape=(1024,),
+            num_classes=32768,
+            dataset="lm_synthetic",
+            compute_dtype=jnp.bfloat16,
+            d_model=1024,
+            n_heads=16,
+            n_layers=24,
+            d_ff=4096,
+            attn="flash",
+            remat=True,
+        )

@@ -48,4 +48,10 @@ def zoo_entry(name: str):
         from theanompi_tpu.models.lm import TransformerLM_136M
 
         return TransformerLM_136M, 8
+    if name == "transformer_lm_350m":
+        # GPT-2-medium scale (~370M params): needs the bench runner's
+        # donate-and-thread timing path (two f32 states would OOM a v5e)
+        from theanompi_tpu.models.lm import TransformerLM_350M
+
+        return TransformerLM_350M, 8
     raise ValueError(f"unknown bench model {name!r}")
