@@ -106,6 +106,24 @@ def test_lm_pipeline_launch():
 
 
 @pytest.mark.slow
+def test_lm_expert_dp_launch():
+    """--expert 4 on 8 devices: the leftover factor becomes plain data
+    parallelism over the expert groups (dp x ep joint batch sharding —
+    the standard MoE layout), through the full driver."""
+    s = run_training(
+        model_cls=MoELMModel,
+        devices=8,
+        expert=4,
+        recipe_overrides={**TINY, "n_layers": 1},
+        dataset_kwargs=DATA,
+        max_steps=4,
+        print_freq=1000,
+    )
+    assert s["steps"] == 4
+    assert np.isfinite(s["val"]["loss"])
+
+
+@pytest.mark.slow
 def test_lm_pp_tp_launch():
     """--pp 2 --tp 2 through the full driver (round-4 verdict item 5):
     the pipeline's stages are Megatron-sharded within the stage, with

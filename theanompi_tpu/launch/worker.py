@@ -281,19 +281,26 @@ def run_training(
 
             if tp > 1 or pp > 1:
                 raise ValueError(
-                    "--expert composes with --sp only (the expert axis "
-                    "is also the batch axis; tp/pp are not implemented "
-                    "for the MoE branch)"
+                    "--expert composes with data parallelism and --sp "
+                    "(expert x tp/pp is not implemented for the MoE "
+                    "branch)"
                 )
-            if len(devs) != expert * sp:
+            if len(devs) % (expert * sp):
                 raise ValueError(
-                    f"--expert {expert} --sp {sp} needs exactly "
-                    f"{expert * sp} devices (expert is also the batch "
-                    f"axis), got {len(devs)}"
+                    f"{len(devs)} devices do not divide "
+                    f"--expert {expert} x --sp {sp}"
                 )
-            names = (EXPERT_AXIS,) + ((SP_AXIS,) if sp > 1 else ())
-            shape = (expert,) + ((sp,) if sp > 1 else ())
+            dp = len(devs) // (expert * sp)
+            # dp major: the (dp, expert) joint batch sharding keeps each
+            # controller's host rows contiguous (NDEngine.host_batch_part)
+            names = ((DP_AXIS,) if dp > 1 else ()) + (EXPERT_AXIS,) + (
+                (SP_AXIS,) if sp > 1 else ()
+            )
+            shape = ((dp,) if dp > 1 else ()) + (expert,) + (
+                (sp,) if sp > 1 else ()
+            )
             nd_axes = dict(ep_axis=EXPERT_AXIS,
+                           dp_axis=DP_AXIS if dp > 1 else None,
                            sp_axis=SP_AXIS if sp > 1 else None)
         elif pp > 1:
             if sp > 1:
@@ -397,7 +404,7 @@ def run_training(
         T = recipe.input_shape[0]
         if sp > 1 and T % sp:
             raise ValueError(f"sequence length {T} not divisible by --sp {sp}")
-        batch_div = expert if expert > 1 else (
+        batch_div = expert * max(1, n_dev // (expert * sp)) if expert > 1 else (
             (microbatches or pp) * max(1, n_dev // (pp * tp)) if pp > 1
             else n_dev // (tp * sp)
         )

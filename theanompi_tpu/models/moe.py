@@ -9,9 +9,12 @@ over an ``expert`` mesh axis that doubles as the data axis (each device
 routes its own tokens; dispatch rides two ``lax.all_to_all``s over ICI),
 with the Switch load-balance auxiliary loss on global statistics.
 
-``make_ep_train_step`` composes EP with sequence parallelism (tokens
-additionally sharded over a ``seq`` axis, ring or Ulysses attention) —
-one SPMD program over a 2-D ``(expert, seq)`` mesh.
+``make_ep_train_step`` composes EP with data parallelism (``dp_axis``:
+the batch dim shards over (data, expert) jointly — the standard MoE
+layout, each dp group running its own all-to-all dispatch) and with
+sequence parallelism (tokens additionally sharded over a ``seq`` axis,
+ring or Ulysses attention) — one SPMD program over a (data, expert,
+seq) mesh.
 """
 
 from __future__ import annotations
@@ -93,11 +96,16 @@ class MoETransformerLM(NamedTuple):
         *,
         sp_axis: Optional[str] = None,
         ep_axis: Optional[str] = None,
+        dp_axis: Optional[str] = None,
     ) -> tuple[jax.Array, jax.Array, jax.Array]:
         """-> (logits, aux_loss_sum, dropped_frac_mean). Runs inside
         shard_map; with ``ep_axis`` the expert leaves arrive sharded per
         :meth:`ep_param_specs` and this device's tokens are its own
-        batch shard (ep doubles as dp)."""
+        batch shard (ep doubles as dp). ``dp_axis`` adds plain data
+        parallelism OVER the expert groups — the batch dim shards over
+        (dp, ep) jointly, each dp group runs its own all-to-all dispatch
+        to its replica of the expert shards, and the load-balance
+        statistics stay GLOBAL (averaged over dp x ep x sp)."""
         B, T = tokens.shape
         if sp_axis is not None:
             pos = lax.axis_index(sp_axis) * T + jnp.arange(T)
@@ -121,7 +129,8 @@ class MoETransformerLM(NamedTuple):
                 blk["expert_out"],
                 ep_axis,
                 capacity_factor=self.capacity_factor,
-                stats_axes=(ep_axis, sp_axis),  # global over every token shard
+                # global over every token shard (switch_moe drops Nones)
+                stats_axes=(dp_axis, ep_axis, sp_axis),
             )
             # the gate scale promotes y to f32; return the residual
             # stream to the compute dtype
@@ -141,12 +150,13 @@ class MoETransformerLM(NamedTuple):
         sp_axis: Optional[str] = None,
         *,
         ep_axis: Optional[str] = None,
+        dp_axis: Optional[str] = None,
     ) -> jax.Array:
         """Next-token CE (global over the sequence, local over this
         device's batch) + ``aux_weight`` x the Switch load-balance
         penalty. Same boundary-target/psum structure as TransformerLM."""
         logits, aux, _ = self.forward(
-            params, tokens, sp_axis=sp_axis, ep_axis=ep_axis
+            params, tokens, sp_axis=sp_axis, ep_axis=ep_axis, dp_axis=dp_axis
         )
         ce = next_token_loss(tokens, sp_axis, softmax_nll(logits))
         return ce + self.aux_weight * aux
@@ -176,13 +186,14 @@ def ep_spec_setup(
     mesh: Mesh,
     ep_axis: str,
     sp_axis: Optional[str],
+    dp_axis: Optional[str] = None,
 ):
     """Shared mesh/shape validation + sharding-spec construction for the
     expert-parallel step builders (:func:`make_ep_train_step` and the
     launchable ``parallel.nd.NDEngine``). Returns ``(axes, n_total,
     param_specs)``."""
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-    axes = [a for a in (ep_axis, sp_axis) if a is not None]
+    axes = [a for a in (dp_axis, ep_axis, sp_axis) if a is not None]
     for a in axes:
         if a not in sizes:
             raise ValueError(f"axis {a!r} not in mesh axes {mesh.axis_names}")
@@ -206,26 +217,36 @@ def make_ep_train_step(
     *,
     ep_axis: str = EXPERT_AXIS,
     sp_axis: Optional[str] = None,
+    dp_axis: Optional[str] = None,
     optimizer=None,
 ):
     """Jitted expert-parallel train step: ``(params, tokens) ->
     (new_params, loss)`` (or over ``(params, opt_state)`` with
     ``optimizer``, as in make_nd_train_step). Tokens ``[B, T]`` are
-    ``P(ep_axis, sp_axis)`` — the expert axis is also the batch axis.
-    Gradient sync follows the universal spec rule (transformer.py):
-    expert shards carry their own full contribution, replicated leaves
-    psum across both axes."""
-    axes, n_total, param_specs = ep_spec_setup(model, mesh, ep_axis, sp_axis)
+    ``P(ep_axis, sp_axis)`` — the expert axis is also the batch axis;
+    with ``dp_axis`` the batch dim shards over ``(dp, ep)`` jointly
+    (dp-major, so multi-controller host slices stay contiguous) — the
+    standard dp x ep MoE layout, each dp group dispatching to its own
+    replica of the expert shards. Gradient sync follows the universal
+    spec rule (transformer.py): expert shards carry their own full
+    contribution, replicated leaves psum across every participating
+    axis."""
+    axes, n_total, param_specs = ep_spec_setup(
+        model, mesh, ep_axis, sp_axis, dp_axis
+    )
 
     def body(params, tokens):
         loss, grads = jax.value_and_grad(model.loss)(
-            params, tokens, sp_axis, ep_axis=ep_axis
+            params, tokens, sp_axis, ep_axis=ep_axis, dp_axis=dp_axis
         )
         grads = sync_grads_by_spec(grads, param_specs, axes, n_total)
-        loss = lax.pmean(loss, ep_axis)  # report the global batch mean
+        for a in (dp_axis, ep_axis):
+            if a is not None:
+                loss = lax.pmean(loss, a)  # report the global batch mean
         return loss, grads
 
+    batch_spec = (dp_axis, ep_axis) if dp_axis else ep_axis
     return build_spec_step(
-        body, mesh, param_specs, P(ep_axis, sp_axis), lr, optimizer,
+        body, mesh, param_specs, P(batch_spec, sp_axis), lr, optimizer,
         lambda: model.init(jax.random.PRNGKey(0)),
     )

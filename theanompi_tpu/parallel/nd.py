@@ -12,7 +12,8 @@ any of:
   Ulysses sequence axis) for the dense :class:`TransformerLMModel`;
 - ``pipe`` (GPipe pipeline axis, microbatched) x ``dp``;
 - ``expert`` (Switch-MoE all-to-all axis, doubling as the batch axis)
-  x ``sp`` for :class:`MoELMModel`.
+  x ``dp`` (data parallelism over the expert groups — the batch dim
+  shards over (dp, expert) jointly) x ``sp`` for :class:`MoELMModel`.
 
 CLI: ``tmpi BSP 8 theanompi_tpu.models.lm TransformerLMModel --tp 2
 --sp 2`` (see cli.py). The engine owns batch *placement* because its
@@ -73,8 +74,8 @@ class NDEngine:
     - dense ND: any of ``dp_axis``/``tp_axis``/``sp_axis``
     - pipeline: ``pipe_axis`` (+ optional ``dp_axis``); tokens are
       reshaped host-side to microbatch-major ``[M, B/M, T]``
-    - expert:   ``ep_axis`` (+ optional ``sp_axis``); the expert axis
-      is also the batch axis
+    - expert:   ``ep_axis`` (+ optional ``dp_axis``/``sp_axis``); the
+      batch dim shards over (dp, expert) jointly
     """
 
     name = "nd"
@@ -152,18 +153,24 @@ class NDEngine:
             tok_spec = P(None, dp_axis)  # [M, B, T]: M replicated, B on dp
             batch_axes = (dp_axis,) if dp_axis else ()
         elif ep_axis is not None:
-            if tp_axis or dp_axis:
+            if tp_axis:
                 raise ValueError(
-                    "the expert branch's expert axis IS the batch axis "
-                    "(composes with sp only; tp/dp are not implemented)"
+                    "the expert branch composes with dp and sp "
+                    "(expert x tp is not implemented)"
                 )
             from theanompi_tpu.models.moe import ep_spec_setup
 
-            axes, n_total, param_specs = ep_spec_setup(arch, mesh, ep_axis, sp_axis)
-            loss_fn = lambda p, t: arch.loss(p, t, sp_axis, ep_axis=ep_axis)  # noqa: E731
+            axes, n_total, param_specs = ep_spec_setup(
+                arch, mesh, ep_axis, sp_axis, dp_axis
+            )
+            loss_fn = lambda p, t: arch.loss(  # noqa: E731
+                p, t, sp_axis, ep_axis=ep_axis, dp_axis=dp_axis
+            )
             init_params = arch.init
-            tok_spec = P(ep_axis, sp_axis)
-            batch_axes = (ep_axis,)
+            # batch dim over (dp, ep) jointly, dp-major: host slices
+            # stay contiguous under multi-controller feeds
+            tok_spec = P((dp_axis, ep_axis) if dp_axis else ep_axis, sp_axis)
+            batch_axes = ((dp_axis,) if dp_axis else ()) + (ep_axis,)
         else:
             axes, n_total, param_specs = nd_spec_setup(
                 arch, mesh, dp_axis, tp_axis, sp_axis
