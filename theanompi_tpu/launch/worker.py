@@ -279,29 +279,30 @@ def run_training(
         if expert > 1:
             from theanompi_tpu.models.moe import EXPERT_AXIS
 
-            if tp > 1 or pp > 1:
+            if pp > 1:
                 raise ValueError(
-                    "--expert composes with data parallelism and --sp "
-                    "(expert x tp/pp is not implemented for the MoE "
-                    "branch)"
+                    "--expert composes with data parallelism, --tp and "
+                    "--sp (expert x pp is not implemented)"
                 )
-            if len(devs) % (expert * sp):
+            if len(devs) % (expert * sp * tp):
                 raise ValueError(
                     f"{len(devs)} devices do not divide "
-                    f"--expert {expert} x --sp {sp}"
+                    f"--expert {expert} x --sp {sp} x --tp {tp}"
                 )
-            dp = len(devs) // (expert * sp)
+            dp = len(devs) // (expert * sp * tp)
             # dp major: the (dp, expert) joint batch sharding keeps each
-            # controller's host rows contiguous (NDEngine.host_batch_part)
+            # controller's host rows contiguous (NDEngine.host_batch_part);
+            # tp innermost: its per-block psum pairs ride adjacent chips
             names = ((DP_AXIS,) if dp > 1 else ()) + (EXPERT_AXIS,) + (
                 (SP_AXIS,) if sp > 1 else ()
-            )
+            ) + ((TP_AXIS,) if tp > 1 else ())
             shape = ((dp,) if dp > 1 else ()) + (expert,) + (
                 (sp,) if sp > 1 else ()
-            )
+            ) + ((tp,) if tp > 1 else ())
             nd_axes = dict(ep_axis=EXPERT_AXIS,
                            dp_axis=DP_AXIS if dp > 1 else None,
-                           sp_axis=SP_AXIS if sp > 1 else None)
+                           sp_axis=SP_AXIS if sp > 1 else None,
+                           tp_axis=TP_AXIS if tp > 1 else None)
         elif pp > 1:
             if sp > 1:
                 raise ValueError(
@@ -404,7 +405,7 @@ def run_training(
         T = recipe.input_shape[0]
         if sp > 1 and T % sp:
             raise ValueError(f"sequence length {T} not divisible by --sp {sp}")
-        batch_div = expert * max(1, n_dev // (expert * sp)) if expert > 1 else (
+        batch_div = expert * max(1, n_dev // (expert * sp * tp)) if expert > 1 else (
             (microbatches or pp) * max(1, n_dev // (pp * tp)) if pp > 1
             else n_dev // (tp * sp)
         )

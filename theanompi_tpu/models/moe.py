@@ -32,8 +32,9 @@ from theanompi_tpu.models.transformer import (
     build_spec_step,
     cast_block_params,
     next_token_loss,
-    softmax_nll,
+    pick_nll,
     sync_grads_by_spec,
+    validate_tp_divisibility,
     validate_ulysses_heads,
 )
 from theanompi_tpu.ops.moe import switch_moe
@@ -97,6 +98,7 @@ class MoETransformerLM(NamedTuple):
         sp_axis: Optional[str] = None,
         ep_axis: Optional[str] = None,
         dp_axis: Optional[str] = None,
+        tp_axis: Optional[str] = None,
     ) -> tuple[jax.Array, jax.Array, jax.Array]:
         """-> (logits, aux_loss_sum, dropped_frac_mean). Runs inside
         shard_map; with ``ep_axis`` the expert leaves arrive sharded per
@@ -105,7 +107,14 @@ class MoETransformerLM(NamedTuple):
         parallelism OVER the expert groups — the batch dim shards over
         (dp, ep) jointly, each dp group runs its own all-to-all dispatch
         to its replica of the expert shards, and the load-balance
-        statistics stay GLOBAL (averaged over dp x ep x sp)."""
+        statistics stay GLOBAL (averaged over dp x ep x sp). ``tp_axis``
+        tensor-shards WITHIN each expert and attention block (Megatron:
+        heads column/row-split, each expert's hidden dim column/row-
+        split — gelu is elementwise in the split dim — vocab-sharded
+        head): one psum after the attention projection and one after
+        the expert combine per block. The router gate stays replicated
+        (routing needs the full [d, E] logits; it is negligible next to
+        the experts)."""
         B, T = tokens.shape
         if sp_axis is not None:
             pos = lax.axis_index(sp_axis) * T + jnp.arange(T)
@@ -119,7 +128,10 @@ class MoETransformerLM(NamedTuple):
         drop_total = jnp.zeros(())
         for blk in params["blocks"]:
             blk = cast_block_params(blk, self.dtype)
-            x = x + attention_block(blk, x, self.attn, sp_axis)
+            delta = attention_block(blk, x, self.attn, sp_axis)
+            if tp_axis is not None:
+                delta = lax.psum(delta, tp_axis)  # row-parallel proj
+            x = x + delta
 
             hin = _rms(x, blk["ln2"])
             y, stats = switch_moe(
@@ -129,9 +141,16 @@ class MoETransformerLM(NamedTuple):
                 blk["expert_out"],
                 ep_axis,
                 capacity_factor=self.capacity_factor,
-                # global over every token shard (switch_moe drops Nones)
+                # global over every token shard (switch_moe drops Nones;
+                # tp replicas compute identical stats — no axis needed)
                 stats_axes=(dp_axis, ep_axis, sp_axis),
             )
+            if tp_axis is not None:
+                # each tp peer held h_local columns of every expert; the
+                # combine is linear in the expert output, so one psum on
+                # y completes the row-parallel expert_out (Megatron MLP
+                # pattern, per expert)
+                y = lax.psum(y, tp_axis)
             # the gate scale promotes y to f32; return the residual
             # stream to the compute dtype
             x = x + y.reshape(B, T, self.d_model).astype(self.dtype)
@@ -151,32 +170,40 @@ class MoETransformerLM(NamedTuple):
         *,
         ep_axis: Optional[str] = None,
         dp_axis: Optional[str] = None,
+        tp_axis: Optional[str] = None,
     ) -> jax.Array:
         """Next-token CE (global over the sequence, local over this
         device's batch) + ``aux_weight`` x the Switch load-balance
-        penalty. Same boundary-target/psum structure as TransformerLM."""
+        penalty. Same boundary-target/psum structure as TransformerLM;
+        with ``tp_axis`` the logits arrive vocab-sharded and the CE runs
+        distributed (Megatron parallel cross-entropy)."""
         logits, aux, _ = self.forward(
-            params, tokens, sp_axis=sp_axis, ep_axis=ep_axis, dp_axis=dp_axis
+            params, tokens, sp_axis=sp_axis, ep_axis=ep_axis,
+            dp_axis=dp_axis, tp_axis=tp_axis,
         )
-        ce = next_token_loss(tokens, sp_axis, softmax_nll(logits))
+        ce = next_token_loss(tokens, sp_axis, pick_nll(logits, tp_axis))
         return ce + self.aux_weight * aux
 
-    def ep_param_specs(self, ep_axis: str = EXPERT_AXIS) -> PyTree:
+    def ep_param_specs(self, ep_axis: str = EXPERT_AXIS,
+                       tp_axis: Optional[str] = None) -> PyTree:
         """Expert weights sharded on their leading (expert) dim;
-        everything else replicated."""
+        everything else replicated. With ``tp_axis``: attention heads
+        column/row-split, each expert's hidden dim column/row-split,
+        vocab head column-split (the router gate and norms stay
+        replicated)."""
         blk = {
-            "qkv": P(),
-            "proj": P(),
+            "qkv": P(None, None, tp_axis, None) if tp_axis else P(),
+            "proj": P(tp_axis, None, None) if tp_axis else P(),
             "gate": P(),
-            "expert_in": P(ep_axis, None, None),
-            "expert_out": P(ep_axis, None, None),
+            "expert_in": P(ep_axis, None, tp_axis),
+            "expert_out": P(ep_axis, tp_axis, None),
             "ln1": P(),
             "ln2": P(),
         }
         return {
             "tok_emb": P(),
             "pos_emb": P(),
-            "head": P(),
+            "head": P(None, tp_axis) if tp_axis else P(),
             "blocks": [blk] * self.n_layers,
         }
 
@@ -187,13 +214,14 @@ def ep_spec_setup(
     ep_axis: str,
     sp_axis: Optional[str],
     dp_axis: Optional[str] = None,
+    tp_axis: Optional[str] = None,
 ):
     """Shared mesh/shape validation + sharding-spec construction for the
     expert-parallel step builders (:func:`make_ep_train_step` and the
     launchable ``parallel.nd.NDEngine``). Returns ``(axes, n_total,
     param_specs)``."""
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-    axes = [a for a in (dp_axis, ep_axis, sp_axis) if a is not None]
+    axes = [a for a in (dp_axis, ep_axis, sp_axis, tp_axis) if a is not None]
     for a in axes:
         if a not in sizes:
             raise ValueError(f"axis {a!r} not in mesh axes {mesh.axis_names}")
@@ -203,11 +231,14 @@ def ep_spec_setup(
             f"n_experts={model.n_experts} must divide the {ep_axis!r} "
             f"axis size {nep}"
         )
-    validate_ulysses_heads(model, sp_axis, sizes, model.n_heads)
+    ntp = sizes[tp_axis] if tp_axis else 1
+    if tp_axis:
+        validate_tp_divisibility(model, tp_axis, ntp)
+    validate_ulysses_heads(model, sp_axis, sizes, model.n_heads // ntp)
     n_total = 1
     for a in axes:
         n_total *= sizes[a]
-    return axes, n_total, model.ep_param_specs(ep_axis)
+    return axes, n_total, model.ep_param_specs(ep_axis, tp_axis)
 
 
 def make_ep_train_step(
@@ -218,6 +249,7 @@ def make_ep_train_step(
     ep_axis: str = EXPERT_AXIS,
     sp_axis: Optional[str] = None,
     dp_axis: Optional[str] = None,
+    tp_axis: Optional[str] = None,
     optimizer=None,
 ):
     """Jitted expert-parallel train step: ``(params, tokens) ->
@@ -230,14 +262,16 @@ def make_ep_train_step(
     replica of the expert shards. Gradient sync follows the universal
     spec rule (transformer.py): expert shards carry their own full
     contribution, replicated leaves psum across every participating
-    axis."""
+    axis. ``tp_axis`` tensor-shards within each expert/attention block
+    (see :meth:`MoETransformerLM.forward`)."""
     axes, n_total, param_specs = ep_spec_setup(
-        model, mesh, ep_axis, sp_axis, dp_axis
+        model, mesh, ep_axis, sp_axis, dp_axis, tp_axis
     )
 
     def body(params, tokens):
         loss, grads = jax.value_and_grad(model.loss)(
-            params, tokens, sp_axis, ep_axis=ep_axis, dp_axis=dp_axis
+            params, tokens, sp_axis, ep_axis=ep_axis, dp_axis=dp_axis,
+            tp_axis=tp_axis,
         )
         grads = sync_grads_by_spec(grads, param_specs, axes, n_total)
         for a in (dp_axis, ep_axis):

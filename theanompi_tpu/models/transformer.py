@@ -291,11 +291,7 @@ class TransformerLM(NamedTuple):
         sp/tp peer)."""
         sp_axis = axis_name
         logits = self.forward(params, tokens, sp_axis=sp_axis, tp_axis=tp_axis)
-        if tp_axis is not None:
-            nll_fn = lambda t: _vocab_sharded_nll(logits, t, tp_axis)  # noqa: E731
-        else:
-            nll_fn = softmax_nll(logits)
-        return next_token_loss(tokens, sp_axis, nll_fn)
+        return next_token_loss(tokens, sp_axis, pick_nll(logits, tp_axis))
 
     # -- TP sharding spec ------------------------------------------------
 
@@ -338,6 +334,28 @@ def _vocab_sharded_nll(logits: jax.Array, targets: jax.Array, tp_axis: str):
     tl = jnp.take_along_axis(logits, idx[..., None], axis=-1)[..., 0]
     tl = lax.psum(jnp.where(in_range, tl, 0.0), tp_axis)
     return jnp.log(z) + m - tl
+
+
+def validate_tp_divisibility(model, tp_axis: str, ntp: int) -> None:
+    """The Megatron sharding's divisibility contract, shared by every
+    tp-capable setup (dense nd, MoE ep, pipeline): heads column/row
+    split, FFN (or per-expert) hidden split, vocab head split."""
+    if model.n_heads % ntp or model.d_ff % ntp or model.vocab % ntp:
+        raise ValueError(
+            f"the {tp_axis!r} axis size {ntp} must divide each of "
+            f"n_heads/d_ff/vocab ({model.n_heads}/{model.d_ff}/"
+            f"{model.vocab})"
+        )
+
+
+def pick_nll(logits, tp_axis: Optional[str]):
+    """The per-position NLL function for (possibly vocab-sharded)
+    logits — the dispatch shared by every tp-capable loss (dense LM,
+    MoE, pipeline head): Megatron distributed CE when ``tp_axis`` is
+    set, the logsumexp form otherwise."""
+    if tp_axis is not None:
+        return lambda t: _vocab_sharded_nll(logits, t, tp_axis)
+    return softmax_nll(logits)
 
 
 def validate_ulysses_heads(model, sp_axis, sizes, heads_local):
@@ -472,13 +490,7 @@ def nd_spec_setup(
         if a not in sizes:
             raise ValueError(f"axis {a!r} not in mesh axes {mesh.axis_names}")
     if tp_axis:
-        ntp = sizes[tp_axis]
-        if model.n_heads % ntp or model.d_ff % ntp or model.vocab % ntp:
-            raise ValueError(
-                f"the {tp_axis!r} axis size {ntp} must divide each of "
-                f"n_heads/d_ff/vocab ({model.n_heads}/{model.d_ff}/"
-                f"{model.vocab})"
-            )
+        validate_tp_divisibility(model, tp_axis, sizes[tp_axis])
     validate_ulysses_heads(
         model, sp_axis, sizes, model.n_heads // (sizes[tp_axis] if tp_axis else 1)
     )

@@ -48,6 +48,21 @@ speedup as 4-6x. The ``block_q=block_k=512`` defaults come from that
 sweep: 128x128 blocks are only ~1.4x over unfused (accumulator-rescale
 overhead dominates), 512-wide blocks are 3-4x faster than 128-wide;
 the causal block skip (:func:`_k_blocks_for`) is worth ~2x at large T.
+
+Long-context operation (measured round 5, v5e, 136M model): at
+T >= 8192 the backward kernels' full-sequence-resident operands
+overflow the 16 MB scoped VMEM stack under Mosaic's double buffering —
+_prepare caps blocks at 256 there (512-wide fails at 17 MB even
+standalone), and the FULL model additionally needs the XLA limit
+raised (``jax.jit(..., compiler_options=
+{"xla_tpu_scoped_vmem_limit_kib": 28672})`` — the remat/transpose
+context reaches 20.5 MB with 256-wide blocks). With both, **T=8192
+trains end-to-end on one chip** (36.3k tokens/s;
+experiments/results/long_context.json). T=16384 is the measured
+BOUNDARY of this single-kernel design: the overflow persists there
+even at a 49152 KiB limit; a 2-D (q-block, k-block) grid for the dkv
+kernel would remove the full-T residency altogether and is the
+follow-up for contexts beyond 8k.
 """
 
 from __future__ import annotations
@@ -388,6 +403,16 @@ def _prepare(q, k, v, causal, scale, precision, block_q, block_k):
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     sc = scale if scale is not None else 1.0 / math.sqrt(D)
+    if max(Tq, Tk) >= 8192:
+        # Long-context VMEM cap (measured on v5e, T=8192/D=64): the
+        # backward kernels keep the full-sequence counterpart operands
+        # VMEM-resident per grid step, and with Mosaic's double
+        # buffering the 512-wide blocks overflow the 16 MB scoped VMEM
+        # stack (17 MB allocation -> compile failure). 256-wide blocks
+        # fit at T=8192 (the full model also needs the scoped limit
+        # raised — module docstring); the 512 default stays for the
+        # short-T regime where it is 3-4x faster than 128.
+        block_q, block_k = min(block_q, 256), min(block_k, 256)
     BQ, BK = min(block_q, _ceil_to(Tq, 8)), min(block_k, _ceil_to(Tk, 8))
     Tqp, Tkp = _ceil_to(Tq, BQ), _ceil_to(Tk, BK)
     cfg = _Cfg(bool(causal), float(sc), Tq, Tk, BQ, BK, _interpret())

@@ -13,7 +13,8 @@ any of:
 - ``pipe`` (GPipe pipeline axis, microbatched) x ``dp``;
 - ``expert`` (Switch-MoE all-to-all axis, doubling as the batch axis)
   x ``dp`` (data parallelism over the expert groups — the batch dim
-  shards over (dp, expert) jointly) x ``sp`` for :class:`MoELMModel`.
+  shards over (dp, expert) jointly) x ``tp`` (Megatron sharding WITHIN
+  each expert/attention block) x ``sp`` for :class:`MoELMModel`.
 
 CLI: ``tmpi BSP 8 theanompi_tpu.models.lm TransformerLMModel --tp 2
 --sp 2`` (see cli.py). The engine owns batch *placement* because its
@@ -74,8 +75,8 @@ class NDEngine:
     - dense ND: any of ``dp_axis``/``tp_axis``/``sp_axis``
     - pipeline: ``pipe_axis`` (+ optional ``dp_axis``); tokens are
       reshaped host-side to microbatch-major ``[M, B/M, T]``
-    - expert:   ``ep_axis`` (+ optional ``dp_axis``/``sp_axis``); the
-      batch dim shards over (dp, expert) jointly
+    - expert:   ``ep_axis`` (+ optional ``dp_axis``/``sp_axis``/
+      ``tp_axis``); the batch dim shards over (dp, expert) jointly
     """
 
     name = "nd"
@@ -153,18 +154,14 @@ class NDEngine:
             tok_spec = P(None, dp_axis)  # [M, B, T]: M replicated, B on dp
             batch_axes = (dp_axis,) if dp_axis else ()
         elif ep_axis is not None:
-            if tp_axis:
-                raise ValueError(
-                    "the expert branch composes with dp and sp "
-                    "(expert x tp is not implemented)"
-                )
             from theanompi_tpu.models.moe import ep_spec_setup
 
             axes, n_total, param_specs = ep_spec_setup(
-                arch, mesh, ep_axis, sp_axis, dp_axis
+                arch, mesh, ep_axis, sp_axis, dp_axis, tp_axis
             )
             loss_fn = lambda p, t: arch.loss(  # noqa: E731
-                p, t, sp_axis, ep_axis=ep_axis, dp_axis=dp_axis
+                p, t, sp_axis, ep_axis=ep_axis, dp_axis=dp_axis,
+                tp_axis=tp_axis,
             )
             init_params = arch.init
             # batch dim over (dp, ep) jointly, dp-major: host slices

@@ -49,6 +49,7 @@ from theanompi_tpu.models.transformer import (
     build_spec_step,
     cast_block_params,
     sync_grads_by_spec,
+    validate_tp_divisibility,
 )
 
 PIPE_AXIS = "pipe"
@@ -217,13 +218,7 @@ def validate_pp_mesh(model: TransformerLM, mesh: Mesh, pipe_axis: str,
             f"{n_pipe}x{interleave} must divide n_layers={model.n_layers}"
         )
     if tp_axis is not None:
-        ntp = sizes[tp_axis]
-        if model.n_heads % ntp or model.d_ff % ntp or model.vocab % ntp:
-            raise ValueError(
-                f"the {tp_axis!r} axis size {ntp} must divide each of "
-                f"n_heads/d_ff/vocab ({model.n_heads}/{model.d_ff}/"
-                f"{model.vocab})"
-            )
+        validate_tp_divisibility(model, tp_axis, sizes[tp_axis])
     axes = [pipe_axis] + [a for a in (dp_axis, tp_axis) if a]
     n_total = 1
     for a in axes:
