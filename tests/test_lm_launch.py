@@ -163,6 +163,26 @@ def test_lm_pp_tp_launch():
 
 
 @pytest.mark.slow
+def test_lm_pp_sp_launch():
+    """--pp 2 --sp 2: sequence sharding through the pipeline schedule
+    (ring attention per tick, boundary targets over sp), with dp on the
+    remaining axis — through the full driver."""
+    s = run_training(
+        model_cls=TransformerLMModel,
+        devices=8,
+        pp=2,
+        sp=2,
+        microbatches=4,
+        recipe_overrides={**TINY, "n_layers": 2},
+        dataset_kwargs=DATA,
+        max_steps=4,
+        print_freq=1000,
+    )
+    assert s["steps"] == 4
+    assert np.isfinite(s["val"]["loss"])
+
+
+@pytest.mark.slow
 def test_lm_interleaved_pipeline_launch():
     """--pp-interleave through the full driver: virtual stages, grouped
     microbatches, schedule report attached to the engine."""

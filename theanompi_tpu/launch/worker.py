@@ -304,25 +304,24 @@ def run_training(
                            sp_axis=SP_AXIS if sp > 1 else None,
                            tp_axis=TP_AXIS if tp > 1 else None)
         elif pp > 1:
-            if sp > 1:
+            if len(devs) % (pp * tp * sp):
                 raise ValueError(
-                    "--pp composes with --tp and data parallelism "
-                    "(pp x sp is not implemented)"
+                    f"{len(devs)} devices do not divide "
+                    f"--pp {pp} x --tp {tp} x --sp {sp}"
                 )
-            if len(devs) % (pp * tp):
-                raise ValueError(
-                    f"{len(devs)} devices do not divide --pp {pp} x --tp {tp}"
-                )
-            dp = len(devs) // (pp * tp)
+            dp = len(devs) // (pp * tp * sp)
             # tp innermost: the per-layer psum pairs ride adjacent
             # devices (densest ICI); pipe outermost — its ppermute runs
             # once per schedule tick, not twice per layer
             names = ("pipe",) + ((DP_AXIS,) if dp > 1 else ()) + (
-                (TP_AXIS,) if tp > 1 else ()
-            )
-            shape = (pp,) + ((dp,) if dp > 1 else ()) + ((tp,) if tp > 1 else ())
+                (SP_AXIS,) if sp > 1 else ()
+            ) + ((TP_AXIS,) if tp > 1 else ())
+            shape = (pp,) + ((dp,) if dp > 1 else ()) + (
+                (sp,) if sp > 1 else ()
+            ) + ((tp,) if tp > 1 else ())
             nd_axes = dict(pipe_axis="pipe",
                            dp_axis=DP_AXIS if dp > 1 else None,
+                           sp_axis=SP_AXIS if sp > 1 else None,
                            tp_axis=TP_AXIS if tp > 1 else None,
                            microbatches=microbatches,
                            pp_interleave=pp_interleave)
@@ -406,7 +405,7 @@ def run_training(
         if sp > 1 and T % sp:
             raise ValueError(f"sequence length {T} not divisible by --sp {sp}")
         batch_div = expert * max(1, n_dev // (expert * sp * tp)) if expert > 1 else (
-            (microbatches or pp) * max(1, n_dev // (pp * tp)) if pp > 1
+            (microbatches or pp) * max(1, n_dev // (pp * tp * sp)) if pp > 1
             else n_dev // (tp * sp)
         )
         for name, b in (("batch", batch), ("val batch", vbatch)):

@@ -29,6 +29,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from theanompi_tpu.models.transformer import (
     _rms,
     attention_block,
+    global_positions,
     build_spec_step,
     cast_block_params,
     next_token_loss,
@@ -116,10 +117,7 @@ class MoETransformerLM(NamedTuple):
         (routing needs the full [d, E] logits; it is negligible next to
         the experts)."""
         B, T = tokens.shape
-        if sp_axis is not None:
-            pos = lax.axis_index(sp_axis) * T + jnp.arange(T)
-        else:
-            pos = jnp.arange(T)
+        pos = global_positions(sp_axis, T)
         x = (params["tok_emb"][tokens] + params["pos_emb"][pos][None]).astype(
             self.dtype
         )

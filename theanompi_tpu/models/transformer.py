@@ -110,6 +110,16 @@ def attention_block(blk, x, attn: str, sp_axis: Optional[str]):
     return jnp.einsum("bthk,hkd->btd", att, blk["proj"])
 
 
+def global_positions(sp_axis: Optional[str], T: int) -> jax.Array:
+    """Global position ids for a (possibly sequence-sharded) window of
+    ``T`` local positions — THE shard-offset rule, shared by the dense,
+    MoE, and pipeline forwards (changing position handling changes all
+    three at once)."""
+    if sp_axis is not None:
+        return lax.axis_index(sp_axis) * T + jnp.arange(T)
+    return jnp.arange(T)
+
+
 def next_token_loss(tokens, sp_axis: Optional[str], nll_fn):
     """Next-token objective plumbing shared by the dense and MoE LMs:
     builds the target sequence (the target of a shard's last position is
@@ -240,10 +250,7 @@ class TransformerLM(NamedTuple):
         the vocab (use :meth:`loss` for the distributed cross-entropy).
         """
         B, T = tokens.shape
-        if sp_axis is not None:
-            pos = lax.axis_index(sp_axis) * T + jnp.arange(T)
-        else:
-            pos = jnp.arange(T)
+        pos = global_positions(sp_axis, T)
         # cast AFTER the gathers (cheaper than casting the [V, d] table)
         x = (params["tok_emb"][tokens] + params["pos_emb"][pos][None]).astype(
             self.dtype

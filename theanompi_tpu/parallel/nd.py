@@ -112,10 +112,10 @@ class NDEngine:
         sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
 
         if pipe_axis is not None:
-            if ep_axis or sp_axis:
+            if ep_axis:
                 raise ValueError(
-                    "the pipeline branch composes with dp and tp "
-                    "(pipe x sp/expert is not implemented)"
+                    "the pipeline branch composes with dp, tp and sp "
+                    "(pipe x expert is not implemented)"
                 )
             from theanompi_tpu.parallel.pipeline import (
                 make_pipeline_loss,
@@ -126,10 +126,13 @@ class NDEngine:
             )
 
             axes, n_total = validate_pp_mesh(
-                arch, mesh, pipe_axis, dp_axis, pp_interleave, tp_axis
+                arch, mesh, pipe_axis, dp_axis, pp_interleave, tp_axis,
+                sp_axis,
             )
             param_specs = pipeline_param_specs(pipe_axis, tp_axis)
-            loss_fn = make_pipeline_loss(arch, pipe_axis, pp_interleave, tp_axis)
+            loss_fn = make_pipeline_loss(
+                arch, pipe_axis, pp_interleave, tp_axis, sp_axis
+            )
             n_pipe = sizes[pipe_axis]
             init_params = lambda key: stack_pipeline_params(  # noqa: E731
                 arch.init(key), n_stages=n_pipe, interleave=pp_interleave
@@ -151,7 +154,8 @@ class NDEngine:
                     f"{self.schedule['suggested_microbatches']} microbatches "
                     f"for <10%)"
                 )
-            tok_spec = P(None, dp_axis)  # [M, B, T]: M replicated, B on dp
+            # [M, B, T]: M replicated, B on dp, T on sp
+            tok_spec = P(None, dp_axis, sp_axis)
             batch_axes = (dp_axis,) if dp_axis else ()
         elif ep_axis is not None:
             from theanompi_tpu.models.moe import ep_spec_setup

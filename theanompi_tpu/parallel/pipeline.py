@@ -44,12 +44,15 @@ from jax.sharding import Mesh, PartitionSpec as P
 from theanompi_tpu.models.transformer import (
     TransformerLM,
     _rms,
-    _vocab_sharded_nll,
     attention_block,
     build_spec_step,
     cast_block_params,
+    global_positions,
+    next_token_loss,
+    pick_nll,
     sync_grads_by_spec,
     validate_tp_divisibility,
+    validate_ulysses_heads,
 )
 
 PIPE_AXIS = "pipe"
@@ -175,16 +178,23 @@ _BLOCK_TEMPLATE = {
 
 
 def _apply_stage(blocks_local, x, dtype=jnp.float32,
-                 tp_axis: Optional[str] = None):
+                 tp_axis: Optional[str] = None,
+                 sp_axis: Optional[str] = None, attn: str = "ring"):
     """Scan this device's stacked layers over the activation. With
     ``tp_axis`` each layer's heads/FFN arrive stage-locally Megatron-
     sharded: one psum after the attention projection and one after the
     FFN out-projection per layer (the same two collectives as the dense
-    TP forward — models/transformer.py::TransformerLM.forward)."""
+    TP forward — models/transformer.py::TransformerLM.forward). With
+    ``sp_axis`` the activation's sequence dim is sharded and attention
+    runs ring/Ulysses over it (the model's ``attn`` scheme), inside
+    each schedule tick."""
 
     def body(h, blk):
         blk = cast_block_params(blk, dtype)
-        delta = attention_block(blk, h, "ring", None)  # local full attn
+        # attention_block handles sp_axis=None for every scheme (flash
+        # variants stay on the fused kernel; ring/ulysses degenerate to
+        # the full reference) — pass the model's scheme through
+        delta = attention_block(blk, h, attn, sp_axis)
         if tp_axis is not None:
             delta = lax.psum(delta, tp_axis)  # row-parallel proj
         h = h + delta
@@ -200,13 +210,14 @@ def _apply_stage(blocks_local, x, dtype=jnp.float32,
 
 def validate_pp_mesh(model: TransformerLM, mesh: Mesh, pipe_axis: str,
                      dp_axis: Optional[str], interleave: int = 1,
-                     tp_axis: Optional[str] = None):
+                     tp_axis: Optional[str] = None,
+                     sp_axis: Optional[str] = None):
     """Shared mesh/shape validation for the pipeline step builders.
     Returns ``(axes, n_total)``."""
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     if pipe_axis not in sizes:
         raise ValueError(f"axis {pipe_axis!r} not in mesh axes {mesh.axis_names}")
-    for a in (dp_axis, tp_axis):
+    for a in (dp_axis, tp_axis, sp_axis):
         if a is not None and a not in sizes:
             raise ValueError(f"axis {a!r} not in mesh axes {mesh.axis_names}")
     n_pipe = sizes[pipe_axis]
@@ -217,9 +228,11 @@ def validate_pp_mesh(model: TransformerLM, mesh: Mesh, pipe_axis: str,
             f"the {pipe_axis!r} axis size x interleave = "
             f"{n_pipe}x{interleave} must divide n_layers={model.n_layers}"
         )
+    ntp = sizes[tp_axis] if tp_axis else 1
     if tp_axis is not None:
-        validate_tp_divisibility(model, tp_axis, sizes[tp_axis])
-    axes = [pipe_axis] + [a for a in (dp_axis, tp_axis) if a]
+        validate_tp_divisibility(model, tp_axis, ntp)
+    validate_ulysses_heads(model, sp_axis, sizes, model.n_heads // ntp)
+    axes = [pipe_axis] + [a for a in (dp_axis, tp_axis, sp_axis) if a]
     n_total = 1
     for a in axes:
         n_total *= sizes[a]
@@ -227,34 +240,33 @@ def validate_pp_mesh(model: TransformerLM, mesh: Mesh, pipe_axis: str,
 
 
 def make_pipeline_loss(model: TransformerLM, pipe_axis: str = PIPE_AXIS,
-                       interleave: int = 1, tp_axis: Optional[str] = None):
+                       interleave: int = 1, tp_axis: Optional[str] = None,
+                       sp_axis: Optional[str] = None):
     """``(stacked_params, tokens [M, B, T]) -> loss`` — the pipeline
     schedule (GPipe, or Megatron-interleaved when ``interleave > 1``)
     as one differentiable function (runs inside shard_map). Shared by
     :func:`make_pp_train_step` and the launchable
     ``parallel.nd.NDEngine`` pipeline branch. With ``tp_axis``, each
     stage's compute is Megatron-sharded within the stage and the head
-    is vocab-sharded with the distributed softmax cross-entropy."""
+    is vocab-sharded with the distributed softmax cross-entropy. With
+    ``sp_axis``, the sequence dim is sharded over it: each schedule
+    tick's attention runs ring/Ulysses across the axis and the
+    next-token targets cross shard boundaries via the standard ppermute
+    (transformer.py::next_token_loss — every sp/tp collective runs
+    uniformly on all pipe ranks, SPMD; the pipe mask picks the real
+    last-stage loss)."""
 
     def _head_loss(params, outs, tokens, rank, n):
         logits = outs @ params["head"].astype(model.dtype)  # [M, B, T, V(/tp)]
-        targets = jnp.concatenate([tokens[:, :, 1:], tokens[:, :, :1]], axis=-1)
-        valid = jnp.broadcast_to(
-            (jnp.arange(tokens.shape[-1]) < tokens.shape[-1] - 1).astype(
-                jnp.float32
-            ),
-            tokens.shape,
+        M, Bb, T = tokens.shape
+        # microbatches fold into the batch dim: the objective (mean over
+        # batch rows x the GLOBAL sequence, boundary targets fetched
+        # across sp shards, final global position masked) is exactly the
+        # dense LM's next_token_loss
+        local = next_token_loss(
+            tokens.reshape(M * Bb, T), sp_axis,
+            pick_nll(logits.reshape(M * Bb, T, logits.shape[-1]), tp_axis),
         )
-        if tp_axis is not None:
-            # vocab-sharded logits: Megatron parallel CE (full logits
-            # never exist); the tp collectives run uniformly on every
-            # pipe rank (SPMD), the pipe mask below picks the real one
-            nll = _vocab_sharded_nll(logits, targets, tp_axis)
-        else:
-            # fp32 softmax statistics (logits may be bf16)
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-            nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        local = jnp.sum(nll * valid) / jnp.sum(valid)
         # only the last stage computed real logits; broadcast its loss
         return lax.psum(jnp.where(rank == n - 1, local, 0.0), pipe_axis)
 
@@ -268,7 +280,7 @@ def make_pipeline_loss(model: TransformerLM, pipe_axis: str = PIPE_AXIS,
         # dead code XLA keeps cheap; grads gate on rank 0 via the where)
         emb = (
             params["tok_emb"][tokens]
-            + params["pos_emb"][jnp.arange(T)][None, None]
+            + params["pos_emb"][global_positions(sp_axis, T)][None, None]
         ).astype(model.dtype)
 
         outs0 = jnp.zeros((M, B, T, model.d_model), model.dtype)
@@ -279,7 +291,8 @@ def make_pipeline_loss(model: TransformerLM, pipe_axis: str = PIPE_AXIS,
             act_in = lax.ppermute(act, pipe_axis, fwd_perm)
             inject = emb[jnp.clip(t, 0, M - 1)]
             x = jnp.where(rank == 0, inject, act_in)
-            y = _apply_stage(params["blocks"], x, model.dtype, tp_axis)
+            y = _apply_stage(params["blocks"], x, model.dtype, tp_axis,
+                             sp_axis, model.attn)
             m = t - (n - 1)
             take = (m >= 0) & (m < M) & (rank == n - 1)
             sel = (jnp.arange(M) == jnp.clip(m, 0, M - 1))[:, None, None, None]
@@ -311,7 +324,7 @@ def make_pipeline_loss(model: TransformerLM, pipe_axis: str = PIPE_AXIS,
 
         emb = (
             params["tok_emb"][tokens]
-            + params["pos_emb"][jnp.arange(T)][None, None]
+            + params["pos_emb"][global_positions(sp_axis, T)][None, None]
         ).astype(model.dtype)
         outs0 = jnp.zeros((M, B, T, model.d_model), model.dtype)
         act0 = jnp.zeros((B, T, model.d_model), model.dtype)
@@ -332,7 +345,8 @@ def make_pipeline_loss(model: TransformerLM, pipe_axis: str = PIPE_AXIS,
             inject = (rank == 0) & (c == 0)
             x = jnp.where(inject, emb[m], act_in)
             chunk = jax.tree_util.tree_map(lambda x_: x_[c], blocks)
-            y = _apply_stage(chunk, x, model.dtype, tp_axis)
+            y = _apply_stage(chunk, x, model.dtype, tp_axis,
+                             sp_axis, model.attn)
             take = in_range & (rank == n - 1) & (c == v - 1)
             sel = (jnp.arange(M) == m)[:, None, None, None]
             outs = jnp.where(take & sel, y[None], outs)
@@ -353,6 +367,7 @@ def make_pp_train_step(
     pipe_axis: str = PIPE_AXIS,
     dp_axis: Optional[str] = None,
     tp_axis: Optional[str] = None,
+    sp_axis: Optional[str] = None,
     optimizer=None,
     interleave: int = 1,
 ):
@@ -363,12 +378,16 @@ def make_pp_train_step(
     given. Params use :func:`stack_pipeline_params`'s layout (pass the
     same ``interleave``/``n_stages`` to it when ``interleave > 1``).
     With ``tp_axis``, stages are internally Megatron-sharded
-    (pp x tp (x dp) — the standard large-LM layout)."""
+    (pp x tp (x dp) — the standard large-LM layout); with ``sp_axis``
+    the sequence dim is additionally sharded (ring/Ulysses attention
+    per schedule tick) — all four axes compose in one SPMD program."""
     axes, n_total = validate_pp_mesh(
-        model, mesh, pipe_axis, dp_axis, interleave, tp_axis
+        model, mesh, pipe_axis, dp_axis, interleave, tp_axis, sp_axis
     )
     param_specs = pipeline_param_specs(pipe_axis, tp_axis)
-    pipeline_loss = make_pipeline_loss(model, pipe_axis, interleave, tp_axis)
+    pipeline_loss = make_pipeline_loss(
+        model, pipe_axis, interleave, tp_axis, sp_axis
+    )
     n_stages = dict(zip(mesh.axis_names, mesh.devices.shape))[pipe_axis]
 
     def body(params, tokens):
@@ -378,7 +397,9 @@ def make_pp_train_step(
             loss = lax.pmean(loss, dp_axis)
         return loss, grads
 
-    tok_spec = P(None, dp_axis) if dp_axis else P()
+    tok_spec = (
+        P(None, dp_axis, sp_axis) if (dp_axis or sp_axis) else P()
+    )
     return build_spec_step(
         body, mesh, param_specs, tok_spec, lr, optimizer,
         lambda: stack_pipeline_params(
