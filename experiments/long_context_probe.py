@@ -52,19 +52,11 @@ def run_config(T: int, remat: bool, trials: int = 3,
         batch_size=batch, input_shape=(T,), remat=remat
     )
     model = TransformerLM_136M(recipe)
-    # T >= 8192 needs the scoped-VMEM limit raised: the flash backward
-    # kernels keep full-sequence counterpart operands VMEM-resident per
-    # grid step, and in the full model (distinct layouts, remat
-    # transpose context) the 16 MB default overflows at 20.5 MB even
-    # with 256-wide blocks — measured, fixed by the limit; see
-    # ops/pallas_attention.py "long-context operation" note
-    opts = (
-        {"xla_tpu_scoped_vmem_limit_kib": 28672 if T < 16384 else 49152}
-        if T >= 8192 else None
-    )
+    # no compiler flags: at T >= 8192 the flash backward dispatches to
+    # the 2-D-grid kernels (block-resident both sides — see
+    # ops/pallas_attention.py "long-context operation" note)
     runner = jax.jit(
-        make_multi_step(make_train_step(model), steps), donate_argnums=(0,),
-        compiler_options=opts,
+        make_multi_step(make_train_step(model), steps), donate_argnums=(0,)
     )
     state = init_train_state(model, jax.random.PRNGKey(0))
     r = np.random.RandomState(0)
@@ -101,7 +93,8 @@ def main() -> int:
            "model": "transformer_lm_136m (bf16, flash attention)",
            "tokens_per_step": TOKENS_PER_STEP, "rows": []}
     for T, remat in ((1024, False), (2048, False), (4096, False),
-                     (8192, False), (8192, True), (16384, True)):
+                     (8192, False), (8192, True), (16384, False),
+                     (16384, True)):
         try:
             # short-T steps raised so the timed window clears the
             # 4x-round-trip guard (a 1024-token step is ~60 ms)

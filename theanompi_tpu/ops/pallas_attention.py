@@ -49,20 +49,21 @@ sweep: 128x128 blocks are only ~1.4x over unfused (accumulator-rescale
 overhead dominates), 512-wide blocks are 3-4x faster than 128-wide;
 the causal block skip (:func:`_k_blocks_for`) is worth ~2x at large T.
 
-Long-context operation (measured round 5, v5e, 136M model): at
-T >= 8192 the backward kernels' full-sequence-resident operands
-overflow the 16 MB scoped VMEM stack under Mosaic's double buffering —
-_prepare caps blocks at 256 there (512-wide fails at 17 MB even
-standalone), and the FULL model additionally needs the XLA limit
-raised (``jax.jit(..., compiler_options=
-{"xla_tpu_scoped_vmem_limit_kib": 28672})`` — the remat/transpose
-context reaches 20.5 MB with 256-wide blocks). With both, **T=8192
-trains end-to-end on one chip** (36.3k tokens/s;
-experiments/results/long_context.json). T=16384 is the measured
-BOUNDARY of this single-kernel design: the overflow persists there
-even at a 49152 KiB limit; a 2-D (q-block, k-block) grid for the dkv
-kernel would remove the full-T residency altogether and is the
-follow-up for contexts beyond 8k.
+Long-context operation (measured round 5, v5e, 136M model): the
+classic backward kernels keep the FULL opposite sequence VMEM-resident
+per grid step, which overflows the 16 MB scoped VMEM stack at
+T >= 8192 (17-20.5 MB allocations -> compile failure; raising
+``xla_tpu_scoped_vmem_limit_kib`` to 28 MB bought T=8192 at 36.3k
+tokens/s but 16k failed even at 48 MB). The fix is structural: at
+T >= ``_BWD_2D_MIN_T`` the backward dispatches to 2-D-grid kernels
+(``_dq_kernel_2d``/``_dkv_kernel_2d``) that stream BOTH sides in
+blocks and accumulate outputs across sequential grid revisits —
+residency is O(block x D) regardless of T, no compiler flags, and
+512-wide blocks stay usable: **T=8192 trains end-to-end at 46.5k
+tokens/s (+28% over the flag route) and T=16384 at 23.5k** on one
+chip (experiments/results/long_context.json). The 1-D kernels keep
+the short-T regime (their in-register fori_loop skips causal-dead
+blocks entirely; the 2-D grid only masks them).
 """
 
 from __future__ import annotations
@@ -248,6 +249,98 @@ def _dkv_kernel(cfg: _Cfg, qo_ref, ko_ref, q_ref, do_ref, lse_ref, dsum_ref,
     dv_ref[0] = dv
 
 
+# Threshold (local sequence length) above which the backward runs on the
+# 2-D-grid kernels below: the classic 1-D kernels keep the FULL opposite
+# sequence VMEM-resident per grid step, which overflows the scoped VMEM
+# stack at long T (module docstring); the 2-D variants stream both sides
+# in blocks, so residency is O(BQ x D + BK x D) regardless of T. Kept at
+# 8192 (not lower) because the 1-D kernels' in-register fori_loop avoids
+# the 2-D grid's per-(i, j) output read-modify-write and its masked
+# causal-skip steps in the short-T regime where they already fit.
+# Tests monkeypatch this to exercise the 2-D path at small T.
+_BWD_2D_MIN_T = 8192
+
+
+def _dq_kernel_2d(cfg: _Cfg, qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref,
+                  lse_ref, dsum_ref, dq_ref):
+    """dq with BOTH sides blocked: grid (BH, q blocks, k blocks), the
+    k dim innermost so ``dq_ref``'s block is revisited sequentially and
+    accumulates in VMEM (written back when the q index advances)."""
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    q_off, k_off = qo_ref[0, 0], ko_ref[0, 0]
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_ref[0] = jnp.zeros_like(dq_ref[0])
+
+    jmax = _k_blocks_for(cfg, i, nk, q_off, k_off)
+
+    @pl.when(j < jmax)
+    def _acc():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        dsum = dsum_ref[0]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * cfg.scale
+        p = jnp.where(_mask(cfg, i, j, q_off, k_off), jnp.exp(s - lse), 0.0)
+        dp = lax.dot_general(
+            do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = (p * (dp - dsum) * cfg.scale).astype(k.dtype)
+        dq_ref[0] += lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+
+def _dkv_kernel_2d(cfg: _Cfg, qo_ref, ko_ref, q_ref, do_ref, lse_ref,
+                   dsum_ref, k_ref, v_ref, dk_ref, dv_ref):
+    """(dk, dv) with both sides blocked: grid (BH, k blocks, q blocks),
+    the q dim innermost so the per-key-block outputs accumulate in VMEM
+    across the q sweep."""
+    j = pl.program_id(1)
+    i = pl.program_id(2)
+    q_off, k_off = qo_ref[0, 0], ko_ref[0, 0]
+
+    @pl.when(i == 0)
+    def _init():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
+
+    istart = _q_block_start(cfg, j, q_off, k_off)
+
+    @pl.when(i >= istart)
+    def _acc():
+        k = k_ref[0]
+        v = v_ref[0]
+        q = q_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        dsum = dsum_ref[0]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * cfg.scale
+        p = jnp.where(_mask(cfg, i, j, q_off, k_off), jnp.exp(s - lse), 0.0)
+        dv_ref[0] += lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = lax.dot_general(
+            do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = (p * (dp - dsum) * cfg.scale).astype(q.dtype)
+        dk_ref[0] += lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+
 def _zero_offs():
     z = jnp.zeros((1, 1), jnp.int32)
     return z, z
@@ -275,6 +368,27 @@ def _full(shape):
 
     return pl.BlockSpec(shape, lambda b, i: (b,) + (0,) * (len(shape) - 1),
                         memory_space=pltpu.VMEM)
+
+
+# NOTE: _smem_spec3/_by mirror _smem_spec/_q_major/_full for the 3-dim
+# (b, x, y) grids of the 2-D backward kernels — the index-map arity is
+# part of pallas_call's contract, so the families cannot share a lambda;
+# keep the two groups in sync when changing memory spaces or layouts.
+def _smem_spec3():
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pl.BlockSpec((1, 1), lambda b, x, y: (0, 0),
+                        memory_space=pltpu.SMEM)
+
+
+def _by(which: str, shape):
+    """3-index-grid block spec selecting the grid dim that indexes this
+    operand's second axis: 'x' = grid dim 1, 'y' = grid dim 2."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    pick = (lambda b, x, y: (b, x) + (0,) * (len(shape) - 2)) if which == "x" \
+        else (lambda b, x, y: (b, y) + (0,) * (len(shape) - 2))
+    return pl.BlockSpec(shape, pick, memory_space=pltpu.VMEM)
 
 
 def _fwd(cfg: _Cfg, q3, k3, v3, q_off, k_off):
@@ -361,11 +475,70 @@ def _dsum_of(g, o):
     )
 
 
+def _dq_call_2d(cfg: _Cfg, q3, k3, v3, g, lse, dsum, q_off, k_off):
+    BH, Tqp, D = q3.shape
+    Tkp = k3.shape[1]
+    return pl.pallas_call(
+        functools.partial(_dq_kernel_2d, cfg),
+        grid=(BH, Tqp // cfg.BQ, Tkp // cfg.BK),
+        in_specs=[
+            _smem_spec3(), _smem_spec3(),
+            _by("x", (1, cfg.BQ, D)),         # q
+            _by("y", (1, cfg.BK, D)),         # k
+            _by("y", (1, cfg.BK, D)),         # v
+            _by("x", (1, cfg.BQ, D)),         # dO
+            _by("x", (1, cfg.BQ, 1)),         # lse
+            _by("x", (1, cfg.BQ, 1)),         # dsum
+        ],
+        out_specs=_by("x", (1, cfg.BQ, D)),   # revisited over the k dim
+        out_shape=jax.ShapeDtypeStruct((BH, Tqp, D), jnp.float32),
+        interpret=cfg.interpret,
+    )(q_off, k_off, q3, k3, v3, g, lse, dsum)
+
+
+def _dkv_call_2d(cfg: _Cfg, q3, g, lse, dsum, k3, v3, q_off, k_off):
+    BH, Tqp, D = q3.shape
+    Tkp = k3.shape[1]
+    return pl.pallas_call(
+        functools.partial(_dkv_kernel_2d, cfg),
+        grid=(BH, Tkp // cfg.BK, Tqp // cfg.BQ),
+        in_specs=[
+            _smem_spec3(), _smem_spec3(),
+            _by("y", (1, cfg.BQ, D)),         # q
+            _by("y", (1, cfg.BQ, D)),         # dO
+            _by("y", (1, cfg.BQ, 1)),         # lse
+            _by("y", (1, cfg.BQ, 1)),         # dsum
+            _by("x", (1, cfg.BK, D)),         # k block
+            _by("x", (1, cfg.BK, D)),         # v block
+        ],
+        out_specs=(_by("x", (1, cfg.BK, D)), _by("x", (1, cfg.BK, D))),
+        out_shape=(
+            jax.ShapeDtypeStruct((BH, Tkp, D), jnp.float32),
+            jax.ShapeDtypeStruct((BH, Tkp, D), jnp.float32),
+        ),
+        interpret=cfg.interpret,
+    )(q_off, k_off, q3, g, lse, dsum, k3, v3)
+
+
+def _bwd_dispatch(cfg: _Cfg, q3, k3, v3, g, lse, dsum, q_off, k_off):
+    """(dq, dk, dv) partials via the 1-D kernels, or the block-streamed
+    2-D kernels when either side's LOCAL length reaches _BWD_2D_MIN_T —
+    the one dispatch shared by the local backward and every ring hop
+    (a ring shard of 8k+ would otherwise rebuild the full-residency
+    kernels the threshold exists to avoid)."""
+    if max(q3.shape[1], k3.shape[1]) >= _BWD_2D_MIN_T:
+        dq = _dq_call_2d(cfg, q3, k3, v3, g, lse, dsum, q_off, k_off)
+        dk, dv = _dkv_call_2d(cfg, q3, g, lse, dsum, k3, v3, q_off, k_off)
+    else:
+        dq = _dq_call(cfg, q3, k3, v3, g, lse, dsum, q_off, k_off)
+        dk, dv = _dkv_call(cfg, q3, g, lse, dsum, k3, v3, q_off, k_off)
+    return dq, dk, dv
+
+
 def _bwd(cfg: _Cfg, q3, k3, v3, o, lse, g):
     q_off, k_off = _zero_offs()
     dsum = _dsum_of(g, o)
-    dq = _dq_call(cfg, q3, k3, v3, g, lse, dsum, q_off, k_off)
-    dk, dv = _dkv_call(cfg, q3, g, lse, dsum, k3, v3, q_off, k_off)
+    dq, dk, dv = _bwd_dispatch(cfg, q3, k3, v3, g, lse, dsum, q_off, k_off)
     return dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype)
 
 
@@ -403,16 +576,6 @@ def _prepare(q, k, v, causal, scale, precision, block_q, block_k):
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     sc = scale if scale is not None else 1.0 / math.sqrt(D)
-    if max(Tq, Tk) >= 8192:
-        # Long-context VMEM cap (measured on v5e, T=8192/D=64): the
-        # backward kernels keep the full-sequence counterpart operands
-        # VMEM-resident per grid step, and with Mosaic's double
-        # buffering the 512-wide blocks overflow the 16 MB scoped VMEM
-        # stack (17 MB allocation -> compile failure). 256-wide blocks
-        # fit at T=8192 (the full model also needs the scoped limit
-        # raised — module docstring); the 512 default stays for the
-        # short-T regime where it is 3-4x faster than 128.
-        block_q, block_k = min(block_q, 256), min(block_k, 256)
     BQ, BK = min(block_q, _ceil_to(Tq, 8)), min(block_k, _ceil_to(Tk, 8))
     Tqp, Tkp = _ceil_to(Tq, BQ), _ceil_to(Tk, BK)
     cfg = _Cfg(bool(causal), float(sc), Tq, Tk, BQ, BK, _interpret())
@@ -556,8 +719,10 @@ def _ring_flash_vjp_bwd(rcfg, res, g):
         dq, kv, dkv = carry
         src = jnp.mod(rank - t, n)
         k_off = _as_off(src * cfg.Tk)
-        dq = dq + _dq_call(cfg, q3, kv[0], kv[1], g, lse, dsum, q_off, k_off)
-        dk_j, dv_j = _dkv_call(cfg, q3, g, lse, dsum, kv[0], kv[1], q_off, k_off)
+        dq_j, dk_j, dv_j = _bwd_dispatch(
+            cfg, q3, kv[0], kv[1], g, lse, dsum, q_off, k_off
+        )
+        dq = dq + dq_j
         dkv = dkv + jnp.stack([dk_j, dv_j])
         kv = lax.ppermute(kv, ax, perm)
         dkv = lax.ppermute(dkv, ax, perm)
