@@ -50,6 +50,10 @@ class LMRecipe(Recipe):
     # "flash"/"ring_flash"/"ulysses"/"ulysses_flash" per TransformerLM
     attn: str = "ring"
     remat: bool = False
+    # chunked loss (transformer.py::chunked_nll): CE per sequence chunk,
+    # full [B, T, V] logits never materialize — the long-context memory
+    # knob alongside remat. None = whole-sequence logits.
+    loss_chunk: int | None = None
     # MoE knobs (MoELMModel only)
     n_experts: int = 8
     capacity_factor: float = 1.25
@@ -79,6 +83,7 @@ class TransformerLMModel(Model):
             attn=r.attn,
             remat=r.remat,
             dtype=r.compute_dtype,
+            loss_chunk=r.loss_chunk,
         )
 
     @classmethod
@@ -101,6 +106,13 @@ class TransformerLMModel(Model):
 
     def apply(self, params, state, tokens, *, train: bool = False, rng=None):
         del train, rng  # no dropout in this LM
+        if self.recipe.loss_chunk:
+            raise ValueError(
+                "loss_chunk runs on the ND-engine path (arch.loss — "
+                "tmpi --sp/--tp or the make_*_train_step builders); the "
+                "classifier-contract path materializes the full logits "
+                "this knob exists to avoid — unset loss_chunk here"
+            )
         return self.arch.forward(params, tokens.astype(jnp.int32)), state
 
     def loss(self, logits, labels):
@@ -128,6 +140,11 @@ class MoELMModel(TransformerLMModel):
 
         self.recipe = recipe or self.default_recipe()
         r = self.recipe
+        if r.loss_chunk:
+            raise ValueError(
+                "loss_chunk is not implemented for the MoE stack "
+                "(dense TransformerLMModel only)"
+            )
         self.arch = MoETransformerLM(
             vocab=r.num_classes,
             d_model=r.d_model,

@@ -221,6 +221,11 @@ def validate_pp_mesh(model: TransformerLM, mesh: Mesh, pipe_axis: str,
         if a is not None and a not in sizes:
             raise ValueError(f"axis {a!r} not in mesh axes {mesh.axis_names}")
     n_pipe = sizes[pipe_axis]
+    if getattr(model, "loss_chunk", None):
+        raise ValueError(
+            "loss_chunk is not implemented for the pipeline branch "
+            "(its head loss runs whole-sequence per microbatch)"
+        )
     if interleave < 1:
         raise ValueError(f"interleave={interleave} must be >= 1")
     if model.n_layers % (n_pipe * interleave):
