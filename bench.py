@@ -513,6 +513,11 @@ def main() -> int:
                     help="scaling mode: comma-separated device counts "
                          "(default 1,2,4,8; the verdict-3 extension runs "
                          "--ns 1,2,4,8,16,32,64)")
+    ap.add_argument("--obs-dir", default=None,
+                    help="also append the result, re-expressed in the obs "
+                         "metrics-snapshot schema, to <dir>/metrics.jsonl "
+                         "(one JSONL format for bench output and training "
+                         "telemetry; schema: tools/check_obs_schema.py)")
     args = ap.parse_args()
 
     if args.mode == "compute":
@@ -522,6 +527,18 @@ def main() -> int:
     else:
         ns = tuple(int(n) for n in args.ns.split(",")) if args.ns else (1, 2, 4, 8)
         result = bench_scaling(ns=ns, steps=args.steps or 4)
+    # obs emission (ISSUE 1 satellite): the same result as a metrics-
+    # snapshot record, printed BEFORE the driver-contract line (the LAST
+    # stdout line stays the raw result object) and optionally appended
+    # to an obs metrics sink
+    from theanompi_tpu.obs.metrics import result_to_snapshot
+
+    snapshot = result_to_snapshot(result, source="bench")
+    print(json.dumps(snapshot))
+    if args.obs_dir:
+        os.makedirs(args.obs_dir, exist_ok=True)
+        with open(os.path.join(args.obs_dir, "metrics.jsonl"), "a") as f:
+            f.write(json.dumps(snapshot) + "\n")
     print(json.dumps(result))
     return 0
 

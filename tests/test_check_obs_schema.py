@@ -1,0 +1,92 @@
+"""tools/check_obs_schema.py: the telemetry drift guard itself."""
+
+import json
+
+import pytest
+
+from theanompi_tpu.tools.check_obs_schema import (
+    check_file,
+    discover,
+    main,
+    validate_record,
+)
+
+
+def test_valid_records_pass():
+    good = [
+        {"kind": "train", "step": 3, "loss": 1.5, "lr": 0.1},
+        {"kind": "val", "epoch": 0, "loss": 1.0, "error": 0.5},
+        {"kind": "epoch", "epoch": 1, "seconds": 12.5, "images_per_sec": 99.0},
+        {"kind": "span", "name": "step", "rank": 0, "t0": 1.0, "dur": 0.1,
+         "depth": 0},
+        {"kind": "span_summary", "rank": 0, "t0": 1.0, "wall_s": 10.0,
+         "fractions": {"step": 0.5}, "totals_s": {"step": 5.0},
+         "counts": {"step": 4}},
+        {"kind": "metrics", "t": 1.0, "step": 2, "metrics": {"g": 1.0}},
+        {"kind": "metrics", "t": 1.0, "metrics": {}, "source": "bench",
+         "labels": {"unit": "images/sec"}},
+        {"kind": "heartbeat", "rank": 0, "t": 1.0, "step": 5, "pid": 42},
+        {"kind": "stall", "rank": 0, "t": 1.0, "step": 5, "stall_s": 3.0,
+         "timeout_s": 1.0, "stacks": {"MainThread (1)": ["frame"]}},
+    ]
+    for rec in good:
+        assert validate_record(rec) == [], rec
+
+
+@pytest.mark.parametrize("rec,frag", [
+    ({"step": 1}, "unknown kind"),
+    ({"kind": "nope"}, "unknown kind"),
+    ({"kind": "train"}, "missing required field 'step'"),
+    ({"kind": "train", "step": 1.5}, "is float, want int"),
+    ({"kind": "train", "step": True}, "is bool"),
+    ({"kind": "span", "name": 3, "rank": 0, "t0": 1.0, "dur": 0.1,
+      "depth": 0}, "want str"),
+    ({"kind": "train", "step": 1, "nested": {"a": 1}}, "non-scalar"),
+    ({"kind": "metrics", "t": 1.0, "metrics": {"g": "high"}}, "not numeric"),
+    ({"kind": "metrics", "t": 1.0, "metrics": {"g": float("nan")}},
+     "not finite"),
+    ({"kind": "span_summary", "rank": 0, "t0": 1.0, "wall_s": 1.0,
+      "fractions": {"a": 0.7, "b": 0.6}, "totals_s": {}, "counts": {}},
+     "> 1.0"),
+    ({"kind": "stall", "rank": 0, "t": 1.0, "step": 1, "stall_s": 1.0,
+      "timeout_s": 0.5, "stacks": {"t": "not-a-list"}}, "frame strings"),
+])
+def test_invalid_records_flagged(rec, frag):
+    errs = validate_record(rec)
+    assert errs and any(frag in e for e in errs), (rec, errs)
+
+
+def test_check_file_reports_line_numbers(tmp_path):
+    p = tmp_path / "mixed.jsonl"
+    p.write_text(
+        json.dumps({"kind": "train", "step": 1, "loss": 1.0}) + "\n"
+        + "not json at all\n"
+        + json.dumps({"kind": "train"}) + "\n"
+    )
+    errs = check_file(str(p))
+    assert len(errs) == 2
+    assert any(":2: unparseable JSON" in e for e in errs)
+    assert any(":3: " in e and "missing required" in e for e in errs)
+
+
+def test_discover_and_main_exit_codes(tmp_path):
+    run = tmp_path / "run"
+    run.mkdir()
+    (run / "r.jsonl").write_text(
+        json.dumps({"kind": "train", "step": 1, "loss": 1.0}) + "\n"
+    )
+    obs = run / "obs"
+    obs.mkdir()
+    (obs / "heartbeat_rank0.json").write_text(
+        json.dumps({"kind": "heartbeat", "rank": 0, "t": 1.0, "step": 1,
+                    "pid": 7}) + "\n"
+    )
+    files = discover([str(run)])
+    assert len(files) == 2  # jsonl + heartbeat, recursively
+    assert main([str(run), "-q"]) == 0
+    (obs / "bad.jsonl").write_text('{"kind": "wat"}\n')
+    assert main([str(run), "-q"]) == 1
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(FileNotFoundError):
+        discover([str(empty)])

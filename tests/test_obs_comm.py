@@ -1,0 +1,155 @@
+"""obs/comm.py: analytic traffic formulas + engine declarations."""
+
+import numpy as np
+import pytest
+
+from tinymodel import TinyCNN
+from theanompi_tpu.obs.comm import (
+    allreduce_bytes,
+    bsp_traffic,
+    easgd_traffic,
+    gosgd_traffic,
+    nd_traffic,
+    pytree_num_elements,
+    zero1_traffic,
+)
+
+
+def _tiny_model():
+    return TinyCNN(
+        TinyCNN.default_recipe().replace(
+            batch_size=32, input_shape=(16, 16, 3)
+        )
+    )
+
+
+def test_allreduce_formula():
+    # ring allreduce: 2 (n-1)/n * N * b per device
+    assert allreduce_bytes(1000, 8) == pytest.approx(2 * 7 / 8 * 1000 * 4)
+    assert allreduce_bytes(1000, 8, wire_bytes=2) == pytest.approx(
+        2 * 7 / 8 * 1000 * 2
+    )
+    assert allreduce_bytes(1000, 1) == 0.0  # no peers, no wire
+
+
+def test_bsp_traffic_strategies():
+    n, N = 8, 1000
+    # psum: 2*(7/8)*1000*4 = 7000 bytes per device per step
+    assert bsp_traffic(N, n).bytes_per_step == pytest.approx(7000.0)
+    # bf16 wire halves bytes; psum and its reference aliases agree
+    assert bsp_traffic(N, n, "psum_bf16").bytes_per_step == pytest.approx(3500.0)
+    assert bsp_traffic(N, n, "nccl32").bytes_per_step == pytest.approx(7000.0)
+    # ring variants pad N to n equal segments
+    ring = bsp_traffic(1001, n, "ring")
+    assert ring.detail["elements"] == 8 * 126  # ceil(1001/8)=126
+    # int8: 128-multiple segments, 1 byte on the wire
+    ri8 = bsp_traffic(1000, n, "ring_int8")
+    assert ri8.detail["elements"] == 8 * 128
+    assert ri8.bytes_per_step == pytest.approx(2 * 7 / 8 * 8 * 128 * 1)
+    # single device: silence
+    assert bsp_traffic(N, 1).bytes_per_step == 0.0
+    with pytest.raises(ValueError, match="unknown strategy"):
+        bsp_traffic(N, n, "warp_drive")
+
+
+def test_zero1_matches_allreduce_volume():
+    """ZeRO-1's headline: reduce-scatter + all-gather == allreduce wire
+    volume (on the padded flat fp32 buffer parallel/zero.py builds)."""
+    n, N = 8, 5354
+    tm = zero1_traffic(N, n)
+    seg = -(-N // n)
+    assert tm.bytes_per_step == pytest.approx(2 * (n - 1) / n * n * seg * 4)
+    assert tm.bytes_per_step == pytest.approx(
+        allreduce_bytes(n * seg, n)
+    )
+    assert zero1_traffic(N, 1).bytes_per_step == 0.0
+
+
+def test_easgd_amortization():
+    tm = easgd_traffic(1000, n_workers=8, avg_freq=4)
+    assert tm.bytes_per_step == 0.0  # local steps are silent
+    assert tm.bytes_per_exchange == pytest.approx(7000.0)
+    assert tm.exchange_every == 4
+    assert tm.bytes_per_step_amortized == pytest.approx(7000.0 / 4)
+    # worker groups: the in-step group psum is NOT silent
+    tg = easgd_traffic(1000, n_workers=4, avg_freq=4, group_size=2)
+    assert tg.bytes_per_step == pytest.approx(allreduce_bytes(1000, 2))
+
+
+def test_gosgd_round_bytes():
+    tm = gosgd_traffic(1000, n_workers=8, gossip_every=2)
+    # one ppermute of the packed (share*w, share) buffer per round
+    assert tm.bytes_per_exchange == pytest.approx((1000 + 1) * 4)
+    assert tm.bytes_per_step_amortized == pytest.approx((1000 + 1) * 4 / 2)
+    assert gosgd_traffic(1000, 1).bytes_per_exchange == 0.0  # no recipient
+
+
+def test_nd_traffic_marked_approx():
+    tm = nd_traffic(1000, dp=4, shard_ways=2)
+    assert tm.detail["approx"] is True
+    assert tm.bytes_per_step == pytest.approx(allreduce_bytes(500, 4))
+
+
+def test_achieved_gbps():
+    tm = bsp_traffic(1000, 8)
+    assert tm.achieved_gbps(0.001) == pytest.approx(7000.0 / 0.001 / 1e9)
+    assert tm.achieved_gbps(0.0) is None
+
+
+def test_pytree_num_elements():
+    tree = {"a": np.zeros((3, 4)), "b": [np.zeros(5), np.float32(1.0)]}
+    assert pytree_num_elements(tree) == 12 + 5 + 1
+
+
+# -- engine declarations ----------------------------------------------------
+
+
+def test_bsp_engine_declares_its_traffic(mesh8, rng):
+    from theanompi_tpu.parallel.bsp import BSPEngine
+
+    model = _tiny_model()
+    engine = BSPEngine(model, mesh8, strategy="psum")
+    state = engine.init_state(rng)
+    P = pytree_num_elements(state.params)
+    tm = engine.traffic_model(state)
+    assert tm.rule == "bsp" and tm.n_workers == 8
+    assert tm.bytes_per_step == pytest.approx(2 * 7 / 8 * P * 4)
+
+
+def test_zero_engine_declares_its_traffic(mesh8, rng):
+    from theanompi_tpu.parallel.zero import ZeroEngine
+
+    model = _tiny_model()
+    engine = ZeroEngine(model, mesh8)
+    state = engine.init_state(rng)
+    P = pytree_num_elements(state.params)
+    seg = -(-P // 8)
+    tm = engine.traffic_model(state)
+    assert tm.rule == "zero1"
+    assert tm.bytes_per_step == pytest.approx(2 * 7 / 8 * 8 * seg * 4)
+
+
+def test_easgd_engine_declares_its_traffic(mesh8, rng):
+    from theanompi_tpu.parallel.easgd import EASGDEngine
+
+    model = _tiny_model()
+    engine = EASGDEngine(model, mesh8, avg_freq=4)
+    state = engine.init_state(rng)
+    # workers leaves are stacked (8, ...): per-worker size is 1/8 of it
+    per_worker = pytree_num_elements(state.workers.params) // 8
+    tm = engine.traffic_model(state)
+    assert tm.rule == "easgd" and tm.exchange_every == 4
+    assert tm.bytes_per_step == 0.0
+    assert tm.bytes_per_exchange == pytest.approx(2 * 7 / 8 * per_worker * 4)
+
+
+def test_gosgd_engine_declares_its_traffic(mesh8, rng):
+    from theanompi_tpu.parallel.gosgd import GOSGDEngine
+
+    model = _tiny_model()
+    engine = GOSGDEngine(model, mesh8, gossip_every=2)
+    state = engine.init_state(rng)
+    per_worker = pytree_num_elements(state.workers.params) // 8
+    tm = engine.traffic_model(state)
+    assert tm.rule == "gosgd" and tm.exchange_every == 2
+    assert tm.bytes_per_exchange == pytest.approx((per_worker + 1) * 4)

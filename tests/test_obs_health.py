@@ -1,0 +1,144 @@
+"""obs/health.py: heartbeat files + stall watchdog units."""
+
+import json
+import os
+import time
+
+from theanompi_tpu.obs.health import Heartbeat, StallWatchdog, thread_stacks
+from theanompi_tpu.tools.check_obs_schema import validate_record
+
+
+def _wait_for(predicate, timeout=5.0, poll=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(poll)
+    return predicate()
+
+
+def test_thread_stacks_sees_this_frame():
+    stacks = thread_stacks()
+    me = [
+        "\n".join(frames) for frames in stacks.values()
+        if "test_thread_stacks_sees_this_frame" in "\n".join(frames)
+    ]
+    assert me, f"own frame missing from {list(stacks)}"
+
+
+def test_heartbeat_writes_and_updates(tmp_path):
+    hb = Heartbeat(str(tmp_path), rank=2, interval=0.25)
+    try:
+        assert _wait_for(lambda: (tmp_path / "heartbeat_rank2.json").exists())
+        hb.set_step(17)
+        assert _wait_for(
+            lambda: json.loads(
+                (tmp_path / "heartbeat_rank2.json").read_text()
+            )["step"] == 17
+        )
+        rec = json.loads((tmp_path / "heartbeat_rank2.json").read_text())
+        assert validate_record(rec) == []
+        assert rec["pid"] == os.getpid() and rec["rank"] == 2
+    finally:
+        hb.stop()
+    # stop() leaves a final beat on disk
+    assert json.loads((tmp_path / "heartbeat_rank2.json").read_text())["step"] == 17
+
+
+def test_watchdog_fires_once_and_rearms(tmp_path):
+    fired = []
+    wd = StallWatchdog(
+        0.2, str(tmp_path), rank=0, arm_profiler=False,
+        on_stall=lambda rep: fired.append(rep),
+    )
+    try:
+        wd.notify_step(1)
+        assert _wait_for(lambda: len(fired) == 1)
+        # no progress: the SAME stall must not refire
+        time.sleep(0.5)
+        assert len(fired) == 1
+        # progress re-arms; a new stall fires again
+        wd.notify_step(2)
+        assert _wait_for(lambda: len(fired) == 2)
+    finally:
+        wd.stop()
+    report = fired[0]
+    assert validate_record(report) == []
+    assert report["step"] == 1 and report["stall_s"] > 0.2
+    assert report["stacks"], "stall report carries no thread stacks"
+    # files on disk: machine-readable + human-readable
+    disk = json.loads((tmp_path / "stall_rank0.json").read_text())
+    assert disk["kind"] == "stall" and disk["stacks"]
+    txt = (tmp_path / "stall_rank0.txt").read_text()
+    assert "STALL at step" in txt and "---" in txt
+
+
+def test_watchdog_fires_on_first_dispatch_hang(tmp_path):
+    """No notify_step ever (wedged in the FIRST collective — the
+    canonical multihost hang): the clock runs from construction, so the
+    watchdog still fires, reporting step -1 (nothing completed yet)."""
+    fired = []
+    wd = StallWatchdog(0.15, str(tmp_path), rank=0, arm_profiler=False,
+                       on_stall=lambda rep: fired.append(rep))
+    try:
+        assert _wait_for(lambda: len(fired) == 1)
+        assert fired[0]["step"] == -1
+        assert validate_record(fired[0]) == []
+        # fires once; the startup stall must not refire
+        time.sleep(0.4)
+        assert len(fired) == 1
+    finally:
+        wd.stop()
+
+
+def test_watchdog_quiet_while_advancing(tmp_path):
+    fired = []
+    wd = StallWatchdog(0.3, str(tmp_path), rank=0, arm_profiler=False,
+                       on_stall=lambda rep: fired.append(rep))
+    try:
+        for step in range(1, 8):
+            wd.notify_step(step)
+            time.sleep(0.08)
+        assert not fired
+    finally:
+        wd.stop()
+
+
+def test_watchdog_rejects_nonpositive_timeout(tmp_path):
+    import pytest
+
+    with pytest.raises(ValueError, match="timeout"):
+        StallWatchdog(0.0, str(tmp_path))
+
+
+def test_watchdog_arms_postmortem_trace(tmp_path, monkeypatch):
+    """With arm_profiler on, a stall starts a bounded jax.profiler
+    capture on its OWN thread and records the trace dir in the report —
+    and a profiler that hangs must not delay the report (faked here;
+    the real profiler is observed to block stop_trace mid-stall)."""
+    import jax
+
+    calls = []
+
+    class FakeProfiler:
+        def start_trace(self, d):
+            calls.append(("start", d))
+
+        def stop_trace(self):
+            calls.append(("stop", None))
+
+    monkeypatch.setattr(jax, "profiler", FakeProfiler())
+    fired = []
+    wd = StallWatchdog(0.15, str(tmp_path), rank=0, capture_s=0.05,
+                       on_stall=lambda rep: fired.append(rep))
+    try:
+        wd.notify_step(5)
+        assert _wait_for(lambda: len(fired) == 1)
+    finally:
+        wd.stop()
+    report = fired[0]
+    expect_dir = str(tmp_path / "postmortem_rank0")
+    assert report["postmortem_trace"] == expect_dir
+    assert validate_record(report) == []
+    assert _wait_for(lambda: ("stop", None) in calls)
+    assert calls[0] == ("start", expect_dir)

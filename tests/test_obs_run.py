@@ -1,0 +1,223 @@
+"""End-to-end observability smoke runs (ISSUE 1 acceptance): CPU-mesh
+BSP and ZeRO training with --obs-dir produces schema-valid telemetry
+whose comm accounting matches the analytic formulas, and a low
+--stall-timeout plus an injected sleep produces a watchdog report with
+thread stacks."""
+
+import json
+import time
+
+import pytest
+
+from tinymodel import TinyCNN
+from theanompi_tpu.launch.worker import run_training
+from theanompi_tpu.obs.comm import pytree_num_elements
+from theanompi_tpu.tools.check_obs_schema import check_file, main as schema_main
+from theanompi_tpu.utils import Recorder
+
+_TINY = dict(
+    recipe_overrides={
+        "batch_size": 32,
+        "input_shape": (16, 16, 3),
+        "sched_kwargs": {"lr": 0.05, "boundaries": [10**9]},
+    },
+    dataset="synthetic",
+    dataset_kwargs={"n_train": 64, "n_val": 32, "image_shape": (16, 16, 3)},
+    print_freq=0,
+)
+
+
+def _tiny_param_count():
+    import jax
+
+    model = TinyCNN(
+        TinyCNN.default_recipe().replace(batch_size=32, input_shape=(16, 16, 3))
+    )
+    params, _ = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0)))
+    return pytree_num_elements(params)
+
+
+def _read_jsonl(path):
+    return [json.loads(l) for l in open(path).read().splitlines() if l.strip()]
+
+
+def _last_metrics(obs_dir):
+    rows = [r for r in _read_jsonl(obs_dir / "metrics.jsonl")
+            if r["kind"] == "metrics"]
+    assert rows, "no metrics snapshots written"
+    return rows[-1]["metrics"]
+
+
+def test_bsp_smoke_obs_outputs(tmp_path):
+    obs = tmp_path / "obs"
+    summary = run_training(
+        rule="bsp", model_cls=TinyCNN, devices=8, n_epochs=2,
+        save_dir=str(tmp_path), obs_dir=str(obs), metrics_snapshot_freq=1,
+        **_TINY,
+    )
+    assert summary["steps"] == 4
+
+    # (1) metrics snapshot: per-step comm bytes == the analytic ring
+    # allreduce of the param pytree, 2*(n-1)/n * P * 4 at n=8
+    P = _tiny_param_count()
+    m = _last_metrics(obs)
+    assert m["tmpi_comm_bytes_per_step"] == pytest.approx(2 * 7 / 8 * P * 4)
+    assert m["tmpi_comm_n_workers"] == 8
+    assert m["tmpi_steps_total"] == 4
+    assert m["tmpi_comm_bytes_total"] == pytest.approx(4 * 2 * 7 / 8 * P * 4)
+    assert m["tmpi_comm_gbps"] > 0
+    # recorder delegation: bracket histograms + train gauges in the sink
+    assert m["tmpi_step_seconds_count"] == 4
+    assert "tmpi_train_loss" in m
+    assert m["tmpi_images_total"] == 4 * 32
+    # prometheus exposition present and self-consistent
+    prom = (obs / "metrics.prom").read_text()
+    assert "# TYPE tmpi_steps_total counter" in prom
+    assert "tmpi_steps_total 4.0" in prom
+
+    # (2) span log: all six stack kinds observed, summary fractions <= 1
+    rows = _read_jsonl(obs / "spans_rank0.jsonl")
+    names = {r["name"] for r in rows if r["kind"] == "span"}
+    assert {"data_wait", "h2d", "step", "eval"} <= names
+    summary_row = [r for r in rows if r["kind"] == "span_summary"][-1]
+    fr = summary_row["fractions"]
+    assert sum(fr.values()) <= 1.0 + 1e-6
+    assert fr["step"] > 0
+
+    # (3) every emitted line passes the documented schema — recorder
+    # JSONL, spans, metrics, heartbeat (the drift guard for bench/plot)
+    for f in ("metrics.jsonl", "spans_rank0.jsonl", "heartbeat_rank0.json"):
+        assert check_file(str(obs / f)) == [], f
+    assert check_file(str(tmp_path / "tinycnn_bsp.jsonl")) == []
+    # and the CLI checker agrees end to end
+    assert schema_main([str(tmp_path), "-q"]) == 0
+
+
+def test_zero_smoke_obs_comm_bytes(tmp_path):
+    obs = tmp_path / "obs"
+    summary = run_training(
+        rule="bsp", model_cls=TinyCNN, devices=8, zero=1, n_epochs=1,
+        obs_dir=str(obs), metrics_snapshot_freq=1, **_TINY,
+    )
+    assert summary["steps"] == 2
+    # ZeRO-1: reduce-scatter + all-gather over the n-segment-padded flat
+    # buffer — same volume as allreduce, on ceil(P/8)*8 elements
+    P = _tiny_param_count()
+    seg = -(-P // 8)
+    m = _last_metrics(obs)
+    assert m["tmpi_comm_bytes_per_step"] == pytest.approx(2 * 7 / 8 * 8 * seg * 4)
+    assert check_file(str(obs / "metrics.jsonl")) == []
+    rows = _read_jsonl(obs / "spans_rank0.jsonl")
+    fr = [r for r in rows if r["kind"] == "span_summary"][-1]["fractions"]
+    assert sum(fr.values()) <= 1.0 + 1e-6
+
+
+def test_easgd_obs_amortized_comm(tmp_path):
+    obs = tmp_path / "obs"
+    # per-worker batch semantics: global batch = 8 workers x 8 = 64,
+    # so 128 train examples give the 2 steps the avg_freq=2 exchange needs
+    kw = dict(_TINY)
+    kw["recipe_overrides"] = {**_TINY["recipe_overrides"], "batch_size": 8}
+    kw["dataset_kwargs"] = {**_TINY["dataset_kwargs"],
+                            "n_train": 128, "n_val": 64}
+    run_training(
+        rule="easgd", model_cls=TinyCNN, devices=8, n_epochs=1,
+        avg_freq=2, obs_dir=str(obs), metrics_snapshot_freq=1, **kw,
+    )
+    P = _tiny_param_count()
+    m = _last_metrics(obs)
+    # local steps silent; elastic psum every 2 steps, amortized
+    assert m["tmpi_comm_bytes_per_step"] == 0.0
+    assert m["tmpi_comm_bytes_per_exchange"] == pytest.approx(2 * 7 / 8 * P * 4)
+    assert m["tmpi_comm_bytes_per_step_amortized"] == pytest.approx(
+        2 * 7 / 8 * P * 4 / 2
+    )
+    # the EASGD exchange rides the recorder 'comm' bracket -> grad_sync span
+    rows = _read_jsonl(obs / "spans_rank0.jsonl")
+    assert any(
+        r["kind"] == "span" and r["name"] == "grad_sync" for r in rows
+    )
+
+
+def test_stall_watchdog_fires_on_injected_sleep(tmp_path, monkeypatch):
+    """--stall-timeout set low + an injected host-side sleep at step 2:
+    the watchdog must report thread stacks that show the stuck frame."""
+    orig = Recorder.train_metrics
+
+    def slow(self, step, metrics, n_images=0):
+        if step == 2:
+            time.sleep(1.0)  # the "hung collective" stand-in
+        return orig(self, step, metrics, n_images=n_images)
+
+    monkeypatch.setattr(Recorder, "train_metrics", slow)
+    # keep the REAL profiler out of the shared pytest process (its
+    # start/stop can wedge the backend's profiler state for later
+    # tests); the arming path is unit-tested with a fake profiler in
+    # test_obs_health.py
+    from theanompi_tpu.obs.health import StallWatchdog
+
+    monkeypatch.setattr(StallWatchdog, "_arm_postmortem", lambda self: None)
+    obs = tmp_path / "obs"
+    run_training(
+        rule="bsp", model_cls=TinyCNN, devices=8, n_epochs=1,
+        obs_dir=str(obs), stall_timeout=0.25, **_TINY,
+    )
+    # the report may land after the run returns, and a cold first-step
+    # compile can produce an EARLIER startup-stall report (step -1,
+    # clock-from-construction semantics) that the step-2 fire then
+    # overwrites: poll for the step-2 report specifically
+    report_path = obs / "stall_rank0.json"
+    report = None
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if report_path.exists():
+            report = json.loads(report_path.read_text())
+            if report["step"] == 2:
+                break
+        time.sleep(0.05)
+    assert report is not None, "watchdog never reported the stall"
+    assert report["step"] == 2 and report["stall_s"] > 0.25
+    all_frames = "\n".join(
+        "\n".join(frames) for frames in report["stacks"].values()
+    )
+    # the main thread's stack shows the injected sleep inside the driver
+    assert "slow" in all_frames or "sleep" in all_frames
+    assert (obs / "stall_rank0.txt").read_text().startswith("STALL at step")
+    assert check_file(str(report_path)) == []
+
+
+def test_tmpi_cli_obs_flags(tmp_path, capsys):
+    """--obs-dir / --metrics-snapshot-freq reach the driver through the
+    CLI and produce the telemetry files."""
+    import os
+
+    from theanompi_tpu.cli import main as tmpi_main
+
+    tinymodel = os.path.join(os.path.dirname(__file__), "tinymodel.py")
+    obs = tmp_path / "obs"
+    rc = tmpi_main([
+        "BSP", "8", tinymodel, "TinyCNN",
+        "--synthetic", "--max-steps", "2", "--epochs", "1",
+        "--batch-size", "32", "--print-freq", "0",
+        "--recipe-arg", "input_shape=[16,16,3]",
+        "--dataset-arg", "n_train=64", "--dataset-arg", "n_val=32",
+        "--dataset-arg", "image_shape=[16,16,3]",
+        "--obs-dir", str(obs), "--metrics-snapshot-freq", "1",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(out)["steps"] == 2
+    assert (obs / "metrics.jsonl").exists()
+    assert (obs / "spans_rank0.jsonl").exists()
+    assert check_file(str(obs / "metrics.jsonl")) == []
+
+
+def test_obs_off_leaves_no_files(tmp_path):
+    run_training(
+        rule="bsp", model_cls=TinyCNN, devices=8, n_epochs=1,
+        save_dir=str(tmp_path), **_TINY,
+    )
+    assert not (tmp_path / "obs").exists()
+    assert not list(tmp_path.glob("spans*")) and not list(
+        tmp_path.glob("heartbeat*")
+    )

@@ -1,0 +1,126 @@
+"""obs/spans.py: nestable spans, JSONL log, summary fractions."""
+
+import json
+import threading
+import time
+
+from theanompi_tpu.obs import spans as spans_mod
+from theanompi_tpu.obs.spans import SpanRecorder, obs_span
+from theanompi_tpu.tools.check_obs_schema import check_file, validate_record
+
+
+def _lines(path):
+    return [json.loads(l) for l in open(path).read().splitlines() if l.strip()]
+
+
+def test_span_lines_and_nesting(tmp_path):
+    p = tmp_path / "spans.jsonl"
+    rec = SpanRecorder(str(p), rank=3)
+    with rec.span("step"):
+        with rec.span("grad_sync"):
+            time.sleep(0.01)
+    rec.close()
+    rows = _lines(p)
+    # inner closes first; summary line last
+    assert [r["kind"] for r in rows] == ["span", "span", "span_summary"]
+    inner, outer, summary = rows
+    assert inner["name"] == "grad_sync" and inner["depth"] == 1
+    assert outer["name"] == "step" and outer["depth"] == 0
+    assert inner["dur"] <= outer["dur"]
+    assert all(r["rank"] == 3 for r in rows)
+    assert check_file(str(p)) == []
+
+
+def test_summary_fractions_sum_le_one(tmp_path):
+    rec = SpanRecorder(str(tmp_path / "s.jsonl"), rank=0)
+    for name in ("data_wait", "step", "step", "eval"):
+        with rec.span(name):
+            time.sleep(0.005)
+    summary = rec.close()
+    assert validate_record(summary) == []
+    fr = summary["fractions"]
+    assert set(fr) == {"data_wait", "step", "eval"}
+    assert sum(fr.values()) <= 1.0 + 1e-6
+    assert summary["counts"]["step"] == 2
+    assert summary["totals_s"]["step"] >= 0.01
+
+
+def test_other_thread_spans_logged_but_not_in_fractions(tmp_path):
+    """The h2d producer-thread spans overlap driver time; they must show
+    up as span lines / totals but stay OUT of the wall-fraction
+    accounting (which would otherwise sum past 1.0)."""
+    p = tmp_path / "s.jsonl"
+    rec = SpanRecorder(str(p), rank=0)
+
+    stop = threading.Event()
+
+    def producer():
+        while not stop.is_set():
+            with rec.span("h2d"):
+                time.sleep(0.004)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    for _ in range(5):
+        with rec.span("step"):
+            time.sleep(0.005)
+    stop.set()
+    t.join(timeout=2)
+    summary = rec.close()
+    assert "h2d" not in summary["fractions"]
+    assert summary["totals_s"]["h2d"] > 0
+    assert summary["counts"]["h2d"] >= 1
+    assert sum(summary["fractions"].values()) <= 1.0 + 1e-6
+    assert check_file(str(p)) == []
+
+
+def test_exception_inside_span_still_closes(tmp_path):
+    p = tmp_path / "s.jsonl"
+    rec = SpanRecorder(str(p), rank=0)
+    try:
+        with rec.span("checkpoint"):
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    rec.close()
+    rows = _lines(p)
+    assert rows[0]["name"] == "checkpoint"
+    assert rows[-1]["kind"] == "span_summary"
+
+
+def test_begin_finish_tolerates_leaked_inner(tmp_path):
+    """An exception path that finishes an OUTER token while an inner one
+    is still open must not corrupt the depth stack."""
+    rec = SpanRecorder(str(tmp_path / "s.jsonl"), rank=0)
+    outer = rec.begin("step")
+    rec.begin("grad_sync")  # leaked
+    rec.finish(outer)
+    nxt = rec.begin("eval")
+    assert nxt["depth"] == 0
+    rec.finish(nxt)
+    rec.close()
+
+
+def test_obs_span_module_hook(tmp_path):
+    # without a current recorder: pure no-op
+    with obs_span("h2d"):
+        pass
+    p = tmp_path / "s.jsonl"
+    rec = SpanRecorder(str(p), rank=0)
+    spans_mod.set_current(rec)
+    try:
+        with obs_span("h2d"):
+            pass
+    finally:
+        spans_mod.set_current(None)
+    rec.close()
+    assert any(r["name"] == "h2d" for r in _lines(p))
+
+
+def test_close_idempotent(tmp_path):
+    rec = SpanRecorder(str(tmp_path / "s.jsonl"), rank=0)
+    with rec.span("step"):
+        pass
+    first = rec.close()
+    assert rec.close() is None  # second close: no duplicate summary
+    assert first["kind"] == "span_summary"

@@ -72,3 +72,74 @@ def test_end_to_end_png(tmp_path):
                "--smooth", "2"])
     assert rc == 0
     assert os.path.getsize(out) > 10_000  # a real rendered figure
+
+
+def _write_obs(run_dir, steps=6):
+    """An obs/ dir next to the run JSONL, the --obs-dir-inside-save-dir
+    convention the plotter keys on."""
+    obs = os.path.join(run_dir, "obs")
+    os.makedirs(obs, exist_ok=True)
+    with open(os.path.join(obs, "metrics.jsonl"), "w") as f:
+        for s in range(1, steps + 1):
+            f.write(json.dumps({
+                "kind": "metrics", "t": 1000.0 + s, "step": s,
+                "metrics": {"tmpi_comm_gbps": 0.5 + 0.01 * s,
+                            "tmpi_steps_total": float(s)},
+            }) + "\n")
+    with open(os.path.join(obs, "spans_rank0.jsonl"), "w") as f:
+        f.write(json.dumps({
+            "kind": "span_summary", "rank": 0, "t0": 1000.0, "wall_s": 10.0,
+            "fractions": {"step": 0.6, "data_wait": 0.1, "eval": 0.05},
+            "totals_s": {"step": 6.0, "data_wait": 1.0, "eval": 0.5},
+            "counts": {"step": 6, "data_wait": 6, "eval": 2},
+        }) + "\n")
+
+
+def test_load_obs_series_and_graceful_absence(tmp_path):
+    from theanompi_tpu.tools.plot_history import load_obs
+
+    p = _write_run(str(tmp_path / "runA"), "runA")
+    # no obs dir: empty series, no raise
+    o = load_obs(p)
+    assert o["comm_gbps"] == [] and o["fractions"] == {}
+    _write_obs(str(tmp_path / "runA"))
+    o = load_obs(p)
+    assert len(o["comm_gbps"]) == 6 and o["comm_step"] == [1, 2, 3, 4, 5, 6]
+    assert o["fractions"]["step"] == 0.6
+
+
+def test_load_obs_keeps_only_newest_rerun(tmp_path):
+    """metrics.jsonl is append-mode: a rerun into the same obs dir
+    restarts the step counter; the plotter keeps the newest run's
+    series (mirrors last-summary-wins for the span fractions)."""
+    from theanompi_tpu.tools.plot_history import load_obs
+
+    p = _write_run(str(tmp_path / "runA"), "runA")
+    _write_obs(str(tmp_path / "runA"), steps=6)
+    # second run appended on top, only 3 steps
+    obs = os.path.join(str(tmp_path / "runA"), "obs")
+    with open(os.path.join(obs, "metrics.jsonl"), "a") as f:
+        for s in range(1, 4):
+            f.write(json.dumps({
+                "kind": "metrics", "t": 2000.0 + s, "step": s,
+                "metrics": {"tmpi_comm_gbps": 9.0 + s},
+            }) + "\n")
+    o = load_obs(p)
+    assert o["comm_step"] == [1, 2, 3]
+    assert o["comm_gbps"] == [10.0, 11.0, 12.0]
+
+
+def test_end_to_end_png_with_obs_panel(tmp_path):
+    """A run WITH obs data gets the extra panel row; mixing it with a
+    run WITHOUT obs data must still render (graceful degradation)."""
+    _write_run(str(tmp_path / "a"), "a")
+    _write_obs(str(tmp_path / "a"))
+    _write_run(str(tmp_path / "b"), "b")  # no obs
+    out = str(tmp_path / "out.png")
+    rc = main([str(tmp_path / "a"), str(tmp_path / "b"), "-o", out])
+    assert rc == 0
+    assert os.path.getsize(out) > 10_000
+    # obs-less inputs keep the original 2x2 figure (smaller canvas)
+    out2 = str(tmp_path / "out2.png")
+    assert main([str(tmp_path / "b"), "-o", out2]) == 0
+    assert os.path.getsize(out2) > 10_000

@@ -1,0 +1,115 @@
+"""Recorder satellites (ISSUE 1): the enable_profile/profile_tick state
+machine (armed -> tracing -> done, stop-at-epoch-end, run-ends-while-
+armed warning) and the end()-without-start() guard."""
+
+import pytest
+
+from theanompi_tpu.utils import Recorder
+
+
+class _FakeProfiler:
+    """Stands in for jax.profiler: records start/stop calls so the state
+    machine is testable without a real trace capture."""
+
+    def __init__(self):
+        self.calls = []
+
+    def start_trace(self, d):
+        self.calls.append(("start", d))
+
+    def stop_trace(self):
+        self.calls.append(("stop", None))
+
+
+@pytest.fixture
+def fake_profiler(monkeypatch):
+    import jax
+
+    fake = _FakeProfiler()
+    monkeypatch.setattr(jax, "profiler", fake)
+    return fake
+
+
+def test_profile_armed_to_tracing_to_done(tmp_path, fake_profiler):
+    rec = Recorder(print_freq=0)
+    rec.enable_profile(str(tmp_path / "t"), start_offset=2, n_steps=3)
+    assert rec._prof["state"] == "armed"
+    # offset is RELATIVE to the first tick (resume support): base=10
+    rec.profile_tick(10)
+    rec.profile_tick(11)
+    assert rec._prof["state"] == "armed" and not fake_profiler.calls
+    rec.profile_tick(12)  # base + offset reached -> start
+    assert rec._prof["state"] == "tracing"
+    assert fake_profiler.calls == [("start", str(tmp_path / "t"))]
+    rec.profile_tick(13)
+    rec.profile_tick(14)
+    assert rec._prof["state"] == "tracing"
+    rec.profile_tick(15)  # started_at + n reached -> stop
+    assert rec._prof["state"] == "done"
+    assert fake_profiler.calls[-1] == ("stop", None)
+    # done is terminal: further ticks never restart
+    rec.profile_tick(16)
+    assert len(fake_profiler.calls) == 2
+    rec.close()
+    assert len(fake_profiler.calls) == 2
+
+
+def test_profile_stops_at_epoch_end_mid_capture(tmp_path, fake_profiler):
+    """The capture window must never run through validation/checkpoint
+    I/O: end_epoch() force-stops a live trace."""
+    rec = Recorder(print_freq=0)
+    rec.enable_profile(str(tmp_path / "t"), start_offset=0, n_steps=100)
+    rec.profile_tick(0)
+    assert rec._prof["state"] == "tracing"
+    rec.start_epoch()
+    rec.end_epoch(0)
+    assert rec._prof["state"] == "done"
+    assert fake_profiler.calls == [("start", str(tmp_path / "t")), ("stop", None)]
+
+
+def test_profile_run_ends_mid_capture_stops_on_close(tmp_path, fake_profiler):
+    rec = Recorder(print_freq=0)
+    rec.enable_profile(str(tmp_path / "t"), start_offset=0, n_steps=100)
+    rec.profile_tick(0)
+    rec.close()  # run died mid-capture: the trace must still be closed
+    assert rec._prof["state"] == "done"
+    assert fake_profiler.calls[-1] == ("stop", None)
+
+
+def test_profile_run_ends_while_armed_warns(tmp_path, fake_profiler, capsys):
+    """A run shorter than the capture offset must WARN (no trace was
+    written) instead of silently producing nothing."""
+    rec = Recorder(print_freq=0)
+    rec.enable_profile(str(tmp_path / "t"), start_offset=5, n_steps=2)
+    rec.profile_tick(0)  # base set; window [5, 7) never reached
+    rec.profile_tick(1)
+    rec.close()
+    assert rec._prof["state"] == "done"
+    assert not fake_profiler.calls  # no trace started, none stopped
+    out = capsys.readouterr().out
+    assert "WARNING" in out and "armed" in out
+
+
+def test_profile_tick_without_enable_is_noop():
+    rec = Recorder(print_freq=0)
+    rec.profile_tick(0)  # must not raise (no _prof attr at all)
+    rec.close()
+
+
+# -- end() without start() satellite ---------------------------------------
+
+
+def test_end_without_start_warns_and_returns_zero():
+    rec = Recorder(print_freq=0)
+    with pytest.warns(RuntimeWarning, match="end\\('comm'\\) without"):
+        dt = rec.end("comm")
+    assert dt == 0.0
+    assert rec.timings.get("comm", []) == []  # no phantom sample
+
+
+def test_end_without_start_after_valid_bracket():
+    rec = Recorder(print_freq=0)
+    rec.start("step")
+    assert rec.end("step") >= 0.0
+    with pytest.warns(RuntimeWarning):
+        assert rec.end("step") == 0.0  # double end: second one is guarded

@@ -1,0 +1,44 @@
+"""Version bridge for the jax APIs this package pins.
+
+The framework is written against the modern surface (``jax.shard_map``
+with ``check_vma=``); older installs (< 0.6) ship the same machinery as
+``jax.experimental.shard_map.shard_map`` with the ``check_rep=`` knob.
+Everything here maps one spelling onto the other and nothing else —
+semantics are the classic ones either way, because every shard_map in
+this tree pins the check OFF (parallel/strategies.py "check_vma pin &
+migration plan"; the checked-mode paths are canary-gated and simply
+stay unavailable on old jax).
+
+Imported for its side effect from ``theanompi_tpu/__init__.py``.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _install() -> None:
+    if not hasattr(jax.lax, "pcast"):
+        # pcast exists only in the vma type system (newer jax); it is
+        # the identity on VALUES — on old jax there is no varying-axes
+        # typing to convert, so the identity IS the bridge
+        jax.lax.pcast = lambda x, axis_name, to: x
+    if hasattr(jax, "shard_map"):
+        return  # modern jax: nothing else to bridge
+    try:
+        from jax.experimental.shard_map import shard_map as _legacy
+    except ImportError:  # pragma: no cover - no known jax lacks both
+        return
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+        if check_vma is not None:
+            # old jax predates the vma type system; check_rep is the
+            # closest knob (False = the classic semantics this tree pins)
+            kw.setdefault("check_rep", bool(check_vma))
+        return _legacy(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, **kw)
+
+    jax.shard_map = shard_map
+
+
+_install()

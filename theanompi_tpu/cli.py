@@ -111,6 +111,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "comm/compute split the reference read from host "
                         "brackets)")
     p.add_argument("--profile-steps", type=int, default=4)
+    p.add_argument("--obs-dir", default=None,
+                   help="observability output dir (obs/ subsystem): metric "
+                        "snapshots (JSONL + Prometheus text), per-rank span "
+                        "trace, heartbeat files, stall-watchdog reports — "
+                        "schemas in theanompi_tpu/tools/check_obs_schema.py")
+    p.add_argument("--stall-timeout", type=float, default=0.0,
+                   help="seconds without global-step progress before the "
+                        "stall watchdog dumps all thread stacks and arms a "
+                        "post-mortem device trace (0 = disabled; set it "
+                        "above the worst expected compile/eval pause)")
+    p.add_argument("--metrics-snapshot-freq", type=int, default=0,
+                   help="write a metrics snapshot every N steps (0 = epoch "
+                        "boundaries only); requires --obs-dir")
     p.add_argument("--avg-freq", type=int, default=None,
                    help="EASGD/GoSGD: steps between exchanges (reference avg_freq)")
     p.add_argument("--group-size", type=int, default=None,
@@ -240,6 +253,9 @@ def main(argv=None) -> int:
     if args.tensorboard and not args.save_dir:
         print("WARNING: --tensorboard needs --save-dir; no TB output will "
               "be written", flush=True)
+    if (args.stall_timeout or args.metrics_snapshot_freq) and not args.obs_dir:
+        print("WARNING: --stall-timeout/--metrics-snapshot-freq need "
+              "--obs-dir; observability is off", flush=True)
     summary = run_training(
         rule=args.rule.lower(),
         model_cls=model_cls,
@@ -269,6 +285,9 @@ def main(argv=None) -> int:
         tensorboard=args.tensorboard,
         profile_dir=args.profile_dir,
         profile_steps=args.profile_steps,
+        obs_dir=args.obs_dir,
+        stall_timeout=args.stall_timeout,
+        metrics_snapshot_freq=args.metrics_snapshot_freq,
         **rule_kwargs,
     )
     print(json.dumps({k: v for k, v in summary.items() if k != "state"}, default=str))

@@ -57,10 +57,17 @@ class PrefetchLoader:
             from theanompi_tpu.utils.hostaffinity import pin_thread
 
             pin_thread()
+            from theanompi_tpu.obs.spans import obs_span
+
             for batch in it:
                 if self._stop.is_set():
                     return
-                placed = self._place(batch)
+                # h2d span (obs/spans.py): the host->device place runs on
+                # THIS producer thread, overlapped with device compute —
+                # recorded for the trace, excluded from the summary's
+                # wall-time fractions (owner-thread accounting)
+                with obs_span("h2d"):
+                    placed = self._place(batch)
                 while not self._stop.is_set():
                     try:
                         self._q.put(placed, timeout=0.1)

@@ -159,6 +159,11 @@ def run_training(
     return_recorder: bool = False,
     profile_dir: Optional[str] = None,
     profile_steps: int = 4,
+    # observability subsystem (obs/): metrics snapshots + span trace +
+    # heartbeat under obs_dir; stall watchdog when stall_timeout > 0
+    obs_dir: Optional[str] = None,
+    stall_timeout: float = 0.0,
+    metrics_snapshot_freq: int = 0,
     # rule-specific kwargs (EASGD avg_freq etc.) forwarded to the rule's
     # step builder
     **rule_kwargs: Any,
@@ -646,6 +651,33 @@ def run_training(
     # fast-forward past the batches the restored step count already
     # consumed, so data order and epoch accounting stay exact.
     skip_batches = step_count % steps_per_epoch
+    from theanompi_tpu.obs import Observability
+
+    # obs facade: span log + heartbeat per rank, metrics snapshots on
+    # rank 0, stall watchdog when requested; inert when obs_dir is None.
+    # Created HERE, immediately before the try whose finally closes it:
+    # any earlier raise (resume mismatch, layout guard, init OOM) must
+    # not leak its threads / open files / the process-global span hook.
+    obs = Observability(
+        obs_dir,
+        rank=jax.process_index(),
+        stall_timeout=stall_timeout,
+        snapshot_freq=metrics_snapshot_freq,
+    )
+    if obs.enabled:
+        # bracket delegation: timing histograms into the obs registry,
+        # wait/step/comm brackets doubling as trace spans
+        rec.registry = obs.registry
+        rec.spans = obs.spans
+        if hasattr(engine, "traffic_model"):
+            # each sync rule declares its analytic wire model
+            # (obs/comm.py); never let an accounting bug take down
+            # training
+            try:
+                obs.set_traffic_model(engine.traffic_model(state))
+            except Exception as e:  # noqa: BLE001
+                print(f"[obs] traffic model unavailable for {rule!r}: "
+                      f"{e!r}", flush=True)
     # the device trace and the JSONL log must be closed even when a
     # step raises (OOM, loader failure, Ctrl-C) — close() stops a
     # live capture and warns if the window never opened
@@ -693,10 +725,11 @@ def run_training(
                     state, metrics = engine.fused_train_step(
                         state, xs, ys, jnp.stack(subs)
                     )
-                    rec.end("step", sync=metrics["loss"])
+                    step_dt = rec.end("step", sync=metrics["loss"])
                     step_count += g
                     epoch_steps += g
                     dispatch_images.append(batch * g)
+                    obs.on_step(step_count, substeps=g, step_seconds=step_dt)
                     # one JSONL row PER SUBSTEP from the stacked metrics,
                     # so fused runs yield the same-resolution loss/LR
                     # curves as per-step runs of the same config
@@ -731,7 +764,7 @@ def run_training(
                     rng, sub = jax.random.split(rng)
                     rec.start("step")
                     state, metrics = engine.train_step(state, xg, yg, sub)
-                    rec.end("step", sync=metrics["loss"])
+                    step_dt = rec.end("step", sync=metrics["loss"])
                     step_count += 1
                     epoch_steps += 1
                     dispatch_images.append(batch)
@@ -744,7 +777,14 @@ def run_training(
                         # the bracket measures only async dispatch and the
                         # collective's real cost bleeds into the next
                         # wait/step brackets
-                        rec.end("comm", sync=jax.tree_util.tree_leaves(state)[0])
+                        step_dt += rec.end(
+                            "comm", sync=jax.tree_util.tree_leaves(state)[0]
+                        )
+                    # after the exchange so the comm gauge's denominator
+                    # includes the exchange's wall time on the steps that
+                    # pay it (amortized bytes / local-only time would
+                    # report gbps above the physical link)
+                    obs.on_step(step_count, step_seconds=step_dt)
                     rec.train_metrics(step_count, metrics, n_images=batch)
                     rec.start("wait")
                     if max_steps and step_count >= max_steps:
@@ -756,27 +796,34 @@ def run_training(
             # validation (reference: per-epoch val loop on the worker/server)
             val_accum: dict[str, float] = {}
             n_val = 0
+            rec.start("eval")
             for vx, vy in data.val_epoch(vbatch, part=vpart):
                 vm = engine.eval_step(state, *place((vx, vy)))
                 for k, v in vm.items():
                     val_accum[k] = val_accum.get(k, 0.0) + float(v)
                 n_val += 1
+            rec.end("eval")
             if n_val:
                 val_metrics = {k: v / n_val for k, v in val_accum.items()}
                 rec.val_metrics(epoch, val_metrics)
                 summary["val"] = val_metrics
 
             if ckpt_dir and (epoch + 1) % ckpt_every_epochs == 0:
+                rec.start("checkpoint")
                 if ckpt_writer is not None:
                     # overlapped with the next epoch's steps; ordering +
                     # durability enforced by the writer (drained in the
-                    # finally below before the summary returns)
+                    # finally below before the summary returns) — this
+                    # bracket times only the enqueue; the real write is
+                    # spanned inside utils/checkpoint.py on its thread
                     ckpt_writer.save(ckpt_dir, state, step_count, rng=rng,
                                      extra_meta=layout_meta)
                 else:
                     sync_save(ckpt_dir, state, step_count, rng=rng,
                               extra_meta=layout_meta)
+                rec.end("checkpoint")
             rec.save()
+            obs.snapshot(step=step_count)  # epoch-boundary metrics snapshot
             summary["epochs"].append(epoch)
             if max_steps and step_count >= max_steps:
                 break
@@ -801,7 +848,12 @@ def run_training(
                 else:
                     ckpt_writer.close()
         finally:
-            rec.close()  # trace + JSONL must close even then
+            try:
+                rec.close()  # trace + JSONL must close even then
+            finally:
+                # final snapshot + span summary + health-thread shutdown;
+                # after rec.close() so the recorder's last emissions land
+                obs.close()
     summary["steps"] = step_count
     # device-truth step counter (host-fetched AFTER training): the host
     # loop counts dispatches, the device counts executions — a tunneled

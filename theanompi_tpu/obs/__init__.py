@@ -1,0 +1,202 @@
+"""Observability subsystem: metrics registry, span tracing, analytic
+comm accounting, and run health (heartbeat + stall watchdog).
+
+The reference's observability was ``lib/recorder.py``'s host wall-clock
+brackets; on TPU the collective is fused inside one XLA program, so
+this package supplies what host brackets cannot (SURVEY.md §5.1,
+ISSUE 1):
+
+- :mod:`~theanompi_tpu.obs.metrics` — labeled counters/gauges/
+  histograms, Prometheus text exposition + JSONL snapshots;
+- :mod:`~theanompi_tpu.obs.spans` — nestable trace spans with a
+  per-rank JSONL log and a run-end time-fraction summary;
+- :mod:`~theanompi_tpu.obs.comm` — closed-form bytes-on-the-wire per
+  step for every sync rule (the comm-side peer of utils/flops.py MFU);
+- :mod:`~theanompi_tpu.obs.health` — heartbeat files + a stall
+  watchdog that dumps thread stacks and arms a post-mortem device
+  trace when the global step stops advancing.
+
+:class:`Observability` is the driver-facing facade
+(``launch/worker.py``): one object that owns the per-run registry, the
+span recorder, the health threads, and the snapshot cadence — and that
+collapses to near-zero-cost no-ops when ``obs_dir`` is None, so the
+training loop carries no conditionals.
+
+On-disk layout under ``obs_dir`` (schemas:
+``theanompi_tpu/tools/check_obs_schema.py``)::
+
+    metrics.jsonl           rank-0 metric snapshots (kind=metrics)
+    metrics.prom            rank-0 Prometheus text exposition (atomic)
+    spans_rank{r}.jsonl     per-rank span + span_summary lines
+    heartbeat_rank{r}.json  per-rank liveness (atomic rewrite)
+    stall_rank{r}.json/.txt stall watchdog reports (thread stacks)
+    postmortem_rank{r}/     jax.profiler trace armed at stall time
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Optional
+
+from theanompi_tpu.obs import spans as _spans_mod
+from theanompi_tpu.obs.comm import (  # noqa: F401
+    TrafficModel,
+    bsp_traffic,
+    easgd_traffic,
+    gosgd_traffic,
+    nd_traffic,
+    pytree_num_elements,
+    zero1_traffic,
+)
+from theanompi_tpu.obs.health import Heartbeat, StallWatchdog  # noqa: F401
+from theanompi_tpu.obs.metrics import (  # noqa: F401
+    REGISTRY,
+    MetricsRegistry,
+    result_to_snapshot,
+)
+from theanompi_tpu.obs.spans import SpanRecorder, obs_span  # noqa: F401
+
+
+class Observability:
+    """Per-run facade over the obs modules (see module docstring).
+
+    ``snapshot_freq``: write a metrics snapshot (JSONL + prom rewrite)
+    every N completed steps; 0 = only at epoch boundaries/close (the
+    driver calls :meth:`snapshot` at epoch end regardless).
+    ``stall_timeout``: seconds without step progress before the
+    watchdog fires; 0 disables it. Set it ABOVE the worst expected
+    compile/eval pause — the watchdog only learns of progress through
+    :meth:`on_step`, so a first-epoch XLA compile longer than the
+    timeout reads as a stall.
+    """
+
+    def __init__(
+        self,
+        obs_dir: Optional[str],
+        *,
+        rank: int = 0,
+        stall_timeout: float = 0.0,
+        snapshot_freq: int = 0,
+        heartbeat_interval: float = 5.0,
+        arm_profiler: bool = True,
+    ):
+        self.obs_dir = obs_dir
+        self.rank = rank
+        self.enabled = obs_dir is not None
+        self.snapshot_freq = max(0, int(snapshot_freq))
+        self.registry = MetricsRegistry()
+        self.spans: Optional[SpanRecorder] = None
+        self.heartbeat: Optional[Heartbeat] = None
+        self.watchdog: Optional[StallWatchdog] = None
+        self.traffic: Optional[TrafficModel] = None
+        self._metrics_f = None
+        self._prom_path = None
+        self._last_snapshot_step = 0
+        self._closed = False
+        if not self.enabled:
+            return
+        os.makedirs(obs_dir, exist_ok=True)
+        self.spans = SpanRecorder(
+            os.path.join(obs_dir, f"spans_rank{rank}.jsonl"), rank=rank
+        )
+        # install as the process-current recorder so deep layers
+        # (utils/checkpoint.py, data/loader.py) can open spans without
+        # plumbing a handle through every signature
+        _spans_mod.set_current(self.spans)
+        if rank == 0:
+            # one metrics sink per run (reference: rank-0 recorder save)
+            self._metrics_f = open(os.path.join(obs_dir, "metrics.jsonl"), "a")
+            self._prom_path = os.path.join(obs_dir, "metrics.prom")
+        self.heartbeat = Heartbeat(obs_dir, rank=rank,
+                                   interval=heartbeat_interval)
+        if stall_timeout and stall_timeout > 0:
+            self.watchdog = StallWatchdog(
+                stall_timeout, obs_dir, rank=rank, arm_profiler=arm_profiler
+            )
+
+    # -- driver hooks --------------------------------------------------------
+    def set_traffic_model(self, tm: Optional[TrafficModel]) -> None:
+        """Record the active sync rule's analytic wire model (engine-
+        declared; see each engine's ``traffic_model``) as gauges, so
+        every snapshot carries the per-step comm bytes next to the
+        measured throughput."""
+        self.traffic = tm
+        if tm is None or not self.enabled:
+            return
+        for key, value in tm.as_metrics().items():
+            self.registry.gauge(
+                f"tmpi_{key}",
+                help=f"analytic {tm.rule} wire model (obs/comm.py)",
+            ).set(value)
+        self.registry.gauge(
+            "tmpi_comm_n_workers", help="sync-rule worker count"
+        ).set(tm.n_workers)
+
+    def on_step(self, step: int, substeps: int = 1,
+                step_seconds: Optional[float] = None) -> None:
+        """Per completed dispatch: advance health + comm accounting.
+        ``substeps`` > 1 for fused dispatches (one call per group)."""
+        if self.heartbeat is not None:
+            self.heartbeat.set_step(step)
+        if self.watchdog is not None:
+            self.watchdog.notify_step(step)
+        if not self.enabled:
+            return
+        self.registry.counter(
+            "tmpi_steps_total", help="completed training steps"
+        ).inc(substeps)
+        if self.traffic is not None:
+            per_step = self.traffic.bytes_per_step_amortized
+            self.registry.counter(
+                "tmpi_comm_bytes_total",
+                help="cumulative analytic per-device wire bytes",
+            ).inc(per_step * substeps)
+            if step_seconds:
+                gbps = self.traffic.achieved_gbps(step_seconds / substeps)
+                if gbps is not None:
+                    self.registry.gauge(
+                        "tmpi_comm_gbps",
+                        help="achieved per-device interconnect GB/s "
+                             "(analytic bytes / measured step time)",
+                    ).set(gbps)
+        if (
+            self.snapshot_freq
+            and step - self._last_snapshot_step >= self.snapshot_freq
+        ):
+            self.snapshot(step=step)
+
+    def snapshot(self, step: Optional[int] = None) -> Optional[dict]:
+        """Write one metrics snapshot line + refresh the Prometheus
+        exposition (rank 0 only; other ranks no-op)."""
+        if not self.enabled or self._metrics_f is None or self._closed:
+            return None
+        if step is not None:
+            self._last_snapshot_step = step
+        rec = self.registry.emit_snapshot(self._metrics_f, step=step)
+        try:
+            self.registry.write_prometheus(self._prom_path)
+        except OSError as e:
+            print(f"[rank {self.rank}] metrics.prom write failed: {e!r}",
+                  file=sys.stderr, flush=True)
+        return rec
+
+    def close(self) -> None:
+        """Final snapshot, span summary, health-thread shutdown.
+        Idempotent; must run even when training raises (the driver's
+        ``finally``)."""
+        if self._closed:
+            return
+        self.snapshot(step=None)
+        self._closed = True
+        if self.spans is not None:
+            if _spans_mod.current() is self.spans:
+                _spans_mod.set_current(None)
+            self.spans.close()
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        if self.heartbeat is not None:
+            self.heartbeat.stop()
+        if self._metrics_f is not None:
+            self._metrics_f.close()
+            self._metrics_f = None

@@ -1,0 +1,226 @@
+"""Analytic collective-traffic accounting per sync rule.
+
+On GPU+MPI the reference could WATCH communication (host wall-clock
+around ``exchanger.exchange()`` — ``lib/recorder.py``'s 'comm'
+bracket); on TPU the collective is fused inside one XLA program, so the
+wire volume must be computed, not bracketed. This module holds the
+closed-form per-step bytes-on-the-wire for every sync rule, given the
+grad/param pytree size and the rule's cadence — the comm-side peer of
+``utils/flops.py``'s MFU (EQuARX, PAPERS.md, shows allreduce cost is a
+first-order scaling term worth measuring per strategy).
+
+Accounting convention: **bytes sent per device per training step** —
+the quantity that divides by step time to give the achieved per-link
+interconnect GB/s a chip must sustain (multiply by ``n_workers`` for
+pod-total traffic). Formulas (N = elements on the wire, b = bytes per
+element after wire compression):
+
+- BSP ring/psum allreduce:   ``2 (n-1)/n · N·b``  (reduce-scatter +
+  all-gather halves; XLA's psum lowers to the same ring on ICI)
+- ZeRO-1:                    identical — psum_scatter ``(n-1)/n`` +
+  all_gather ``(n-1)/n`` over the padded flat buffer (the update
+  between the halves is free on the wire)
+- EASGD center<->worker:     one psum of the elastic differences every
+  ``avg_freq`` steps: ``2 (n-1)/n · N·b`` per exchange, amortized
+- GoSGD gossip:              ONE ppermute of the packed
+  ``(share·w, share)`` buffer per gossip round: ``(N+1)·b``, amortized
+  by ``gossip_every``
+
+Known under-counts, flagged in ``detail`` rather than silently wrong:
+ring variants pad N up to a segment multiple (accounted), int8 wire
+carries a per-segment scale (~1% — ignored), and the ND engine's
+activation collectives (tp psum, sp ring/all-to-all, pp ppermute, MoE
+all-to-all) are NOT modeled — its figure covers the dp-axis grad sync
+only and is marked ``approx``.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+# wire bytes per element after each strategy's compression
+# (parallel/strategies.py: packed ring variants cast/quantize the wire;
+# psum runs in the operand dtype — grads are fp32 here)
+STRATEGY_WIRE_BYTES = {
+    "psum": 4, "ring": 4,
+    "psum_bf16": 2, "ring_bf16": 2,
+    "ring_int8": 1,
+    # reference aliases (strategies._ALIASES)
+    "ar": 4, "cudaaware": 4, "copper": 4, "nccl32": 4,
+    "nccl16": 2, "asa32": 4, "asa16": 2,
+}
+
+
+@dataclass
+class TrafficModel:
+    """Per-device wire volume for one sync rule instance."""
+
+    rule: str
+    n_workers: int
+    bytes_per_step: float  # every-step collectives (in-step grad sync)
+    bytes_per_exchange: float = 0.0  # periodic exchange collectives
+    exchange_every: int = 0  # steps between exchanges (0 = none)
+    detail: dict = field(default_factory=dict)
+
+    @property
+    def bytes_per_step_amortized(self) -> float:
+        """Every-step bytes plus the periodic exchange amortized over
+        its cadence — the honest sustained per-step wire load."""
+        amort = (
+            self.bytes_per_exchange / self.exchange_every
+            if self.exchange_every else 0.0
+        )
+        return self.bytes_per_step + amort
+
+    def achieved_gbps(self, step_seconds: float) -> Optional[float]:
+        """Sustained per-device interconnect GB/s implied by a measured
+        step time (None when unmeasurable)."""
+        if not step_seconds or step_seconds <= 0:
+            return None
+        return self.bytes_per_step_amortized / step_seconds / 1e9
+
+    def as_metrics(self) -> dict:
+        return {
+            "comm_bytes_per_step": self.bytes_per_step,
+            "comm_bytes_per_exchange": self.bytes_per_exchange,
+            "comm_exchange_every": float(self.exchange_every),
+            "comm_bytes_per_step_amortized": self.bytes_per_step_amortized,
+        }
+
+
+def pytree_num_elements(tree: Any) -> int:
+    import jax
+
+    return sum(
+        int(math.prod(getattr(l, "shape", ()) or ()))
+        for l in jax.tree_util.tree_leaves(tree)
+    )
+
+
+def wire_bytes_per_element(strategy: str) -> int:
+    try:
+        return STRATEGY_WIRE_BYTES[strategy]
+    except KeyError:
+        raise ValueError(
+            f"unknown strategy {strategy!r} for traffic accounting; "
+            f"known: {sorted(STRATEGY_WIRE_BYTES)}"
+        ) from None
+
+
+def allreduce_bytes(n_elements: int, n: int, wire_bytes: int = 4) -> float:
+    """Ring allreduce per-device bytes: ``2 (n-1)/n * N * b``."""
+    if n <= 1:
+        return 0.0
+    return 2.0 * (n - 1) / n * n_elements * wire_bytes
+
+
+def reduce_scatter_bytes(n_elements: int, n: int, wire_bytes: int = 4) -> float:
+    if n <= 1:
+        return 0.0
+    return (n - 1) / n * n_elements * wire_bytes
+
+
+all_gather_bytes = reduce_scatter_bytes  # same wire volume, other half
+
+
+def bsp_traffic(n_elements: int, n: int, strategy: str = "psum") -> TrafficModel:
+    """BSP in-step gradient allreduce. Ring variants pad the flat buffer
+    to ``n`` equal segments (128-multiples for int8) — accounted, since
+    the padding rides the wire."""
+    b = wire_bytes_per_element(strategy)
+    canonical = {"ar": "psum", "cudaaware": "psum", "copper": "psum",
+                 "nccl32": "psum", "nccl16": "psum_bf16", "asa32": "ring",
+                 "asa16": "ring_bf16"}.get(strategy, strategy)
+    elems = n_elements
+    if n > 1 and canonical.startswith("ring"):
+        seg = -(-n_elements // n)
+        if canonical == "ring_int8":
+            seg = -(-seg // 128) * 128
+        elems = n * seg
+    return TrafficModel(
+        rule="bsp", n_workers=n,
+        bytes_per_step=allreduce_bytes(elems, n, b),
+        detail={"strategy": strategy, "elements": elems,
+                "wire_bytes_per_element": b},
+    )
+
+
+def zero1_traffic(n_elements: int, n: int) -> TrafficModel:
+    """ZeRO-1: psum_scatter + all_gather over the flat fp32 buffer
+    padded to ``n`` equal segments (parallel/zero.py pads to
+    ``n * ceil(P/n)``) — same total wire as the plain allreduce."""
+    seg = -(-n_elements // n) if n > 1 else n_elements
+    padded = n * seg if n > 1 else n_elements
+    return TrafficModel(
+        rule="zero1", n_workers=n,
+        bytes_per_step=(
+            reduce_scatter_bytes(padded, n) + all_gather_bytes(padded, n)
+        ),
+        detail={"elements": padded, "wire_bytes_per_element": 4,
+                "padded_from": n_elements},
+    )
+
+
+def easgd_traffic(
+    n_elements: int, n_workers: int, avg_freq: int, group_size: int = 1
+) -> TrafficModel:
+    """EASGD: zero comm on local steps (the selling point) unless the
+    worker is a chip GROUP (in-step grad psum over the group's data
+    axis); every ``avg_freq`` steps one psum of the param-sized elastic
+    differences over the worker axis."""
+    per_step = (
+        allreduce_bytes(n_elements, group_size) if group_size > 1 else 0.0
+    )
+    return TrafficModel(
+        rule="easgd", n_workers=n_workers,
+        bytes_per_step=per_step,
+        bytes_per_exchange=allreduce_bytes(n_elements, n_workers),
+        exchange_every=max(1, int(avg_freq)),
+        detail={"elements": n_elements, "wire_bytes_per_element": 4,
+                "group_size": group_size},
+    )
+
+
+def gosgd_traffic(
+    n_elements: int, n_workers: int, gossip_every: int = 1,
+    group_size: int = 1,
+) -> TrafficModel:
+    """GoSGD: every gossip round is ONE ppermute of the packed
+    ``(share*w, share)`` buffer — ``(N+1)*4`` bytes per device per
+    round regardless of n (parallel/gosgd.py), zero between rounds
+    (plus the group grad psum when workers are chip groups). The
+    Bernoulli push DECISION gates merging, not the wire: the ppermute
+    ships every round it runs."""
+    per_step = (
+        allreduce_bytes(n_elements, group_size) if group_size > 1 else 0.0
+    )
+    round_bytes = float((n_elements + 1) * 4) if n_workers > 1 else 0.0
+    return TrafficModel(
+        rule="gosgd", n_workers=n_workers,
+        bytes_per_step=per_step,
+        bytes_per_exchange=round_bytes,
+        exchange_every=max(1, int(gossip_every)),
+        detail={"elements": n_elements, "wire_bytes_per_element": 4,
+                "group_size": group_size},
+    )
+
+
+def nd_traffic(
+    n_elements: int, dp: int, shard_ways: int = 1
+) -> TrafficModel:
+    """ND engine, dp-axis grad sync only: each device allreduces its
+    LOCAL (1/shard_ways) slice of the params over the dp axis.
+    Activation collectives (tp psum, sp ring, pp ppermute, MoE
+    all-to-all) are NOT modeled — marked ``approx`` so downstream
+    readers can't mistake this for a full wire audit."""
+    local = n_elements / max(1, shard_ways)
+    return TrafficModel(
+        rule="nd", n_workers=dp,
+        bytes_per_step=allreduce_bytes(local, dp),
+        detail={"elements": local, "wire_bytes_per_element": 4,
+                "approx": True, "shard_ways": shard_ways,
+                "note": "dp grad sync only; activation collectives "
+                        "(tp/sp/pp/moe) not modeled"},
+    )

@@ -1,0 +1,169 @@
+"""Nestable trace spans with a per-rank JSONL span log.
+
+The reference's whole trace story was the recorder's flat calc/comm
+wall-clock brackets (reference: ``lib/recorder.py``; SURVEY.md §5.1).
+Spans generalize that to a NESTABLE, named tree — ``checkpoint`` inside
+``step``-adjacent driver code, ``h2d`` inside the prefetch producer
+thread — written one JSON object per line as each span closes, plus a
+run-end ``span_summary`` line with per-kind time fractions.
+
+Span kinds used by the training stack (callers may add their own):
+``data_wait``, ``h2d``, ``step``, ``grad_sync``, ``eval``,
+``checkpoint`` — plus the nested ``checkpoint_gather`` /
+``checkpoint_write`` sub-spans utils/checkpoint.py opens inside a save
+(named apart so a synchronous save does not count the same wall time
+twice under one kind). Schema: tools/check_obs_schema.py.
+
+Fraction semantics: the summary's ``fractions`` divide per-kind
+EXCLUSIVE top-level time by the recorder's open→close wall clock, and
+count only spans opened on the OWNER thread (the driver). Owner-thread
+depth-0 spans are sequential by construction, so the fractions sum to
+<= 1.0 — the acceptance invariant a concurrent accounting (e.g. adding
+the producer thread's overlapping ``h2d`` spans) could not honor.
+Spans from other threads still appear as ``span`` lines and in
+``totals_s``/``counts``; they are simply excluded from ``fractions``.
+
+A module-level *current recorder* lets deep layers (utils/checkpoint.py,
+data/loader.py) open spans without threading a handle through every
+signature: ``with obs_span("checkpoint"): ...`` is a no-op unless the
+driver installed a recorder.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+SPAN_KINDS = ("data_wait", "h2d", "step", "grad_sync", "eval", "checkpoint")
+
+
+class SpanRecorder:
+    def __init__(self, path: str, rank: int = 0):
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self.path = path
+        self.rank = rank
+        self._f = open(path, "a")
+        self._wlock = threading.Lock()
+        self._stacks = threading.local()  # per-thread open-span stack
+        self._owner = threading.get_ident()
+        self._t_open = time.perf_counter()
+        self._t_open_wall = time.time()
+        # totals over ALL spans / owner-thread depth-0 spans respectively
+        self._totals: dict[str, float] = {}
+        self._counts: dict[str, int] = {}
+        self._owner_top: dict[str, float] = {}
+        self._closed = False
+
+    def _stack(self) -> list:
+        if not hasattr(self._stacks, "s"):
+            self._stacks.s = []
+        return self._stacks.s
+
+    # -- explicit begin/finish (the Recorder bracket bridge) ----------------
+    def begin(self, name: str) -> dict:
+        stack = self._stack()
+        token = {
+            "name": str(name),
+            "t0": time.perf_counter(),
+            "t0_wall": time.time(),
+            "depth": len(stack),
+            "thread": threading.get_ident(),
+        }
+        stack.append(token)
+        return token
+
+    def finish(self, token: dict) -> float:
+        stack = self._stack()
+        if any(t is token for t in stack):
+            # tolerate out-of-order finishes (a bracket leaked across an
+            # exception): drop everything opened above the token too
+            while stack[-1] is not token:
+                stack.pop()
+            stack.pop()
+        # a token not on the stack (double finish / cross-thread) still
+        # records its span but must not disturb other threads' nesting
+        dur = time.perf_counter() - token["t0"]
+        name = token["name"]
+        rec = {
+            "kind": "span",
+            "name": name,
+            "rank": self.rank,
+            "t0": token["t0_wall"],
+            "dur": dur,
+            "depth": token["depth"],
+        }
+        with self._wlock:
+            if not self._closed:
+                self._f.write(json.dumps(rec) + "\n")
+            self._totals[name] = self._totals.get(name, 0.0) + dur
+            self._counts[name] = self._counts.get(name, 0) + 1
+            if token["depth"] == 0 and token["thread"] == self._owner:
+                self._owner_top[name] = self._owner_top.get(name, 0.0) + dur
+        return dur
+
+    @contextmanager
+    def span(self, name: str):
+        token = self.begin(name)
+        try:
+            yield token
+        finally:
+            self.finish(token)
+
+    # -- run-end summary ----------------------------------------------------
+    def summary(self) -> dict:
+        wall = max(time.perf_counter() - self._t_open, 1e-9)
+        with self._wlock:
+            fractions = {
+                k: min(v / wall, 1.0) for k, v in sorted(self._owner_top.items())
+            }
+            rec = {
+                "kind": "span_summary",
+                "rank": self.rank,
+                "t0": self._t_open_wall,
+                "wall_s": wall,
+                "fractions": fractions,
+                "totals_s": dict(sorted(self._totals.items())),
+                "counts": dict(sorted(self._counts.items())),
+            }
+        return rec
+
+    def close(self) -> Optional[dict]:
+        """Write the summary line and close the file. Idempotent."""
+        rec = None
+        if not self._closed:
+            rec = self.summary()
+            with self._wlock:
+                self._closed = True
+                self._f.write(json.dumps(rec) + "\n")
+                self._f.close()
+        return rec
+
+
+# -- module-level current recorder (deep-layer span hook) -------------------
+
+_current: Optional[SpanRecorder] = None
+
+
+def set_current(rec: Optional[SpanRecorder]) -> None:
+    global _current
+    _current = rec
+
+
+def current() -> Optional[SpanRecorder]:
+    return _current
+
+
+@contextmanager
+def obs_span(name: str):
+    """Open ``name`` on the installed current recorder; no-op (zero
+    overhead beyond one global read) when observability is off."""
+    rec = _current
+    if rec is None:
+        yield None
+        return
+    with rec.span(name) as token:
+        yield token

@@ -283,6 +283,22 @@ class BSPEngine:
 
         return int(first_local_value(state.step))
 
+    def traffic_model(self, state):
+        """Analytic per-step wire volume of this engine's gradient
+        allreduce (obs/comm.py): the in-step psum/ring over the data
+        axes, sized by the grad pytree (= params) and the strategy's
+        wire compression."""
+        from theanompi_tpu.obs.comm import bsp_traffic, pytree_num_elements
+
+        axes = _axes_tuple(self._build["axis_name"])
+        n = 1
+        for a in axes:
+            n *= self.mesh.shape[a]
+        return bsp_traffic(
+            pytree_num_elements(state.params), n,
+            strategy=self._build["strategy"],
+        )
+
 
 def make_bsp_eval_step(
     model: Model, mesh: Mesh, axis_name=DATA_AXIS, input_transform=None,

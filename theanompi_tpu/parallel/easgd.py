@@ -253,3 +253,16 @@ class EASGDEngine:
         from theanompi_tpu.parallel.mesh import first_local_value
 
         return int(first_local_value(state.workers.step))
+
+    def traffic_model(self, state):
+        """EASGD wire model (obs/comm.py): silent local steps (plus the
+        group-internal grad psum when workers are chip groups), one
+        param-sized psum of elastic differences every ``avg_freq``
+        steps over the worker axis."""
+        from theanompi_tpu.obs.comm import easgd_traffic, pytree_num_elements
+
+        # workers leaves are stacked (n_workers, ...): per-worker size
+        per_worker = pytree_num_elements(state.workers.params) // self.n
+        return easgd_traffic(
+            per_worker, self.n, self.avg_freq, group_size=self.group_size
+        )

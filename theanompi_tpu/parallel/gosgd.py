@@ -323,3 +323,16 @@ class GOSGDEngine:
         from theanompi_tpu.parallel.mesh import first_local_value
 
         return int(first_local_value(state.workers.step))
+
+    def traffic_model(self, state):
+        """GoSGD wire model (obs/comm.py): one ppermute of the packed
+        ``(share*w, share)`` buffer per gossip round (every
+        ``gossip_every`` steps), plus the group-internal grad psum when
+        workers are chip groups."""
+        from theanompi_tpu.obs.comm import gosgd_traffic, pytree_num_elements
+
+        per_worker = pytree_num_elements(state.workers.params) // self.n
+        return gosgd_traffic(
+            per_worker, self.n, gossip_every=self.gossip_every,
+            group_size=self.group_size,
+        )

@@ -107,6 +107,7 @@ class NDEngine:
         self.mesh = mesh
         self.microbatches = None
         self.schedule = None  # pipeline branch: schedule_report dict
+        self._dp_axis = dp_axis  # kept for the analytic traffic model
         opt = model.optimizer()
         schedule_lr = make_schedule_fn(model, steps_per_epoch)
         sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
@@ -417,3 +418,18 @@ class NDEngine:
         from theanompi_tpu.parallel.mesh import first_local_value
 
         return int(first_local_value(state.step))
+
+    def traffic_model(self, state):
+        """Approximate ND wire model (obs/comm.py): the dp-axis grad
+        allreduce over each device's local (1/shard_ways) param slice.
+        Activation collectives (tp psum, sp ring/all-to-all, pipeline
+        ppermute, MoE all-to-all) are NOT modeled — the returned model
+        is flagged ``approx`` in its detail."""
+        from theanompi_tpu.obs.comm import nd_traffic, pytree_num_elements
+
+        sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        dp = sizes.get(self._dp_axis, 1) if self._dp_axis else 1
+        shard_ways = max(1, self.mesh.devices.size // dp)
+        return nd_traffic(
+            pytree_num_elements(state.params), dp, shard_ways=shard_ways
+        )

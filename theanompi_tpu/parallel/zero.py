@@ -265,3 +265,13 @@ class ZeroEngine:
         from theanompi_tpu.parallel.mesh import first_local_value
 
         return int(first_local_value(state.step))
+
+    def traffic_model(self, state):
+        """ZeRO-1 wire model (obs/comm.py): psum_scatter + all_gather
+        over the flat fp32 buffer padded to n segments — same volume as
+        the plain allreduce, which is the module's headline claim."""
+        from theanompi_tpu.obs.comm import pytree_num_elements, zero1_traffic
+
+        return zero1_traffic(
+            pytree_num_elements(state.params), self.mesh.devices.size
+        )

@@ -1,0 +1,220 @@
+"""Validate telemetry JSONL against the documented schemas.
+
+Every machine-readable line this framework emits — Recorder history
+(``<run>.jsonl``), span traces (``obs/spans_rank*.jsonl``), metric
+snapshots (``obs/metrics.jsonl``, bench.py's snapshot line), heartbeat
+and stall reports — must match ONE of the record kinds below, keyed by
+the ``kind`` field. Downstream parsing (bench.py drivers, BENCH_*.json
+diffing, tools/plot_history.py) reads these streams; without an
+enforced schema they drift silently and the first symptom is a broken
+plot three PRs later. The schema table here is the single source of
+truth (README "Observability" documents it for humans) and a test
+validates every line the live system emits against it.
+
+Usage::
+
+    python -m theanompi_tpu.tools.check_obs_schema RUN_DIR [...]
+    python -m theanompi_tpu.tools.check_obs_schema path/to/run.jsonl
+
+Directories are walked for ``*.jsonl`` (including ``obs/``
+subdirectories). Exit code 1 on any invalid line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import sys
+from typing import Any, Optional
+
+_NUM = (int, float)
+
+# kind -> {field: (types, required)}; fields absent from a spec are
+# allowed if numeric/str (the Recorder forwards model-defined metrics:
+# loss/error/top5_error/lr/... — an open union by design)
+SCHEMAS: dict[str, dict[str, tuple[tuple, bool]]] = {
+    "train": {
+        "step": ((int,), True),
+    },
+    "val": {
+        "epoch": ((int,), True),
+    },
+    "epoch": {
+        "epoch": ((int,), True),
+        "seconds": (_NUM, True),
+    },
+    "span": {
+        "name": ((str,), True),
+        "rank": ((int,), True),
+        "t0": (_NUM, True),
+        "dur": (_NUM, True),
+        "depth": ((int,), True),
+    },
+    "span_summary": {
+        "rank": ((int,), True),
+        "t0": (_NUM, True),
+        "wall_s": (_NUM, True),
+        "fractions": ((dict,), True),
+        "totals_s": ((dict,), True),
+        "counts": ((dict,), True),
+    },
+    "metrics": {
+        "t": (_NUM, True),
+        "metrics": ((dict,), True),
+        "step": ((int,), False),
+        "source": ((str,), False),
+        "labels": ((dict,), False),
+    },
+    "heartbeat": {
+        "rank": ((int,), True),
+        "t": (_NUM, True),
+        "step": ((int,), True),
+        "pid": ((int,), True),
+    },
+    "stall": {
+        "rank": ((int,), True),
+        "t": (_NUM, True),
+        "step": ((int,), True),
+        "stall_s": (_NUM, True),
+        "timeout_s": (_NUM, True),
+        "stacks": ((dict,), True),
+        "postmortem_trace": ((str,), False),
+    },
+}
+
+
+def _check_numeric_map(d: dict, what: str) -> list[str]:
+    errs = []
+    for k, v in d.items():
+        if not isinstance(k, str):
+            errs.append(f"{what} key {k!r} is not a string")
+        if not isinstance(v, _NUM) or isinstance(v, bool):
+            errs.append(f"{what}[{k!r}] = {v!r} is not numeric")
+        elif not math.isfinite(float(v)):
+            errs.append(f"{what}[{k!r}] = {v!r} is not finite")
+    return errs
+
+
+def validate_record(obj: Any) -> list[str]:
+    """Error strings for one parsed JSONL record (empty = valid)."""
+    if not isinstance(obj, dict):
+        return [f"record is {type(obj).__name__}, not an object"]
+    kind = obj.get("kind")
+    if kind not in SCHEMAS:
+        return [f"unknown kind {kind!r} (known: {sorted(SCHEMAS)})"]
+    spec = SCHEMAS[kind]
+    errs = []
+    for field, (types, required) in spec.items():
+        if field not in obj:
+            if required:
+                errs.append(f"{kind}: missing required field {field!r}")
+            continue
+        v = obj[field]
+        # bool is an int subclass; an int-typed field must reject True
+        if isinstance(v, bool) and bool not in types:
+            errs.append(f"{kind}.{field} = {v!r} is bool, want "
+                        f"{'/'.join(t.__name__ for t in types)}")
+        elif not isinstance(v, types):
+            errs.append(f"{kind}.{field} = {v!r} is "
+                        f"{type(v).__name__}, want "
+                        f"{'/'.join(t.__name__ for t in types)}")
+    for field, v in obj.items():
+        if field == "kind" or field in spec:
+            continue
+        # open-union extras must stay scalar (nested structures belong
+        # in a typed field, or downstream flattening breaks)
+        if not isinstance(v, (str, int, float, bool)) and v is not None:
+            errs.append(f"{kind}: extra field {field!r} has non-scalar "
+                        f"type {type(v).__name__}")
+    if not errs:
+        if kind == "metrics":
+            errs += _check_numeric_map(obj["metrics"], "metrics")
+        elif kind == "span_summary":
+            errs += _check_numeric_map(obj["fractions"], "fractions")
+            errs += _check_numeric_map(obj["totals_s"], "totals_s")
+            # the acceptance invariant: owner-thread top-level fractions
+            # cover disjoint stretches of the run wall clock
+            total = sum(obj["fractions"].values())
+            if total > 1.0 + 1e-6:
+                errs.append(
+                    f"span_summary fractions sum to {total:.6f} > 1.0"
+                )
+        elif kind == "stall":
+            for name, frames in obj["stacks"].items():
+                if not isinstance(frames, list) or not all(
+                    isinstance(f, str) for f in frames
+                ):
+                    errs.append(f"stall.stacks[{name!r}] is not a list "
+                                "of frame strings")
+    return errs
+
+
+def check_file(path: str) -> list[str]:
+    """``'path:line: error'`` strings for every invalid line."""
+    errs = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError as e:
+                errs.append(f"{path}:{i}: unparseable JSON ({e})")
+                continue
+            for e in validate_record(obj):
+                errs.append(f"{path}:{i}: {e}")
+    return errs
+
+
+def discover(paths: list[str]) -> list[str]:
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            found = sorted(
+                glob.glob(os.path.join(p, "**", "*.jsonl"), recursive=True)
+            ) + sorted(
+                glob.glob(os.path.join(p, "**", "heartbeat_rank*.json"),
+                          recursive=True)
+            ) + sorted(
+                glob.glob(os.path.join(p, "**", "stall_rank*.json"),
+                          recursive=True)
+            )
+            if not found:
+                raise FileNotFoundError(f"no telemetry files under {p!r}")
+            files += found
+        else:
+            files.append(p)
+    return files
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+",
+                    help="telemetry .jsonl/.json files, or directories to "
+                         "walk (run save-dirs, obs dirs)")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="print only the summary line")
+    args = ap.parse_args(argv)
+    files = discover(args.paths)
+    all_errs = []
+    n_lines = 0
+    for f in files:
+        with open(f) as fh:
+            n_lines += sum(1 for line in fh if line.strip())
+        all_errs += check_file(f)
+    if not args.quiet:
+        for e in all_errs:
+            print(e)
+    print(
+        f"checked {n_lines} records in {len(files)} files: "
+        + ("OK" if not all_errs else f"{len(all_errs)} schema errors")
+    )
+    return 1 if all_errs else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,6 +16,13 @@ are one command:
 Accepts run directories (every ``*.jsonl`` inside) or ``.jsonl`` files.
 Headless-safe (Agg backend); ``--show`` opens a window where a display
 exists.
+
+When a run was recorded with ``--obs-dir`` pointing INSIDE its save
+dir (an ``obs/`` directory next to the run JSONL), a third panel row
+appears: achieved interconnect GB/s per step (obs/metrics.jsonl
+snapshots) and per-kind span time fractions (the ``span_summary`` line
+of obs/spans_rank*.jsonl). Runs without obs data plot exactly as
+before — the extra row only renders when at least one run has it.
 """
 
 from __future__ import annotations
@@ -53,6 +60,63 @@ def load_jsonl(path: str) -> dict:
                     if k in row:
                         val[k].append(row[k])
     return {"train": train, "val": val}
+
+
+def load_obs(jsonl_path: str) -> dict:
+    """Obs-subsystem series for the run at ``jsonl_path``: looks for an
+    ``obs/`` directory next to the run JSONL (the ``--obs-dir`` inside
+    the save dir convention). Returns ``{"comm_step": [...],
+    "comm_gbps": [...], "fractions": {kind: frac}}`` — empty lists/dict
+    when the run has no (or unreadable) obs data, so callers degrade
+    gracefully."""
+    out: dict = {"comm_step": [], "comm_gbps": [], "fractions": {}}
+    obs_dir = os.path.join(os.path.dirname(os.path.abspath(jsonl_path)), "obs")
+    metrics = os.path.join(obs_dir, "metrics.jsonl")
+    if os.path.exists(metrics):
+        try:
+            with open(metrics) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    row = json.loads(line)
+                    if row.get("kind") != "metrics" or "step" not in row:
+                        continue
+                    gbps = row.get("metrics", {}).get("tmpi_comm_gbps")
+                    if gbps is not None:
+                        if out["comm_step"] and row["step"] < out["comm_step"][-1]:
+                            # append-mode rerun into the same obs dir:
+                            # the step counter restarted — keep only the
+                            # newest run's series (mirrors the
+                            # last-summary-wins rule below)
+                            out["comm_step"], out["comm_gbps"] = [], []
+                        if out["comm_step"] and row["step"] == out["comm_step"][-1]:
+                            # epoch-end snapshot repeats the step of the
+                            # last per-step snapshot: newest value wins
+                            out["comm_gbps"][-1] = gbps
+                        else:
+                            out["comm_step"].append(row["step"])
+                            out["comm_gbps"].append(gbps)
+        except (OSError, ValueError):
+            pass  # partial/corrupt telemetry: plot what parses
+    # rank 0's trace is the driver view; one bar set per run
+    span_files = sorted(glob.glob(os.path.join(obs_dir, "spans_rank*.jsonl")))
+    if span_files:
+        try:
+            with open(span_files[0]) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    row = json.loads(line)
+                    if row.get("kind") == "span_summary":
+                        # last summary wins (append-mode reruns into the
+                        # same dir stack summaries; newest describes the
+                        # most recent run)
+                        out["fractions"] = row.get("fractions", {})
+        except (OSError, ValueError):
+            pass
+    return out
 
 
 def discover(paths: list[str]) -> dict[str, str]:
@@ -132,11 +196,34 @@ def plot(runs: dict[str, str], out: str, show: bool = False,
                 out_y.append(acc / k)
         return out_x, out_y
 
-    fig, axes = plt.subplots(2, 2, figsize=(11, 7))
-    (ax_loss, ax_val), (ax_ips, ax_lr) = axes
-    for label, path in runs.items():
+    obs = {label: load_obs(path) for label, path in runs.items()}
+    has_obs = any(
+        o["comm_gbps"] or o["fractions"] for o in obs.values()
+    )
+    if has_obs:
+        fig, axes = plt.subplots(3, 2, figsize=(11, 10.5))
+        (ax_loss, ax_val), (ax_ips, ax_lr), (ax_comm, ax_frac) = axes
+    else:
+        fig, axes = plt.subplots(2, 2, figsize=(11, 7))
+        (ax_loss, ax_val), (ax_ips, ax_lr) = axes
+        ax_comm = ax_frac = None
+    frac_kinds: list[str] = []
+    for o in obs.values():
+        frac_kinds += [k for k in o["fractions"] if k not in frac_kinds]
+    for run_i, (label, path) in enumerate(runs.items()):
         h = load_jsonl(path)
         t, v = h["train"], h["val"]
+        o = obs[label]
+        if ax_comm is not None and o["comm_gbps"]:
+            ax_comm.plot(*smoothed(o["comm_step"], o["comm_gbps"], smooth),
+                         label=label)
+        if ax_frac is not None and o["fractions"]:
+            # grouped bars: one cluster per span kind, one bar per run
+            width = 0.8 / max(1, len(runs))
+            xs = [frac_kinds.index(k) + run_i * width
+                  for k in o["fractions"]]
+            ax_frac.bar(xs, list(o["fractions"].values()), width=width,
+                        label=label)
         if t["step"] and t["loss"]:
             ax_loss.plot(*smoothed(t["step"], t["loss"], smooth), label=label)
         if v["epoch"]:
@@ -153,9 +240,19 @@ def plot(runs: dict[str, str], out: str, show: bool = False,
     ax_val.set(title="validation", xlabel="epoch")
     ax_ips.set(title="throughput (images/sec)", xlabel="step")
     ax_lr.set(title="learning rate", xlabel="step")
-    for ax in (ax_loss, ax_val, ax_ips, ax_lr):
+    all_axes = [ax_loss, ax_val, ax_ips, ax_lr]
+    if ax_comm is not None:
+        ax_comm.set(title="interconnect GB/s (analytic bytes / step time)",
+                    xlabel="step")
+        ax_frac.set(title="span time fractions (of run wall clock)")
+        if frac_kinds:
+            ax_frac.set_xticks(range(len(frac_kinds)))
+            ax_frac.set_xticklabels(frac_kinds, rotation=30, ha="right",
+                                    fontsize=8)
+        all_axes += [ax_comm, ax_frac]
+    for ax in all_axes:
         ax.grid(True, alpha=0.3)
-        if ax.lines:
+        if ax.lines or ax.patches:
             ax.legend(fontsize=8)
     fig.tight_layout()
     fig.savefig(out, dpi=120)

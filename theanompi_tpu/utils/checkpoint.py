@@ -72,7 +72,15 @@ def save_checkpoint(
     pipeline stack layout here so a checkpoint copied into a fresh dir
     (without its ``pipeline_layout.json`` sidecar) still refuses to load
     layer-permuted."""
-    flat = _flatten_with_paths(state)
+    from theanompi_tpu.obs.spans import obs_span
+
+    # checkpoint_gather span (obs/spans.py): the device->host gather,
+    # the expensive half of a save — runs on whichever thread calls
+    # (the AsyncCheckpointer's writer thread under async saves). Named
+    # apart from the driver's 'checkpoint' bracket so a SYNC save does
+    # not double-count the same wall time under one kind.
+    with obs_span("checkpoint_gather"):
+        flat = _flatten_with_paths(state)
     if extra_meta:
         import json as _json
 
@@ -226,11 +234,16 @@ def save_checkpoint_sharded(
             meta["rng_impl"] = impl
             flat["__rng__"] = raw
     flat["__meta__"] = np.asarray(_json.dumps(meta))
+    from theanompi_tpu.obs.spans import obs_span
+
     os.makedirs(directory, exist_ok=True)
     path = os.path.join(directory, f"ckpt_{step}.proc{me}of{n_proc}.npz")
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
     try:
-        with os.fdopen(fd, "wb") as f:
+        # checkpoint_write span (obs/spans.py): the serialize+rename of
+        # this host's shard files (distinct from the driver's
+        # 'checkpoint' bracket — see save_checkpoint's gather span note)
+        with obs_span("checkpoint_write"), os.fdopen(fd, "wb") as f:
             np.savez(f, **flat)
         os.replace(tmp, path)
     except BaseException:

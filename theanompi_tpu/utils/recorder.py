@@ -10,7 +10,13 @@ is kept because it was good; additions over the reference:
 - images/sec and cumulative epoch timing (the BASELINE.json metric);
 - correct device-timing semantics for XLA: an async dispatch means
   host-side brackets measure nothing unless the caller synchronizes —
-  ``end()`` optionally blocks on a ``jax.Array`` for honest splits.
+  ``end()`` optionally blocks on a ``jax.Array`` for honest splits;
+- optional delegation to the obs subsystem (ISSUE 1): pass ``registry``
+  (obs/metrics.py) and the brackets feed timing histograms + last-value
+  gauges; pass ``spans`` (obs/spans.py) and every ``start``/``end``
+  bracket ALSO opens/closes a trace span (wait -> ``data_wait``,
+  comm -> ``grad_sync``, others by name) — the Recorder stays the
+  single emission point, the obs files the machine-readable sinks.
 
 Note on calc/comm split: in the reference these were separate host
 phases (Theano call, then MPI). Here the collective is fused INSIDE the
@@ -33,6 +39,10 @@ import numpy as np
 
 
 class Recorder:
+    # bracket category -> obs span kind (obs/spans.py SPAN_KINDS); the
+    # reference's 'comm' bracket is the gradient exchange, hence grad_sync
+    SPAN_NAMES = {"wait": "data_wait", "comm": "grad_sync"}
+
     def __init__(
         self,
         rank: int = 0,
@@ -40,11 +50,16 @@ class Recorder:
         save_dir: Optional[str] = None,
         run_name: str = "run",
         tensorboard: bool = False,
+        registry=None,
+        spans=None,
     ):
         self.rank = rank
         self.print_freq = print_freq
         self.save_dir = save_dir
         self.run_name = run_name
+        self.registry = registry  # obs.MetricsRegistry or None
+        self.spans = spans  # obs.SpanRecorder or None
+        self._span_tokens: dict[str, object] = {}
         self._t0: dict[str, float] = {}
         self.timings: dict[str, list[float]] = defaultdict(list)
         self.history: dict[str, list] = defaultdict(list)
@@ -129,25 +144,57 @@ class Recorder:
 
     # -- timing brackets (reference API) ------------------------------------
     def start(self, category: str = "calc") -> None:
+        if self.spans is not None:
+            self._span_tokens[category] = self.spans.begin(
+                self.SPAN_NAMES.get(category, category)
+            )
         self._t0[category] = time.perf_counter()
 
     def end(self, category: str = "calc", sync=None) -> float:
         """Close a bracket. Pass a ``jax.Array`` (e.g. the loss) as
         ``sync`` to block until the device work really finished —
-        without it the bracket only measures dispatch."""
+        without it the bracket only measures dispatch.
+
+        An ``end`` without a matching ``start`` warns (naming the
+        category) and returns 0.0 instead of raising — an accounting
+        slip must not kill a training run."""
         if sync is not None:
             try:
                 sync.block_until_ready()
             except AttributeError:
                 pass
-        dt = time.perf_counter() - self._t0.pop(category)
+        t0 = self._t0.pop(category, None)
+        if t0 is None:
+            import warnings
+
+            warnings.warn(
+                f"Recorder.end({category!r}) without a matching "
+                f"start({category!r}); returning 0.0",
+                RuntimeWarning, stacklevel=2,
+            )
+            self._span_tokens.pop(category, None)
+            return 0.0
+        dt = time.perf_counter() - t0
         self.timings[category].append(dt)
+        token = self._span_tokens.pop(category, None)
+        if token is not None and self.spans is not None:
+            self.spans.finish(token)
+        if self.registry is not None:
+            name = self.SPAN_NAMES.get(category, category)
+            self.registry.histogram(
+                f"tmpi_{name}_seconds",
+                help=f"Recorder '{category}' bracket wall time",
+            ).observe(dt)
         return dt
 
     # -- metric accumulation -------------------------------------------------
     def train_metrics(self, step: int, metrics: dict, n_images: int = 0) -> None:
         rec = {k: float(v) for k, v in metrics.items()}
         rec["step"] = int(step)
+        if n_images and self.registry is not None:
+            self.registry.counter(
+                "tmpi_images_total", help="training examples consumed"
+            ).inc(n_images)
         if n_images and self.timings.get("step"):
             rec["images_per_sec"] = n_images / self.timings["step"][-1]
         self.history["train"].append(rec)
@@ -216,6 +263,20 @@ class Recorder:
         if self._jsonl:
             self._jsonl.write(json.dumps({"kind": kind, **rec}) + "\n")
             self._jsonl.flush()
+        if self.registry is not None:
+            # last-value gauges per metric (tmpi_train_loss, tmpi_val_error,
+            # tmpi_epoch_seconds, ...) so obs snapshots carry the training
+            # curve's current point next to the comm/health telemetry;
+            # images ride a counter (throughput = rate(tmpi_images_total))
+            for k, v in rec.items():
+                if k in ("step", "epoch") or not isinstance(v, float):
+                    continue
+                if k == "images_per_sec":
+                    self.registry.gauge(
+                        "tmpi_images_per_sec", help="recent throughput"
+                    ).set(v)
+                else:
+                    self.registry.gauge(f"tmpi_{kind}_{k}").set(v)
         if self._tb is not None:
             x = rec.get("step", rec.get("epoch", 0))
             for k, v in rec.items():
