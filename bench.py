@@ -320,12 +320,21 @@ def bench_compute(steps: int = 20, trials: int = 5, model_name: str = "alexnet")
     return result
 
 
-def bench_e2e(max_steps: int = 48, batch: int = 0) -> dict:
+def bench_e2e(max_steps: int = 48, batch: int = 0,
+              dispatch_depths=(1,)) -> dict:
     """The honest framework benchmark: run_training end-to-end — disk
     shards -> mmap gather -> crop/mirror/normalize -> PrefetchLoader ->
     H2D -> fused step. The reference's headline claim was "I/O fully
-    hidden behind compute" (SURVEY.md §6); wait_frac measures it.
-    ``batch=0``: recipe batch (128) per visible device."""
+    hidden behind compute" (SURVEY.md §6); wait_frac measures it, and
+    host_blocked_frac measures the OUTPUT-side tax: the fraction of the
+    train loop the host spent blocked on device syncs (the per-step
+    round trip the async dispatch pipeline removes — utils/dispatch.py).
+    ``batch=0``: recipe batch (128) per visible device.
+
+    ``dispatch_depths``: one run per depth over the SAME shard files;
+    the deepest run is the headline and, when more than one depth was
+    swept, the per-depth readings land in ``dispatch_sweep`` so the
+    dispatch win is visible directly in the bench JSON."""
     import tempfile
 
     import jax
@@ -338,6 +347,7 @@ def bench_e2e(max_steps: int = 48, batch: int = 0) -> dict:
     batch = batch or 128 * n_dev
     rng = np.random.RandomState(0)
     n_train = max(2048, 8 * batch)
+    rows = []
     with tempfile.TemporaryDirectory(prefix="tmpi_bench_") as d:
         write_shards(
             d, "train",
@@ -351,42 +361,58 @@ def bench_e2e(max_steps: int = 48, batch: int = 0) -> dict:
             rng.randint(0, 1000, size=256).astype(np.int64),
             shard_size=256,
         )
-        summary = run_training(
-            rule="bsp",
-            model_cls=AlexNet,
-            dataset="imagenet",
-            dataset_kwargs={"root": d},
-            recipe_overrides={"batch_size": batch},
-            n_epochs=max(1, max_steps // (n_train // batch)),
-            max_steps=max_steps,
-            print_freq=0,
-            return_recorder=True,
-        )
-    rec = summary["recorder"]
-    # executed-work check: device-side counter vs host dispatch count
-    if summary.get("device_steps") != summary["steps"]:
-        raise RuntimeError(
-            f"bench_e2e: device executed {summary.get('device_steps')} steps "
-            f"but the host dispatched {summary['steps']} — backend dropped "
-            "work (see tools/repro_tunnel_fault.py)"
-        )
-    # drop the first epoch's first steps (compile) via last-n means
-    n = max(4, max_steps // 2)
-    step_t = rec.mean_time("step", n)
-    wait_t = rec.mean_time("wait", n)
-    img_s = batch / (step_t + wait_t) if (step_t + wait_t) else 0.0
-    return {
+        for depth in dispatch_depths:
+            summary = run_training(
+                rule="bsp",
+                model_cls=AlexNet,
+                dataset="imagenet",
+                dataset_kwargs={"root": d},
+                recipe_overrides={"batch_size": batch},
+                n_epochs=max(1, max_steps // (n_train // batch)),
+                max_steps=max_steps,
+                dispatch_depth=depth,
+                print_freq=0,
+                return_recorder=True,
+            )
+            rec = summary["recorder"]
+            # executed-work check: device-side counter vs host dispatches
+            if summary.get("device_steps") != summary["steps"]:
+                raise RuntimeError(
+                    f"bench_e2e: device executed {summary.get('device_steps')} "
+                    f"steps but the host dispatched {summary['steps']} — "
+                    "backend dropped work (see tools/repro_tunnel_fault.py)"
+                )
+            # drop the first epoch's first steps (compile) via last-n means
+            n = max(4, max_steps // 2)
+            step_t = rec.mean_time("step", n)
+            wait_t = rec.mean_time("wait", n)
+            img_s = batch / (step_t + wait_t) if (step_t + wait_t) else 0.0
+            rows.append({
+                "dispatch_depth": depth,
+                "images_per_sec": round(img_s, 1),
+                "wait_ms": round(1000 * wait_t, 2),
+                "step_ms": round(1000 * step_t, 2),
+                "wait_frac": round(wait_t / (step_t + wait_t), 4) if step_t else None,
+                "host_blocked_frac": summary.get("host_blocked_frac"),
+            })
+    head = max(rows, key=lambda r: r["dispatch_depth"])  # deepest = headline
+    result = {
         "metric": f"alexnet_e2e_images_per_sec_{n_dev}chip",
-        "value": round(img_s, 1),
+        "value": head["images_per_sec"],
         "unit": "images/sec",
-        "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
+        "vs_baseline": round(head["images_per_sec"] / BASELINE_IMG_S, 4),
         "baseline_estimated": True,
-        "wait_ms": round(1000 * wait_t, 2),
-        "step_ms": round(1000 * step_t, 2),
-        "wait_frac": round(wait_t / (step_t + wait_t), 4) if step_t else None,
+        "wait_ms": head["wait_ms"],
+        "step_ms": head["step_ms"],
+        "wait_frac": head["wait_frac"],
+        "host_blocked_frac": head["host_blocked_frac"],
+        "dispatch_depth": head["dispatch_depth"],
         "batch": batch,
         "max_steps": max_steps,
     }
+    if len(rows) > 1:
+        result["dispatch_sweep"] = rows
+    return result
 
 
 _SCALING_PROBE = """
@@ -509,6 +535,15 @@ def main() -> int:
                     help="compute mode: which zoo model to benchmark "
                          "(the driver contract stays the AlexNet default)")
     ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--dispatch-depth", type=int, default=1,
+                    help="e2e mode: async dispatch pipeline depth "
+                         "(run_training --dispatch-depth; 1 = classic "
+                         "per-step sync)")
+    ap.add_argument("--dispatch-depths", default=None,
+                    help="e2e mode: comma-separated depth sweep (e.g. "
+                         "1,4,8) over the same shard files; emits the "
+                         "per-depth table as dispatch_sweep in the "
+                         "bench JSON, headline = deepest")
     ap.add_argument("--ns", default=None,
                     help="scaling mode: comma-separated device counts "
                          "(default 1,2,4,8; the verdict-3 extension runs "
@@ -523,7 +558,11 @@ def main() -> int:
     if args.mode == "compute":
         result = bench_compute(steps=args.steps or 20, model_name=args.model)
     elif args.mode == "e2e":
-        result = bench_e2e(max_steps=args.steps or 48)
+        depths = (
+            tuple(int(k) for k in args.dispatch_depths.split(","))
+            if args.dispatch_depths else (args.dispatch_depth,)
+        )
+        result = bench_e2e(max_steps=args.steps or 48, dispatch_depths=depths)
     else:
         ns = tuple(int(n) for n in args.ns.split(",")) if args.ns else (1, 2, 4, 8)
         result = bench_scaling(ns=ns, steps=args.steps or 4)

@@ -1,0 +1,72 @@
+"""Hot-loop lint (tools/check_hot_loop.py): the worker train loops must
+stay free of per-step host syncs — the regression this lint exists to
+catch is a one-line metric fetch quietly reinstating the round trip the
+dispatch pipeline removed."""
+
+import pytest
+
+from theanompi_tpu.tools.check_hot_loop import (
+    WORKER_PATH,
+    check_source,
+    main as lint_main,
+    train_loop_segments,
+)
+
+_BAD = '''
+def run_training():
+    loader = [1, 2]
+    for xg in loader:
+        state, metrics = step(state, xg)
+        loss = float(metrics["loss"])  # the per-step sync, reborn
+        v = metrics["lr"].item()
+    for xs in loader:  # fused path
+        import numpy as np
+        mh = {k: np.asarray(v) for k, v in metrics.items()}
+'''
+
+_CLEAN = '''
+def run_training():
+    loader = [1, 2]
+    for xg in loader:
+        state, metrics = step(state, xg)
+        disp.push(1, metrics)  # np.asarray( lives in the drain module
+    n = float(accum)  # outside the loop: epoch-level drain is allowed
+'''
+
+
+def test_live_worker_source_is_clean():
+    with open(WORKER_PATH) as f:
+        src = f.read()
+    assert check_source(src) == []
+    # the lint actually found both train loops (anchor guard)
+    assert len(train_loop_segments(src)) >= 2
+
+
+def test_violations_detected_per_line():
+    errs = check_source(_BAD)
+    assert len(errs) == 3
+    assert any("float(" in e for e in errs)
+    assert any(".item(" in e for e in errs)
+    assert any("np.asarray(" in e for e in errs)
+
+
+def test_clean_loop_passes_and_comments_ignored():
+    assert check_source(_CLEAN) == []
+
+
+def test_missing_anchor_raises():
+    with pytest.raises(ValueError, match="no function"):
+        check_source("x = 1")
+    with pytest.raises(ValueError, match="train loops"):
+        check_source("def run_training():\n    pass\n")
+
+
+def test_cli_gate_on_live_worker():
+    assert lint_main([]) == 0
+
+
+def test_cli_gate_fails_on_violation(tmp_path, capsys):
+    bad = tmp_path / "bad_worker.py"
+    bad.write_text(_BAD)
+    assert lint_main([str(bad)]) == 1
+    assert "forbidden host sync" in capsys.readouterr().out

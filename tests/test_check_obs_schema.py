@@ -19,6 +19,9 @@ def test_valid_records_pass():
         {"kind": "epoch", "epoch": 1, "seconds": 12.5, "images_per_sec": 99.0},
         {"kind": "span", "name": "step", "rank": 0, "t0": 1.0, "dur": 0.1,
          "depth": 0},
+        # amortized span (utils/dispatch.py spaced-sync attribution)
+        {"kind": "span", "name": "step", "rank": 0, "t0": 1.0, "dur": 0.1,
+         "depth": 0, "amortized": True},
         {"kind": "span_summary", "rank": 0, "t0": 1.0, "wall_s": 10.0,
          "fractions": {"step": 0.5}, "totals_s": {"step": 5.0},
          "counts": {"step": 4}},
@@ -41,6 +44,8 @@ def test_valid_records_pass():
     ({"kind": "train", "step": True}, "is bool"),
     ({"kind": "span", "name": 3, "rank": 0, "t0": 1.0, "dur": 0.1,
       "depth": 0}, "want str"),
+    ({"kind": "span", "name": "step", "rank": 0, "t0": 1.0, "dur": 0.1,
+      "depth": 0, "amortized": 1}, "want bool"),
     ({"kind": "train", "step": 1, "nested": {"a": 1}}, "non-scalar"),
     ({"kind": "metrics", "t": 1.0, "metrics": {"g": "high"}}, "not numeric"),
     ({"kind": "metrics", "t": 1.0, "metrics": {"g": float("nan")}},

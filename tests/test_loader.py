@@ -82,3 +82,36 @@ def test_close_mid_epoch_stops_producer():
     loader.close()
     assert loader._thread.is_alive() is False
     assert len(produced) < 1000  # stopped early, not drained to the end
+
+
+def test_context_manager_closes_producer_on_exit():
+    with PrefetchLoader([np.zeros((2,))] * 50, place=_host_place, depth=2) as loader:
+        next(loader)
+    assert loader._stop.is_set()
+    assert loader._thread.is_alive() is False
+
+
+def test_context_manager_closes_on_consumer_exception():
+    """The worker-loop leak (ISSUE 2 satellite): a consumer that raises
+    mid-epoch must still tear down the producer thread."""
+    produced = []
+
+    def gen():
+        for i in range(1000):
+            produced.append(i)
+            yield np.full((2,), i)
+
+    with pytest.raises(RuntimeError, match="consumer died"):
+        with PrefetchLoader(gen(), place=_host_place, depth=2) as loader:
+            next(loader)
+            raise RuntimeError("consumer died")
+    assert loader._thread.is_alive() is False
+    assert len(produced) < 1000
+
+
+def test_close_is_idempotent():
+    loader = PrefetchLoader([np.zeros((2,))] * 10, place=_host_place, depth=2)
+    next(loader)
+    loader.close()
+    loader.close()  # second close (e.g. explicit close inside a with)
+    assert loader._thread.is_alive() is False

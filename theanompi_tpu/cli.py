@@ -46,6 +46,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "directly-attached hosts — measured HARMFUL on "
                         "network-tunneled dev chips, whose large single "
                         "transfers stall")
+    p.add_argument("--dispatch-depth", type=int, default=1,
+                   help="async dispatch pipeline: keep up to K steps in "
+                        "flight before the host blocks on a metrics "
+                        "fetch (utils/dispatch.py). 1 = classic per-step "
+                        "sync; recorder JSONL rows are bit-identical "
+                        "either way, deeper pipelines just emit them "
+                        "later. Costs K extra in-flight input batches "
+                        "of HBM; see README 'Async dispatch pipeline'")
+    p.add_argument("--compile-cache-dir", default=None,
+                   help="persistent XLA compilation-cache directory: "
+                        "repeated runs (bench sweeps, requeued jobs) "
+                        "skip recompiling identical programs")
     p.add_argument("--accum-steps", type=int, default=1,
                    help="gradient accumulation: split each (per-device) "
                         "batch into this many microbatches inside the step "
@@ -263,6 +275,8 @@ def main(argv=None) -> int:
         strategy=args.strategy,
         n_slices=args.slices,
         steps_per_dispatch=args.steps_per_dispatch,
+        dispatch_depth=args.dispatch_depth,
+        compile_cache_dir=args.compile_cache_dir,
         accum_steps=args.accum_steps,
         tp=args.tp,
         sp=args.sp,

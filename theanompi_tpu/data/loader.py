@@ -94,7 +94,11 @@ class PrefetchLoader:
     def close(self) -> None:
         """Stop the producer and drop prefetched batches — call when
         abandoning the iterator early (e.g. max_steps truncation), so
-        device-placed batches are not pinned for the process lifetime."""
+        device-placed batches are not pinned for the process lifetime.
+        Idempotent, and safe mid-exception: the preferred form is the
+        context manager, which guarantees the producer thread is torn
+        down even when the consuming loop raises (a bare ``for`` over
+        an abandoned loader leaks the thread for the process lifetime)."""
         self._stop.set()
         while True:
             try:
@@ -102,6 +106,12 @@ class PrefetchLoader:
             except queue.Empty:
                 break
         self._thread.join(timeout=2.0)
+
+    def __enter__(self) -> "PrefetchLoader":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
 
     def __iter__(self):
         return self

@@ -18,6 +18,7 @@ the driver is plain single-controller Python around jitted SPMD steps
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Optional
 
 import jax
@@ -130,6 +131,10 @@ def run_training(
     strategy: str = "psum",
     n_slices: Optional[int] = None,
     steps_per_dispatch: int = 1,
+    # async dispatch pipeline (utils/dispatch.py): keep up to this many
+    # steps in flight before the host blocks on a metrics D2H; 1 = the
+    # classic per-step sync (bit-identical recorder rows either way)
+    dispatch_depth: int = 1,
     accum_steps: int = 1,
     # N-D parallelism axes (BSP rule only; LM models — parallel/nd.py):
     tp: int = 1,
@@ -164,6 +169,9 @@ def run_training(
     obs_dir: Optional[str] = None,
     stall_timeout: float = 0.0,
     metrics_snapshot_freq: int = 0,
+    # persistent XLA compilation cache: repeated runs (bench sweeps,
+    # requeued jobs) skip recompiling identical programs
+    compile_cache_dir: Optional[str] = None,
     # rule-specific kwargs (EASGD avg_freq etc.) forwarded to the rule's
     # step builder
     **rule_kwargs: Any,
@@ -181,6 +189,11 @@ def run_training(
     """
     if model_cls is None:
         raise ValueError("model_cls is required")
+
+    if compile_cache_dir:
+        # set BEFORE any compile; the threshold knob is left to the
+        # environment (conftest/session config own it where they care)
+        jax.config.update("jax_compilation_cache_dir", compile_cache_dir)
 
     recipe = model_cls.default_recipe()
     if recipe_overrides:
@@ -678,6 +691,24 @@ def run_training(
             except Exception as e:  # noqa: BLE001
                 print(f"[obs] traffic model unavailable for {rule!r}: "
                       f"{e!r}", flush=True)
+    from theanompi_tpu.utils.dispatch import MetricsDispatcher
+
+    # Async dispatch pipeline (utils/dispatch.py): the ONLY
+    # host<->device sync in the train loops below lives in the
+    # dispatcher's drain (lint: tools/check_hot_loop.py). depth=1
+    # reproduces the classic per-step sync exactly.
+    disp = MetricsDispatcher(
+        rec, depth=dispatch_depth, on_step_seconds=obs.note_step_seconds
+    )
+    if disp.depth > 1 and not getattr(engine, "donates_state", False):
+        print(
+            f"[rank {jax.process_index()}] WARNING: engine {rule!r} does "
+            f"not donate its state buffers on this mesh; dispatch_depth="
+            f"{disp.depth} keeps extra params+opt copies live in HBM",
+            flush=True,
+        )
+    train_loop_s = 0.0  # wall time inside the train loops (the
+    # denominator of summary['host_blocked_frac'])
     # the device trace and the JSONL log must be closed even when a
     # step raises (OOM, loader failure, Ctrl-C) — close() stops a
     # live capture and warns if the window never opened
@@ -685,12 +716,13 @@ def run_training(
         for epoch in range(start_epoch, n_epochs):
             rec.start_epoch()
             epoch_steps = 0
+            t_loop0 = time.perf_counter()
             if fuse > 1:
                 # fused dispatch: groups of <= fuse batches, stacked and
                 # shipped in one transfer, run by one compiled program
                 import itertools
 
-                loader = PrefetchLoader(
+                with PrefetchLoader(
                     grouper(
                         itertools.islice(
                             data.train_epoch(epoch, batch, seed=seed, part=part),
@@ -704,107 +736,130 @@ def run_training(
                     # comparable to the per-step path (depth x fuse steps
                     # prefetched would scale input HBM by fuse)
                     depth=max(1, prefetch_depth // fuse),
-                )
-                skip_batches = 0
-                rec.start("wait")
-                for xs, ys in loader:
-                    rec.end("wait")
-                    if max_steps and step_count + xs.shape[0] > max_steps:
-                        # trim the final group to land exactly on max_steps
-                        keep = max_steps - step_count
-                        xs, ys = xs[:keep], ys[:keep]
-                    rec.profile_tick(step_count)
-                    g = int(xs.shape[0])
-                    # the SAME sequential splits the per-step path draws,
-                    # shipped stacked — fused training is bit-identical
-                    subs = []
-                    for _ in range(g):
-                        rng, s = jax.random.split(rng)
-                        subs.append(s)
-                    rec.start("step")
-                    state, metrics = engine.fused_train_step(
-                        state, xs, ys, jnp.stack(subs)
-                    )
-                    step_dt = rec.end("step", sync=metrics["loss"])
-                    step_count += g
-                    epoch_steps += g
-                    dispatch_images.append(batch * g)
-                    obs.on_step(step_count, substeps=g, step_seconds=step_dt)
-                    # one JSONL row PER SUBSTEP from the stacked metrics,
-                    # so fused runs yield the same-resolution loss/LR
-                    # curves as per-step runs of the same config
-                    # (trajectories are bit-identical); the group's
-                    # throughput is attributed to its final row
-                    mh = {k: np.asarray(v) for k, v in metrics.items()}
-                    for i in range(g):
-                        rec.train_metrics(
-                            step_count - g + i + 1,
-                            {k: a[i] for k, a in mh.items()},
-                            n_images=batch * g if i == g - 1 else 0,
-                        )
+                ) as loader:
+                    skip_batches = 0
                     rec.start("wait")
-                    if max_steps and step_count >= max_steps:
-                        loader.close()
-                        break
-                rec.end("wait")
+                    for xs, ys in loader:
+                        disp.note_wait(rec.end("wait"))
+                        if max_steps and step_count + xs.shape[0] > max_steps:
+                            # trim the final group to land exactly on max_steps
+                            keep = max_steps - step_count
+                            xs, ys = xs[:keep], ys[:keep]
+                        rec.profile_tick(step_count)
+                        g = int(xs.shape[0])
+                        # the SAME sequential splits the per-step path draws,
+                        # shipped stacked — fused training is bit-identical
+                        subs = []
+                        for _ in range(g):
+                            rng, s = jax.random.split(rng)
+                            subs.append(s)
+                        state, metrics = engine.fused_train_step(
+                            state, xs, ys, jnp.stack(subs)
+                        )
+                        step_count += g
+                        epoch_steps += g
+                        dispatch_images.append(batch * g)
+                        # liveness first (watchdog/heartbeat learn of the
+                        # dispatch immediately — a hung collective stops
+                        # the drain, and with it further dispatches,
+                        # within `depth` groups), then rows + step timing
+                        # via the dispatcher's drain — the only host sync
+                        # in this loop
+                        obs.on_step(step_count, substeps=g)
+                        disp.push(step_count, metrics,
+                                  n_images=batch * g, substeps=g)
+                        rec.start("wait")
+                        if max_steps and step_count >= max_steps:
+                            break
+                    # the epoch-tail wait (the loader's StopIteration
+                    # fetch) must be credited too, or the flush below
+                    # would attribute it to the in-flight steps AND the
+                    # wait bracket — double counting that breaks the
+                    # span-fraction invariant
+                    disp.note_wait(rec.end("wait"))
+                disp.flush()
                 rec.end_epoch(epoch, n_images=epoch_steps * batch)
             else:
-                loader = PrefetchLoader(
+                with PrefetchLoader(
                     data.train_epoch(epoch, batch, seed=seed, part=part),
                     place,
                     depth=prefetch_depth,
-                )
-                rec.start("wait")
-                for xg, yg in loader:
-                    if skip_batches:
-                        skip_batches -= 1
-                        continue
-                    rec.end("wait")
-                    rec.profile_tick(step_count)
-                    rng, sub = jax.random.split(rng)
-                    rec.start("step")
-                    state, metrics = engine.train_step(state, xg, yg, sub)
-                    step_dt = rec.end("step", sync=metrics["loss"])
-                    step_count += 1
-                    epoch_steps += 1
-                    dispatch_images.append(batch)
-                    # periodic exchange (EASGD avg_freq; reference: worker
-                    # loop calling exchanger.exchange() — recorded as 'comm')
-                    if engine.exchange_every and step_count % engine.exchange_every == 0:
-                        rec.start("comm")
-                        state = engine.exchange(state)
-                        # sync on a leaf of the exchanged state: without it
-                        # the bracket measures only async dispatch and the
-                        # collective's real cost bleeds into the next
-                        # wait/step brackets
-                        step_dt += rec.end(
-                            "comm", sync=jax.tree_util.tree_leaves(state)[0]
-                        )
-                    # after the exchange so the comm gauge's denominator
-                    # includes the exchange's wall time on the steps that
-                    # pay it (amortized bytes / local-only time would
-                    # report gbps above the physical link)
-                    obs.on_step(step_count, step_seconds=step_dt)
-                    rec.train_metrics(step_count, metrics, n_images=batch)
+                ) as loader:
                     rec.start("wait")
-                    if max_steps and step_count >= max_steps:
-                        loader.close()
-                        break
-                rec.end("wait")
+                    for xg, yg in loader:
+                        if skip_batches:
+                            skip_batches -= 1
+                            continue
+                        disp.note_wait(rec.end("wait"))
+                        rec.profile_tick(step_count)
+                        rng, sub = jax.random.split(rng)
+                        state, metrics = engine.train_step(state, xg, yg, sub)
+                        step_count += 1
+                        epoch_steps += 1
+                        dispatch_images.append(batch)
+                        # liveness first (watchdog/heartbeat track
+                        # dispatched progress; a hang stops dispatches
+                        # within `depth` steps), then the row + step
+                        # timing via the dispatcher's drain (step
+                        # N-depth+1 while this step runs) — the per-step
+                        # host round trip lives ONLY there
+                        obs.on_step(step_count)
+                        disp.push(step_count, metrics, n_images=batch)
+                        # periodic exchange (EASGD avg_freq; reference: worker
+                        # loop calling exchanger.exchange() — recorded as 'comm')
+                        if engine.exchange_every and step_count % engine.exchange_every == 0:
+                            # exchange boundary: drain in-flight metrics
+                            # first so the comm bracket below times the
+                            # collective, not K backlogged steps
+                            disp.flush()
+                            rec.start("comm")
+                            state = engine.exchange(state)
+                            # sync on a leaf of the exchanged state: without it
+                            # the bracket measures only async dispatch and the
+                            # collective's real cost bleeds into the next
+                            # wait/step brackets
+                            cdt = rec.end(
+                                "comm", sync=jax.tree_util.tree_leaves(state)[0]
+                            )
+                            # the comm gauge's denominator includes the
+                            # exchange's wall time on the steps that pay
+                            # it (amortized bytes / local-only time would
+                            # report gbps above the physical link)
+                            obs.note_step_seconds(
+                                (disp.last_step_seconds or 0.0) + cdt
+                            )
+                        rec.start("wait")
+                        if max_steps and step_count >= max_steps:
+                            break
+                    # credit the epoch-tail wait (see the fused path)
+                    disp.note_wait(rec.end("wait"))
+                disp.flush()
                 rec.end_epoch(epoch, n_images=epoch_steps * batch)
 
+            train_loop_s += time.perf_counter() - t_loop0
+
             # validation (reference: per-epoch val loop on the worker/server)
-            val_accum: dict[str, float] = {}
+            val_accum: Optional[dict] = None
             n_val = 0
             rec.start("eval")
             for vx, vy in data.val_epoch(vbatch, part=vpart):
                 vm = engine.eval_step(state, *place((vx, vy)))
-                for k, v in vm.items():
-                    val_accum[k] = val_accum.get(k, 0.0) + float(v)
+                # device-side accumulation: the adds dispatch async and
+                # the ONE D2H for the whole val epoch happens below —
+                # the old per-batch float(v) was a hidden host round
+                # trip per val batch (the same tax the train loop paid)
+                val_accum = (
+                    vm if val_accum is None
+                    else jax.tree_util.tree_map(jnp.add, val_accum, vm)
+                )
                 n_val += 1
-            rec.end("eval")
+            rec.end(
+                "eval",
+                sync=None if val_accum is None
+                else jax.tree_util.tree_leaves(val_accum)[0],
+            )
             if n_val:
-                val_metrics = {k: v / n_val for k, v in val_accum.items()}
+                val_metrics = {k: float(v) / n_val for k, v in val_accum.items()}
                 rec.val_metrics(epoch, val_metrics)
                 summary["val"] = val_metrics
 
@@ -860,6 +915,16 @@ def run_training(
     # backend that silently drops work (tools/repro_tunnel_fault.py)
     # shows up as a mismatch here
     summary["device_steps"] = engine.get_step(state)
+    # dispatch-pipeline accounting: how much of the train loop the host
+    # spent BLOCKED on device syncs (the per-step tax dispatch_depth>1
+    # removes; bench.py reports this as host_blocked_frac)
+    summary["dispatch_depth"] = disp.depth
+    summary["host_blocked_s"] = round(disp.host_blocked_s, 6)
+    summary["train_loop_s"] = round(train_loop_s, 6)
+    summary["host_blocked_frac"] = (
+        round(min(1.0, disp.host_blocked_s / train_loop_s), 6)
+        if train_loop_s > 0 else None
+    )
     k_recent = min(50, len(dispatch_images))
     t_recent = rec.mean_time("step", k_recent)
     summary["images_per_sec"] = (

@@ -464,14 +464,22 @@ def opt_state_specs(opt_template, param_specs):
     return match(opt_template)
 
 
-def build_spec_step(body, mesh, param_specs, tok_spec, lr, optimizer, init_fn):
+def build_spec_step(body, mesh, param_specs, tok_spec, lr, optimizer, init_fn,
+                    donate: bool = False):
     """Shared plumbing for the spec-sharded train steps (nd/ep/pp):
     ``body(params, tokens) -> (loss, synced_grads)`` becomes a jitted
     shard_map step — ``(params, tokens) -> (params, loss)`` for plain
     SGD, or over ``(params, opt_state)`` when ``optimizer`` (registry
     name or Optimizer) is given. ``init_fn()`` supplies a params
     template for sizing the opt state (evaluated abstractly — nothing
-    is materialized)."""
+    is materialized).
+
+    ``donate`` (ISSUE 2 donation audit): when True the state argument's
+    buffers are donated so a training loop threading state through the
+    step holds ONE params(+opt) copy instead of two. Default False —
+    these builders also serve the oracle tests and probes, which reuse
+    the input state across calls (a donated input is deleted). The
+    driver-facing engines (parallel/nd.py NDEngine) donate by default."""
     if optimizer is None:
 
         def sharded(params, tokens):
@@ -488,7 +496,8 @@ def build_spec_step(body, mesh, param_specs, tok_spec, lr, optimizer, init_fn):
                 in_specs=(param_specs, tok_spec),
                 out_specs=(param_specs, P()),
                 check_vma=False,
-            )
+            ),
+            donate_argnums=(0,) if donate else (),
         )
 
     from theanompi_tpu.ops.optimizers import apply_updates, get_optimizer
@@ -510,7 +519,8 @@ def build_spec_step(body, mesh, param_specs, tok_spec, lr, optimizer, init_fn):
             in_specs=((param_specs, opt_specs), tok_spec),
             out_specs=((param_specs, opt_specs), P()),
             check_vma=False,
-        )
+        ),
+        donate_argnums=(0,) if donate else (),
     )
 
 

@@ -166,6 +166,23 @@ class Observability:
         ):
             self.snapshot(step=step)
 
+    def note_step_seconds(self, per_step_seconds: Optional[float]) -> None:
+        """Refresh the achieved-GB/s gauge from an amortized per-step
+        time (utils/dispatch.py's spaced syncs). Under deferred dispatch
+        :meth:`on_step` no longer knows the step time at push time —
+        the dispatcher calls this at each sync point instead, so the
+        gauge carries the same analytic-bytes / measured-time reading
+        sync mode produced, just on the sync cadence."""
+        if not self.enabled or self.traffic is None or not per_step_seconds:
+            return
+        gbps = self.traffic.achieved_gbps(per_step_seconds)
+        if gbps is not None:
+            self.registry.gauge(
+                "tmpi_comm_gbps",
+                help="achieved per-device interconnect GB/s "
+                     "(analytic bytes / measured step time)",
+            ).set(gbps)
+
     def snapshot(self, step: Optional[int] = None) -> Optional[dict]:
         """Write one metrics snapshot line + refresh the Prometheus
         exposition (rank 0 only; other ranks no-op)."""

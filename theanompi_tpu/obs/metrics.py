@@ -272,7 +272,9 @@ def result_to_snapshot(result: dict, source: str = "bench") -> dict:
             reg.gauge(f"{source}_{k}").set(float(v))
         elif isinstance(v, str):
             labels[k] = v
-        # nested dicts (timing/table) stay in the native bench line only
+        # nested dicts/lists (timing, scaling table, dispatch_sweep)
+        # stay in the native bench line only — snapshot metrics are a
+        # flat numeric map by schema (tools/check_obs_schema.py)
     return reg.snapshot(extra={"source": source, "labels": labels})
 
 

@@ -105,6 +105,35 @@ class SpanRecorder:
                 self._owner_top[name] = self._owner_top.get(name, 0.0) + dur
         return dur
 
+    def note(self, name: str, dur: float, t0_wall: Optional[float] = None) -> None:
+        """Record a span measured EXTERNALLY (no begin/finish pair) —
+        the dispatch pipeline's amortized step windows
+        (utils/dispatch.py). Attributed to the calling thread at depth
+        0, so when the caller is the driver the duration lands in the
+        summary ``fractions``; the caller must therefore pass exclusive
+        time (overlapping spans like data waits already subtracted) to
+        preserve the fractions-sum<=1 invariant. The emitted line is
+        flagged ``amortized`` so trace readers can tell attributed time
+        from bracketed time (schema: tools/check_obs_schema.py)."""
+        dur = float(dur)
+        name = str(name)
+        rec = {
+            "kind": "span",
+            "name": name,
+            "rank": self.rank,
+            "t0": (time.time() - dur) if t0_wall is None else t0_wall,
+            "dur": dur,
+            "depth": 0,
+            "amortized": True,
+        }
+        with self._wlock:
+            if not self._closed:
+                self._f.write(json.dumps(rec) + "\n")
+            self._totals[name] = self._totals.get(name, 0.0) + dur
+            self._counts[name] = self._counts.get(name, 0) + 1
+            if threading.get_ident() == self._owner:
+                self._owner_top[name] = self._owner_top.get(name, 0.0) + dur
+
     @contextmanager
     def span(self, name: str):
         token = self.begin(name)

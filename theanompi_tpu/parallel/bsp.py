@@ -224,6 +224,12 @@ class BSPEngine:
 
     name = "bsp"
     exchange_every = 0  # the allreduce is inside every step
+    # donation audit (ISSUE 2): with donate_argnums=(0,) every in-flight
+    # step under the async dispatch pipeline reuses the params+opt
+    # buffers instead of doubling HBM; the single-device path opts out
+    # (tunneled-backend relayout recompile — see make_bsp_train_step)
+    # and __init__ overrides this flag accordingly
+    donates_state = True
 
     def __init__(
         self,
@@ -248,6 +254,10 @@ class BSPEngine:
             accum_steps=accum_steps,
         )
         self._fused_step = None  # built lazily; jit retraces per group size
+        n = 1
+        for a in _axes_tuple(axis_name):
+            n *= mesh.shape[a]
+        self.donates_state = n > 1  # single-device path does not donate
         self._step = make_bsp_train_step(model, mesh, **self._build)
         self._eval = make_bsp_eval_step(
             model, mesh, axis_name=axis_name, input_transform=input_transform,

@@ -72,6 +72,10 @@ class EASGDEngine:
     """
 
     name = "easgd"
+    # donation audit (ISSUE 2): local steps and the elastic exchange
+    # both donate the stacked worker state, so async in-flight steps
+    # reuse buffers instead of doubling HBM
+    donates_state = True
 
     def __init__(
         self,

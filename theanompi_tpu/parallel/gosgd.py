@@ -78,6 +78,9 @@ class GOSGDEngine:
     """
 
     name = "gosgd"
+    # donation audit (ISSUE 2): the gossip step donates its stacked
+    # per-worker state — in-flight async dispatches reuse buffers
+    donates_state = True
 
     def __init__(
         self,

@@ -81,6 +81,7 @@ class NDEngine:
 
     name = "nd"
     exchange_every = 0
+    donates_state = True  # overridden per-instance from the donate flag
 
     def __init__(
         self,
@@ -195,6 +196,7 @@ class NDEngine:
         # fused dispatch: group dim replicated ahead of the token spec
         self._stacked_sharding = NamedSharding(mesh, P(None, *tok_spec))
         self._donate = donate
+        self.donates_state = bool(donate)
         self._fused = None
         # multi-controller feed fraction (lo, hi, B): set by
         # host_batch_part when hosts load only their slice of the global

@@ -375,6 +375,7 @@ def make_pp_train_step(
     sp_axis: Optional[str] = None,
     optimizer=None,
     interleave: int = 1,
+    donate: bool = False,
 ):
     """Jitted pipeline-parallel train step ``(stacked_params, tokens) ->
     (stacked_params, loss)`` (or over ``(params, opt_state)`` with
@@ -411,4 +412,8 @@ def make_pp_train_step(
             model.init(jax.random.PRNGKey(0)),
             n_stages=n_stages, interleave=interleave,
         ),
+        # ISSUE 2 donation audit: default False keeps the oracle-test
+        # contract (inputs reusable); training loops that thread state
+        # pass donate=True to hold one stacked-params(+opt) copy
+        donate=donate,
     )

@@ -213,6 +213,9 @@ class ZeroEngine:
 
     name = "zero1"
     exchange_every = 0
+    # donation audit (ISSUE 2): make_zero1_train_step donates by default
+    # (the sharded opt state + replicated params reuse their buffers)
+    donates_state = True
 
     def __init__(
         self,

@@ -1,0 +1,107 @@
+"""Hot-loop lint: no host<->device syncs in the worker train loops.
+
+ISSUE 2 removed the per-step host sync from ``launch/worker.py``'s
+train loops — metric D2H fetches live ONLY in the dispatch pipeline's
+drain (``utils/dispatch.py``), so the host can keep ``--dispatch-depth``
+steps in flight. This lint keeps it that way: it fails if a host-
+materializing call (``float(...)``, ``.item(...)``, ``np.asarray(...)``,
+``jax.device_get(...)``, ``block_until_ready(...)``) reappears inside a
+train loop — the kind of one-line "just print the loss" patch that
+silently reinstates a full round trip per step.
+
+Scope: every ``for ... in loader`` loop inside ``run_training`` (the
+per-step and fused dispatch loops). The epoch-level code around them —
+eval's single end-of-epoch ``float(v)`` drain, checkpoint enqueue,
+``Recorder.end(..., sync=...)`` comm brackets after a pipeline flush —
+is deliberately out of scope: those are per-epoch / per-exchange syncs,
+not per-step ones.
+
+Usage::
+
+    python -m theanompi_tpu.tools.check_hot_loop            # lint worker.py
+    python -m theanompi_tpu.tools.check_hot_loop path.py    # lint that file
+
+Exit code 1 on any violation (CI gate; tests/test_check_hot_loop.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Optional
+
+# host-materializing call patterns forbidden inside the train loops
+FORBIDDEN = (
+    "float(",
+    ".item(",
+    "np.asarray(",
+    "jax.device_get(",
+    "block_until_ready(",
+)
+
+WORKER_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "launch", "worker.py",
+)
+
+
+def train_loop_segments(source: str, func: str = "run_training"):
+    """``(first_lineno, segment_source)`` for every ``for ... in
+    <something mentioning 'loader'>`` loop inside ``func`` — the worker
+    train loops. Raises if the function or the loops are missing, so a
+    refactor that moves them cannot turn this lint into a silent pass."""
+    tree = ast.parse(source)
+    fn: Optional[ast.FunctionDef] = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == func:
+            fn = node
+            break
+    if fn is None:
+        raise ValueError(f"no function {func!r} found to lint")
+    segs = []
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.For) and "loader" in ast.unparse(sub.iter):
+            segs.append((sub.lineno, ast.get_source_segment(source, sub)))
+    if not segs:
+        raise ValueError(
+            f"no 'for ... in loader' train loops found in {func!r} — "
+            "the lint's anchor moved; update tools/check_hot_loop.py"
+        )
+    return segs
+
+
+def check_source(source: str, func: str = "run_training") -> list[str]:
+    """Violation strings (empty = clean)."""
+    errs = []
+    for lineno, seg in train_loop_segments(source, func=func):
+        for off, line in enumerate(seg.splitlines()):
+            code = line.split("#", 1)[0]
+            for tok in FORBIDDEN:
+                if tok in code:
+                    errs.append(
+                        f"line {lineno + off}: forbidden host sync "
+                        f"{tok!r} inside the train loop: {line.strip()} "
+                        "(metric fetches belong in utils/dispatch.py's "
+                        "drain)"
+                    )
+    return errs
+
+
+def main(argv: Optional[list] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    path = argv[0] if argv else WORKER_PATH
+    with open(path) as f:
+        source = f.read()
+    errs = check_source(source)
+    for e in errs:
+        print(f"{path}:{e}")
+    print(
+        f"hot-loop lint on {os.path.relpath(path)}: "
+        + ("OK" if not errs else f"{len(errs)} violations")
+    )
+    return 1 if errs else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

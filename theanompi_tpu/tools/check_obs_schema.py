@@ -52,6 +52,10 @@ SCHEMAS: dict[str, dict[str, tuple[tuple, bool]]] = {
         "t0": (_NUM, True),
         "dur": (_NUM, True),
         "depth": ((int,), True),
+        # amortized spans (utils/dispatch.py spaced syncs): duration is
+        # ATTRIBUTED window time, not a begin/finish bracket — flagged
+        # so trace readers can tell the two apart
+        "amortized": ((bool,), False),
     },
     "span_summary": {
         "rank": ((int,), True),

@@ -1,5 +1,7 @@
-"""Cross-cutting utilities: recorder (timing/metrics), checkpointing, logging."""
+"""Cross-cutting utilities: recorder (timing/metrics), async dispatch
+pipeline, checkpointing, logging."""
 
+from theanompi_tpu.utils.dispatch import MetricsDispatcher  # noqa: F401
 from theanompi_tpu.utils.recorder import Recorder  # noqa: F401
 from theanompi_tpu.utils.checkpoint import (  # noqa: F401
     checkpoint_step,

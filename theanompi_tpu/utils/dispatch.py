@@ -1,0 +1,178 @@
+"""Asynchronous dispatch pipeline: deferred step metrics + amortized timing.
+
+The reference hid host work behind device compute on the INPUT side
+(``lib/proc_load_mpi.py`` double-buffering; our ``data/loader.py``
+PrefetchLoader) — and then the per-step driver threw the win away on the
+OUTPUT side: ``rec.end("step", sync=metrics["loss"])`` forced a full
+host<->device round trip per step, so the host could not enqueue step
+N+1 until step N's loss had been materialized. On a tunneled dev chip
+that round trip is ~100 ms against a ~15 ms step; on pods it is ~10 ms —
+either way it serializes dispatch.
+
+:class:`MetricsDispatcher` removes the per-step sync. The driver pushes
+each step's DEVICE-RESIDENT metric pytree into a ring buffer of
+``depth`` in-flight entries; pushing entry N drains entry N-depth+1 —
+whose D2H fetch blocks only if the device has not yet finished a step
+that is ``depth-1`` dispatches old (in steady state: never). The drain
+is the ONLY host<->device sync in the train loop
+(``tools/check_hot_loop.py`` lints that it stays that way).
+
+Timing semantics (amortized spaced syncs): each drain IS a spaced sync,
+and the per-step wall time attributed to the drained step is the
+interval between consecutive drain returns minus the data-wait time the
+driver reported via :meth:`note_wait` in that interval. In steady state
+the device completes exactly one step per drain interval, so the
+attributed time converges to the true device step time whether the
+device or the host is the bottleneck. ``flush()`` (epoch / exchange /
+checkpoint boundaries) blocks once on the newest in-flight step and
+attributes the remaining window evenly across the drained entries.
+
+With ``depth=1`` every push drains immediately — the attributed time is
+dispatch + block, exactly what the old ``end("step", sync=...)`` bracket
+measured, and rows are emitted at the same points in the JSONL stream.
+Deeper pipelines emit the SAME rows (same steps, same values, same
+n_images attribution), just later — tests/test_dispatch.py proves the
+streams bit-identical modulo the wall-clock ``images_per_sec`` field.
+
+``host_blocked_s`` accumulates the time the host actually spent blocked
+inside drains — ``host_blocked_frac`` in the run summary / bench output
+is this over the train-loop wall time, the direct measurement of the
+per-step host tax this module exists to remove.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Callable, Optional
+
+import numpy as np
+
+
+def _block_on(metrics: dict) -> None:
+    """Block until the step that produced ``metrics`` has executed
+    (device arrays expose ``block_until_ready``; host values no-op)."""
+    for v in metrics.values():
+        block = getattr(v, "block_until_ready", None)
+        if block is not None:
+            block()  # one leaf suffices: all values share the program
+            return
+
+
+class MetricsDispatcher:
+    """Ring buffer of in-flight step metrics (see module docstring).
+
+    ``recorder``: the run's :class:`~theanompi_tpu.utils.recorder.Recorder`
+    — drains call ``recorder.note_time("step", dt)`` then
+    ``recorder.train_metrics(...)``, so rows carry the amortized
+    per-step throughput exactly like sync-mode rows carry the bracketed
+    one. ``on_step_seconds``: optional callback receiving the amortized
+    per-substep seconds at each sync point (the driver wires
+    ``Observability.note_step_seconds`` so the comm-GB/s gauge stays
+    live under deferred timing).
+    """
+
+    def __init__(
+        self,
+        recorder,
+        depth: int = 1,
+        on_step_seconds: Optional[Callable[[float], None]] = None,
+    ):
+        self.rec = recorder
+        self.depth = max(1, int(depth))
+        self._buf: deque = deque()
+        self._t_mark: Optional[float] = None
+        self._wait_s = 0.0
+        self._on_step_seconds = on_step_seconds
+        # time the host spent actually blocked inside drains (the tax)
+        self.host_blocked_s = 0.0
+        self.n_syncs = 0
+        # amortized per-substep seconds of the most recent sync; None
+        # while steps are in flight without a completed sync
+        self.last_step_seconds: Optional[float] = None
+
+    @property
+    def in_flight(self) -> int:
+        """Entries pushed but not yet drained."""
+        return len(self._buf)
+
+    # -- driver hooks --------------------------------------------------------
+    def note_wait(self, dt: float) -> None:
+        """Report data-wait time (the recorder's ``wait`` bracket) so the
+        amortized step attribution excludes it — keeping the wait/step
+        split's meaning identical to sync mode."""
+        self._wait_s += float(dt)
+
+    def push(self, step: int, metrics: dict, n_images: int = 0,
+             substeps: int = 1) -> None:
+        """Enqueue one dispatched step (or fused group of ``substeps``)
+        whose ``metrics`` are still device-resident futures. Drains the
+        oldest entry once ``depth`` entries are in flight."""
+        if self._t_mark is None:
+            # window opens at the first in-flight push; waits before it
+            # (epoch-boundary eval/checkpoint, first batch load) are not
+            # part of any step's attribution
+            self._t_mark = time.perf_counter()
+            self._wait_s = 0.0
+        self._buf.append((int(step), metrics, int(n_images), max(1, int(substeps))))
+        while len(self._buf) >= self.depth:
+            self._drain_one()
+
+    def flush(self) -> None:
+        """Drain every in-flight entry: ONE block on the newest step
+        (which implies all older steps finished), remaining window time
+        attributed evenly. Call at epoch ends, before an engine
+        exchange, and before checkpoints — the recorder stream then
+        holds exactly the rows sync mode would hold at the same point."""
+        if not self._buf:
+            return
+        entries = list(self._buf)
+        self._buf.clear()
+        t0 = time.perf_counter()
+        _block_on(entries[-1][1])
+        now = time.perf_counter()
+        self.host_blocked_s += now - t0
+        self.n_syncs += 1
+        total = max(0.0, (now - self._t_mark) - self._wait_s)
+        per_entry = total / len(entries)
+        self._t_mark = None
+        self._wait_s = 0.0
+        for step, metrics, n_images, substeps in entries:
+            self.last_step_seconds = per_entry / substeps
+            self.rec.note_time("step", per_entry)
+            self._emit_rows(step, metrics, n_images, substeps)
+        if self._on_step_seconds is not None and entries:
+            self._on_step_seconds(self.last_step_seconds)
+
+    # -- internals -----------------------------------------------------------
+    def _drain_one(self) -> None:
+        step, metrics, n_images, substeps = self._buf.popleft()
+        t0 = time.perf_counter()
+        host = {k: np.asarray(v) for k, v in metrics.items()}  # D2H sync
+        now = time.perf_counter()
+        self.host_blocked_s += now - t0
+        self.n_syncs += 1
+        dt = max(0.0, (now - self._t_mark) - self._wait_s)
+        self._t_mark = now
+        self._wait_s = 0.0
+        self.last_step_seconds = dt / substeps
+        self.rec.note_time("step", dt)
+        self._emit_rows(step, host, n_images, substeps)
+        if self._on_step_seconds is not None:
+            self._on_step_seconds(self.last_step_seconds)
+
+    def _emit_rows(self, step: int, metrics: dict, n_images: int,
+                   substeps: int) -> None:
+        if substeps == 1:
+            self.rec.train_metrics(step, metrics, n_images=n_images)
+            return
+        # fused group: one JSONL row PER SUBSTEP from the stacked
+        # metrics (same-resolution loss/LR curves as per-step runs);
+        # the group's throughput is attributed to its final row
+        host = {k: np.asarray(v) for k, v in metrics.items()}
+        for i in range(substeps):
+            self.rec.train_metrics(
+                step - substeps + i + 1,
+                {k: a[i] for k, a in host.items()},
+                n_images=n_images if i == substeps - 1 else 0,
+            )

@@ -187,6 +187,26 @@ class Recorder:
             ).observe(dt)
         return dt
 
+    def note_time(self, category: str, dt: float) -> float:
+        """Record an externally measured bracket duration without a
+        ``start``/``end`` pair — the dispatch pipeline's amortized
+        spaced-sync timing (utils/dispatch.py). Feeds the same sinks a
+        bracket would: the timings list, the obs histogram, and an
+        ``amortized``-flagged span line (the duration must already
+        EXCLUDE overlapping owner-thread spans, e.g. data waits, so the
+        span summary's fraction invariant holds)."""
+        dt = float(dt)
+        self.timings[category].append(dt)
+        name = self.SPAN_NAMES.get(category, category)
+        if self.spans is not None:
+            self.spans.note(name, dt)
+        if self.registry is not None:
+            self.registry.histogram(
+                f"tmpi_{name}_seconds",
+                help=f"Recorder '{category}' bracket wall time",
+            ).observe(dt)
+        return dt
+
     # -- metric accumulation -------------------------------------------------
     def train_metrics(self, step: int, metrics: dict, n_images: int = 0) -> None:
         rec = {k: float(v) for k, v in metrics.items()}
