@@ -22,6 +22,7 @@ def run_training():
     for xs in loader:  # fused path
         import numpy as np
         mh = {k: np.asarray(v) for k, v in metrics.items()}
+        block_until_ready(metrics)  # bare from-import form
 '''
 
 _CLEAN = '''
@@ -44,10 +45,11 @@ def test_live_worker_source_is_clean():
 
 def test_violations_detected_per_line():
     errs = check_source(_BAD)
-    assert len(errs) == 3
+    assert len(errs) == 4
     assert any("float(" in e for e in errs)
     assert any(".item(" in e for e in errs)
     assert any("np.asarray(" in e for e in errs)
+    assert any("block_until_ready(" in e for e in errs)
 
 
 def test_clean_loop_passes_and_comments_ignored():

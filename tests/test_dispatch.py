@@ -95,6 +95,50 @@ def test_flush_attributes_evenly_and_is_idempotent():
     assert len(rec.times) == 3
 
 
+class _Poisoned:
+    """Device value whose producing program faulted: any
+    materialization (sync or D2H) raises, like a real poisoned jax
+    Array after an execution error."""
+
+    def block_until_ready(self):
+        raise RuntimeError("device fault")
+
+    def __array__(self, *a, **kw):
+        raise RuntimeError("device fault")
+
+
+def test_flush_salvages_healthy_rows_on_device_fault():
+    # depth>1: a faulted step surfaces at the boundary/finally flush's
+    # sync — the OLDER buffered steps completed fine and their rows
+    # must still land (depth=1 would already have written them)
+    rec = FakeRecorder()
+    disp = MetricsDispatcher(rec, depth=8)
+    disp.push(1, {"loss": np.float32(1.0)})
+    disp.push(2, {"loss": np.float32(2.0)})
+    disp.push(3, {"loss": _Poisoned()})
+    with pytest.raises(RuntimeError, match="device fault"):
+        disp.flush()
+    assert [r[0] for r in rec.rows] == [1, 2]
+    assert disp.in_flight == 0
+
+
+def test_empty_flush_closes_timing_window():
+    # depth=1: push drains immediately, so every boundary flush sees an
+    # EMPTY buffer — it must still close the timing window, or the first
+    # step after the boundary absorbs the full eval/val/checkpoint (or
+    # exchange) wall time into its attribution
+    rec = FakeRecorder()
+    disp = MetricsDispatcher(rec, depth=1)
+    disp.push(1, {"loss": np.float32(1.0)})
+    disp.flush()  # epoch-boundary flush with nothing in flight
+    disp.note_wait(0.01)  # stray wait noted outside any window
+    time.sleep(0.05)  # boundary work (eval / checkpoint / exchange)
+    disp.push(2, {"loss": np.float32(2.0)})  # drains immediately
+    assert [r[0] for r in rec.rows] == [1, 2]
+    _, dt = rec.times[1]
+    assert dt < 0.04  # the boundary gap is NOT attributed to step 2
+
+
 def test_wait_time_subtracted_from_attribution():
     rec = FakeRecorder()
     disp = MetricsDispatcher(rec, depth=2)
@@ -179,6 +223,47 @@ def test_drain_equivalence_easgd_exchange_boundary(tmp_path):
     s4, r4 = _run(tmp_path, "async", 4, **kw)
     assert s1["steps"] == s4["steps"] == 4
     assert r1 == r4
+
+
+def test_crash_mid_epoch_persists_buffered_rows(tmp_path, monkeypatch):
+    # an exception mid-epoch with depth>1 must not discard the buffered
+    # pre-crash steps: the worker's finally does a best-effort
+    # disp.flush() before rec.close(), so the JSONL holds the same rows
+    # sync mode would have persisted up to the crash
+    import theanompi_tpu.launch.worker as worker_mod
+    from theanompi_tpu.data import get_dataset
+
+    class Boom(RuntimeError):
+        pass
+
+    class FailingData:
+        def __init__(self, real, fail_after):
+            self._real = real
+            self._fail_after = fail_after
+
+        def __getattr__(self, name):
+            return getattr(self._real, name)
+
+        def train_epoch(self, *a, **kw):
+            for i, item in enumerate(self._real.train_epoch(*a, **kw)):
+                if i == self._fail_after:
+                    raise Boom("injected loader failure")
+                yield item
+
+    monkeypatch.setattr(
+        worker_mod, "get_dataset",
+        lambda name, **kw: FailingData(get_dataset(name, **kw), 3),
+    )
+    args = dict(_TINY)
+    args["dataset_kwargs"] = {**_TINY["dataset_kwargs"], "n_train": 256}
+    d = str(tmp_path / "crash")
+    with pytest.raises(Boom):
+        run_training(model_cls=TinyCNN, devices=8, save_dir=d,
+                     run_name="run", dispatch_depth=8, rule="bsp",
+                     n_epochs=1, **args)
+    rows = _rows(d, "run")
+    # steps 1-3 executed and sat in the depth-8 ring at the crash
+    assert [r["step"] for r in rows if r["kind"] == "train"] == [1, 2, 3]
 
 
 def test_drain_equivalence_max_steps_early_exit(tmp_path):

@@ -18,6 +18,7 @@ the driver is plain single-controller Python around jitted SPMD steps
 from __future__ import annotations
 
 import os
+import sys
 import time
 from typing import Any, Optional
 
@@ -847,7 +848,13 @@ def run_training(
                 # device-side accumulation: the adds dispatch async and
                 # the ONE D2H for the whole val epoch happens below —
                 # the old per-batch float(v) was a hidden host round
-                # trip per val batch (the same tax the train loop paid)
+                # trip per val batch (the same tax the train loop paid).
+                # Accumulate in float32 regardless of the metric dtype
+                # (the old host sum was float64; low-precision metrics
+                # would drift far worse summed in their own dtype)
+                vm = jax.tree_util.tree_map(
+                    lambda a: jnp.asarray(a, jnp.float32), vm
+                )
                 val_accum = (
                     vm if val_accum is None
                     else jax.tree_util.tree_map(jnp.add, val_accum, vm)
@@ -884,31 +891,55 @@ def run_training(
                 break
 
     finally:
+        # best-effort drain of in-flight step metrics BEFORE the
+        # recorder closes: an exception mid-epoch with dispatch_depth>1
+        # leaves up to depth-1 executed steps buffered — their rows are
+        # exactly the pre-crash tail a post-mortem reads, and sync mode
+        # would have persisted them (clean exits reach here with the
+        # buffer already empty: the boundary flushes ran). Suppressed on
+        # failure: a poisoned device value must not mask the training
+        # exception already propagating. SKIPPED when unwinding a
+        # BaseException (KeyboardInterrupt/SystemExit): Ctrl-C on a
+        # wedged collective is the canonical escape hatch, and the
+        # flush's block_until_ready would never return — the recorder
+        # and obs must still close so the process can exit.
+        # ... and wrapped so a KeyboardInterrupt arriving DURING the
+        # flush's device sync still reaches rec.close()/obs.close()
+        # in the inner finally below.
         try:
-            if ckpt_writer is not None:
-                # may re-raise a failed background write — but never let
-                # that replace a training exception already propagating
-                # (the original would survive only as __context__)
-                import sys
-
-                if sys.exc_info()[0] is not None:
-                    try:
-                        ckpt_writer.close()
-                    except Exception as e:  # noqa: BLE001
-                        print(
-                            f"checkpoint writer failed during error "
-                            f"unwinding (suppressed): {e!r}",
-                            flush=True,
-                        )
-                else:
-                    ckpt_writer.close()
+            _exc = sys.exc_info()[0]
+            if _exc is None or issubclass(_exc, Exception):
+                try:
+                    disp.flush()
+                except Exception as e:  # noqa: BLE001
+                    print(f"dispatch flush failed during error unwinding "
+                          f"(suppressed): {e!r}", flush=True)
         finally:
             try:
-                rec.close()  # trace + JSONL must close even then
+                if ckpt_writer is not None:
+                    # may re-raise a failed background write — but never
+                    # let that replace a training exception already
+                    # propagating (the original would survive only as
+                    # __context__)
+                    if sys.exc_info()[0] is not None:
+                        try:
+                            ckpt_writer.close()
+                        except Exception as e:  # noqa: BLE001
+                            print(
+                                f"checkpoint writer failed during error "
+                                f"unwinding (suppressed): {e!r}",
+                                flush=True,
+                            )
+                    else:
+                        ckpt_writer.close()
             finally:
-                # final snapshot + span summary + health-thread shutdown;
-                # after rec.close() so the recorder's last emissions land
-                obs.close()
+                try:
+                    rec.close()  # trace + JSONL must close even then
+                finally:
+                    # final snapshot + span summary + health-thread
+                    # shutdown; after rec.close() so the recorder's last
+                    # emissions land
+                    obs.close()
     summary["steps"] = step_count
     # device-truth step counter (host-fetched AFTER training): the host
     # loop counts dispatches, the device counts executions — a tunneled

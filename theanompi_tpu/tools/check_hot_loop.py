@@ -31,14 +31,18 @@ import os
 import sys
 from typing import Optional
 
-# host-materializing call patterns forbidden inside the train loops
-FORBIDDEN = (
-    "float(",
-    ".item(",
-    "np.asarray(",
-    "jax.device_get(",
-    "block_until_ready(",
-)
+# host-materializing calls forbidden inside the train loops; matched on
+# the AST (ast.Call func shapes), NOT by substring — a '#' inside a
+# string literal or a benign "float(" in a log message can never
+# truncate code or false-positive
+# bare calls: float(x), plus the from-import forms of the module-
+# qualified syncs below (`from jax import device_get`, ...)
+FORBIDDEN_NAMES = {"float", "block_until_ready", "device_get", "asarray"}
+FORBIDDEN_ATTRS = {"item", "block_until_ready"}  # any .item() / .block_until_ready()
+FORBIDDEN_MODULE_ATTRS = {  # module-qualified calls: np.asarray(x), ...
+    "asarray": {"np", "numpy"},
+    "device_get": {"jax"},
+}
 
 WORKER_PATH = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -46,11 +50,26 @@ WORKER_PATH = os.path.join(
 )
 
 
-def train_loop_segments(source: str, func: str = "run_training"):
-    """``(first_lineno, segment_source)`` for every ``for ... in
-    <something mentioning 'loader'>`` loop inside ``func`` — the worker
-    train loops. Raises if the function or the loops are missing, so a
-    refactor that moves them cannot turn this lint into a silent pass."""
+def _forbidden_call(node: ast.Call) -> Optional[str]:
+    """The violated pattern (display token) if ``node`` is a forbidden
+    host-materializing call, else None."""
+    f = node.func
+    if isinstance(f, ast.Name) and f.id in FORBIDDEN_NAMES:
+        return f"{f.id}("
+    if isinstance(f, ast.Attribute):
+        if f.attr in FORBIDDEN_ATTRS:
+            return f".{f.attr}("
+        mods = FORBIDDEN_MODULE_ATTRS.get(f.attr)
+        if mods and isinstance(f.value, ast.Name) and f.value.id in mods:
+            return f"{f.value.id}.{f.attr}("
+    return None
+
+
+def _train_loops(source: str, func: str = "run_training") -> list[ast.For]:
+    """Every ``for ... in <something mentioning 'loader'>`` loop inside
+    ``func`` — the worker train loops. Raises if the function or the
+    loops are missing, so a refactor that moves them cannot turn this
+    lint into a silent pass."""
     tree = ast.parse(source)
     fn: Optional[ast.FunctionDef] = None
     for node in ast.walk(tree):
@@ -59,32 +78,41 @@ def train_loop_segments(source: str, func: str = "run_training"):
             break
     if fn is None:
         raise ValueError(f"no function {func!r} found to lint")
-    segs = []
-    for sub in ast.walk(fn):
-        if isinstance(sub, ast.For) and "loader" in ast.unparse(sub.iter):
-            segs.append((sub.lineno, ast.get_source_segment(source, sub)))
-    if not segs:
+    loops = [
+        sub for sub in ast.walk(fn)
+        if isinstance(sub, ast.For) and "loader" in ast.unparse(sub.iter)
+    ]
+    if not loops:
         raise ValueError(
             f"no 'for ... in loader' train loops found in {func!r} — "
             "the lint's anchor moved; update tools/check_hot_loop.py"
         )
-    return segs
+    return loops
+
+
+def train_loop_segments(source: str, func: str = "run_training"):
+    """``(first_lineno, segment_source)`` per train loop (anchor guard
+    helper; the lint itself walks the loop nodes directly)."""
+    return [(loop.lineno, ast.get_source_segment(source, loop))
+            for loop in _train_loops(source, func=func)]
 
 
 def check_source(source: str, func: str = "run_training") -> list[str]:
     """Violation strings (empty = clean)."""
     errs = []
-    for lineno, seg in train_loop_segments(source, func=func):
-        for off, line in enumerate(seg.splitlines()):
-            code = line.split("#", 1)[0]
-            for tok in FORBIDDEN:
-                if tok in code:
-                    errs.append(
-                        f"line {lineno + off}: forbidden host sync "
-                        f"{tok!r} inside the train loop: {line.strip()} "
-                        "(metric fetches belong in utils/dispatch.py's "
-                        "drain)"
-                    )
+    for loop in _train_loops(source, func=func):
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            tok = _forbidden_call(node)
+            if tok is not None:
+                errs.append(
+                    f"line {node.lineno}: forbidden host sync "
+                    f"{tok!r} inside the train loop: "
+                    f"{ast.unparse(node)} "
+                    "(metric fetches belong in utils/dispatch.py's "
+                    "drain)"
+                )
     return errs
 
 

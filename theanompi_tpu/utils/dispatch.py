@@ -125,11 +125,26 @@ class MetricsDispatcher:
         exchange, and before checkpoints — the recorder stream then
         holds exactly the rows sync mode would hold at the same point."""
         if not self._buf:
+            # close the timing window even with nothing in flight: with
+            # depth=1 the buffer is ALWAYS empty here (push drains
+            # immediately), and a stale _t_mark would hand the whole
+            # boundary's wall time (eval/val/checkpoint, or an EASGD
+            # exchange) to the first step drained after it
+            self._t_mark = None
+            self._wait_s = 0.0
             return
         entries = list(self._buf)
         self._buf.clear()
         t0 = time.perf_counter()
-        _block_on(entries[-1][1])
+        err: Optional[Exception] = None
+        try:
+            _block_on(entries[-1][1])
+        except Exception as e:  # noqa: BLE001
+            # a buffered step's program faulted (OOM, NaN check, ...) —
+            # the newest entry's sync surfaces it, but OLDER steps may
+            # have completed fine; persist their rows (exactly what
+            # depth=1 would already have written) before re-raising
+            err = e
         now = time.perf_counter()
         self.host_blocked_s += now - t0
         self.n_syncs += 1
@@ -138,9 +153,18 @@ class MetricsDispatcher:
         self._t_mark = None
         self._wait_s = 0.0
         for step, metrics, n_images, substeps in entries:
+            if err is not None:
+                # oldest-first salvage: materializing the first poisoned
+                # entry re-raises; everything older is already emitted
+                try:
+                    metrics = {k: np.asarray(v) for k, v in metrics.items()}
+                except Exception:  # noqa: BLE001
+                    raise err
             self.last_step_seconds = per_entry / substeps
             self.rec.note_time("step", per_entry)
             self._emit_rows(step, metrics, n_images, substeps)
+        if err is not None:
+            raise err
         if self._on_step_seconds is not None and entries:
             self._on_step_seconds(self.last_step_seconds)
 
