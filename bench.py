@@ -321,7 +321,7 @@ def bench_compute(steps: int = 20, trials: int = 5, model_name: str = "alexnet")
 
 
 def bench_e2e(max_steps: int = 48, batch: int = 0,
-              dispatch_depths=(1,)) -> dict:
+              dispatch_depths=(1,), numerics: bool = False) -> dict:
     """The honest framework benchmark: run_training end-to-end — disk
     shards -> mmap gather -> crop/mirror/normalize -> PrefetchLoader ->
     H2D -> fused step. The reference's headline claim was "I/O fully
@@ -334,7 +334,12 @@ def bench_e2e(max_steps: int = 48, batch: int = 0,
     ``dispatch_depths``: one run per depth over the SAME shard files;
     the deepest run is the headline and, when more than one depth was
     swept, the per-depth readings land in ``dispatch_sweep`` so the
-    dispatch win is visible directly in the bench JSON."""
+    dispatch win is visible directly in the bench JSON.
+
+    ``numerics``: also run the headline depth with ``--numerics-freq 1``
+    (in-graph sentinels on EVERY step — the worst case) and report
+    ``numerics_overhead_frac``: the step-time fraction the flight
+    recorder's sentinels cost, measured, not guessed."""
     import tempfile
 
     import jax
@@ -361,8 +366,8 @@ def bench_e2e(max_steps: int = 48, batch: int = 0,
             rng.randint(0, 1000, size=256).astype(np.int64),
             shard_size=256,
         )
-        for depth in dispatch_depths:
-            summary = run_training(
+        def one_run(depth, numerics_freq=0):
+            return run_training(
                 rule="bsp",
                 model_cls=AlexNet,
                 dataset="imagenet",
@@ -371,9 +376,15 @@ def bench_e2e(max_steps: int = 48, batch: int = 0,
                 n_epochs=max(1, max_steps // (n_train // batch)),
                 max_steps=max_steps,
                 dispatch_depth=depth,
+                numerics_freq=numerics_freq,
                 print_freq=0,
                 return_recorder=True,
             )
+
+        raw_step_s: dict = {}  # unrounded per-depth step time (the
+        # numerics-overhead baseline must not absorb row rounding)
+        for depth in dispatch_depths:
+            summary = one_run(depth)
             rec = summary["recorder"]
             # executed-work check: device-side counter vs host dispatches
             if summary.get("device_steps") != summary["steps"]:
@@ -385,6 +396,7 @@ def bench_e2e(max_steps: int = 48, batch: int = 0,
             # drop the first epoch's first steps (compile) via last-n means
             n = max(4, max_steps // 2)
             step_t = rec.mean_time("step", n)
+            raw_step_s[depth] = step_t
             wait_t = rec.mean_time("wait", n)
             img_s = batch / (step_t + wait_t) if (step_t + wait_t) else 0.0
             rows.append({
@@ -395,6 +407,19 @@ def bench_e2e(max_steps: int = 48, batch: int = 0,
                 "wait_frac": round(wait_t / (step_t + wait_t), 4) if step_t else None,
                 "host_blocked_frac": summary.get("host_blocked_frac"),
             })
+        nm_overhead = None
+        if numerics:
+            # same shards, headline depth, sentinels on EVERY step: the
+            # measured per-step tax of the numerics flight recorder
+            # (noise floor applies — on small CPU runs a slightly
+            # negative reading means "within noise, effectively free")
+            head_depth = max(dispatch_depths)
+            rec_nm = one_run(head_depth, numerics_freq=1)["recorder"]
+            n = max(4, max_steps // 2)
+            step_nm = rec_nm.mean_time("step", n)
+            base_s = raw_step_s[head_depth]
+            if base_s:
+                nm_overhead = (step_nm - base_s) / base_s
     head = max(rows, key=lambda r: r["dispatch_depth"])  # deepest = headline
     result = {
         "metric": f"alexnet_e2e_images_per_sec_{n_dev}chip",
@@ -410,6 +435,8 @@ def bench_e2e(max_steps: int = 48, batch: int = 0,
         "batch": batch,
         "max_steps": max_steps,
     }
+    if nm_overhead is not None:
+        result["numerics_overhead_frac"] = round(nm_overhead, 4)
     if len(rows) > 1:
         result["dispatch_sweep"] = rows
     return result
@@ -544,6 +571,11 @@ def main() -> int:
                          "1,4,8) over the same shard files; emits the "
                          "per-depth table as dispatch_sweep in the "
                          "bench JSON, headline = deepest")
+    ap.add_argument("--numerics-overhead", action="store_true",
+                    help="e2e mode: also run the headline depth with "
+                         "--numerics-freq 1 and report "
+                         "numerics_overhead_frac (the measured step-"
+                         "time cost of the in-graph sentinels)")
     ap.add_argument("--ns", default=None,
                     help="scaling mode: comma-separated device counts "
                          "(default 1,2,4,8; the verdict-3 extension runs "
@@ -562,7 +594,8 @@ def main() -> int:
             tuple(int(k) for k in args.dispatch_depths.split(","))
             if args.dispatch_depths else (args.dispatch_depth,)
         )
-        result = bench_e2e(max_steps=args.steps or 48, dispatch_depths=depths)
+        result = bench_e2e(max_steps=args.steps or 48, dispatch_depths=depths,
+                           numerics=args.numerics_overhead)
     else:
         ns = tuple(int(n) for n in args.ns.split(",")) if args.ns else (1, 2, 4, 8)
         result = bench_scaling(ns=ns, steps=args.steps or 4)

@@ -139,7 +139,7 @@ def test_gosgd_round_cost_is_one_ppermute(mesh8):
     x, y = _batch(model)
     eng = GOSGDEngine(model, mesh8, p_push=0.5)
     state = eng.init_state(jax.random.PRNGKey(0))
-    jaxpr = jax.make_jaxpr(eng._step_gossip)(
+    jaxpr = jax.make_jaxpr(eng._steps[(True, False)])(
         state, put_global_batch(mesh8, x), put_global_batch(mesh8, y),
         jax.random.PRNGKey(1),
     )

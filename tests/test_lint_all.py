@@ -1,0 +1,40 @@
+"""tools/lint_all.py: the one-command CI lint (hot-loop + telemetry
+schemas) — wired as a tier-1 test so the tree can never merge with a
+train-loop host sync or a schema-drifting telemetry emitter."""
+
+import json
+
+from theanompi_tpu.tools.lint_all import main, telemetry_files
+
+
+def test_lint_all_passes_on_the_tree():
+    """The committed tree must be lint-clean: worker train loops free of
+    host syncs, every committed telemetry JSONL schema-valid."""
+    assert main([]) == 0
+
+
+def test_telemetry_discovery_skips_caches(tmp_path):
+    (tmp_path / ".jax_cache").mkdir()
+    (tmp_path / ".jax_cache" / "junk.jsonl").write_text("not json\n")
+    (tmp_path / "run.jsonl").write_text(
+        json.dumps({"kind": "train", "step": 1, "loss": 1.0}) + "\n"
+    )
+    (tmp_path / "heartbeat_rank0.json").write_text(
+        json.dumps({"kind": "heartbeat", "rank": 0, "t": 1.0, "step": 1,
+                    "pid": 42}) + "\n"
+    )
+    files = telemetry_files([str(tmp_path)])
+    names = sorted(f.split("/")[-1] for f in files)
+    assert names == ["heartbeat_rank0.json", "run.jsonl"]
+
+
+def test_lint_all_fails_on_bad_telemetry(tmp_path):
+    (tmp_path / "bad.jsonl").write_text(
+        json.dumps({"kind": "train"}) + "\n"  # missing required step
+    )
+    assert main([str(tmp_path)]) == 1
+
+
+def test_lint_all_ok_when_no_telemetry(tmp_path, capsys):
+    assert main([str(tmp_path)]) == 0
+    assert "no telemetry files" in capsys.readouterr().out

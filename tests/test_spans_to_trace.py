@@ -1,0 +1,72 @@
+"""tools/spans_to_trace.py: span JSONL -> Chrome/Perfetto trace_event
+JSON — one process per rank, amortized spans on their own lane."""
+
+import json
+
+from theanompi_tpu.tools.spans_to_trace import convert, discover, main
+
+
+def _write_spans(path, rank, rows):
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps({"rank": rank, **r}) + "\n")
+
+
+def test_convert_spans_and_lanes(tmp_path):
+    p = tmp_path / "spans_rank0.jsonl"
+    _write_spans(p, 0, [
+        {"kind": "span", "name": "step", "t0": 100.0, "dur": 0.5, "depth": 0},
+        {"kind": "span", "name": "checkpoint_write", "t0": 100.1,
+         "dur": 0.2, "depth": 1},
+        {"kind": "span", "name": "step", "t0": 101.0, "dur": 0.4,
+         "depth": 0, "amortized": True},
+        {"kind": "span_summary", "t0": 100.0, "wall_s": 2.0,
+         "fractions": {"step": 0.45}, "totals_s": {"step": 0.9},
+         "counts": {"step": 2}},
+    ])
+    trace = convert([str(p)])
+    evs = trace["traceEvents"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert len(xs) == 3
+    # microsecond conversion + per-lane routing
+    bracketed = [e for e in xs if not e["args"]["amortized"]]
+    assert all(e["tid"] == 0 for e in bracketed)
+    amort = [e for e in xs if e["args"]["amortized"]]
+    assert len(amort) == 1 and amort[0]["tid"] == 1
+    assert amort[0]["ts"] == 101.0 * 1e6 and amort[0]["dur"] == 0.4 * 1e6
+    # nested span keeps its depth in args
+    assert any(e["args"]["depth"] == 1 for e in xs)
+    # summary rides as a process-scoped instant with the fractions
+    inst = [e for e in evs if e["ph"] == "i"]
+    assert len(inst) == 1 and inst[0]["args"]["fractions"] == {"step": 0.45}
+    # rank metadata present
+    meta = {(e["name"], e.get("tid")) for e in evs if e["ph"] == "M"}
+    assert ("process_name", None) in meta
+    assert ("thread_name", 0) in meta and ("thread_name", 1) in meta
+
+
+def test_multi_rank_pids_and_discover(tmp_path):
+    _write_spans(tmp_path / "spans_rank0.jsonl", 0, [
+        {"kind": "span", "name": "step", "t0": 1.0, "dur": 0.1, "depth": 0},
+    ])
+    _write_spans(tmp_path / "spans_rank3.jsonl", 3, [
+        {"kind": "span", "name": "step", "t0": 1.0, "dur": 0.1, "depth": 0},
+    ])
+    files = discover([str(tmp_path)])
+    assert len(files) == 2
+    trace = convert(files)
+    pids = {e["pid"] for e in trace["traceEvents"] if e["ph"] == "X"}
+    assert pids == {0, 3}  # rank parsed from the filename
+
+
+def test_main_writes_valid_json(tmp_path, capsys):
+    _write_spans(tmp_path / "spans_rank0.jsonl", 0, [
+        {"kind": "span", "name": "step", "t0": 1.0, "dur": 0.1, "depth": 0},
+        {"not": "json-span"},  # junk lines are skipped, not fatal
+    ])
+    out = tmp_path / "trace.json"
+    assert main([str(tmp_path), "-o", str(out)]) == 0
+    trace = json.loads(out.read_text())
+    assert trace["displayTimeUnit"] == "ms"
+    assert sum(1 for e in trace["traceEvents"] if e["ph"] == "X") == 1
+    assert "1 spans" in capsys.readouterr().out

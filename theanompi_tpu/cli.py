@@ -136,6 +136,28 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-snapshot-freq", type=int, default=0,
                    help="write a metrics snapshot every N steps (0 = epoch "
                         "boundaries only); requires --obs-dir")
+    p.add_argument("--numerics-freq", type=int, default=0,
+                   help="numerics flight recorder: compute in-graph "
+                        "sentinels (grad/update/param norms, fused "
+                        "non-finite count, per-rule divergence gauge) "
+                        "every N steps inside the compiled step — they "
+                        "drain through the dispatch pipeline, zero new "
+                        "host syncs; 0 = off. GoSGD's divergence gauge "
+                        "costs a param-sized pmean per numerics step, so "
+                        "raise N on that rule")
+    p.add_argument("--flight-window", type=int, default=64,
+                   help="flight recorder: keep the last N drained step "
+                        "records in a ring; an anomaly or stall dumps "
+                        "them as <obs-dir>/anomaly_rank{r}/ with thread "
+                        "stacks, span summary, optional state checkpoint "
+                        "and an armed device trace")
+    p.add_argument("--on-anomaly", choices=["record", "dump", "halt"],
+                   default="dump",
+                   help="what a detected numerics anomaly (NaN/Inf, EWMA "
+                        "spike) does: record = anomaly JSONL + gauges "
+                        "only; dump = also write the flight-recorder "
+                        "triage bundle (default); halt = dump, then stop "
+                        "training with a NumericsAnomaly error")
     p.add_argument("--avg-freq", type=int, default=None,
                    help="EASGD/GoSGD: steps between exchanges (reference avg_freq)")
     p.add_argument("--group-size", type=int, default=None,
@@ -268,6 +290,8 @@ def main(argv=None) -> int:
     if (args.stall_timeout or args.metrics_snapshot_freq) and not args.obs_dir:
         print("WARNING: --stall-timeout/--metrics-snapshot-freq need "
               "--obs-dir; observability is off", flush=True)
+    # (--numerics-freq without --obs-dir warns inside run_training,
+    # which covers API callers too)
     summary = run_training(
         rule=args.rule.lower(),
         model_cls=model_cls,
@@ -302,6 +326,9 @@ def main(argv=None) -> int:
         obs_dir=args.obs_dir,
         stall_timeout=args.stall_timeout,
         metrics_snapshot_freq=args.metrics_snapshot_freq,
+        numerics_freq=args.numerics_freq,
+        flight_window=args.flight_window,
+        on_anomaly=args.on_anomaly,
         **rule_kwargs,
     )
     print(json.dumps({k: v for k, v in summary.items() if k != "state"}, default=str))

@@ -170,6 +170,18 @@ def run_training(
     obs_dir: Optional[str] = None,
     stall_timeout: float = 0.0,
     metrics_snapshot_freq: int = 0,
+    # numerics flight recorder (obs/numerics.py, obs/flight.py):
+    # numerics_freq > 0 compiles the sentinel gauges into every Nth
+    # step (grad/update/param norms, fused non-finite count, per-rule
+    # divergence) — they drain through the dispatch pipeline, zero new
+    # host syncs; anomalies (NaN/Inf, EWMA spikes) are detected at
+    # drain time and handled per on_anomaly: 'record' (log + gauges),
+    # 'dump' (also write the anomaly_rank{r}/ triage bundle), 'halt'
+    # (dump, then stop training). flight_window sizes the ring of
+    # drained step records the bundle preserves.
+    numerics_freq: int = 0,
+    flight_window: int = 64,
+    on_anomaly: str = "dump",
     # persistent XLA compilation cache: repeated runs (bench sweeps,
     # requeued jobs) skip recompiling identical programs
     compile_cache_dir: Optional[str] = None,
@@ -672,11 +684,23 @@ def run_training(
     # Created HERE, immediately before the try whose finally closes it:
     # any earlier raise (resume mismatch, layout guard, init OOM) must
     # not leak its threads / open files / the process-global span hook.
+    nfreq = max(0, int(numerics_freq))
+    if nfreq and obs_dir is None:
+        print(
+            f"[rank {jax.process_index()}] WARNING: --numerics-freq "
+            f"without --obs-dir: sentinels and anomaly detection run "
+            f"(on_anomaly={on_anomaly!r} is honored) but no numerics "
+            "telemetry or flight dump can be written",
+            flush=True,
+        )
     obs = Observability(
         obs_dir,
         rank=jax.process_index(),
         stall_timeout=stall_timeout,
         snapshot_freq=metrics_snapshot_freq,
+        numerics_freq=nfreq,
+        flight_window=flight_window,
+        on_anomaly=on_anomaly,
     )
     if obs.enabled:
         # bracket delegation: timing histograms into the obs registry,
@@ -692,15 +716,41 @@ def run_training(
             except Exception as e:  # noqa: BLE001
                 print(f"[obs] traffic model unavailable for {rule!r}: "
                       f"{e!r}", flush=True)
+        if nfreq and hasattr(engine, "numerics_model"):
+            # ... and its numerics declaration (obs/numerics.py):
+            # which sentinels ride the step, which divergence gauge
+            # the rule supports, what extra wire the gauge costs
+            try:
+                obs.set_numerics_model(engine.numerics_model(state))
+            except Exception as e:  # noqa: BLE001
+                print(f"[obs] numerics model unavailable for {rule!r}: "
+                      f"{e!r}", flush=True)
+
+    def _flight_state_saver(dump_dir):
+        # best-effort param-state capture into the triage bundle (the
+        # anomalous step's params/opt state, NaNs and all); closure
+        # reads the CURRENT state/step — the dump happens at drain
+        # time, on the driver thread
+        sync_save(dump_dir, state, step_count, rng=rng, keep=1)
+
+    obs.set_flight_state_saver(_flight_state_saver)
     from theanompi_tpu.utils.dispatch import MetricsDispatcher
 
     # Async dispatch pipeline (utils/dispatch.py): the ONLY
     # host<->device sync in the train loops below lives in the
     # dispatcher's drain (lint: tools/check_hot_loop.py). depth=1
-    # reproduces the classic per-step sync exactly.
+    # reproduces the classic per-step sync exactly. on_row feeds each
+    # drained row (already host-side) to the flight ring + anomaly
+    # detection — numerics telemetry adds no sync of its own. Wired
+    # only when something can consume it: sentinels requested, or a
+    # stall watchdog whose dump would preserve the ring (plain obs runs
+    # keep their drain path lean).
     disp = MetricsDispatcher(
-        rec, depth=dispatch_depth, on_step_seconds=obs.note_step_seconds
+        rec, depth=dispatch_depth, on_step_seconds=obs.note_step_seconds,
+        on_row=obs.on_row
+        if (nfreq or (obs.enabled and stall_timeout > 0)) else None,
     )
+    obs.attach_dispatcher(disp)
     if disp.depth > 1 and not getattr(engine, "donates_state", False):
         print(
             f"[rank {jax.process_index()}] WARNING: engine {rule!r} does "
@@ -754,8 +804,21 @@ def run_training(
                         for _ in range(g):
                             rng, s = jax.random.split(rng)
                             subs.append(s)
+                        # numerics under fusion: the dispatch unit is
+                        # the GROUP, so the cadence gates at group
+                        # granularity — the numerics variant runs only
+                        # for groups that contain a step on the nfreq
+                        # grid (then sentinels ride every substep of
+                        # that group; per-substep gating would split
+                        # the compiled program). GoSGD's param-sized
+                        # divergence pmean is therefore still amortized
+                        # by raising --numerics-freq.
+                        nm_group = bool(nfreq) and (
+                            (step_count + g) // nfreq > step_count // nfreq
+                        )
                         state, metrics = engine.fused_train_step(
-                            state, xs, ys, jnp.stack(subs)
+                            state, xs, ys, jnp.stack(subs),
+                            numerics=nm_group,
                         )
                         step_count += g
                         epoch_steps += g
@@ -794,7 +857,15 @@ def run_training(
                         disp.note_wait(rec.end("wait"))
                         rec.profile_tick(step_count)
                         rng, sub = jax.random.split(rng)
-                        state, metrics = engine.train_step(state, xg, yg, sub)
+                        # sentinel cadence: every nfreq-th step runs the
+                        # numerics variant of the SAME compiled step
+                        # (extra scalar outputs; obs/numerics.py) — the
+                        # scalars drain with the loss, no host sync here
+                        state, metrics = engine.train_step(
+                            state, xg, yg, sub,
+                            numerics=bool(nfreq)
+                            and (step_count + 1) % nfreq == 0,
+                        )
                         step_count += 1
                         epoch_steps += 1
                         dispatch_images.append(batch)
@@ -869,6 +940,9 @@ def run_training(
                 val_metrics = {k: float(v) / n_val for k, v in val_accum.items()}
                 rec.val_metrics(epoch, val_metrics)
                 summary["val"] = val_metrics
+                # a non-finite val metric is an anomaly even when the
+                # sentinel cadence skipped the poisoning train step
+                obs.check_val_metrics(epoch, step_count, val_metrics)
 
             if ckpt_dir and (epoch + 1) % ckpt_every_epochs == 0:
                 rec.start("checkpoint")
@@ -950,6 +1024,10 @@ def run_training(
     # spent BLOCKED on device syncs (the per-step tax dispatch_depth>1
     # removes; bench.py reports this as host_blocked_frac)
     summary["dispatch_depth"] = disp.depth
+    # numerics flight recorder: anomalies seen at drain time (0 when
+    # numerics is off) — a nonzero count with policy 'record'/'dump' is
+    # the "check the triage bundle" signal for sweep drivers
+    summary["anomalies"] = obs.anomaly_count
     summary["host_blocked_s"] = round(disp.host_blocked_s, 6)
     summary["train_loop_s"] = round(train_loop_s, 6)
     summary["host_blocked_frac"] = (

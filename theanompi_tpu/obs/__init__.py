@@ -14,7 +14,14 @@ ISSUE 1):
   step for every sync rule (the comm-side peer of utils/flops.py MFU);
 - :mod:`~theanompi_tpu.obs.health` — heartbeat files + a stall
   watchdog that dumps thread stacks and arms a post-mortem device
-  trace when the global step stops advancing.
+  trace when the global step stops advancing;
+- :mod:`~theanompi_tpu.obs.numerics` — in-graph numerics sentinels
+  (grad/update/param norms, fused non-finite count, per-rule
+  divergence gauges) + host-side EWMA/NaN anomaly detection evaluated
+  at dispatch-drain time;
+- :mod:`~theanompi_tpu.obs.flight` — flight recorder: bounded ring of
+  the last N drained step records, dumped as an ``anomaly_rank{r}/``
+  triage bundle when a sentinel fires or the stall watchdog trips.
 
 :class:`Observability` is the driver-facing facade
 (``launch/worker.py``): one object that owns the per-run registry, the
@@ -28,9 +35,25 @@ On-disk layout under ``obs_dir`` (schemas:
     metrics.jsonl           rank-0 metric snapshots (kind=metrics)
     metrics.prom            rank-0 Prometheus text exposition (atomic)
     spans_rank{r}.jsonl     per-rank span + span_summary lines
-    heartbeat_rank{r}.json  per-rank liveness (atomic rewrite)
+    heartbeat_rank{r}.json  per-rank liveness (atomic rewrite; carries
+                            dispatch_in_flight + last_drained_step so a
+                            wedged device program — drains stop, ring
+                            full — reads apart from a stalled host
+                            driver, whose dispatches stop too)
     stall_rank{r}.json/.txt stall watchdog reports (thread stacks)
     postmortem_rank{r}/     jax.profiler trace armed at stall time
+    numerics_rank{r}.jsonl  kind=numerics sentinel rows (one per
+                            drained numerics step: tmpi gauge values
+                            under ``metrics``, non-finite keys named in
+                            ``nonfinite_keys``) + kind=anomaly records
+    anomaly_rank{r}/        flight-recorder triage bundle (ring.jsonl,
+                            report.json, stacks.txt, span_summary.json,
+                            optional state/ checkpoint + postmortem/
+                            trace) — written once per run at the FIRST
+                            anomaly; a stall-watchdog trip writes its
+                            own anomaly_rank{r}-stall/ bundle, so a
+                            benign stall never consumes the anomaly's
+                            forensic budget
 """
 
 from __future__ import annotations
@@ -49,13 +72,21 @@ from theanompi_tpu.obs.comm import (  # noqa: F401
     pytree_num_elements,
     zero1_traffic,
 )
+from theanompi_tpu.obs.flight import FlightRecorder, sanitize_record  # noqa: F401
 from theanompi_tpu.obs.health import Heartbeat, StallWatchdog  # noqa: F401
 from theanompi_tpu.obs.metrics import (  # noqa: F401
     REGISTRY,
     MetricsRegistry,
     result_to_snapshot,
 )
+from theanompi_tpu.obs.numerics import (  # noqa: F401
+    AnomalyDetector,
+    NumericsAnomaly,
+    NumericsModel,
+)
 from theanompi_tpu.obs.spans import SpanRecorder, obs_span  # noqa: F401
+
+ANOMALY_POLICIES = ("record", "dump", "halt")
 
 
 class Observability:
@@ -80,17 +111,41 @@ class Observability:
         snapshot_freq: int = 0,
         heartbeat_interval: float = 5.0,
         arm_profiler: bool = True,
+        numerics_freq: int = 0,
+        flight_window: int = 64,
+        on_anomaly: str = "dump",
     ):
+        if on_anomaly not in ANOMALY_POLICIES:
+            raise ValueError(
+                f"on_anomaly must be one of {ANOMALY_POLICIES}, "
+                f"got {on_anomaly!r}"
+            )
         self.obs_dir = obs_dir
         self.rank = rank
         self.enabled = obs_dir is not None
         self.snapshot_freq = max(0, int(snapshot_freq))
+        self.numerics_freq = max(0, int(numerics_freq))
+        self.on_anomaly = on_anomaly
         self.registry = MetricsRegistry()
         self.spans: Optional[SpanRecorder] = None
         self.heartbeat: Optional[Heartbeat] = None
         self.watchdog: Optional[StallWatchdog] = None
         self.traffic: Optional[TrafficModel] = None
+        self.numerics: Optional[NumericsModel] = None
+        self.flight: Optional[FlightRecorder] = None
+        # detection is a host-side float check per drained row — active
+        # whenever sentinels are requested, even with no obs_dir (the
+        # halt policy must work without telemetry output)
+        self.detector = (
+            AnomalyDetector() if self.numerics_freq > 0 else None
+        )
+        self.anomaly_count = 0
+        self._anomaly_lines = 0
+        self._anomaly_lines_max = 200  # NaN persists once params poison:
+        # cap the per-rank anomaly log rather than writing one line per
+        # step for the rest of the run
         self._metrics_f = None
+        self._numerics_f = None
         self._prom_path = None
         self._last_snapshot_step = 0
         self._closed = False
@@ -108,11 +163,29 @@ class Observability:
             # one metrics sink per run (reference: rank-0 recorder save)
             self._metrics_f = open(os.path.join(obs_dir, "metrics.jsonl"), "a")
             self._prom_path = os.path.join(obs_dir, "metrics.prom")
+        if flight_window and flight_window > 0:
+            self.flight = FlightRecorder(
+                obs_dir, rank=rank, window=flight_window,
+                arm_profiler=arm_profiler,
+            )
+            self.flight.spans = self.spans
         self.heartbeat = Heartbeat(obs_dir, rank=rank,
                                    interval=heartbeat_interval)
         if stall_timeout and stall_timeout > 0:
+            flight = self.flight
+
+            def on_stall(report: dict) -> None:
+                # a tripped watchdog is a flight-dump trigger too: the
+                # ring holds the last healthy steps before the hang.
+                # No state save (a wedged device cannot be fetched) and
+                # no second profiler arm (the watchdog armed one).
+                if flight is not None:
+                    flight.dump("stall", step=report.get("step"),
+                                include_state=False, arm_profiler=False)
+
             self.watchdog = StallWatchdog(
-                stall_timeout, obs_dir, rank=rank, arm_profiler=arm_profiler
+                stall_timeout, obs_dir, rank=rank, arm_profiler=arm_profiler,
+                on_stall=on_stall,
             )
 
     # -- driver hooks --------------------------------------------------------
@@ -132,6 +205,137 @@ class Observability:
         self.registry.gauge(
             "tmpi_comm_n_workers", help="sync-rule worker count"
         ).set(tm.n_workers)
+
+    def set_numerics_model(self, nm: Optional["NumericsModel"]) -> None:
+        """Record the active rule's numerics declaration (engine-
+        declared ``numerics_model()``, the ``traffic_model`` peer) as
+        gauges, so snapshots say which sentinels ride the steps and
+        whether a divergence gauge exists for this rule."""
+        self.numerics = nm
+        if nm is None or not self.enabled:
+            return
+        for key, value in nm.as_metrics().items():
+            self.registry.gauge(
+                f"tmpi_{key}",
+                help=f"{nm.rule} numerics declaration (obs/numerics.py)",
+            ).set(value)
+        self.registry.gauge(
+            "tmpi_numerics_freq",
+            help="sentinel cadence (steps; 0 = numerics off)",
+        ).set(self.numerics_freq)
+
+    def set_flight_state_saver(self, saver) -> None:
+        """Install the driver's ``saver(dump_dir)`` that checkpoints the
+        current train state into an anomaly bundle (skipped for
+        stall-triggered dumps — a wedged device cannot be fetched)."""
+        if self.flight is not None:
+            self.flight.state_saver = saver
+
+    def attach_dispatcher(self, disp) -> None:
+        """Expose the dispatch pipeline's live counters through the
+        heartbeat: ``dispatch_in_flight`` + ``last_drained_step`` let a
+        stall-report reader tell a wedged DEVICE program (dispatches
+        advance then stop with the ring pinned full) from a stalled
+        HOST driver (dispatches stop, in-flight falls to zero)."""
+        if self.heartbeat is not None:
+            self.heartbeat.set_extra(
+                lambda: {"dispatch_in_flight": int(disp.in_flight),
+                         "last_drained_step": int(disp.last_drained_step)}
+            )
+
+    def on_row(self, step: int, metrics: dict, numerics: dict) -> None:
+        """Per drained row (utils/dispatch.py ``on_row``): feed the
+        flight ring, refresh the sentinel gauges, and run anomaly
+        detection — all on host floats the drain already fetched, so
+        the hot loop gains zero syncs. Raises :class:`NumericsAnomaly`
+        under ``--on-anomaly halt`` (after the dump landed)."""
+        rec = sanitize_record(self.rank, step, {**metrics, **numerics})
+        if self.flight is not None:
+            self.flight.record(rec)
+        if numerics and self.enabled:
+            for k, v in numerics.items():
+                self.registry.gauge(
+                    f"tmpi_{k}", help="in-graph numerics sentinel "
+                                      "(obs/numerics.py)"
+                ).set(v)
+        if numerics:
+            self._write_numerics_line(rec)
+        if self.detector is None:
+            return
+        anomalies = self.detector.observe(step, metrics, numerics)
+        if anomalies:
+            self._handle_anomalies(step, anomalies)
+
+    def check_val_metrics(self, epoch: int, step: int, metrics: dict) -> None:
+        """Epoch-end hook: a non-finite validation metric is an anomaly
+        too (a train-side NaN can slip between sentinel steps when
+        ``--numerics-freq > 1``; the val epoch always sees it)."""
+        if self.detector is None:
+            return
+        import math as _math
+
+        bad = {k: v for k, v in metrics.items()
+               if not _math.isfinite(float(v))}
+        if bad:
+            self._handle_anomalies(step, [
+                {"metric": f"val_{k}", "reason": "nonfinite",
+                 "value_repr": repr(float(v)), "step": int(step),
+                 "epoch": int(epoch)}
+                for k, v in bad.items()
+            ])
+
+    def _numerics_sink(self):
+        """Lazy-opened per-rank numerics/anomaly JSONL (shared by the
+        sentinel-row and anomaly-record writers so the two streams can
+        never diverge into different files)."""
+        if self._numerics_f is None:
+            self._numerics_f = open(
+                os.path.join(self.obs_dir,
+                             f"numerics_rank{self.rank}.jsonl"), "a"
+            )
+        return self._numerics_f
+
+    def _write_numerics_line(self, rec: dict) -> None:
+        if not self.enabled or self._closed:
+            return
+        import json as _json
+
+        f = self._numerics_sink()
+        f.write(_json.dumps(rec) + "\n")
+        f.flush()
+
+    def _handle_anomalies(self, step: int, anomalies: list) -> None:
+        self.anomaly_count += len(anomalies)
+        if self.enabled:
+            self.registry.counter(
+                "tmpi_anomalies_total",
+                help="numerics anomalies detected at drain time",
+            ).inc(len(anomalies))
+        import json as _json
+        import time as _time
+
+        for a in anomalies:
+            if self._anomaly_lines >= self._anomaly_lines_max:
+                break
+            self._anomaly_lines += 1
+            line = {"kind": "anomaly", "rank": self.rank, "t": _time.time(),
+                    "policy": self.on_anomaly, **a}
+            if self.enabled and not self._closed:
+                f = self._numerics_sink()
+                f.write(_json.dumps(line) + "\n")
+                f.flush()
+            else:
+                print(f"[rank {self.rank}] numerics anomaly: {line}",
+                      file=sys.stderr, flush=True)
+        if self.on_anomaly in ("dump", "halt") and self.flight is not None:
+            self.flight.dump("anomaly", step=step, anomalies=anomalies)
+        if self.on_anomaly == "halt":
+            names = sorted({a["metric"] for a in anomalies})
+            raise NumericsAnomaly(
+                f"numerics anomaly at step {step}: {names} "
+                f"({len(anomalies)} trigger(s); triage bundle: "
+                f"{self.flight.dir if self.flight else 'no obs_dir'})"
+            )
 
     def on_step(self, step: int, substeps: int = 1,
                 step_seconds: Optional[float] = None) -> None:
@@ -217,3 +421,6 @@ class Observability:
         if self._metrics_f is not None:
             self._metrics_f.close()
             self._metrics_f = None
+        if self._numerics_f is not None:
+            self._numerics_f.close()
+            self._numerics_f = None

@@ -1,0 +1,211 @@
+"""Flight recorder: bounded ring of drained step records + triage dumps.
+
+A numerics anomaly three hours into a pod run is useless as a stack
+trace: by the time a human looks, the interesting state — what the last
+N steps' losses and grad norms looked like, which step went non-finite,
+what every thread was doing — is gone. :class:`FlightRecorder` keeps a
+bounded ring of the last ``window`` drained step records (training
+metrics + numerics sentinels + divergence gauges, exactly what the
+dispatch pipeline drains anyway) and, when a sentinel fires or the
+stall watchdog trips, writes a self-contained triage bundle::
+
+    <obs_dir>/anomaly_rank{r}/
+        ring.jsonl          the ring contents (kind=numerics records)
+        report.json         reason, anomalous step, anomaly list,
+                            thread stacks, ring span
+        stacks.txt          human-readable thread stacks
+        span_summary.json   the span recorder's fractions at dump time
+        state/              optional param-state checkpoint (the
+                            driver's saver callback; skipped for
+                            stall dumps — saving needs a live device)
+        postmortem/         armed jax.profiler capture (anomaly dumps
+                            only; stall dumps already armed one)
+
+One dump per run PER REASON: the first anomaly is the forensic moment
+(later anomalies in the same run are almost always the first one's
+fallout), but a benign stall trip — a watchdog timeout sized under a
+long compile pause — must not consume the budget a later genuine
+numerics anomaly needs. Stall-triggered bundles therefore land in
+``anomaly_rank{r}-stall/`` and anomaly bundles keep the pristine
+``anomaly_rank{r}/``; subsequent fires of an already-dumped reason
+still count and log through the obs facade.
+
+Ring records are schema-valid ``numerics`` lines
+(tools/check_obs_schema.py): non-finite values cannot ride a JSON
+numeric map, so they are dropped from ``metrics`` and named in the
+``nonfinite_keys`` scalar field — the non-finite COUNT sentinel stays
+numeric, so the anomalous step remains machine-findable.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from theanompi_tpu.obs.health import arm_profiler_capture, thread_stacks
+from theanompi_tpu.obs.metrics import atomic_write_text
+
+
+def sanitize_record(rank: int, step: int, metrics: dict,
+                    t: Optional[float] = None) -> dict:
+    """One JSONL-ready ``numerics`` record: finite values only in the
+    numeric map, non-finite keys listed in ``nonfinite_keys``."""
+    finite: dict[str, float] = {}
+    bad: list[str] = []
+    for k, v in metrics.items():
+        v = float(v)
+        if math.isfinite(v):
+            finite[k] = v
+        else:
+            bad.append(k)
+    rec = {
+        "kind": "numerics",
+        "rank": int(rank),
+        "t": time.time() if t is None else t,
+        "step": int(step),
+        "metrics": finite,
+    }
+    if bad:
+        rec["nonfinite_keys"] = ",".join(sorted(bad))
+    return rec
+
+
+class FlightRecorder:
+    def __init__(
+        self,
+        obs_dir: str,
+        rank: int = 0,
+        window: int = 64,
+        arm_profiler: bool = True,
+        capture_s: float = 2.0,
+        state_saver: Optional[Callable[[str], None]] = None,
+    ):
+        self.dir = os.path.join(obs_dir, f"anomaly_rank{rank}")
+        self.rank = rank
+        self.window = max(1, int(window))
+        self.arm_profiler = arm_profiler
+        self.capture_s = capture_s
+        # driver-installed: state_saver(dump_dir) persists the current
+        # train state into the bundle (worker.py wires a checkpoint save)
+        self.state_saver = state_saver
+        self.spans = None  # obs facade installs its SpanRecorder
+        self._ring: deque = deque(maxlen=self.window)
+        self._lock = threading.Lock()
+        self.dump_count = 0
+        self._dumped_reasons: set = set()
+
+    def record(self, rec: dict) -> None:
+        """Append one drained step record (already sanitized — see
+        :func:`sanitize_record`). Called from the dispatcher drain on
+        the driver thread; the lock only guards against a concurrent
+        watchdog-triggered dump."""
+        with self._lock:
+            self._ring.append(rec)
+
+    def dump(
+        self,
+        reason: str,
+        step: Optional[int] = None,
+        anomalies: Optional[list] = None,
+        include_state: bool = True,
+        arm_profiler: Optional[bool] = None,
+    ) -> Optional[str]:
+        """Write the triage bundle; returns its path, or None when this
+        run already dumped for this ``reason`` (first fire wins — and a
+        benign stall cannot consume a later anomaly's budget: each
+        reason owns its own bundle dir). Never raises — forensics must
+        not take down the run they describe."""
+        with self._lock:
+            self.dump_count += 1
+            if reason in self._dumped_reasons:
+                return None
+            # claimed inside the lock (a concurrent watchdog fire must
+            # not double-write), RELEASED on failure below — a transient
+            # write error (ENOSPC) must not consume the run's only
+            # budget for this reason
+            self._dumped_reasons.add(reason)
+            entries = list(self._ring)
+        try:
+            return self._write(reason, step, anomalies or [], entries,
+                               include_state, arm_profiler)
+        except Exception as e:  # noqa: BLE001 — diagnostics only
+            import sys
+
+            print(f"[rank {self.rank}] flight dump failed: {e!r}",
+                  file=sys.stderr, flush=True)
+            with self._lock:
+                self._dumped_reasons.discard(reason)
+            return None
+
+    def _write(self, reason, step, anomalies, entries, include_state,
+               arm_profiler) -> str:
+        # each reason owns its bundle: anomalies keep the canonical
+        # anomaly_rank{r}/, other triggers (stall) get a -{reason} dir
+        out_dir = self.dir if reason == "anomaly" else f"{self.dir}-{reason}"
+        os.makedirs(out_dir, exist_ok=True)
+        with open(os.path.join(out_dir, "ring.jsonl"), "w") as f:
+            for rec in entries:
+                f.write(json.dumps(rec) + "\n")
+        stacks = thread_stacks()
+        report = {
+            "reason": reason,
+            "rank": self.rank,
+            "t": time.time(),
+            "step": None if step is None else int(step),
+            "anomalies": anomalies,
+            "ring_len": len(entries),
+            "ring_steps": [r.get("step") for r in entries[:1]]
+            + ([r.get("step") for r in entries[-1:]] if len(entries) > 1 else []),
+            "stacks": stacks,
+        }
+        txt = [
+            f"FLIGHT DUMP ({reason}) at step {step}, rank {self.rank}",
+            "",
+            "anomalies:",
+        ] + [f"  {a}" for a in anomalies] + [""]
+        for name, frames in stacks.items():
+            txt.append(f"--- {name} ---")
+            txt += frames + [""]
+        atomic_write_text(os.path.join(out_dir, "stacks.txt"),
+                          "\n".join(txt) + "\n")
+        if self.spans is not None:
+            try:
+                atomic_write_text(
+                    os.path.join(out_dir, "span_summary.json"),
+                    json.dumps(self.spans.summary()),
+                )
+            except Exception:  # noqa: BLE001 — spans may already be closed
+                pass
+        if include_state and self.state_saver is not None:
+            state_dir = os.path.join(out_dir, "state")
+            try:
+                self.state_saver(state_dir)
+                report["state_dir"] = state_dir
+            except Exception as e:  # noqa: BLE001 — a poisoned device
+                # value can make the save itself raise; the ring and
+                # stacks are the critical payload
+                report["state_error"] = repr(e)
+        if (self.arm_profiler if arm_profiler is None else arm_profiler):
+            # wait_at_exit: an anomaly dump's runtime is alive (a row
+            # just drained from it), and halt exits the process right
+            # after — a bounded atexit join lets the capture complete
+            # instead of segfaulting mid-trace at interpreter teardown
+            report["postmortem_trace"] = arm_profiler_capture(
+                os.path.join(out_dir, "postmortem"),
+                capture_s=self.capture_s, rank=self.rank, wait_at_exit=True,
+            )
+        atomic_write_text(os.path.join(out_dir, "report.json"),
+                          json.dumps(report))
+        import sys
+
+        print(
+            f"[rank {self.rank}] FLIGHT RECORDER: {reason} at step {step} — "
+            f"triage bundle ({len(entries)} ring records) in {out_dir}",
+            file=sys.stderr, flush=True,
+        )
+        return out_dir

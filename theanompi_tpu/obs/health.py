@@ -50,6 +50,48 @@ def _atomic_write_json(path: str, obj: dict) -> None:
     atomic_write_text(path, json.dumps(obj))
 
 
+def arm_profiler_capture(trace_dir: str, capture_s: float = 2.0,
+                         rank: int = 0, wait_at_exit: bool = False) -> str:
+    """Best-effort ``jax.profiler`` capture of a ``capture_s`` window on
+    a daemon thread — armed-and-forgotten, shared by the stall watchdog
+    and the flight recorder (obs/flight.py). start/stop can themselves
+    BLOCK on a wedged runtime (observed: stop_trace hangs on the CPU
+    backend mid-stall), so nothing waits on the thread; any failure
+    (already tracing, wedged runtime) is swallowed. Returns the target
+    directory immediately.
+
+    ``wait_at_exit``: run the capture on a NON-daemon thread so a
+    process that exits right after arming (the ``--on-anomaly halt``
+    path) lets the capture finish instead of tearing the interpreter
+    down mid-trace (measured: a daemon capture killed at finalization
+    segfaults the CPU backend — an atexit join does NOT save it, the
+    thread never gets scheduled again once shutdown starts). Callers
+    must only set this when the runtime is known-alive (an anomaly dump
+    just drained a row from it); stall dumps keep the daemon default —
+    their runtime is presumed wedged and a hung stop_trace must never
+    block exit."""
+
+    def capture():
+        try:
+            import jax
+
+            os.makedirs(trace_dir, exist_ok=True)
+            jax.profiler.start_trace(trace_dir)
+            time.sleep(capture_s)
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001 — an armed Recorder
+            # trace (already tracing) or a wedged runtime must not
+            # surface as a crash from a diagnostics thread
+            print(f"[rank {rank}] post-mortem trace capture "
+                  f"failed: {e!r}", file=sys.stderr, flush=True)
+
+    threading.Thread(
+        target=capture, name=f"tmpi-postmortem-r{rank}",
+        daemon=not wait_at_exit,
+    ).start()
+    return trace_dir
+
+
 def thread_stacks() -> dict[str, list[str]]:
     """``{thread_name: [formatted frames...]}`` for every live Python
     thread (the stall report payload)."""
@@ -70,6 +112,7 @@ class Heartbeat:
         self.rank = rank
         self.interval = max(0.2, float(interval))
         self._step = 0
+        self._extra: Optional[Callable[[], dict]] = None
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name=f"tmpi-heartbeat-r{rank}", daemon=True
@@ -79,14 +122,30 @@ class Heartbeat:
     def set_step(self, step: int) -> None:
         self._step = int(step)
 
+    def set_extra(self, provider: Optional[Callable[[], dict]]) -> None:
+        """Install a provider whose dict merges into every beat — the
+        driver wires the dispatch pipeline's ``dispatch_in_flight`` /
+        ``last_drained_step`` here, so a stall report reader can tell a
+        wedged DEVICE program (step advances, drains stop: in-flight
+        pinned at depth) from a stalled HOST driver (dispatches stop:
+        in-flight falls to 0 and stays)."""
+        self._extra = provider
+
     def _beat(self) -> None:
-        _atomic_write_json(self.path, {
+        payload = {
             "kind": "heartbeat",
             "rank": self.rank,
             "t": time.time(),
             "step": self._step,
             "pid": os.getpid(),
-        })
+        }
+        provider = self._extra
+        if provider is not None:
+            try:
+                payload.update(provider())
+            except Exception:  # noqa: BLE001 — liveness must not die
+                pass           # because a telemetry getter raced a close
+        _atomic_write_json(self.path, payload)
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -209,35 +268,16 @@ class StallWatchdog:
             self._on_stall(report)
 
     def _arm_postmortem(self) -> Optional[str]:
-        """Best-effort ``jax.profiler`` capture of a ``capture_s`` window
-        DURING the stall: if the device is actually executing (slow
-        collective, DCN congestion) the trace shows it. start/stop can
-        themselves BLOCK on a wedged runtime (observed: stop_trace hangs
-        on the CPU backend mid-stall), so the capture runs on its own
-        daemon thread — armed-and-forgotten, never gating the report or
-        the watchdog loop; any failure is swallowed."""
+        """Capture a ``capture_s`` device-trace window DURING the stall
+        (shared :func:`arm_profiler_capture` machinery): if the device
+        is actually executing (slow collective, DCN congestion) the
+        trace shows it."""
         if not self.arm_profiler:
             return None
-        d = os.path.join(self.obs_dir, f"postmortem_rank{self.rank}")
-
-        def capture():
-            try:
-                import jax
-
-                os.makedirs(d, exist_ok=True)
-                jax.profiler.start_trace(d)
-                time.sleep(self.capture_s)
-                jax.profiler.stop_trace()
-            except Exception as e:  # noqa: BLE001 — an armed Recorder
-                # trace (already tracing) or a wedged runtime must not
-                # surface as a crash from a diagnostics thread
-                print(f"[rank {self.rank}] post-mortem trace capture "
-                      f"failed: {e!r}", file=sys.stderr, flush=True)
-
-        threading.Thread(
-            target=capture, name=f"tmpi-postmortem-r{self.rank}", daemon=True
-        ).start()
-        return d
+        return arm_profiler_capture(
+            os.path.join(self.obs_dir, f"postmortem_rank{self.rank}"),
+            capture_s=self.capture_s, rank=self.rank,
+        )
 
     def stop(self) -> None:
         self._stop.set()

@@ -1,0 +1,289 @@
+"""Numerics sentinels + host-side anomaly detection.
+
+The reference's only numerics signal was the printed loss (its recorder
+accumulated cost/error lists and nothing else); a NaN burst, a
+gradient-norm explosion, or EASGD/GoSGD replicas silently drifting
+apart all look identical to a healthy run until the loss curve is
+inspected offline. This module supplies both halves of the fix:
+
+- **In-graph sentinels** (device side): pure jnp helpers the engines
+  compile INTO their train steps when the driver requests numerics
+  (``--numerics-freq``): global grad-norm, update-norm, param-norm and
+  a fused non-finite count, plus per-rule divergence gauges (EASGD
+  center<->worker L2, GoSGD inter-replica disagreement). The resulting
+  scalars ride the step's metrics dict under the ``nm_`` prefix, so
+  they drain through the async dispatch pipeline
+  (utils/dispatch.py) with ZERO new host syncs — the same D2H fetch
+  that already carries the loss carries them
+  (tools/check_hot_loop.py enforces the train loops stay sync-free).
+
+- **Host-side detection** (drain side): :class:`AnomalyDetector`
+  evaluates each drained row — hard NaN/Inf triggers on every metric,
+  a ``> 0`` trigger on the non-finite count, and EWMA spike detectors
+  on the norm/divergence gauges — and returns ``anomaly`` records for
+  the obs facade to log, gauge, and hand to the flight recorder
+  (obs/flight.py) per the ``--on-anomaly {record,dump,halt}`` policy.
+
+Every engine declares a :class:`NumericsModel` via ``numerics_model()``
+(mirroring ``traffic_model()`` / obs/comm.py): which sentinels its
+step emits, which divergence gauge the rule supports (BSP/ZeRO/ND are
+replicated or sharded-consistent by construction — no gauge needed),
+and what extra wire the gauge costs (GoSGD's disagreement needs a
+param-sized pmean per numerics step; that is exactly what
+``--numerics-freq > 1`` amortizes).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+# metric-key namespace for in-graph sentinels: the dispatcher splits
+# these out of every drained row so recorder JSONL stays bit-identical
+# to a numerics-off run (acceptance invariant, tests/test_numerics.py)
+NM_PREFIX = "nm_"
+
+SENTINEL_KEYS = ("nm_grad_norm", "nm_update_norm", "nm_param_norm",
+                 "nm_nonfinite")
+
+
+class NumericsAnomaly(RuntimeError):
+    """Raised by the obs facade under ``--on-anomaly halt`` after the
+    flight dump landed — stops training at the first detected anomaly
+    instead of burning hours on NaN params."""
+
+
+@dataclass
+class NumericsModel:
+    """Per-engine numerics declaration (the ``traffic_model()`` peer)."""
+
+    rule: str
+    sentinels: tuple = SENTINEL_KEYS
+    divergence: Optional[str] = None  # nm_divergence semantics, or None
+    detail: dict = field(default_factory=dict)
+
+    def as_metrics(self) -> dict:
+        return {
+            "numerics_sentinels": float(len(self.sentinels)),
+            "numerics_has_divergence": float(self.divergence is not None),
+        }
+
+
+# -- in-graph sentinel helpers (call inside compiled steps only) ------------
+
+def global_norm(tree: Any):
+    """Global L2 norm of a pytree, accumulated in float32 (bf16 squares
+    overflow at ~3e38 far later than they lose precision)."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+    )
+
+
+def nonfinite_count(tree: Any):
+    """Fused count of NaN/Inf elements across every leaf (float32 so it
+    rides the metrics dict like the other scalars)."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return sum(
+        jnp.sum((~jnp.isfinite(l.astype(jnp.float32))).astype(jnp.float32))
+        for l in leaves
+    )
+
+
+def sentinel_metrics(grads: Any, updates: Any, params: Any) -> dict:
+    """The standard sentinel set over REPLICATED trees (post-sync grads,
+    optimizer updates, new params) — BSP/EASGD/GoSGD local steps, where
+    every device holds the full tree."""
+    return {
+        "nm_grad_norm": global_norm(grads),
+        "nm_update_norm": global_norm(updates),
+        "nm_param_norm": global_norm(params),
+        "nm_nonfinite": nonfinite_count(grads),
+    }
+
+
+def _spec_axes(spec) -> tuple:
+    """Mesh axis names a PartitionSpec shards over (flattened)."""
+    axes = []
+    for part in tuple(spec or ()):
+        if part is None:
+            continue
+        for a in part if isinstance(part, tuple) else (part,):
+            if a is not None:
+                axes.append(a)
+    return tuple(axes)
+
+
+def sharded_global_norm(tree: Any, specs: Any):
+    """Global L2 norm when leaves are SHARDED per ``specs`` (the ND
+    engine's tp/pipe/expert layouts): each device sums its local shard's
+    squares, psums over exactly the axes that leaf is sharded over
+    (replicated axes must NOT be summed — they would count each copy),
+    then sqrts the total. Scalar collectives only."""
+    import jax
+    from jax import lax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    specs_l = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: x is None or not isinstance(x, (dict, list))
+    )
+    total = jnp.zeros((), jnp.float32)
+    for leaf, spec in zip(leaves, specs_l):
+        s = jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+        for a in _spec_axes(spec):
+            s = lax.psum(s, a)
+        total = total + s
+    return jnp.sqrt(total)
+
+
+def sharded_nonfinite_count(tree: Any, specs: Any):
+    """Non-finite count over sharded leaves (see sharded_global_norm)."""
+    import jax
+    from jax import lax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    specs_l = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: x is None or not isinstance(x, (dict, list))
+    )
+    total = jnp.zeros((), jnp.float32)
+    for leaf, spec in zip(leaves, specs_l):
+        s = jnp.sum((~jnp.isfinite(leaf.astype(jnp.float32))).astype(jnp.float32))
+        for a in _spec_axes(spec):
+            s = lax.psum(s, a)
+        total = total + s
+    return total
+
+
+def sharded_sentinels(grads: Any, updates: Any, params: Any, specs: Any) -> dict:
+    """Sentinel set for spec-sharded trees (grads/updates/params all
+    shard like the params under ND engines)."""
+    return {
+        "nm_grad_norm": sharded_global_norm(grads, specs),
+        "nm_update_norm": sharded_global_norm(updates, specs),
+        "nm_param_norm": sharded_global_norm(params, specs),
+        "nm_nonfinite": sharded_nonfinite_count(grads, specs),
+    }
+
+
+def sentinels_across_workers(metrics: dict, axis) -> dict:
+    """Aggregate per-worker sentinel readings across a worker axis with
+    per-metric semantics (EASGD/GoSGD, whose metrics otherwise drain as
+    a blanket pmean): the non-finite COUNT psums — one worker's NaN
+    must read as >= 1, never as 1/n — and the norms combine as RMS over
+    workers, comparable in scale to a single worker's reading. Values
+    already uniform across ``axis`` (the divergence gauge) pass through
+    unchanged (RMS of a uniform value is that value). Call inside the
+    engine's shard_map only."""
+    from jax import lax
+    import jax.numpy as jnp
+
+    out = dict(metrics)
+    for k in metrics:
+        if not k.startswith(NM_PREFIX):
+            continue
+        if k == "nm_nonfinite":
+            out[k] = lax.psum(metrics[k], axis)
+        else:
+            out[k] = jnp.sqrt(lax.pmean(jnp.square(metrics[k]), axis))
+    return out
+
+
+def split_numerics(metrics: dict) -> tuple:
+    """``(plain, numerics)`` — strip ``nm_``-prefixed keys out of a
+    drained metrics dict so recorder rows stay bit-identical to a
+    numerics-off run. Cheap key scan; returns the original dict
+    untouched when no sentinels rode along."""
+    if not any(k.startswith(NM_PREFIX) for k in metrics):
+        return metrics, {}
+    plain = {k: v for k, v in metrics.items() if not k.startswith(NM_PREFIX)}
+    nm = {k: v for k, v in metrics.items() if k.startswith(NM_PREFIX)}
+    return plain, nm
+
+
+# -- host-side detection (drain time) ---------------------------------------
+
+class AnomalyDetector:
+    """Per-metric EWMA spike detection + hard non-finite triggers.
+
+    ``observe(step, metrics, numerics)`` returns a (possibly empty) list
+    of anomaly dicts. Rules:
+
+    - any non-finite value (loss, lr, any sentinel) fires ``nonfinite``;
+    - ``nm_nonfinite > 0`` fires ``nonfinite_grads`` (the fused in-graph
+      count caught NaN/Inf before it even reached the loss);
+    - norm/divergence gauges fire ``spike`` when the value exceeds
+      ``spike_factor`` x their EWMA, after ``warmup`` observations (the
+      first steps of a run legitimately swing orders of magnitude).
+
+    Stateful per metric; host-side only (runs in the dispatcher drain,
+    a few float compares per row).
+    """
+
+    def __init__(self, spike_factor: float = 10.0, ewma_alpha: float = 0.2,
+                 warmup: int = 4):
+        self.spike_factor = float(spike_factor)
+        self.alpha = float(ewma_alpha)
+        self.warmup = int(warmup)
+        self._ewma: dict[str, float] = {}
+        self._seen: dict[str, int] = {}
+
+    def _check_spike(self, key: str, v: float) -> Optional[dict]:
+        seen = self._seen.get(key, 0)
+        ewma = self._ewma.get(key)
+        fired = None
+        if (
+            seen >= self.warmup
+            and ewma is not None
+            and v > self.spike_factor * max(ewma, 1e-30)
+        ):
+            fired = {"metric": key, "reason": "spike", "value": v,
+                     "ewma": ewma, "factor": self.spike_factor}
+        # the spiked value still updates the EWMA: a legitimate regime
+        # change (LR drop boundary) fires once, then re-baselines
+        self._ewma[key] = v if ewma is None else (
+            (1 - self.alpha) * ewma + self.alpha * v
+        )
+        self._seen[key] = seen + 1
+        return fired
+
+    def observe(self, step: int, metrics: dict, numerics: dict) -> list:
+        anomalies = []
+        for src in (metrics, numerics):
+            for k, v in src.items():
+                v = float(v)
+                if not math.isfinite(v):
+                    anomalies.append({"metric": k, "reason": "nonfinite",
+                                      "value_repr": repr(v)})
+        nonf = numerics.get("nm_nonfinite")
+        if nonf is not None and math.isfinite(float(nonf)) and float(nonf) > 0:
+            anomalies.append({"metric": "nm_nonfinite",
+                              "reason": "nonfinite_grads",
+                              "value": float(nonf)})
+        for k in numerics:
+            # EWMA spike detection covers the magnitude gauges — every
+            # nm_*_norm plus the per-rule divergence; counts use the
+            # >0 trigger above
+            if (k.startswith(NM_PREFIX) and k.endswith("_norm")) or (
+                k == "nm_divergence"
+            ):
+                v = float(numerics[k])
+                if math.isfinite(v):
+                    fired = self._check_spike(k, v)
+                    if fired:
+                        anomalies.append(fired)
+        for a in anomalies:
+            a["step"] = int(step)
+        return anomalies

@@ -62,6 +62,7 @@ def make_bsp_train_step(
     donate: bool = True,
     input_transform=None,
     accum_steps: int = 1,
+    numerics: bool = False,
 ):
     """Build the jitted BSP step: ``(state, images, labels, rng) ->
     (state, metrics)`` over global arrays. ``accum_steps``: gradient
@@ -93,7 +94,7 @@ def make_bsp_train_step(
         # save is not binding on one chip.
         base = make_train_step(model, steps_per_epoch,
                                input_transform=input_transform,
-                               accum_steps=accum_steps)
+                               accum_steps=accum_steps, numerics=numerics)
 
         def single_step(state, images, labels, rng):
             return base(state, images, labels, jax.random.fold_in(rng, 0))
@@ -108,6 +109,7 @@ def make_bsp_train_step(
     base_step = make_train_step(
         model, steps_per_epoch, grad_sync=grad_sync,
         input_transform=input_transform, accum_steps=accum_steps,
+        numerics=numerics,
     )
 
     def sharded_step(state: TrainState, images, labels, rng):
@@ -146,6 +148,7 @@ def make_bsp_fused_step(
     axis_name=DATA_AXIS,
     input_transform=None,
     accum_steps: int = 1,
+    numerics: bool = False,
 ):
     """``k`` BSP steps fused into ONE compiled program via ``lax.scan``
     over stacked batches ``[k, batch, ...]`` — one host dispatch (and one
@@ -174,7 +177,7 @@ def make_bsp_fused_step(
     if n == 1:
         base = make_train_step(
             model, steps_per_epoch, input_transform=input_transform,
-            accum_steps=accum_steps,
+            accum_steps=accum_steps, numerics=numerics,
         )
 
         def single(state, images, labels, rngs):
@@ -188,6 +191,7 @@ def make_bsp_fused_step(
     base_step = make_train_step(
         model, steps_per_epoch, grad_sync=grad_sync,
         input_transform=input_transform, accum_steps=accum_steps,
+        numerics=numerics,
     )
 
     def sharded_step(state: TrainState, images, labels, rngs):
@@ -253,12 +257,15 @@ class BSPEngine:
             axis_name=axis_name, input_transform=input_transform,
             accum_steps=accum_steps,
         )
-        self._fused_step = None  # built lazily; jit retraces per group size
+        # per-flag variants, built lazily: {numerics_flag: jitted step}.
+        # The numerics step is a SECOND compiled program (sentinels are
+        # extra outputs) — only runs where --numerics-freq selects it.
+        self._fused_steps: dict = {}
         n = 1
         for a in _axes_tuple(axis_name):
             n *= mesh.shape[a]
         self.donates_state = n > 1  # single-device path does not donate
-        self._step = make_bsp_train_step(model, mesh, **self._build)
+        self._steps = {False: make_bsp_train_step(model, mesh, **self._build)}
         self._eval = make_bsp_eval_step(
             model, mesh, axis_name=axis_name, input_transform=input_transform,
             eval_views=eval_views,
@@ -267,20 +274,27 @@ class BSPEngine:
     def init_state(self, rng):
         return init_train_state(self.model, rng)
 
-    def train_step(self, state, images, labels, rng):
-        return self._step(state, images, labels, rng)
+    def train_step(self, state, images, labels, rng, numerics: bool = False):
+        numerics = bool(numerics)
+        if numerics not in self._steps:
+            self._steps[numerics] = make_bsp_train_step(
+                self.model, self.mesh, numerics=numerics, **self._build
+            )
+        return self._steps[numerics](state, images, labels, rng)
 
-    def fused_train_step(self, state, images, labels, rngs):
+    def fused_train_step(self, state, images, labels, rngs,
+                         numerics: bool = False):
         """Run ``images.shape[0]`` fused steps on stacked batches
         ``[g, batch, ...]`` with stacked per-step keys (one dispatch).
-        One jitted function; jit recompiles per distinct group size (the
-        driver produces at most the configured k plus an epoch-remainder
-        size)."""
-        if self._fused_step is None:
-            self._fused_step = make_bsp_fused_step(
-                self.model, self.mesh, **self._build
+        One jitted function per numerics flag; jit recompiles per
+        distinct group size (the driver produces at most the configured
+        k plus an epoch-remainder size)."""
+        numerics = bool(numerics)
+        if numerics not in self._fused_steps:
+            self._fused_steps[numerics] = make_bsp_fused_step(
+                self.model, self.mesh, numerics=numerics, **self._build
             )
-        return self._fused_step(state, images, labels, rngs)
+        return self._fused_steps[numerics](state, images, labels, rngs)
 
     def exchange(self, state):
         return state
@@ -307,6 +321,19 @@ class BSPEngine:
         return bsp_traffic(
             pytree_num_elements(state.params), n,
             strategy=self._build["strategy"],
+        )
+
+    def numerics_model(self, state):
+        """Numerics declaration (obs/numerics.py): the standard sentinel
+        set; no divergence gauge — BSP params are replicated by
+        construction (the in-step pmean IS the consistency proof)."""
+        from theanompi_tpu.obs.numerics import NumericsModel
+
+        del state  # sentinel set is state-independent for this rule
+        return NumericsModel(
+            rule="bsp",
+            detail={"note": "params replicated in-step; no divergence "
+                            "gauge needed"},
         )
 
 

@@ -106,10 +106,6 @@ class EASGDEngine:
         self.n = mesh.shape[ax]  # number of WORKERS
         self.avg_freq = max(1, avg_freq)
         self.alpha = alpha if alpha is not None else 0.9 / self.n
-        base_step = make_train_step(
-            model, steps_per_epoch, grad_sync=grad_sync,
-            input_transform=input_transform, accum_steps=accum_steps,
-        )
         base_eval = make_eval_step(
             model, input_transform=input_transform, views=eval_views
         )
@@ -125,34 +121,74 @@ class EASGDEngine:
 
         # ---- local step: each worker trains its own replica; groups
         # ---- psum gradients over their internal data axis, no comm
-        # ---- crosses workers ----
-        def sharded_step(state: EASGDState, images, labels, rng):
-            local = jax.tree_util.tree_map(lambda v: v[0], state.workers)
-            new_local, metrics = base_step(local, images, labels, fold_all(rng))
-            if g > 1:
-                # group-replicated state: average BN stats within the
-                # group (grads were already psummed; BN stats are not)
-                new_local = new_local._replace(
-                    model_state=lax.pmean(new_local.model_state, DATA_AXIS)
-                )
-            workers = jax.tree_util.tree_map(lambda v: v[None], new_local)
-            metrics = lax.pmean(metrics, all_axes)
-            return state._replace(workers=workers), metrics
+        # ---- crosses workers. A factory per numerics flag: the
+        # ---- sentinel variant adds the in-graph gauges (obs/numerics)
+        # ---- including the EASGD-specific center<->worker L2 distance
+        # ---- (one scalar psum — local steps stay otherwise silent) ----
+        def make_sharded_step(numerics: bool):
+            from theanompi_tpu.obs.numerics import sentinels_across_workers
 
-        self._sharded_step_fn = sharded_step
+            bstep = make_train_step(
+                model, steps_per_epoch, grad_sync=grad_sync,
+                input_transform=input_transform, accum_steps=accum_steps,
+                numerics=numerics,
+            )
+
+            def sharded_step(state: EASGDState, images, labels, rng):
+                local = jax.tree_util.tree_map(lambda v: v[0], state.workers)
+                new_local, metrics = bstep(local, images, labels, fold_all(rng))
+                if g > 1:
+                    # group-replicated state: average BN stats within the
+                    # group (grads were already psummed; BN stats are not)
+                    new_local = new_local._replace(
+                        model_state=lax.pmean(new_local.model_state, DATA_AXIS)
+                    )
+                if numerics:
+                    # divergence gauge: RMS over workers of the L2
+                    # distance to the center — what the elastic force
+                    # acts on; unbounded growth = replicas escaping the
+                    # center's basin (raise alpha / lower avg_freq)
+                    d2 = sum(
+                        jnp.sum(jnp.square(w.astype(jnp.float32)
+                                           - c.astype(jnp.float32)))
+                        for w, c in zip(
+                            jax.tree_util.tree_leaves(new_local.params),
+                            jax.tree_util.tree_leaves(state.center_params),
+                        )
+                    )
+                    metrics["nm_divergence"] = jnp.sqrt(lax.pmean(d2, ax))
+                    # per-worker rule: aggregate the base-step sentinels
+                    # across the worker axis with their own semantics —
+                    # the non-finite COUNT psums (a fractional count
+                    # would misstate magnitude), the norms combine as
+                    # RMS over workers (comparable to a single worker's
+                    # reading); the blanket pmean below is then identity
+                    metrics = sentinels_across_workers(metrics, ax)
+                workers = jax.tree_util.tree_map(lambda v: v[None], new_local)
+                metrics = lax.pmean(metrics, all_axes)
+                return state._replace(workers=workers), metrics
+
+            return sharded_step
+
+        self._make_sharded_step = make_sharded_step
         self._state_spec = EASGDState(P(ax), P(), P())
         self._bspec = bspec
-        self._fused = None
-        self._step = jax.jit(
-            jax.shard_map(
-                sharded_step,
-                mesh=mesh,
-                in_specs=(EASGDState(P(ax), P(), P()), bspec, bspec, P()),
-                out_specs=(EASGDState(P(ax), P(), P()), P()),
-                check_vma=False,
-            ),
-            donate_argnums=(0,),
-        )
+        self._fused: dict = {}
+
+        def jit_step(numerics: bool):
+            return jax.jit(
+                jax.shard_map(
+                    make_sharded_step(numerics),
+                    mesh=mesh,
+                    in_specs=(EASGDState(P(ax), P(), P()), bspec, bspec, P()),
+                    out_specs=(EASGDState(P(ax), P(), P()), P()),
+                    check_vma=False,
+                ),
+                donate_argnums=(0,),
+            )
+
+        self._jit_step = jit_step
+        self._steps = {False: jit_step(False)}
 
         # ---- elastic exchange: one psum of the elastic differences ----
         def sharded_exchange(state: EASGDState):
@@ -214,21 +250,26 @@ class EASGDEngine:
             center_model_state=ts.model_state,
         )
 
-    def train_step(self, state, images, labels, rng):
-        return self._step(state, images, labels, rng)
+    def train_step(self, state, images, labels, rng, numerics: bool = False):
+        numerics = bool(numerics)
+        if numerics not in self._steps:
+            self._steps[numerics] = self._jit_step(numerics)
+        return self._steps[numerics](state, images, labels, rng)
 
-    def fused_train_step(self, state, images, labels, rngs):
+    def fused_train_step(self, state, images, labels, rngs,
+                         numerics: bool = False):
         """``g`` local steps in ONE program, with the elastic exchange
         embedded at the exact ``avg_freq`` boundaries the per-step
         driver would hit (``lax.cond`` on the in-program step counter) —
         identical trajectory, one dispatch. The driver must NOT call
         ``exchange()`` around fused groups; the recorder's comm bracket
         is subsumed into the step (documented tradeoff of fusion)."""
-        if self._fused is None:
+        numerics = bool(numerics)
+        if numerics not in self._fused:
             from theanompi_tpu.parallel.fused import fuse_sharded_step
 
             freq = self.avg_freq
-            step_fn = self._sharded_step_fn
+            step_fn = self._make_sharded_step(numerics)
             exchange_fn = self._sharded_exchange_fn
 
             def step_and_maybe_exchange(st, x, y, r):
@@ -241,11 +282,11 @@ class EASGDEngine:
                 )
                 return st, metrics
 
-            self._fused = fuse_sharded_step(
+            self._fused[numerics] = fuse_sharded_step(
                 step_and_maybe_exchange, self.mesh, self._state_spec,
                 (P(None, *self._bspec), P(None, *self._bspec), P()), True,
             )
-        return self._fused(state, images, labels, rngs)
+        return self._fused[numerics](state, images, labels, rngs)
 
     def exchange(self, state):
         return self._exchange(state)
@@ -269,4 +310,19 @@ class EASGDEngine:
         per_worker = pytree_num_elements(state.workers.params) // self.n
         return easgd_traffic(
             per_worker, self.n, self.avg_freq, group_size=self.group_size
+        )
+
+    def numerics_model(self, state):
+        """Numerics declaration (obs/numerics.py): standard sentinels
+        plus the EASGD divergence gauge — RMS-over-workers L2 distance
+        of worker params to the center. Costs one scalar psum per
+        numerics step; local steps stay otherwise collective-free."""
+        from theanompi_tpu.obs.numerics import NumericsModel
+
+        del state  # the gauge's cost is state-size independent (scalar)
+        return NumericsModel(
+            rule="easgd",
+            divergence="center_worker_l2",
+            detail={"extra_wire": "one scalar psum per numerics step",
+                    "avg_freq": self.avg_freq},
         )

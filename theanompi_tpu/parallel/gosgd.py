@@ -115,10 +115,15 @@ class GOSGDEngine:
         self.p_push = float(p_push)
         self.gossip_every = max(1, int(gossip_every))
         self._count: int | None = None
-        base_step = make_train_step(
-            model, steps_per_epoch, grad_sync=grad_sync,
-            input_transform=input_transform, accum_steps=accum_steps,
-        )
+
+        def make_base_step(numerics: bool):
+            return make_train_step(
+                model, steps_per_epoch, grad_sync=grad_sync,
+                input_transform=input_transform, accum_steps=accum_steps,
+                numerics=numerics,
+            )
+
+        base_step = make_base_step(False)
         base_eval = make_eval_step(
             model, input_transform=input_transform, views=eval_views
         )
@@ -162,57 +167,91 @@ class GOSGDEngine:
             acc_share = keep_share + received[-1]
             return unravel(acc / acc_share), acc_share
 
-        def sharded_step_flag(state: GOSGDState, images, labels, rng,
-                              with_gossip):
-            """``with_gossip`` may be a static Python bool (the cond
-            folds at trace time — the per-step jit variants) or a traced
-            bool (the fused scan decides per substep)."""
-            local = jax.tree_util.tree_map(lambda v: v[0], state.workers)
-            a_local = state.alpha[0]
-            step_rng, gossip_rng = jax.random.split(rng)
-            from theanompi_tpu.parallel.mesh import fold_linear_index
+        def make_flag_fn(numerics: bool):
+            """Factory per numerics flag: the sentinel variant adds the
+            in-graph gauges (obs/numerics.py) including the GoSGD
+            inter-replica disagreement — RMS distance of worker params
+            to the unweighted replica mean, whose pmean costs one
+            param-sized allreduce per numerics step (exactly what
+            ``--numerics-freq > 1`` amortizes on this rule)."""
+            from theanompi_tpu.obs.numerics import sentinels_across_workers
 
-            step_rng = fold_linear_index(step_rng, all_axes, mesh)
-            new_local, metrics = base_step(local, images, labels, step_rng)
-            if g > 1:
-                # group-replicated worker: average BN stats within
-                # the group (grads were already psummed)
-                new_local = new_local._replace(
-                    model_state=lax.pmean(new_local.model_state, DATA_AXIS)
-                )
-            if isinstance(with_gossip, bool):
-                # static flag (the per-step jit variants): keep the
-                # no-gossip program genuinely collective-free — lax.cond
-                # stages BOTH branches even for a concrete predicate
-                # (verified), which would put a dead ppermute switch in
-                # the local step and lean on XLA to simplify it out
-                merged, a_new = (
-                    gossip(new_local.params, a_local, gossip_rng)
-                    if with_gossip else (new_local.params, a_local)
-                )
-            else:
-                merged, a_new = lax.cond(
-                    with_gossip,
-                    lambda: gossip(new_local.params, a_local, gossip_rng),
-                    lambda: (new_local.params, a_local),
-                )
-            new_local = new_local._replace(params=merged)
-            metrics = lax.pmean(metrics, all_axes)
-            return (
-                GOSGDState(
-                    jax.tree_util.tree_map(lambda v: v[None], new_local), a_new[None]
-                ),
-                metrics,
-            )
+            bstep = make_base_step(numerics) if numerics else base_step
 
-        self._sharded_step_flag = sharded_step_flag
+            def sharded_step_flag(state: GOSGDState, images, labels, rng,
+                                  with_gossip):
+                """``with_gossip`` may be a static Python bool (the cond
+                folds at trace time — the per-step jit variants) or a
+                traced bool (the fused scan decides per substep)."""
+                local = jax.tree_util.tree_map(lambda v: v[0], state.workers)
+                a_local = state.alpha[0]
+                step_rng, gossip_rng = jax.random.split(rng)
+                from theanompi_tpu.parallel.mesh import fold_linear_index
+
+                step_rng = fold_linear_index(step_rng, all_axes, mesh)
+                new_local, metrics = bstep(local, images, labels, step_rng)
+                if g > 1:
+                    # group-replicated worker: average BN stats within
+                    # the group (grads were already psummed)
+                    new_local = new_local._replace(
+                        model_state=lax.pmean(new_local.model_state, DATA_AXIS)
+                    )
+                if isinstance(with_gossip, bool):
+                    # static flag (the per-step jit variants): keep the
+                    # no-gossip program genuinely collective-free — lax.cond
+                    # stages BOTH branches even for a concrete predicate
+                    # (verified), which would put a dead ppermute switch in
+                    # the local step and lean on XLA to simplify it out
+                    merged, a_new = (
+                        gossip(new_local.params, a_local, gossip_rng)
+                        if with_gossip else (new_local.params, a_local)
+                    )
+                else:
+                    merged, a_new = lax.cond(
+                        with_gossip,
+                        lambda: gossip(new_local.params, a_local, gossip_rng),
+                        lambda: (new_local.params, a_local),
+                    )
+                new_local = new_local._replace(params=merged)
+                if numerics:
+                    wbar = jax.tree_util.tree_map(
+                        lambda w: lax.pmean(w.astype(jnp.float32), ax), merged
+                    )
+                    d2 = sum(
+                        jnp.sum(jnp.square(w.astype(jnp.float32) - wb))
+                        for w, wb in zip(
+                            jax.tree_util.tree_leaves(merged),
+                            jax.tree_util.tree_leaves(wbar),
+                        )
+                    )
+                    metrics["nm_divergence"] = jnp.sqrt(lax.pmean(d2, ax))
+                    # per-worker sentinel aggregation (obs/numerics.py):
+                    # count psums, norms RMS over workers — the blanket
+                    # pmean below is then identity on the nm_ keys
+                    metrics = sentinels_across_workers(metrics, ax)
+                metrics = lax.pmean(metrics, all_axes)
+                return (
+                    GOSGDState(
+                        jax.tree_util.tree_map(lambda v: v[None], new_local), a_new[None]
+                    ),
+                    metrics,
+                )
+
+            return sharded_step_flag
+
+        self._make_flag_fn = make_flag_fn
+        self._sharded_step_flag = make_flag_fn(False)
         self._state_spec = GOSGDState(P(ax), P(ax))
         self._bspec = bspec
-        self._fused = None
+        self._fused: dict = {}
 
-        def make_sharded_step(with_gossip: bool):
+        def make_sharded_step(with_gossip: bool, numerics: bool = False):
+            flag_fn = (
+                self._sharded_step_flag if not numerics else make_flag_fn(True)
+            )
+
             def sharded_step(state, images, labels, rng):
-                return sharded_step_flag(state, images, labels, rng, with_gossip)
+                return flag_fn(state, images, labels, rng, with_gossip)
 
             return jax.jit(
                 jax.shard_map(
@@ -225,9 +264,11 @@ class GOSGDEngine:
                 donate_argnums=(0,),
             )
 
-        self._step_gossip = make_sharded_step(True)
-        self._step_local = (
-            make_sharded_step(False) if self.gossip_every > 1 else self._step_gossip
+        self._make_jit_step = make_sharded_step
+        self._steps = {(True, False): make_sharded_step(True)}
+        self._steps[(False, False)] = (
+            make_sharded_step(False) if self.gossip_every > 1
+            else self._steps[(True, False)]
         )
 
         # ---- eval on the consensus params: sum_i alpha_i w_i -------------
@@ -270,46 +311,48 @@ class GOSGDEngine:
             alpha=jnp.full((self.n,), 1.0 / self.n),
         )
 
-    def train_step(self, state, images, labels, rng):
+    def train_step(self, state, images, labels, rng, numerics: bool = False):
         if self._count is None:  # resumed state: derive from the step counter
             self._count = self.get_step(state)
         nxt = self._count + 1
-        step = (
-            self._step_gossip
-            if nxt % self.gossip_every == 0
-            else self._step_local
-        )
-        out = step(state, images, labels, rng)
+        key = (nxt % self.gossip_every == 0, bool(numerics))
+        if key not in self._steps:
+            self._steps[key] = self._make_jit_step(*key)
+        out = self._steps[key](state, images, labels, rng)
         # advance only after the dispatch succeeds: a raise (OOM on a new
         # shape) must not shift the gossip cadence off the applied steps
         self._count = nxt
         return out
 
-    def fused_train_step(self, state, images, labels, rngs):
+    def fused_train_step(self, state, images, labels, rngs,
+                         numerics: bool = False):
         """``g`` local-SGD-plus-gossip steps in ONE program; each
         substep's gossip decision follows the same ``gossip_every``
         cadence the per-step path applies (substep counters shipped as
         a stacked operand, uniform across devices so the in-cond
         collective cannot diverge)."""
+        numerics = bool(numerics)
         if self._count is None:
             self._count = self.get_step(state)
         g_steps = int(images.shape[0])
         counts = jnp.arange(1, g_steps + 1, dtype=jnp.int32) + self._count
-        if self._fused is None:
+        if numerics not in self._fused:
             from theanompi_tpu.parallel.fused import fuse_sharded_step
 
             every = self.gossip_every
-            flag_fn = self._sharded_step_flag
+            flag_fn = self._make_flag_fn(numerics) if numerics else (
+                self._sharded_step_flag
+            )
 
             def substep(st, x, y, r, count):
                 return flag_fn(st, x, y, r, count % every == 0)
 
-            self._fused = fuse_sharded_step(
+            self._fused[numerics] = fuse_sharded_step(
                 substep, self.mesh, self._state_spec,
                 (P(None, *self._bspec), P(None, *self._bspec), P(), P()),
                 True,
             )
-        out = self._fused(state, images, labels, rngs, counts)
+        out = self._fused[numerics](state, images, labels, rngs, counts)
         # advance only after the fused dispatch returns: a raise (OOM on
         # a new trimmed-group shape) must not permanently shift the
         # gossip cadence off the actually-applied steps
@@ -338,4 +381,22 @@ class GOSGDEngine:
         return gosgd_traffic(
             per_worker, self.n, gossip_every=self.gossip_every,
             group_size=self.group_size,
+        )
+
+    def numerics_model(self, state):
+        """Numerics declaration (obs/numerics.py): standard sentinels
+        plus the inter-replica disagreement gauge (RMS distance to the
+        replica mean). The mean needs a param-sized pmean — one full
+        allreduce of extra wire per numerics step, so size
+        ``--numerics-freq`` accordingly on this rule."""
+        from theanompi_tpu.obs.comm import allreduce_bytes, pytree_num_elements
+        from theanompi_tpu.obs.numerics import NumericsModel
+
+        per_worker = pytree_num_elements(state.workers.params) // self.n
+        return NumericsModel(
+            rule="gosgd",
+            divergence="replica_disagreement",
+            detail={"extra_wire": "param-sized pmean per numerics step",
+                    "extra_bytes_per_numerics_step": allreduce_bytes(
+                        per_worker, self.n)},
         )

@@ -197,38 +197,59 @@ class NDEngine:
         self._stacked_sharding = NamedSharding(mesh, P(None, *tok_spec))
         self._donate = donate
         self.donates_state = bool(donate)
-        self._fused = None
+        self._fused: dict = {}
         # multi-controller feed fraction (lo, hi, B): set by
         # host_batch_part when hosts load only their slice of the global
         # batch; None = every host feeds the full batch (replicated
         # tokens, or the pipeline's interleaved microbatch-major layout)
         self._part = None
 
-        def sharded_step(state: NDTrainState, tokens, rng):
-            del rng  # no dropout in the LM stack; kept for protocol parity
-            loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
-            grads = sync_grads_by_spec(grads, param_specs, axes, n_total)
-            for a in batch_axes:
-                loss = lax.pmean(loss, a)  # report the global batch mean
-            lr = schedule_lr(state.step)
-            updates, new_opt = opt.update(grads, state.opt_state, state.params, lr)
-            new_params = apply_updates(state.params, updates)
-            return (
-                NDTrainState(new_params, new_opt, state.step + 1),
-                {"loss": loss, "lr": lr},
+        def make_sharded_step(numerics: bool):
+            def sharded_step(state: NDTrainState, tokens, rng):
+                del rng  # no dropout in the LM stack; kept for protocol parity
+                loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
+                grads = sync_grads_by_spec(grads, param_specs, axes, n_total)
+                for a in batch_axes:
+                    loss = lax.pmean(loss, a)  # report the global batch mean
+                lr = schedule_lr(state.step)
+                updates, new_opt = opt.update(grads, state.opt_state, state.params, lr)
+                new_params = apply_updates(state.params, updates)
+                metrics = {"loss": loss, "lr": lr}
+                if numerics:
+                    # sentinels over SPEC-SHARDED trees: per-leaf local
+                    # squared sums psummed over exactly the axes that
+                    # leaf shards over (obs/numerics.py) — scalar
+                    # collectives, no gather of the sharded params
+                    from theanompi_tpu.obs.numerics import sharded_sentinels
+
+                    metrics = {
+                        **metrics,
+                        **sharded_sentinels(grads, updates, new_params,
+                                            param_specs),
+                    }
+                return (
+                    NDTrainState(new_params, new_opt, state.step + 1),
+                    metrics,
+                )
+
+            return sharded_step
+
+        self._make_sharded_step = make_sharded_step
+
+        def jit_step(numerics: bool):
+            return jax.jit(
+                jax.shard_map(
+                    make_sharded_step(numerics),
+                    mesh=mesh,
+                    in_specs=(state_specs, tok_spec, P()),
+                    out_specs=(state_specs, P()),
+                    check_vma=False,
+                ),
+                donate_argnums=(0,) if donate else (),
             )
 
-        self._sharded_step_fn = sharded_step
-        self._step = jax.jit(
-            jax.shard_map(
-                sharded_step,
-                mesh=mesh,
-                in_specs=(state_specs, tok_spec, P()),
-                out_specs=(state_specs, P()),
-                check_vma=False,
-            ),
-            donate_argnums=(0,) if donate else (),
-        )
+        self._jit_step = jit_step
+        self._steps = {False: jit_step(False)}
 
         def sharded_eval(state: NDTrainState, tokens):
             loss = loss_fn(state.params, tokens)
@@ -377,9 +398,12 @@ class NDEngine:
         )
         return t, t
 
-    def train_step(self, state, tokens, labels, rng):
+    def train_step(self, state, tokens, labels, rng, numerics: bool = False):
         del labels
-        return self._step(state, tokens, rng)
+        numerics = bool(numerics)
+        if numerics not in self._steps:
+            self._steps[numerics] = self._jit_step(numerics)
+        return self._steps[numerics](state, tokens, rng)
 
     def place_group(self, group):
         """Fused dispatch: stack ``g`` host token batches into ONE
@@ -392,7 +416,8 @@ class NDEngine:
         )
         return t, t
 
-    def fused_train_step(self, state, tokens_g, labels_g, rngs):
+    def fused_train_step(self, state, tokens_g, labels_g, rngs,
+                         numerics: bool = False):
         """``g`` steps in ONE compiled program (``lax.scan`` over the
         stacked group — same dispatch-amortization as
         ``parallel/bsp.py::make_bsp_fused_step``); per-step keys stacked
@@ -400,14 +425,16 @@ class NDEngine:
         group size (the driver produces at most the configured k plus an
         epoch remainder)."""
         del labels_g
-        if self._fused is None:
+        numerics = bool(numerics)
+        if numerics not in self._fused:
             from theanompi_tpu.parallel.fused import fuse_sharded_step
 
-            self._fused = fuse_sharded_step(
-                self._sharded_step_fn, self.mesh, self._state_specs,
+            self._fused[numerics] = fuse_sharded_step(
+                self._make_sharded_step(numerics), self.mesh,
+                self._state_specs,
                 (P(None, *self._tok_spec), P()), self._donate,
             )
-        return self._fused(state, tokens_g, rngs)
+        return self._fused[numerics](state, tokens_g, rngs)
 
     def exchange(self, state):
         return state
@@ -434,4 +461,20 @@ class NDEngine:
         shard_ways = max(1, self.mesh.devices.size // dp)
         return nd_traffic(
             pytree_num_elements(state.params), dp, shard_ways=shard_ways
+        )
+
+    def numerics_model(self, state):
+        """Numerics declaration (obs/numerics.py): sentinels computed
+        spec-aware over the sharded param/grad trees (per-leaf scalar
+        psums over each leaf's sharded axes); no divergence gauge — the
+        sharding IS the single source of truth, there are no replicas
+        to drift."""
+        from theanompi_tpu.obs.numerics import NumericsModel
+
+        del state
+        return NumericsModel(
+            rule="nd",
+            detail={"note": "spec-aware sharded norms (scalar psums per "
+                            "leaf); tp/sp/pp/expert layouts have no "
+                            "replica divergence by construction"},
         )

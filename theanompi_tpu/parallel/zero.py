@@ -66,6 +66,7 @@ def make_zero1_train_step(
     input_transform: Optional[Callable] = None,
     donate: bool = True,
     fused: bool = False,
+    numerics: bool = False,
 ):
     """Build ``(init_state, train_step)`` for ZeRO-1 BSP training over
     ``mesh``'s ``axis_name``.
@@ -171,6 +172,30 @@ def make_zero1_train_step(
             **{k: lax.pmean(v, axis_name)
                for k, v in model.metrics(logits, labels).items()},
         }
+        if numerics:
+            # sentinels over the SHARDED flat segments (obs/numerics.py
+            # semantics): each rank owns one 1/n slice of the summed
+            # grads/updates, so the global norms are psums of local
+            # squared sums — scalar collectives only. param_norm reads
+            # the freshly all-gathered full buffer (replicated), and
+            # the non-finite count covers the synced grads exactly like
+            # the replicated engines'.
+            gsq = lax.psum(jnp.sum(jnp.square(g_seg)), axis_name)
+            usq = lax.psum(
+                jnp.sum(jnp.square(updates.astype(jnp.float32))), axis_name
+            )
+            nonf = lax.psum(
+                jnp.sum((~jnp.isfinite(g_seg)).astype(jnp.float32)), axis_name
+            )
+            metrics = {
+                **metrics,
+                "nm_grad_norm": jnp.sqrt(gsq),
+                "nm_update_norm": jnp.sqrt(usq),
+                "nm_param_norm": jnp.sqrt(
+                    jnp.sum(jnp.square(new_flat.astype(jnp.float32)))
+                ),
+                "nm_nonfinite": nonf,
+            }
         return (
             ZeroTrainState(new_params, new_model_state, new_opt, state.step + 1),
             metrics,
@@ -229,13 +254,11 @@ class ZeroEngine:
 
         self.model = model
         self.mesh = mesh
-        self._init, self._step = make_zero1_train_step(
-            model, mesh, steps_per_epoch=steps_per_epoch,
-            input_transform=input_transform,
-        )
         self._build = dict(steps_per_epoch=steps_per_epoch,
                            input_transform=input_transform)
-        self._fused = None
+        self._init, step = make_zero1_train_step(model, mesh, **self._build)
+        self._steps = {False: step}
+        self._fused: dict = {}
         self._eval = make_bsp_eval_step(
             model, mesh, input_transform=input_transform, eval_views=eval_views,
         )
@@ -243,17 +266,25 @@ class ZeroEngine:
     def init_state(self, rng) -> ZeroTrainState:
         return self._init(rng)
 
-    def train_step(self, state, images, labels, rng):
-        return self._step(state, images, labels, rng)
+    def train_step(self, state, images, labels, rng, numerics: bool = False):
+        numerics = bool(numerics)
+        if numerics not in self._steps:
+            _, self._steps[numerics] = make_zero1_train_step(
+                self.model, self.mesh, numerics=numerics, **self._build
+            )
+        return self._steps[numerics](state, images, labels, rng)
 
-    def fused_train_step(self, state, images, labels, rngs):
+    def fused_train_step(self, state, images, labels, rngs,
+                         numerics: bool = False):
         """``g`` ZeRO steps in one program (stacked batches + keys, like
         make_bsp_fused_step); jit recompiles per distinct group size."""
-        if self._fused is None:
-            _, self._fused = make_zero1_train_step(
-                self.model, self.mesh, fused=True, **self._build
+        numerics = bool(numerics)
+        if numerics not in self._fused:
+            _, self._fused[numerics] = make_zero1_train_step(
+                self.model, self.mesh, fused=True, numerics=numerics,
+                **self._build
             )
-        return self._fused(state, images, labels, rngs)
+        return self._fused[numerics](state, images, labels, rngs)
 
     def exchange(self, state):
         return state
@@ -277,4 +308,19 @@ class ZeroEngine:
 
         return zero1_traffic(
             pytree_num_elements(state.params), self.mesh.devices.size
+        )
+
+    def numerics_model(self, state):
+        """Numerics declaration (obs/numerics.py): standard sentinels
+        computed over the sharded flat segments (scalar psums); no
+        divergence gauge — the all_gather re-replicates params every
+        step, so sharded-consistency holds by construction."""
+        from theanompi_tpu.obs.numerics import NumericsModel
+
+        del state
+        return NumericsModel(
+            rule="zero1",
+            detail={"note": "segment-sharded norms via scalar psums; "
+                            "params re-replicated by the in-step "
+                            "all_gather"},
         )

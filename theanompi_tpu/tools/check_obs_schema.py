@@ -77,6 +77,38 @@ SCHEMAS: dict[str, dict[str, tuple[tuple, bool]]] = {
         "t": (_NUM, True),
         "step": ((int,), True),
         "pid": ((int,), True),
+        # dispatch-pipeline liveness split (utils/dispatch.py): step
+        # advancing while last_drained_step froze at in_flight=depth is
+        # a wedged DEVICE program; both frozen is a stalled HOST driver
+        "dispatch_in_flight": ((int,), False),
+        "last_drained_step": ((int,), False),
+    },
+    # numerics flight recorder (obs/numerics.py, obs/flight.py): one
+    # sentinel row per drained numerics step (also the flight ring's
+    # entry format). Non-finite values cannot ride a JSON numeric map —
+    # they are dropped from `metrics` and named in `nonfinite_keys`
+    # (comma-joined); the fused non-finite COUNT stays numeric.
+    "numerics": {
+        "rank": ((int,), True),
+        "t": (_NUM, True),
+        "step": ((int,), True),
+        "metrics": ((dict,), True),
+        "nonfinite_keys": ((str,), False),
+    },
+    # one record per detected anomaly (NaN/Inf trigger or EWMA spike),
+    # written at dispatch-drain time into numerics_rank{r}.jsonl
+    "anomaly": {
+        "rank": ((int,), True),
+        "t": (_NUM, True),
+        "step": ((int,), True),
+        "metric": ((str,), True),
+        "reason": ((str,), True),
+        "policy": ((str,), False),
+        "value": (_NUM, False),
+        "value_repr": ((str,), False),  # non-finite values ride as text
+        "ewma": (_NUM, False),
+        "factor": (_NUM, False),
+        "epoch": ((int,), False),
     },
     "stall": {
         "rank": ((int,), True),
@@ -134,7 +166,7 @@ def validate_record(obj: Any) -> list[str]:
             errs.append(f"{kind}: extra field {field!r} has non-scalar "
                         f"type {type(v).__name__}")
     if not errs:
-        if kind == "metrics":
+        if kind in ("metrics", "numerics"):
             errs += _check_numeric_map(obj["metrics"], "metrics")
         elif kind == "span_summary":
             errs += _check_numeric_map(obj["fractions"], "fractions")

@@ -1,0 +1,81 @@
+"""Run every repo lint in one pass: hot-loop + telemetry schemas.
+
+One entry point for CI and the tier-1 suite (tests/test_lint_all.py):
+
+1. **hot-loop lint** (tools/check_hot_loop.py): the worker train loops
+   must contain no host-materializing calls — the invariant the async
+   dispatch pipeline (and the numerics sentinels that ride it) depend
+   on;
+2. **schema lint** (tools/check_obs_schema.py): every telemetry
+   ``*.jsonl`` (plus heartbeat/stall ``.json``) found under the given
+   paths — default: the repo tree — must match the documented record
+   schemas, including the ``numerics``/``anomaly`` kinds the flight
+   recorder emits.
+
+A tree with no telemetry files passes the schema step vacuously (fresh
+checkouts hold none until a run writes some); a single invalid line
+fails the whole lint.
+
+Usage::
+
+    python -m theanompi_tpu.tools.lint_all              # repo tree
+    python -m theanompi_tpu.tools.lint_all runs/ exp/   # specific dirs
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import sys
+from typing import Optional
+
+from theanompi_tpu.tools import check_hot_loop, check_obs_schema
+
+# never telemetry; test fixtures under tests/ may hold deliberately
+# invalid lines for the schema checker's own tests
+_SKIP_DIRS = {".git", "__pycache__", ".jax_cache", "node_modules",
+              ".pytest_cache", "tests"}
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def telemetry_files(paths: Optional[list] = None) -> list[str]:
+    """Every ``*.jsonl`` + heartbeat/stall ``.json`` under ``paths``
+    (default: the repo root), skipping VCS/cache/test dirs."""
+    roots = paths or [REPO_ROOT]
+    files: list[str] = []
+    for root in roots:
+        if os.path.isfile(root):
+            files.append(root)
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d not in _SKIP_DIRS]
+            for name in sorted(filenames):
+                if name.endswith(".jsonl") or fnmatch.fnmatch(
+                    name, "heartbeat_rank*.json"
+                ) or fnmatch.fnmatch(name, "stall_rank*.json"):
+                    files.append(os.path.join(dirpath, name))
+    return files
+
+
+def main(argv: Optional[list] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    rc = 0
+
+    # 1. hot-loop lint on the worker train loops
+    rc |= check_hot_loop.main([])
+
+    # 2. schema lint over every telemetry file found
+    files = telemetry_files(argv or None)
+    if not files:
+        print("schema lint: no telemetry files found (OK)")
+    else:
+        rc |= check_obs_schema.main([*files, "-q"])
+
+    print("lint_all: " + ("OK" if rc == 0 else "FAILED"))
+    return 1 if rc else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

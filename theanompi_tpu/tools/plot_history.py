@@ -21,8 +21,12 @@ When a run was recorded with ``--obs-dir`` pointing INSIDE its save
 dir (an ``obs/`` directory next to the run JSONL), a third panel row
 appears: achieved interconnect GB/s per step (obs/metrics.jsonl
 snapshots) and per-kind span time fractions (the ``span_summary`` line
-of obs/spans_rank*.jsonl). Runs without obs data plot exactly as
-before — the extra row only renders when at least one run has it.
+of obs/spans_rank*.jsonl). Runs recorded with ``--numerics-freq`` add
+a FOURTH row from ``obs/numerics_rank0.jsonl``: grad/update norms
+(left, log scale) and the per-rule divergence gauge (right), with
+detected anomaly steps marked as vertical lines on both. Runs without
+obs/numerics data plot exactly as before — extra rows only render when
+at least one run has them.
 """
 
 from __future__ import annotations
@@ -116,6 +120,34 @@ def load_obs(jsonl_path: str) -> dict:
                         out["fractions"] = row.get("fractions", {})
         except (OSError, ValueError):
             pass
+    # numerics flight-recorder telemetry (obs/numerics.py): sentinel
+    # rows -> norm/divergence curves, anomaly records -> step markers
+    out.update({"nm_step": [], "grad_norm": [], "update_norm": [],
+                "div_step": [], "divergence": [], "anomaly_steps": []})
+    numerics = os.path.join(obs_dir, "numerics_rank0.jsonl")
+    if os.path.exists(numerics):
+        try:
+            with open(numerics) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    row = json.loads(line)
+                    if row.get("kind") == "numerics":
+                        m = row.get("metrics", {})
+                        if "nm_grad_norm" in m:
+                            out["nm_step"].append(row["step"])
+                            out["grad_norm"].append(m["nm_grad_norm"])
+                            out["update_norm"].append(
+                                m.get("nm_update_norm", float("nan"))
+                            )
+                        if "nm_divergence" in m:
+                            out["div_step"].append(row["step"])
+                            out["divergence"].append(m["nm_divergence"])
+                    elif row.get("kind") == "anomaly":
+                        out["anomaly_steps"].append(row["step"])
+        except (OSError, ValueError):
+            pass  # partial/corrupt telemetry: plot what parses
     return out
 
 
@@ -200,13 +232,20 @@ def plot(runs: dict[str, str], out: str, show: bool = False,
     has_obs = any(
         o["comm_gbps"] or o["fractions"] for o in obs.values()
     )
+    has_nm = any(
+        o["nm_step"] or o["div_step"] or o["anomaly_steps"]
+        for o in obs.values()
+    )
+    n_rows = 2 + int(has_obs) + int(has_nm)
+    fig, axes = plt.subplots(n_rows, 2, figsize=(11, 3.5 * n_rows))
+    (ax_loss, ax_val), (ax_ips, ax_lr) = axes[0], axes[1]
+    ax_comm = ax_frac = ax_nm = ax_div = None
+    row = 2
     if has_obs:
-        fig, axes = plt.subplots(3, 2, figsize=(11, 10.5))
-        (ax_loss, ax_val), (ax_ips, ax_lr), (ax_comm, ax_frac) = axes
-    else:
-        fig, axes = plt.subplots(2, 2, figsize=(11, 7))
-        (ax_loss, ax_val), (ax_ips, ax_lr) = axes
-        ax_comm = ax_frac = None
+        ax_comm, ax_frac = axes[row]
+        row += 1
+    if has_nm:
+        ax_nm, ax_div = axes[row]
     frac_kinds: list[str] = []
     for o in obs.values():
         frac_kinds += [k for k in o["fractions"] if k not in frac_kinds]
@@ -224,6 +263,23 @@ def plot(runs: dict[str, str], out: str, show: bool = False,
                   for k in o["fractions"]]
             ax_frac.bar(xs, list(o["fractions"].values()), width=width,
                         label=label)
+        if ax_nm is not None and o["nm_step"]:
+            ax_nm.plot(*smoothed(o["nm_step"], o["grad_norm"], smooth),
+                       label=f"{label} grad")
+            ax_nm.plot(*smoothed(o["nm_step"], o["update_norm"], smooth),
+                       label=f"{label} update", linestyle="--")
+        if ax_div is not None and o["div_step"]:
+            ax_div.plot(*smoothed(o["div_step"], o["divergence"], smooth),
+                        label=label)
+        if o["anomaly_steps"]:
+            # anomaly markers on both numerics panels: first marker per
+            # run carries the legend entry, the rest stay unlabeled
+            for ax in (ax_nm, ax_div):
+                if ax is None:
+                    continue
+                for j, s in enumerate(sorted(set(o["anomaly_steps"]))):
+                    ax.axvline(s, color="red", alpha=0.4, linestyle=":",
+                               label=f"{label} anomaly" if j == 0 else None)
         if t["step"] and t["loss"]:
             ax_loss.plot(*smoothed(t["step"], t["loss"], smooth), label=label)
         if v["epoch"]:
@@ -250,6 +306,14 @@ def plot(runs: dict[str, str], out: str, show: bool = False,
             ax_frac.set_xticklabels(frac_kinds, rotation=30, ha="right",
                                     fontsize=8)
         all_axes += [ax_comm, ax_frac]
+    if ax_nm is not None:
+        ax_nm.set(title="grad/update norm (numerics sentinels)",
+                  xlabel="step")
+        if ax_nm.lines:
+            ax_nm.set_yscale("log")  # norms span orders of magnitude
+        ax_div.set(title="divergence gauge (anomaly steps dotted red)",
+                   xlabel="step")
+        all_axes += [ax_nm, ax_div]
     for ax in all_axes:
         ax.grid(True, alpha=0.3)
         if ax.lines or ax.patches:

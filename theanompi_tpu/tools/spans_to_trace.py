@@ -1,0 +1,137 @@
+"""Export per-rank span JSONL to Chrome/Perfetto ``trace_event`` JSON.
+
+The span log (obs/spans.py, ``spans_rank{r}.jsonl``) is machine-
+readable but nothing renders it; this exporter turns any set of span
+files into ONE trace viewable in ``chrome://tracing`` / Perfetto /
+``ui.perfetto.dev``:
+
+- one trace **process** per rank (``pid = rank``), named ``rank {r}``;
+- bracketed spans on thread 0 (``spans``) as complete ``"ph": "X"``
+  events — nesting renders from the timestamps, ``depth`` rides in
+  ``args``;
+- ``amortized`` spans (the dispatch pipeline's attributed step windows,
+  utils/dispatch.py) on their OWN lane (thread 1, ``amortized``),
+  flagged in ``args`` — attributed time is not a measured bracket and
+  must not fake-nest under real ones;
+- ``span_summary`` lines become per-process metadata (``args`` on a
+  zero-duration instant event) so the per-kind fractions travel with
+  the trace.
+
+Usage::
+
+    python -m theanompi_tpu.tools.spans_to_trace RUN_OBS_DIR -o trace.json
+    python -m theanompi_tpu.tools.spans_to_trace spans_rank0.jsonl ...
+
+Directories are searched for ``spans_rank*.jsonl``. Timestamps are the
+span log's wall-clock ``t0`` (seconds) converted to microseconds, so
+multi-rank traces align on real time.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Optional
+
+
+def _rank_of(path: str, fallback: int = 0) -> int:
+    m = re.search(r"spans_rank(\d+)\.jsonl$", os.path.basename(path))
+    return int(m.group(1)) if m else fallback
+
+
+def discover(paths: list[str]) -> list[str]:
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            found = sorted(
+                glob.glob(os.path.join(p, "**", "spans_rank*.jsonl"),
+                          recursive=True)
+            )
+            if not found:
+                raise FileNotFoundError(f"no spans_rank*.jsonl under {p!r}")
+            files += found
+        else:
+            files.append(p)
+    return files
+
+
+def convert(paths: list[str]) -> dict:
+    """``{"traceEvents": [...], "displayTimeUnit": "ms"}`` from span
+    files. Unparseable / non-span lines are skipped (partial telemetry
+    still converts)."""
+    events = []
+    seen_ranks = set()
+    for i, path in enumerate(paths):
+        rank = _rank_of(path, fallback=i)
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                kind = row.get("kind")
+                if kind == "span":
+                    amortized = bool(row.get("amortized", False))
+                    events.append({
+                        "name": row["name"],
+                        "ph": "X",
+                        "ts": row["t0"] * 1e6,
+                        "dur": max(0.0, row["dur"] * 1e6),
+                        "pid": rank,
+                        "tid": 1 if amortized else 0,
+                        "args": {"depth": row.get("depth", 0),
+                                 "amortized": amortized},
+                    })
+                    seen_ranks.add(rank)
+                elif kind == "span_summary":
+                    events.append({
+                        "name": "span_summary",
+                        "ph": "i",  # instant: fractions ride in args
+                        "ts": (row.get("t0", 0.0)
+                               + row.get("wall_s", 0.0)) * 1e6,
+                        "pid": rank,
+                        "tid": 0,
+                        "s": "p",  # process-scoped instant
+                        "args": {"fractions": row.get("fractions", {}),
+                                 "totals_s": row.get("totals_s", {}),
+                                 "wall_s": row.get("wall_s")},
+                    })
+                    seen_ranks.add(rank)
+    for rank in sorted(seen_ranks):
+        events.append({"name": "process_name", "ph": "M", "pid": rank,
+                       "args": {"name": f"rank {rank}"}})
+        events.append({"name": "thread_name", "ph": "M", "pid": rank,
+                       "tid": 0, "args": {"name": "spans"}})
+        events.append({"name": "thread_name", "ph": "M", "pid": rank,
+                       "tid": 1, "args": {"name": "amortized (attributed)"}})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+",
+                    help="span .jsonl files, or directories to search "
+                         "for spans_rank*.jsonl (obs dirs)")
+    ap.add_argument("-o", "--out", default="trace.json",
+                    help="output trace_event JSON (chrome://tracing, "
+                         "Perfetto)")
+    args = ap.parse_args(argv)
+    files = discover(args.paths)
+    trace = convert(files)
+    with open(args.out, "w") as f:
+        json.dump(trace, f)
+    n_spans = sum(1 for e in trace["traceEvents"] if e["ph"] == "X")
+    print(f"wrote {args.out}: {n_spans} spans from {len(files)} "
+          f"file{'s' if len(files) != 1 else ''}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -87,6 +87,7 @@ def make_train_step(
     loss_scale: float = 1.0,
     input_transform: Optional[Callable] = None,
     accum_steps: int = 1,
+    numerics: bool = False,
 ):
     """Build the pure train step: ``(state, images, labels, rng) ->
     (state, metrics)``.
@@ -110,6 +111,14 @@ def make_train_step(
     ``grad_sync`` is the exchanger hook — under ``shard_map`` it holds the
     collective (psum mean / ring / compressed ring); None means single
     replica.
+
+    ``numerics``: compile the numerics sentinels into the step
+    (obs/numerics.py) — global grad-norm (post-sync: the gradient the
+    update actually sees), update-norm, new-param-norm, and a fused
+    non-finite count over the grads, returned in the metrics dict under
+    ``nm_``-prefixed keys. The loss/grad/update math is untouched; the
+    sentinels are extra outputs of the same XLA program, so they drain
+    through the dispatch pipeline with zero new host syncs.
 
     ``input_transform`` runs ON DEVICE at the top of the compiled step
     (e.g. uint8 -> ``(x - mean) * scale``): the host then ships compact
@@ -200,6 +209,11 @@ def make_train_step(
         new_params = apply_updates(state.params, updates)
 
         metrics = {**metrics, "lr": lr}
+        if numerics:
+            from theanompi_tpu.obs.numerics import sentinel_metrics
+
+            metrics = {**metrics,
+                       **sentinel_metrics(grads, updates, new_params)}
         new_state = TrainState(new_params, new_model_state, new_opt_state, state.step + 1)
         return new_state, metrics
 

@@ -77,6 +77,7 @@ class MetricsDispatcher:
         recorder,
         depth: int = 1,
         on_step_seconds: Optional[Callable[[float], None]] = None,
+        on_row: Optional[Callable[[int, dict, dict], None]] = None,
     ):
         self.rec = recorder
         self.depth = max(1, int(depth))
@@ -84,9 +85,19 @@ class MetricsDispatcher:
         self._t_mark: Optional[float] = None
         self._wait_s = 0.0
         self._on_step_seconds = on_step_seconds
+        # per-emitted-row hook ``(step, metrics, numerics)`` — the obs
+        # facade's flight-ring/anomaly entry point (obs/numerics.py).
+        # Called AFTER the recorder row lands, with host floats from the
+        # SAME D2H fetch the row came from: numerics detection adds no
+        # sync of its own, it rides the drain.
+        self._on_row = on_row
         # time the host spent actually blocked inside drains (the tax)
         self.host_blocked_s = 0.0
         self.n_syncs = 0
+        # newest step whose row has been emitted (heartbeat telemetry:
+        # in_flight + this distinguish a wedged device program from a
+        # stalled host driver)
+        self.last_drained_step = -1
         # amortized per-substep seconds of the most recent sync; None
         # while steps are in flight without a completed sync
         self.last_step_seconds: Optional[float] = None
@@ -187,16 +198,37 @@ class MetricsDispatcher:
 
     def _emit_rows(self, step: int, metrics: dict, n_images: int,
                    substeps: int) -> None:
+        from theanompi_tpu.obs.numerics import split_numerics
+
         if substeps == 1:
-            self.rec.train_metrics(step, metrics, n_images=n_images)
+            plain, nm = split_numerics(metrics)
+            self.rec.train_metrics(step, plain, n_images=n_images)
+            self.last_drained_step = step
+            if self._on_row is not None:
+                # row first, hook second: an --on-anomaly halt raised
+                # here still leaves the anomalous step's row persisted
+                self._on_row(
+                    step,
+                    {k: float(v) for k, v in plain.items()},
+                    {k: float(v) for k, v in nm.items()},
+                )
             return
         # fused group: one JSONL row PER SUBSTEP from the stacked
         # metrics (same-resolution loss/LR curves as per-step runs);
         # the group's throughput is attributed to its final row
         host = {k: np.asarray(v) for k, v in metrics.items()}
         for i in range(substeps):
+            sub = {k: a[i] for k, a in host.items()}
+            plain, nm = split_numerics(sub)
+            sub_step = step - substeps + i + 1
             self.rec.train_metrics(
-                step - substeps + i + 1,
-                {k: a[i] for k, a in host.items()},
+                sub_step, plain,
                 n_images=n_images if i == substeps - 1 else 0,
             )
+            self.last_drained_step = sub_step
+            if self._on_row is not None:
+                self._on_row(
+                    sub_step,
+                    {k: float(v) for k, v in plain.items()},
+                    {k: float(v) for k, v in nm.items()},
+                )
