@@ -321,7 +321,8 @@ def bench_compute(steps: int = 20, trials: int = 5, model_name: str = "alexnet")
 
 
 def bench_e2e(max_steps: int = 48, batch: int = 0,
-              dispatch_depths=(1,), numerics: bool = False) -> dict:
+              dispatch_depths=(1,), numerics: bool = False,
+              recovery: bool = False) -> dict:
     """The honest framework benchmark: run_training end-to-end — disk
     shards -> mmap gather -> crop/mirror/normalize -> PrefetchLoader ->
     H2D -> fused step. The reference's headline claim was "I/O fully
@@ -339,7 +340,14 @@ def bench_e2e(max_steps: int = 48, batch: int = 0,
     ``numerics``: also run the headline depth with ``--numerics-freq 1``
     (in-graph sentinels on EVERY step — the worst case) and report
     ``numerics_overhead_frac``: the step-time fraction the flight
-    recorder's sentinels cost, measured, not guessed."""
+    recorder's sentinels cost, measured, not guessed.
+
+    ``recovery``: also time one clean checkpointed run against one run
+    with an injected crash mid-way, auto-resumed by the supervisor
+    (launch/supervisor.py, zero backoff), and report
+    ``recovery_overhead_frac``: the wall-time fraction one
+    crash+verified-resume costs — the recovery path's tracked perf
+    number (replay from the last epoch boundary dominates it)."""
     import tempfile
 
     import jax
@@ -366,8 +374,8 @@ def bench_e2e(max_steps: int = 48, batch: int = 0,
             rng.randint(0, 1000, size=256).astype(np.int64),
             shard_size=256,
         )
-        def one_run(depth, numerics_freq=0):
-            return run_training(
+        def run_kwargs(depth, numerics_freq=0):
+            return dict(
                 rule="bsp",
                 model_cls=AlexNet,
                 dataset="imagenet",
@@ -380,6 +388,9 @@ def bench_e2e(max_steps: int = 48, batch: int = 0,
                 print_freq=0,
                 return_recorder=True,
             )
+
+        def one_run(depth, numerics_freq=0):
+            return run_training(**run_kwargs(depth, numerics_freq))
 
         raw_step_s: dict = {}  # unrounded per-depth step time (the
         # numerics-overhead baseline must not absorb row rounding)
@@ -420,6 +431,36 @@ def bench_e2e(max_steps: int = 48, batch: int = 0,
             base_s = raw_step_s[head_depth]
             if base_s:
                 nm_overhead = (step_nm - base_s) / base_s
+        recovery_overhead = None
+        if recovery:
+            # same shards, headline depth, epoch checkpoints on: one
+            # clean wall-clock vs one with a crash injected mid-run and
+            # auto-resumed by the supervisor (verified checkpoint +
+            # mid-epoch replay) — the measured cost of surviving one
+            # host death
+            from theanompi_tpu.launch.supervisor import supervise_training
+
+            head_depth = max(dispatch_depths)
+            kw = run_kwargs(head_depth)
+            kw["return_recorder"] = False
+            t0 = time.perf_counter()
+            run_training(ckpt_dir=os.path.join(d, "ck_clean"), **kw)
+            t_clean = time.perf_counter() - t0
+            crash_at = max(2, max_steps // 2)
+            t0 = time.perf_counter()
+            crashed = supervise_training(
+                ckpt_dir=os.path.join(d, "ck_crash"),
+                max_retries=1, backoff_base=0.0,
+                inject_faults=[f"crash@{crash_at}"], **kw,
+            )
+            t_crash = time.perf_counter() - t0
+            if crashed["retries"] != 1:
+                raise RuntimeError(
+                    f"recovery bench: expected exactly 1 retry, got "
+                    f"{crashed['retries']}"
+                )
+            if t_clean > 0:
+                recovery_overhead = (t_crash - t_clean) / t_clean
     head = max(rows, key=lambda r: r["dispatch_depth"])  # deepest = headline
     result = {
         "metric": f"alexnet_e2e_images_per_sec_{n_dev}chip",
@@ -437,6 +478,8 @@ def bench_e2e(max_steps: int = 48, batch: int = 0,
     }
     if nm_overhead is not None:
         result["numerics_overhead_frac"] = round(nm_overhead, 4)
+    if recovery_overhead is not None:
+        result["recovery_overhead_frac"] = round(recovery_overhead, 4)
     if len(rows) > 1:
         result["dispatch_sweep"] = rows
     return result
@@ -576,6 +619,11 @@ def main() -> int:
                          "--numerics-freq 1 and report "
                          "numerics_overhead_frac (the measured step-"
                          "time cost of the in-graph sentinels)")
+    ap.add_argument("--recovery-overhead", action="store_true",
+                    help="e2e mode: also time clean vs injected-crash+"
+                         "supervisor-resume runs and report "
+                         "recovery_overhead_frac (the measured wall-"
+                         "time cost of surviving one crash)")
     ap.add_argument("--ns", default=None,
                     help="scaling mode: comma-separated device counts "
                          "(default 1,2,4,8; the verdict-3 extension runs "
@@ -595,7 +643,8 @@ def main() -> int:
             if args.dispatch_depths else (args.dispatch_depth,)
         )
         result = bench_e2e(max_steps=args.steps or 48, dispatch_depths=depths,
-                           numerics=args.numerics_overhead)
+                           numerics=args.numerics_overhead,
+                           recovery=args.recovery_overhead)
     else:
         ns = tuple(int(n) for n in args.ns.split(",")) if args.ns else (1, 2, 4, 8)
         result = bench_scaling(ns=ns, steps=args.steps or 4)

@@ -31,6 +31,15 @@ def test_valid_records_pass():
         {"kind": "heartbeat", "rank": 0, "t": 1.0, "step": 5, "pid": 42},
         {"kind": "stall", "rank": 0, "t": 1.0, "step": 5, "stall_s": 3.0,
          "timeout_s": 1.0, "stacks": {"MainThread (1)": ["frame"]}},
+        # fault-tolerant run supervisor (launch/supervisor.py)
+        {"kind": "retry", "rank": 0, "t": 1.0, "attempt": 1, "step": 4,
+         "error": "InjectedCrash('boom')", "backoff_s": 0.5,
+         "resumable": False},
+        {"kind": "retry", "rank": 0, "t": 1.0, "attempt": 2, "step": -1,
+         "error": "OSError()", "backoff_s": 0.0},
+        # anomaly rollback (--on-anomaly rollback, launch/worker.py)
+        {"kind": "rollback", "rank": 0, "t": 1.0, "step": 7,
+         "restore_step": 4, "budget_left": 1, "skipped": 1},
     ]
     for rec in good:
         assert validate_record(rec) == [], rec
@@ -55,6 +64,12 @@ def test_valid_records_pass():
      "> 1.0"),
     ({"kind": "stall", "rank": 0, "t": 1.0, "step": 1, "stall_s": 1.0,
       "timeout_s": 0.5, "stacks": {"t": "not-a-list"}}, "frame strings"),
+    ({"kind": "retry", "rank": 0, "t": 1.0, "attempt": 1, "step": 4,
+      "backoff_s": 0.5}, "missing required field 'error'"),
+    ({"kind": "retry", "rank": 0, "t": 1.0, "attempt": 1, "step": 4,
+      "error": "x", "backoff_s": 0.5, "resumable": 1}, "want bool"),
+    ({"kind": "rollback", "rank": 0, "t": 1.0, "step": 7,
+      "budget_left": 1}, "missing required field 'restore_step'"),
 ])
 def test_invalid_records_flagged(rec, frag):
     errs = validate_record(rec)

@@ -1,0 +1,103 @@
+"""Fault-injection registry tests (utils/faults.py): spec parsing and
+once-only firing semantics — the deterministic substrate every recovery
+path's acceptance test stands on."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from theanompi_tpu.utils.faults import (
+    FaultInjector,
+    FaultSpec,
+    InjectedCrash,
+    parse_fault_spec,
+)
+
+
+def test_parse_fault_spec():
+    s = parse_fault_spec("crash@5")
+    assert (s.kind, s.step, s.arg) == ("crash", 5, None)
+    s = parse_fault_spec("loader_stall@3:0.25")
+    assert (s.kind, s.step, s.arg) == ("loader_stall", 3, 0.25)
+    # already-parsed specs pass through
+    assert parse_fault_spec(s) is s
+
+
+@pytest.mark.parametrize("bad", [
+    "crash",          # no @STEP
+    "explode@5",      # unknown kind
+    "crash@x",        # non-int step
+    "crash@0",        # steps are 1-based
+    "loader_stall@3:fast",  # non-numeric arg
+])
+def test_parse_fault_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_fault_spec(bad)
+
+
+def test_crash_fires_exactly_once():
+    inj = FaultInjector(["crash@3"])
+    inj.check_step(1)
+    inj.check_step(2)
+    with pytest.raises(InjectedCrash, match="before step 3"):
+        inj.check_step(3)
+    inj.check_step(3)  # fired: replaying the same step is clean
+    inj.check_step(4)
+
+
+def test_crash_fires_inside_fused_group_range():
+    inj = FaultInjector(["crash@6"])
+    inj.check_step(1, 4)  # group [1,4]: not due
+    with pytest.raises(InjectedCrash):
+        inj.check_step(5, 8)  # group [5,8] contains step 6
+
+
+def test_nan_batch_poisons_float_once():
+    inj = FaultInjector(["nan_batch@2"])
+    x = jnp.ones((4, 3))
+    assert np.isfinite(np.asarray(inj.poison_batch(x, 1))).all()
+    poisoned = inj.poison_batch(x, 2)
+    assert np.isnan(np.asarray(poisoned)).all()
+    # fired: the replayed batch comes back clean (transient fault)
+    assert np.isfinite(np.asarray(inj.poison_batch(x, 2))).all()
+
+
+def test_nan_batch_rejects_int_batches():
+    inj = FaultInjector(["nan_batch@1"])
+    with pytest.raises(ValueError, match="cannot carry"):
+        inj.poison_batch(jnp.ones((4,), jnp.int32), 1)
+
+
+def test_loader_stall_sleeps():
+    import time
+
+    inj = FaultInjector(["loader_stall@1:0.15"])
+    t0 = time.perf_counter()
+    inj.check_step(1)
+    assert time.perf_counter() - t0 >= 0.15
+    t0 = time.perf_counter()
+    inj.check_step(1)  # fired: no second stall
+    assert time.perf_counter() - t0 < 0.1
+
+
+def test_truncate_due_and_truncate_newest(tmp_path):
+    from theanompi_tpu.utils.checkpoint import (
+        save_checkpoint,
+        verify_checkpoint,
+    )
+
+    inj = FaultInjector(["ckpt_truncate@4"])
+    assert not inj.truncate_due(3)  # not yet
+    assert inj.truncate_due(5)      # due at/after step 4
+    assert not inj.truncate_due(6)  # fired
+
+    p = save_checkpoint(str(tmp_path), {"w": jnp.arange(64.0)}, 7)
+    assert verify_checkpoint(p)
+    assert FaultInjector.truncate_newest(str(tmp_path)) == p
+    assert not verify_checkpoint(p)
+
+
+def test_injector_accepts_prebuilt_specs():
+    inj = FaultInjector([FaultSpec(kind="crash", step=1)])
+    with pytest.raises(InjectedCrash):
+        inj.check_step(1)

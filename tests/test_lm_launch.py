@@ -237,7 +237,9 @@ def test_pipeline_layout_guard(tmp_path):
     np.save(os.path.join(d, "x.npy"), np.zeros(1))  # not a checkpoint
     pipeline_layout_guard(d, 2, 2, resume=False)  # empty of ckpts: ok
     pipeline_layout_guard(d, 4, 2, resume=False)  # restore layout 4x2
-    open(os.path.join(d, "ckpt_5.npz"), "wb").close()
+    # non-empty stand-in: a zero-byte file now reads as an aborted save
+    # (absent), not a checkpoint — see utils/checkpoint._readable_nonempty
+    np.savez(os.path.join(d, "ckpt_5.npz"), w=np.zeros(1))
     with pytest.raises(ValueError, match="already holds checkpoints"):
         pipeline_layout_guard(d, 2, 2, resume=False)
     pipeline_layout_guard(d, 4, 2, resume=False)  # matching: fine

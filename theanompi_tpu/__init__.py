@@ -58,5 +58,6 @@ if _impl:
 
 from theanompi_tpu import _jax_compat  # noqa: F401,E402  (jax API bridge)
 from theanompi_tpu.launch.session import BSP, EASGD, GOSGD, SyncRule  # noqa: F401,E402
+from theanompi_tpu.launch.supervisor import supervise_training  # noqa: F401,E402
 
 __all__ = ["BSP", "EASGD", "GOSGD", "SyncRule", "__version__"]

@@ -110,6 +110,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="also emit TensorBoard scalars under <save-dir>/tb "
                         "(soft dependency on tensorboardX)")
     p.add_argument("--ckpt-dir", default=None)
+    p.add_argument("--sync-ckpt", action="store_true",
+                   help="write epoch checkpoints synchronously instead "
+                        "of on the background writer thread: the save "
+                        "is durable before the next step dispatches "
+                        "(deterministic durability for preemption-prone "
+                        "runs, at the cost of stalling the loop for the "
+                        "full gather+write)")
     p.add_argument("--ckpt-sharded", action="store_true",
                    help="per-host sharded checkpoints (each controller "
                         "writes only its shards — no cross-host gather or "
@@ -151,13 +158,49 @@ def build_parser() -> argparse.ArgumentParser:
                         "them as <obs-dir>/anomaly_rank{r}/ with thread "
                         "stacks, span summary, optional state checkpoint "
                         "and an armed device trace")
-    p.add_argument("--on-anomaly", choices=["record", "dump", "halt"],
+    p.add_argument("--on-anomaly",
+                   choices=["record", "dump", "halt", "rollback"],
                    default="dump",
                    help="what a detected numerics anomaly (NaN/Inf, EWMA "
                         "spike) does: record = anomaly JSONL + gauges "
                         "only; dump = also write the flight-recorder "
                         "triage bundle (default); halt = dump, then stop "
-                        "training with a NumericsAnomaly error")
+                        "training with a NumericsAnomaly error; rollback "
+                        "= dump, then restore the last VERIFIED "
+                        "checkpoint and keep training (needs --ckpt-dir; "
+                        "see --rollback-budget/--rollback-skip)")
+    p.add_argument("--rollback-budget", type=int, default=2,
+                   help="with --on-anomaly rollback: how many restores a "
+                        "run may absorb before the anomaly escalates to "
+                        "a halt (budget exhausted = stop)")
+    p.add_argument("--rollback-skip", type=int, default=1,
+                   help="with --on-anomaly rollback: skip this many data "
+                        "batches at the anomalous step on replay, so a "
+                        "persistently bad batch cannot re-poison every "
+                        "attempt (0 = replay everything)")
+    p.add_argument("--max-retries", type=int, default=0,
+                   help="run under the fault-tolerant supervisor "
+                        "(launch/supervisor.py): retry a crashed run up "
+                        "to N times, auto-resuming each attempt from the "
+                        "newest VERIFIED checkpoint with exponential "
+                        "backoff (requires --ckpt-dir; 0 = no supervisor)")
+    p.add_argument("--retry-backoff", type=float, default=1.0,
+                   help="supervisor backoff base in seconds: retry k "
+                        "sleeps base * 2**(k-1), capped at 60s")
+    p.add_argument("--sigterm-grace", type=float, default=0.0,
+                   help="preemption grace window in seconds: > 0 "
+                        "installs a SIGTERM handler that checkpoints, "
+                        "marks the run resumable (resumable.json in "
+                        "--ckpt-dir), and exits cleanly instead of dying "
+                        "mid-step (0 = default SIGTERM disposition)")
+    p.add_argument("--inject-fault", action="append", default=[],
+                   metavar="KIND@STEP",
+                   help="deterministic fault injection (repeatable; "
+                        "utils/faults.py): crash@K, sigterm@K, "
+                        "sigkill@K, ckpt_truncate@K, nan_batch@K, "
+                        "loader_stall@K:SECONDS — each fires once, "
+                        "before dispatching step K; exercises the "
+                        "supervisor/rollback/integrity recovery paths")
     p.add_argument("--avg-freq", type=int, default=None,
                    help="EASGD/GoSGD: steps between exchanges (reference avg_freq)")
     p.add_argument("--group-size", type=int, default=None,
@@ -244,6 +287,7 @@ def main(argv=None) -> int:
 
     from theanompi_tpu.launch.session import resolve_model
     from theanompi_tpu.launch.worker import run_training
+    from theanompi_tpu.utils.faults import Preempted as _Preempted
 
     model_cls = resolve_model(args.modelfile, args.modelclass)
 
@@ -292,45 +336,86 @@ def main(argv=None) -> int:
               "--obs-dir; observability is off", flush=True)
     # (--numerics-freq without --obs-dir warns inside run_training,
     # which covers API callers too)
-    summary = run_training(
-        rule=args.rule.lower(),
-        model_cls=model_cls,
-        devices=args.n_devices or None,
-        strategy=args.strategy,
-        n_slices=args.slices,
-        steps_per_dispatch=args.steps_per_dispatch,
-        dispatch_depth=args.dispatch_depth,
-        compile_cache_dir=args.compile_cache_dir,
-        accum_steps=args.accum_steps,
-        tp=args.tp,
-        sp=args.sp,
-        pp=args.pp,
-        expert=args.expert,
-        microbatches=args.microbatches,
-        pp_interleave=args.pp_interleave,
-        zero=args.zero,
-        n_epochs=args.epochs,
-        max_steps=args.max_steps,
-        dataset=args.dataset,
-        dataset_kwargs=dataset_kwargs,
-        recipe_overrides=overrides,
-        seed=args.seed,
-        save_dir=args.save_dir,
-        ckpt_dir=args.ckpt_dir,
-        sharded_ckpt=args.ckpt_sharded,
-        resume=args.resume,
-        print_freq=args.print_freq,
-        tensorboard=args.tensorboard,
-        profile_dir=args.profile_dir,
-        profile_steps=args.profile_steps,
-        obs_dir=args.obs_dir,
-        stall_timeout=args.stall_timeout,
-        metrics_snapshot_freq=args.metrics_snapshot_freq,
-        numerics_freq=args.numerics_freq,
-        flight_window=args.flight_window,
-        on_anomaly=args.on_anomaly,
-        **rule_kwargs,
-    )
+    if args.on_anomaly == "rollback" and not args.ckpt_dir:
+        raise SystemExit("--on-anomaly rollback requires --ckpt-dir "
+                         "(the rollback restores a checkpoint)")
+    if args.max_retries and not args.ckpt_dir:
+        raise SystemExit("--max-retries requires --ckpt-dir (retries "
+                         "auto-resume from the newest verified checkpoint)")
+    if args.sigterm_grace and not args.ckpt_dir:
+        # without a ckpt dir the grace path has nothing to save and no
+        # marker to drop — exiting 75/"resumable" would promise a
+        # scheduler an auto-resume that silently restarts from step 0
+        raise SystemExit("--sigterm-grace requires --ckpt-dir (the grace "
+                         "window checkpoints and marks the run resumable)")
+
+    if args.max_retries > 0:
+        # fault-tolerant supervisor: bounded retry + verified
+        # auto-resume + preemption-marker handling around run_training
+        from theanompi_tpu.launch.supervisor import supervise_training
+
+        def _run(**kw):
+            return supervise_training(
+                max_retries=args.max_retries,
+                backoff_base=args.retry_backoff,
+                **kw,
+            )
+    else:
+        _run = run_training
+
+    try:
+        summary = _run(
+            rule=args.rule.lower(),
+            model_cls=model_cls,
+            devices=args.n_devices or None,
+            strategy=args.strategy,
+            n_slices=args.slices,
+            steps_per_dispatch=args.steps_per_dispatch,
+            dispatch_depth=args.dispatch_depth,
+            compile_cache_dir=args.compile_cache_dir,
+            accum_steps=args.accum_steps,
+            tp=args.tp,
+            sp=args.sp,
+            pp=args.pp,
+            expert=args.expert,
+            microbatches=args.microbatches,
+            pp_interleave=args.pp_interleave,
+            zero=args.zero,
+            n_epochs=args.epochs,
+            max_steps=args.max_steps,
+            dataset=args.dataset,
+            dataset_kwargs=dataset_kwargs,
+            recipe_overrides=overrides,
+            seed=args.seed,
+            save_dir=args.save_dir,
+            ckpt_dir=args.ckpt_dir,
+            async_checkpoint=not args.sync_ckpt,
+            sharded_ckpt=args.ckpt_sharded,
+            resume=args.resume,
+            print_freq=args.print_freq,
+            tensorboard=args.tensorboard,
+            profile_dir=args.profile_dir,
+            profile_steps=args.profile_steps,
+            obs_dir=args.obs_dir,
+            stall_timeout=args.stall_timeout,
+            metrics_snapshot_freq=args.metrics_snapshot_freq,
+            numerics_freq=args.numerics_freq,
+            flight_window=args.flight_window,
+            on_anomaly=args.on_anomaly,
+            rollback_budget=args.rollback_budget,
+            rollback_skip=args.rollback_skip,
+            sigterm_grace=args.sigterm_grace,
+            inject_faults=args.inject_fault or None,
+            **rule_kwargs,
+        )
+    except _Preempted as e:
+        # graceful preemption: checkpointed + marked resumable inside
+        # the grace window. EX_TEMPFAIL tells the scheduler this exit
+        # is retryable; the next invocation (supervisor or --resume)
+        # picks the run back up from the marker.
+        print(json.dumps({"preempted": True, "step": e.step,
+                          "resumable": True}))
+        return 75  # EX_TEMPFAIL
     print(json.dumps({k: v for k, v in summary.items() if k != "state"}, default=str))
     return 0
 

@@ -1,0 +1,196 @@
+"""Fault-tolerant run supervisor: bounded retry + verified auto-resume.
+
+The reference framework's failure story was "the mpirun dies" — a
+crashed worker, a corrupt checkpoint, or a transient infrastructure
+fault all required a human to notice, diagnose, and relaunch
+(SURVEY.md §5.4). :func:`supervise_training` wraps
+:func:`~theanompi_tpu.launch.worker.run_training` with the recovery
+contract a production run needs:
+
+- **Bounded retry with exponential backoff**: an attempt that dies with
+  an ordinary exception is retried up to ``max_retries`` times, sleeping
+  ``backoff_base * 2**(failures-1)`` (capped at ``backoff_max``) between
+  attempts — a crash-looping run must not hammer shared storage or the
+  scheduler.
+- **Verified auto-resume**: every retry resumes from the newest
+  checkpoint that passes the integrity chain
+  (``latest_checkpoint(verify=True)``: per-array CRC32 manifests,
+  utils/checkpoint.py) — a truncated or bit-corrupted newest file is
+  walked back past, never resumed into.
+- **Preemption awareness**: a run that exits via the SIGTERM grace path
+  (:class:`~theanompi_tpu.utils.faults.Preempted`) already checkpointed
+  and dropped a ``resumable.json`` marker; the supervisor records the
+  attempt and RE-RAISES — the SIGKILL is coming, auto-resuming in-place
+  would race it. The NEXT invocation sees the marker and auto-resumes
+  without being told ``resume=True``.
+- **Deliberate stops are not retried**: ``--on-anomaly halt`` (and a
+  rollback whose budget is exhausted) raises
+  :class:`~theanompi_tpu.obs.numerics.NumericsAnomaly` — retrying would
+  override an explicit stop-the-run policy, so it propagates.
+  ``KeyboardInterrupt``/``SystemExit`` likewise.
+
+Telemetry rides the existing obs stack: one ``kind=retry`` JSONL record
+per failed/preempted attempt in ``<obs_dir>/supervisor.jsonl`` (schema:
+tools/check_obs_schema.py) and a final ``kind=metrics`` snapshot line
+carrying ``tmpi_retries_total`` / ``tmpi_preempt_resumes_total``
+appended to ``<obs_dir>/metrics.jsonl``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Optional
+
+from theanompi_tpu.obs.numerics import NumericsAnomaly
+from theanompi_tpu.utils.checkpoint import (
+    checkpoint_step,
+    clear_resumable_marker,
+    latest_checkpoint,
+    read_resumable_marker,
+)
+from theanompi_tpu.utils.faults import Preempted
+
+
+class _SupervisorLog:
+    """Per-attempt ``retry`` records + the final metrics snapshot,
+    appended under ``obs_dir`` (inert when obs_dir is None)."""
+
+    def __init__(self, obs_dir: Optional[str], rank: int = 0):
+        self.obs_dir = obs_dir
+        self.rank = int(rank)
+        if obs_dir:
+            os.makedirs(obs_dir, exist_ok=True)
+
+    def _append(self, filename: str, rec: dict) -> None:
+        if not self.obs_dir:
+            return
+        with open(os.path.join(self.obs_dir, filename), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    def retry(self, attempt: int, step: int, error: BaseException,
+              backoff_s: float, resumable: bool = False) -> None:
+        self._append("supervisor.jsonl", {
+            "kind": "retry", "rank": self.rank, "t": time.time(),
+            "attempt": int(attempt), "step": int(step),
+            "error": repr(error), "backoff_s": float(backoff_s),
+            "resumable": bool(resumable),
+        })
+
+    def snapshot(self, retries: int, preempts: int,
+                 step: Optional[int] = None) -> None:
+        rec = {"kind": "metrics", "t": time.time(), "source": "supervisor",
+               "metrics": {"tmpi_retries_total": float(retries),
+                           "tmpi_preempt_resumes_total": float(preempts)}}
+        if step is not None:
+            rec["step"] = int(step)
+        self._append("metrics.jsonl", rec)
+
+
+def supervise_training(
+    *,
+    max_retries: int = 2,
+    backoff_base: float = 1.0,
+    backoff_max: float = 60.0,
+    ckpt_dir: Optional[str] = None,
+    obs_dir: Optional[str] = None,
+    resume: bool = False,
+    **run_kwargs: Any,
+) -> dict:
+    """Run :func:`run_training` under the supervisor (module docstring).
+
+    ``ckpt_dir`` is REQUIRED when ``max_retries > 0`` — a retry without
+    a checkpoint to resume from silently restarts training from scratch,
+    which is never what a recovery path should do quietly. All other
+    kwargs forward to ``run_training`` unchanged.
+
+    Returns the successful attempt's summary dict, extended with
+    ``retries`` (failed attempts absorbed), ``preempt_resumes``
+    (marker-driven resumes) and ``attempts`` (total runs started).
+    """
+    from theanompi_tpu.launch.worker import run_training
+
+    if max_retries and not ckpt_dir:
+        raise ValueError(
+            "supervise_training with max_retries > 0 requires ckpt_dir — "
+            "a retry can only auto-resume from a checkpoint"
+        )
+    if run_kwargs.get("inject_faults"):
+        # one injector across ALL attempts: fired flags persist, so an
+        # injected fault is transient (fires once per supervised run);
+        # rebuilding per attempt would refire it on every retry and no
+        # bounded retry policy could ever pass the faulted step
+        from theanompi_tpu.utils.faults import FaultInjector
+
+        if not isinstance(run_kwargs["inject_faults"], FaultInjector):
+            run_kwargs["inject_faults"] = FaultInjector(
+                run_kwargs["inject_faults"]
+            )
+    log = _SupervisorLog(obs_dir)
+    retries = 0
+    preempts = 0
+    attempt = 0
+    if ckpt_dir and read_resumable_marker(ckpt_dir) is not None:
+        # a previous invocation was preempted mid-run and checkpointed
+        # inside its grace window: auto-resume, no flag needed
+        preempts += 1
+        resume = True
+        print(f"[supervisor] resumable marker found in {ckpt_dir!r}; "
+              "auto-resuming", flush=True)
+    while True:
+        attempt += 1
+        if ckpt_dir:
+            # consumed: if THIS attempt is preempted too it rewrites it
+            clear_resumable_marker(ckpt_dir)
+        try:
+            summary = run_training(ckpt_dir=ckpt_dir, obs_dir=obs_dir,
+                                   resume=resume, **run_kwargs)
+            break
+        except Preempted as e:
+            # graceful preemption: checkpointed + marker written by the
+            # worker. Do NOT resume in-process — SIGTERM means the kill
+            # is imminent; record the attempt and let the exit happen.
+            # The next supervise_training() sees the marker and resumes.
+            log.retry(attempt, e.step, e, 0.0, resumable=True)
+            log.snapshot(retries, preempts, step=e.step)
+            raise
+        except NumericsAnomaly:
+            # --on-anomaly halt (or an exhausted rollback budget) is a
+            # DELIBERATE stop; retrying would override the policy
+            raise
+        except Exception as e:  # noqa: BLE001 — the retry boundary
+            retries += 1
+            # verify=True deliberately duplicates the walk resume will
+            # redo: the retry record's `step` field is the contract
+            # "what the next attempt ACTUALLY resumes from" — after a
+            # torn newest checkpoint, the unverified newest would name
+            # the very file resume walks past. Retries are rare and
+            # backoff-dominated; the extra decompress+CRC walk is the
+            # price of an honest record.
+            path = latest_checkpoint(ckpt_dir, verify=True) if ckpt_dir else None
+            step = checkpoint_step(path)
+            if retries > max_retries:
+                log.retry(attempt, step, e, 0.0)
+                log.snapshot(retries, preempts)
+                raise
+            backoff = min(float(backoff_max),
+                          float(backoff_base) * (2 ** (retries - 1)))
+            log.retry(attempt, step, e, backoff)
+            print(
+                f"[supervisor] attempt {attempt} failed ({e!r}); retry "
+                f"{retries}/{max_retries} resumes from "
+                f"{'step ' + str(step) if step >= 0 else 'scratch (no verified checkpoint)'} "
+                f"after {backoff:.2f}s backoff",
+                flush=True,
+            )
+            if backoff > 0:
+                time.sleep(backoff)
+            resume = True
+    if ckpt_dir:
+        clear_resumable_marker(ckpt_dir)
+    summary["retries"] = retries
+    summary["preempt_resumes"] = preempts
+    summary["attempts"] = attempt
+    log.snapshot(retries, preempts, step=summary.get("steps"))
+    return summary

@@ -18,7 +18,9 @@ the driver is plain single-controller Python around jitted SPMD steps
 from __future__ import annotations
 
 import os
+import signal
 import sys
+import threading
 import time
 from typing import Any, Optional
 
@@ -38,7 +40,14 @@ from theanompi_tpu.utils import (
     load_checkpoint,
     save_checkpoint,
 )
-from theanompi_tpu.utils.checkpoint import AsyncCheckpointer, save_checkpoint_sharded
+from theanompi_tpu.utils.checkpoint import (
+    AsyncCheckpointer,
+    clear_resumable_marker,
+    save_checkpoint_sharded,
+    write_resumable_marker,
+)
+from theanompi_tpu.utils.faults import FaultInjector, Preempted
+from theanompi_tpu.obs.numerics import NumericsAnomaly, RollbackRequested
 
 
 def _layout_mismatch(a: dict, b: dict) -> bool:
@@ -177,11 +186,28 @@ def run_training(
     # host syncs; anomalies (NaN/Inf, EWMA spikes) are detected at
     # drain time and handled per on_anomaly: 'record' (log + gauges),
     # 'dump' (also write the anomaly_rank{r}/ triage bundle), 'halt'
-    # (dump, then stop training). flight_window sizes the ring of
-    # drained step records the bundle preserves.
+    # (dump, then stop training), 'rollback' (dump, then restore the
+    # last verified checkpoint and keep training — see rollback_budget/
+    # rollback_skip below). flight_window sizes the ring of drained
+    # step records the bundle preserves.
     numerics_freq: int = 0,
     flight_window: int = 64,
     on_anomaly: str = "dump",
+    # anomaly rollback (--on-anomaly rollback): on a confirmed anomaly
+    # restore the last VERIFIED checkpoint and keep training — at most
+    # rollback_budget times per run; on replay, skip rollback_skip data
+    # batches at the anomalous step (a persistent bad batch must not
+    # re-poison every attempt)
+    rollback_budget: int = 2,
+    rollback_skip: int = 1,
+    # SIGTERM grace (preemption): > 0 installs a handler; the train
+    # loop then checkpoints, marks the run resumable, and exits cleanly
+    # (Preempted) instead of dying mid-step
+    sigterm_grace: float = 0.0,
+    # deterministic fault injection (utils/faults.py): KIND@STEP specs —
+    # crash/sigterm/sigkill/ckpt_truncate/nan_batch/loader_stall — so
+    # recovery paths are exercised by tests, not trusted on faith
+    inject_faults: Optional[list] = None,
     # persistent XLA compilation cache: repeated runs (bench sweeps,
     # requeued jobs) skip recompiling identical programs
     compile_cache_dir: Optional[str] = None,
@@ -552,14 +578,39 @@ def run_training(
     state = engine.init_state(rng)
     start_epoch = 0
     summary_resumed_from = None
+    # data batches skipped by anomaly rollbacks in this training
+    # timeline (restored from checkpoint meta on resume): every replay
+    # position below must count BATCHES CONSUMED = step + skipped, or a
+    # later resume would re-feed one already-trained batch per skip and
+    # shift every subsequent step's data
+    skipped_prior = 0
     layout_meta = None
     if ckpt_dir:
         # validates for EVERY rule (a fresh non-pipeline run must not
         # clobber an interleaved dir either); writes/clears the sidecar
         layout = pipeline_layout_guard(ckpt_dir, pp, pp_interleave, resume)
         layout_meta = {"pipeline_layout": layout}
+
+    def _place_restored(restored):
+        # restored leaves are full host arrays; under multi-controller
+        # the SPMD step needs global sharded jax Arrays — each process
+        # commits only its addressable shards (jnp.asarray would make
+        # process-local arrays). Shared by resume and anomaly rollback.
+        shardings = getattr(engine, "state_shardings", None)
+        if n_proc > 1 and shardings is not None:
+            return jax.tree_util.tree_map(
+                lambda a, s: jax.make_array_from_callback(
+                    np.shape(a), s, lambda idx, a=a: np.asarray(a)[idx]
+                ),
+                restored, shardings,
+            )
+        return jax.tree_util.tree_map(jnp.asarray, restored)
+
     if resume and ckpt_dir:
-        path = latest_checkpoint(ckpt_dir)
+        # verify=True: the integrity chain (per-array CRC manifests)
+        # walks back past a corrupt/truncated newest checkpoint instead
+        # of resuming into a load-time explosion
+        path = latest_checkpoint(ckpt_dir, verify=True)
         if n_proc > 1:
             # Every controller must resume from the SAME step or the
             # lockstep SPMD program diverges/deadlocks. ckpt_dir must be
@@ -586,7 +637,8 @@ def run_training(
         if path:
             from theanompi_tpu.utils.checkpoint import read_checkpoint_meta
 
-            saved_layout = read_checkpoint_meta(path).get("pipeline_layout")
+            ckpt_meta = read_checkpoint_meta(path)
+            saved_layout = ckpt_meta.get("pipeline_layout")
             if saved_layout is not None and layout_meta is not None and (
                 _layout_mismatch(saved_layout, layout_meta["pipeline_layout"])
             ):
@@ -601,25 +653,16 @@ def run_training(
                     "matching --pp/--pp-interleave"
                 )
             restored, saved_rng = load_checkpoint(path, state)
-            shardings = getattr(engine, "state_shardings", None)
-            if n_proc > 1 and shardings is not None:
-                # restored leaves are full host arrays; under multi-
-                # controller the SPMD step needs global sharded jax
-                # Arrays — each process commits only its addressable
-                # shards (jnp.asarray would make process-local arrays)
-                state = jax.tree_util.tree_map(
-                    lambda a, s: jax.make_array_from_callback(
-                        np.shape(a), s, lambda idx, a=a: np.asarray(a)[idx]
-                    ),
-                    restored, shardings,
-                )
-            else:
-                state = jax.tree_util.tree_map(jnp.asarray, restored)
+            state = _place_restored(restored)
             if saved_rng is not None:
                 # already wrapped with the impl that wrote it — a
                 # pre-rbg-default threefry checkpoint keeps resuming
                 rng = saved_rng
-            start_epoch = engine.get_step(state) // steps_per_epoch
+            # positioning counts BATCHES CONSUMED, not steps: rollback
+            # skips consumed batches without training steps, and the
+            # checkpoint records how many (see skipped_prior above)
+            skipped_prior = int(ckpt_meta.get("skipped_batches", 0))
+            start_epoch = (engine.get_step(state) + skipped_prior) // steps_per_epoch
             summary_resumed_from = engine.get_step(state)
             print(f"resumed from {path} at step {engine.get_step(state)}", flush=True)
 
@@ -674,9 +717,10 @@ def run_training(
     sync_save = save_checkpoint_sharded if sharded_ckpt else save_checkpoint
     step_count = engine.get_step(state)
     # Mid-epoch resume (checkpoint written after a max_steps truncation):
-    # fast-forward past the batches the restored step count already
-    # consumed, so data order and epoch accounting stay exact.
-    skip_batches = step_count % steps_per_epoch
+    # fast-forward past the batches the restored timeline already
+    # consumed — trained steps PLUS rollback-skipped batches — so data
+    # order and epoch accounting stay exact.
+    skip_batches = (step_count + skipped_prior) % steps_per_epoch
     from theanompi_tpu.obs import Observability
 
     # obs facade: span log + heartbeat per rank, metrics snapshots on
@@ -760,11 +804,77 @@ def run_training(
         )
     train_loop_s = 0.0  # wall time inside the train loops (the
     # denominator of summary['host_blocked_frac'])
+    # -- fault-tolerance state (fault-tolerant run supervisor PR) -------
+    # injected faults fire at deterministic steps (utils/faults.py);
+    # SIGTERM flips a flag the train loops poll, so preemption
+    # checkpoints and exits cleanly inside the grace window; the
+    # rollback policy restores the last VERIFIED checkpoint on a
+    # confirmed anomaly and keeps training within its budget.
+    # accept a pre-built injector: the supervisor passes ONE instance
+    # through every retry attempt, so its fired flags persist and an
+    # injected fault is transient (fires once per supervised run, not
+    # once per attempt — refiring every attempt would model a permanent
+    # bug no retry policy could absorb)
+    faults = (
+        inject_faults if isinstance(inject_faults, FaultInjector)
+        else (FaultInjector(inject_faults) if inject_faults else None)
+    )
+    rollbacks = 0
+    rollback_budget_left = (
+        max(0, int(rollback_budget)) if on_anomaly == "rollback" else 0
+    )
+    skip_from_step: Optional[int] = None  # anomalous step whose batch
+    # window the post-rollback replay skips (per-step path)
+    skip_data_batches = 0
+    skipped_steps_total = skipped_prior  # timeline total, persisted in
+    # every checkpoint's meta so replay positioning survives resume
+    # set the moment an anomaly is detected in the LIVE state (a flush
+    # during preemption/unwinding making the first detection): both the
+    # preemption save and the finally's crash save honor it, so a
+    # poisoned state can never become the newest resumable checkpoint
+    _state_poisoned = False
+
+    def _save_meta():
+        # checkpoint meta: pipeline layout + (when any) the rollback-
+        # skipped batch count — the replay-position correction a later
+        # resume needs (batches consumed = step + skipped)
+        m = dict(layout_meta or {})
+        if skipped_steps_total:
+            m["skipped_batches"] = skipped_steps_total
+        return m or None
+    # step of the newest durable checkpoint: the crash-path save in the
+    # finally below must not duplicate a boundary save (-1 = none yet)
+    last_ckpt_step = step_count if summary_resumed_from is not None else -1
+    _preempt = {"flag": False}
+    _prev_sigterm = None
+    if sigterm_grace and sigterm_grace > 0:
+        if threading.current_thread() is threading.main_thread():
+
+            def _on_sigterm(signum, frame):
+                _preempt["flag"] = True
+                print(
+                    f"[rank {jax.process_index()}] SIGTERM: will "
+                    f"checkpoint and exit within the {sigterm_grace}s "
+                    "grace window",
+                    flush=True,
+                )
+
+            _prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
+        else:
+            print(
+                f"[rank {jax.process_index()}] WARNING: sigterm_grace "
+                "needs the main thread (signal handlers cannot be "
+                "installed from session-API background threads); "
+                "preemption grace is off for this run",
+                flush=True,
+            )
     # the device trace and the JSONL log must be closed even when a
     # step raises (OOM, loader failure, Ctrl-C) — close() stops a
     # live capture and warns if the window never opened
     try:
-        for epoch in range(start_epoch, n_epochs):
+        epoch = start_epoch
+        while epoch < n_epochs:
+          try:
             rec.start_epoch()
             epoch_steps = 0
             t_loop0 = time.perf_counter()
@@ -791,6 +901,8 @@ def run_training(
                     skip_batches = 0
                     rec.start("wait")
                     for xs, ys in loader:
+                        if _preempt["flag"]:
+                            raise Preempted(step_count)
                         disp.note_wait(rec.end("wait"))
                         if max_steps and step_count + xs.shape[0] > max_steps:
                             # trim the final group to land exactly on max_steps
@@ -798,6 +910,16 @@ def run_training(
                             xs, ys = xs[:keep], ys[:keep]
                         rec.profile_tick(step_count)
                         g = int(xs.shape[0])
+                        if faults is not None:
+                            # fused injection at GROUP granularity: a
+                            # fault due anywhere in the group fires
+                            # before its dispatch; nan_batch poisons
+                            # the whole stacked transfer (the sentinel
+                            # machinery reads it identically)
+                            faults.check_step(step_count + 1, step_count + g)
+                            xs = faults.poison_batch(
+                                xs, step_count + 1, step_count + g
+                            )
                         # the SAME sequential splits the per-step path draws,
                         # shipped stacked — fused training is bit-identical
                         subs = []
@@ -854,7 +976,27 @@ def run_training(
                         if skip_batches:
                             skip_batches -= 1
                             continue
+                        if skip_from_step is not None and (
+                            step_count + 1 == skip_from_step
+                        ):
+                            # post-rollback replay reached the anomalous
+                            # step again: skip its batch window (consume
+                            # the data and its rng splits, train
+                            # nothing) so a persistent bad batch cannot
+                            # re-poison every rollback attempt
+                            skip_from_step = None
+                            skip_data_batches = max(0, int(rollback_skip))
+                        if skip_data_batches:
+                            skip_data_batches -= 1
+                            skipped_steps_total += 1
+                            rng, _ = jax.random.split(rng)
+                            continue
+                        if _preempt["flag"]:
+                            raise Preempted(step_count)
                         disp.note_wait(rec.end("wait"))
+                        if faults is not None:
+                            faults.check_step(step_count + 1)
+                            xg = faults.poison_batch(xg, step_count + 1)
                         rec.profile_tick(step_count)
                         rng, sub = jax.random.split(rng)
                         # sentinel cadence: every nfreq-th step runs the
@@ -953,16 +1095,157 @@ def run_training(
                     # bracket times only the enqueue; the real write is
                     # spanned inside utils/checkpoint.py on its thread
                     ckpt_writer.save(ckpt_dir, state, step_count, rng=rng,
-                                     extra_meta=layout_meta)
+                                     extra_meta=_save_meta())
                 else:
                     sync_save(ckpt_dir, state, step_count, rng=rng,
-                              extra_meta=layout_meta)
+                              extra_meta=_save_meta())
                 rec.end("checkpoint")
+                last_ckpt_step = step_count
+                if faults is not None and faults.truncate_due(step_count):
+                    # ckpt_truncate: tear the newest checkpoint the way
+                    # a host dying mid-write would (the async save must
+                    # be durable first, or the PREVIOUS file would be
+                    # the one torn) — latest_checkpoint(verify=True)
+                    # must walk back past it
+                    if ckpt_writer is not None:
+                        ckpt_writer.wait()
+                    faults.truncate_newest(ckpt_dir)
             rec.save()
             obs.snapshot(step=step_count)  # epoch-boundary metrics snapshot
             summary["epochs"].append(epoch)
             if max_steps and step_count >= max_steps:
                 break
+            epoch += 1
+          except RollbackRequested as rb:
+            # --on-anomaly rollback: restore the newest VERIFIED
+            # checkpoint and keep training. The dispatcher's in-flight
+            # entries belong to steps the restore is about to erase —
+            # discard them, never drain (draining would re-run anomaly
+            # detection on the very rows that fired). With the budget
+            # exhausted, no ckpt_dir, or nothing verified on disk, the
+            # raise stands and rollback degrades to halt semantics.
+            disp.discard()
+            if rollback_budget_left <= 0 or not ckpt_dir:
+                raise
+            if ckpt_writer is not None:
+                try:
+                    ckpt_writer.wait()  # the pre-anomaly boundary save
+                except Exception as e:  # noqa: BLE001
+                    print(f"checkpoint writer failed before rollback "
+                          f"(suppressed): {e!r}", flush=True)
+            path = latest_checkpoint(ckpt_dir, verify=True)
+            if n_proc > 1:
+                # same agreement guard as the resume path: every
+                # controller must restore the SAME step (an NFS
+                # attribute cache or a short sharded set can make one
+                # rank resolve an older checkpoint) or the lockstep
+                # SPMD replay diverges/deadlocks silently
+                from jax.experimental import multihost_utils
+
+                steps_seen = np.asarray(
+                    multihost_utils.process_allgather(
+                        np.int64(checkpoint_step(path))
+                    )
+                ).reshape(-1)
+                if not np.all(steps_seen == steps_seen[0]):
+                    raise RuntimeError(
+                        f"controller processes resolved different "
+                        f"rollback checkpoints {steps_seen.tolist()} "
+                        f"(this is process {jax.process_index()}): "
+                        f"ckpt_dir={ckpt_dir!r} views disagree"
+                    ) from rb
+            if path is None:
+                raise
+            rollback_budget_left -= 1
+            rollbacks += 1
+            restored, saved_rng = load_checkpoint(path, state)
+            state = _place_restored(restored)
+            if saved_rng is not None:
+                rng = saved_rng
+            step_count = engine.get_step(state)
+            last_ckpt_step = step_count
+            # replay from the restored boundary; the per-step path
+            # skips the anomalous step's batch window when it gets
+            # there (fused dispatch replays without skipping: transient
+            # faults clear on replay, persistent ones exhaust the
+            # budget)
+            skip_from_step = (
+                rb.step if (rollback_skip and fuse == 1) else None
+            )
+            skip_data_batches = 0
+            # position by BATCHES CONSUMED in the restored timeline:
+            # the checkpoint's meta records the batches earlier
+            # rollbacks skipped before it was written — skips after it
+            # are erased with the state they fed
+            from theanompi_tpu.utils.checkpoint import read_checkpoint_meta
+
+            skipped_steps_total = int(
+                read_checkpoint_meta(path).get("skipped_batches", 0)
+            )
+            consumed = step_count + skipped_steps_total
+            epoch = consumed // steps_per_epoch
+            skip_batches = consumed % steps_per_epoch
+            obs.note_rollback(rb.step, step_count, rollback_budget_left,
+                              skipped=int(rollback_skip) if fuse == 1 else 0)
+            print(
+                f"[rank {jax.process_index()}] anomaly rollback: restored "
+                f"{path} at step {step_count} (anomaly at step {rb.step}; "
+                f"budget left {rollback_budget_left})",
+                flush=True,
+            )
+          except Preempted:
+            # SIGTERM grace: persist what we have — drain the in-flight
+            # rows, make any async save durable, write a final
+            # checkpoint at the current step, and mark the run
+            # resumable so the supervisor's next invocation picks it
+            # up. The re-raise unwinds through the finally below
+            # (recorder/obs close) and reaches the CLI/supervisor as a
+            # clean, resumable exit.
+            try:
+                disp.flush()
+            except NumericsAnomaly as e:
+                # the drained tail held the FIRST detection of an
+                # anomaly: the live state is poisoned — it must NOT
+                # become the newest resumable checkpoint (quarantine
+                # invariant; the flag also disarms the finally's crash
+                # save); the marker still lands, so the next invocation
+                # resumes from the last GOOD checkpoint
+                _state_poisoned = True
+                print(f"numerics anomaly surfaced during preemption "
+                      f"flush; skipping the final checkpoint: {e!r}",
+                      flush=True)
+            except Exception as e:  # noqa: BLE001
+                print(f"dispatch flush failed during preemption "
+                      f"(suppressed): {e!r}", flush=True)
+            if ckpt_dir:
+                if ckpt_writer is not None:
+                    # suppressed like the rollback path: a failed
+                    # BACKGROUND write must not replace the clean
+                    # Preempted exit (the sync save below still runs)
+                    try:
+                        ckpt_writer.wait()
+                    except Exception as e:  # noqa: BLE001
+                        print(f"checkpoint writer failed during "
+                              f"preemption (suppressed): {e!r}",
+                              flush=True)
+                if step_count != last_ckpt_step and not _state_poisoned:
+                    # best-effort like the crash-save path: a failed
+                    # final save (quota, transient NFS) must not
+                    # replace the clean Preempted exit — the last
+                    # boundary checkpoint is still a valid resume
+                    # point, and the marker below records it
+                    try:
+                        sync_save(ckpt_dir, state, step_count, rng=rng,
+                                  extra_meta=_save_meta())
+                        last_ckpt_step = step_count
+                    except Exception as e:  # noqa: BLE001
+                        print(f"final preemption checkpoint failed "
+                              f"(suppressed; marker will point at step "
+                              f"{last_ckpt_step}): {e!r}", flush=True)
+                if jax.process_index() == 0:
+                    write_resumable_marker(ckpt_dir, last_ckpt_step,
+                                           "sigterm")
+            raise
 
     finally:
         # best-effort drain of in-flight step metrics BEFORE the
@@ -985,9 +1268,54 @@ def run_training(
             if _exc is None or issubclass(_exc, Exception):
                 try:
                     disp.flush()
+                except NumericsAnomaly as e:
+                    # first detection arrived in the unwinding flush:
+                    # the state is poisoned — record that so the crash
+                    # save below cannot quarantine-break (the anomaly
+                    # itself stays suppressed; the original exception
+                    # keeps propagating)
+                    _state_poisoned = True
+                    print(f"numerics anomaly surfaced during error-"
+                          f"unwinding flush (suppressed): {e!r}",
+                          flush=True)
                 except Exception as e:  # noqa: BLE001
                     print(f"dispatch flush failed during error unwinding "
                           f"(suppressed): {e!r}", flush=True)
+            if (
+                _exc is not None
+                and issubclass(_exc, Exception)
+                and not issubclass(_exc, NumericsAnomaly)
+                and not _state_poisoned
+                and ckpt_dir
+                and step_count > last_ckpt_step
+            ):
+                # crash-path durability: an exception with an async save
+                # still in flight must not lose the newest state — wait()
+                # the pending write, then attempt ONE final synchronous
+                # checkpoint at the crash step (the disp.flush() pattern
+                # above, applied to state). Best-effort: a poisoned
+                # device value can fail the gather, and that failure
+                # must not mask the training exception propagating.
+                # Skipped for NumericsAnomaly unwinds (halt / rollback
+                # budget exhausted): that state IS the anomalous one —
+                # making it the newest resumable checkpoint would poison
+                # every future resume; the flight dump's state/ capture
+                # already preserves it for triage, quarantined from the
+                # resume chain.
+                try:
+                    if ckpt_writer is not None:
+                        ckpt_writer.wait()
+                    sync_save(ckpt_dir, state, step_count, rng=rng,
+                              extra_meta=_save_meta())
+                    last_ckpt_step = step_count
+                    print(
+                        f"[rank {jax.process_index()}] crash checkpoint "
+                        f"saved at step {step_count}",
+                        flush=True,
+                    )
+                except Exception as e:  # noqa: BLE001
+                    print(f"crash checkpoint failed during error "
+                          f"unwinding (suppressed): {e!r}", flush=True)
         finally:
             try:
                 if ckpt_writer is not None:
@@ -1013,7 +1341,20 @@ def run_training(
                     # final snapshot + span summary + health-thread
                     # shutdown; after rec.close() so the recorder's last
                     # emissions land
-                    obs.close()
+                    try:
+                        obs.close()
+                    finally:
+                        if _prev_sigterm is not None:
+                            # restore the caller's SIGTERM disposition
+                            # (tests and stacked runs share the process)
+                            signal.signal(signal.SIGTERM, _prev_sigterm)
+    # reached only on success: a completed run consumed any resumable
+    # marker a preempted predecessor left — otherwise a later SUPERVISED
+    # run reusing this ckpt_dir would silently flip into resume mode
+    # off the stale marker (the supervisor clears its own, but plain
+    # --resume completions must too)
+    if ckpt_dir and jax.process_index() == 0:
+        clear_resumable_marker(ckpt_dir)
     summary["steps"] = step_count
     # device-truth step counter (host-fetched AFTER training): the host
     # loop counts dispatches, the device counts executions — a tunneled
@@ -1028,6 +1369,11 @@ def run_training(
     # numerics is off) — a nonzero count with policy 'record'/'dump' is
     # the "check the triage bundle" signal for sweep drivers
     summary["anomalies"] = obs.anomaly_count
+    # anomaly-rollback accounting (--on-anomaly rollback): restores of
+    # the last verified checkpoint, and the data batches the replay
+    # skipped at the anomalous steps
+    summary["rollbacks"] = rollbacks
+    summary["skipped_steps"] = skipped_steps_total
     summary["host_blocked_s"] = round(disp.host_blocked_s, 6)
     summary["train_loop_s"] = round(train_loop_s, 6)
     summary["host_blocked_frac"] = (

@@ -46,6 +46,19 @@ On-disk layout under ``obs_dir`` (schemas:
                             drained numerics step: tmpi gauge values
                             under ``metrics``, non-finite keys named in
                             ``nonfinite_keys``) + kind=anomaly records
+                            + kind=rollback records (one per
+                            ``--on-anomaly rollback`` restore: the
+                            anomalous step, the verified checkpoint
+                            step restored, budget left, batches
+                            skipped)
+    supervisor.jsonl        kind=retry records from the run supervisor
+                            (launch/supervisor.py): one per failed or
+                            preempted attempt — attempt index, the
+                            verified resume-from step, the error, the
+                            backoff applied; the supervisor also
+                            appends a final kind=metrics snapshot
+                            (source="supervisor") carrying
+                            tmpi_retries_total to metrics.jsonl
     anomaly_rank{r}/        flight-recorder triage bundle (ring.jsonl,
                             report.json, stacks.txt, span_summary.json,
                             optional state/ checkpoint + postmortem/
@@ -83,10 +96,11 @@ from theanompi_tpu.obs.numerics import (  # noqa: F401
     AnomalyDetector,
     NumericsAnomaly,
     NumericsModel,
+    RollbackRequested,
 )
 from theanompi_tpu.obs.spans import SpanRecorder, obs_span  # noqa: F401
 
-ANOMALY_POLICIES = ("record", "dump", "halt")
+ANOMALY_POLICIES = ("record", "dump", "halt", "rollback")
 
 
 class Observability:
@@ -327,8 +341,13 @@ class Observability:
             else:
                 print(f"[rank {self.rank}] numerics anomaly: {line}",
                       file=sys.stderr, flush=True)
-        if self.on_anomaly in ("dump", "halt") and self.flight is not None:
+        if self.on_anomaly in ("dump", "halt", "rollback") and self.flight is not None:
             self.flight.dump("anomaly", step=step, anomalies=anomalies)
+        if self.on_anomaly == "rollback":
+            # the driver catches this, restores the last verified
+            # checkpoint, and keeps training within its rollback budget
+            # (launch/worker.py); escaping it degrades to halt semantics
+            raise RollbackRequested(step, anomalies)
         if self.on_anomaly == "halt":
             names = sorted({a["metric"] for a in anomalies})
             raise NumericsAnomaly(
@@ -336,6 +355,33 @@ class Observability:
                 f"({len(anomalies)} trigger(s); triage bundle: "
                 f"{self.flight.dir if self.flight else 'no obs_dir'})"
             )
+
+    def note_rollback(self, anomaly_step: int, restore_step: int,
+                      budget_left: int, skipped: int = 0) -> None:
+        """Driver hook (``--on-anomaly rollback``, launch/worker.py):
+        one restore happened. Counts ``tmpi_rollbacks_total``, writes a
+        ``rollback`` JSONL record next to the anomaly records, and
+        RESETS the anomaly detector — its EWMA baselines were fed by
+        the poisoned steps the restore just erased, and the replayed
+        steps must re-warm from clean values."""
+        if self.enabled:
+            self.registry.counter(
+                "tmpi_rollbacks_total",
+                help="anomaly rollbacks: restores of the last verified "
+                     "checkpoint (--on-anomaly rollback)",
+            ).inc()
+        if self.detector is not None:
+            self.detector = AnomalyDetector()
+        import time as _time
+
+        line = {"kind": "rollback", "rank": self.rank, "t": _time.time(),
+                "step": int(anomaly_step), "restore_step": int(restore_step),
+                "budget_left": int(budget_left), "skipped": int(skipped)}
+        if self.enabled and not self._closed:
+            self._write_numerics_line(line)
+        else:
+            print(f"[rank {self.rank}] anomaly rollback: {line}",
+                  file=sys.stderr, flush=True)
 
     def on_step(self, step: int, substeps: int = 1,
                 step_seconds: Optional[float] = None) -> None:

@@ -54,6 +54,25 @@ class NumericsAnomaly(RuntimeError):
     instead of burning hours on NaN params."""
 
 
+class RollbackRequested(NumericsAnomaly):
+    """Raised by the obs facade under ``--on-anomaly rollback`` (after
+    the flight dump landed): the training driver catches it, restores
+    the last VERIFIED checkpoint, optionally skips the offending step
+    window, decrements the rollback budget, and keeps training
+    (``launch/worker.py``). Escapes the driver only when the budget is
+    exhausted or there is nothing verified to roll back to — then it
+    behaves exactly like ``halt``."""
+
+    def __init__(self, step: int, anomalies: list):
+        self.step = int(step)
+        self.anomalies = list(anomalies)
+        names = sorted({a.get("metric", "?") for a in self.anomalies})
+        super().__init__(
+            f"numerics anomaly at step {step}: {names} — rollback "
+            f"requested ({len(self.anomalies)} trigger(s))"
+        )
+
+
 @dataclass
 class NumericsModel:
     """Per-engine numerics declaration (the ``traffic_model()`` peer)."""

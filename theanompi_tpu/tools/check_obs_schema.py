@@ -119,6 +119,33 @@ SCHEMAS: dict[str, dict[str, tuple[tuple, bool]]] = {
         "stacks": ((dict,), True),
         "postmortem_trace": ((str,), False),
     },
+    # fault-tolerant run supervisor (launch/supervisor.py): one record
+    # per failed or preempted attempt, appended to supervisor.jsonl.
+    # `step` is the VERIFIED checkpoint step the next attempt resumes
+    # from (-1 = none: the retry restarts from scratch); `resumable`
+    # marks a SIGTERM-grace exit that checkpointed cleanly.
+    "retry": {
+        "rank": ((int,), True),
+        "t": (_NUM, True),
+        "attempt": ((int,), True),
+        "step": ((int,), True),
+        "error": ((str,), True),
+        "backoff_s": (_NUM, True),
+        "resumable": ((bool,), False),
+    },
+    # anomaly rollback (--on-anomaly rollback, launch/worker.py): one
+    # record per restore, written to numerics_rank{r}.jsonl next to the
+    # anomaly records that triggered it. `step` is the anomalous step,
+    # `restore_step` the verified checkpoint step restored, `skipped`
+    # the data batches the replay will skip at the anomalous step.
+    "rollback": {
+        "rank": ((int,), True),
+        "t": (_NUM, True),
+        "step": ((int,), True),
+        "restore_step": ((int,), True),
+        "budget_left": ((int,), True),
+        "skipped": ((int,), False),
+    },
 }
 
 

@@ -1,5 +1,5 @@
 """Cross-cutting utilities: recorder (timing/metrics), async dispatch
-pipeline, checkpointing, logging."""
+pipeline, checkpointing, fault injection, logging."""
 
 from theanompi_tpu.utils.dispatch import MetricsDispatcher  # noqa: F401
 from theanompi_tpu.utils.recorder import Recorder  # noqa: F401
@@ -8,5 +8,12 @@ from theanompi_tpu.utils.checkpoint import (  # noqa: F401
     load_checkpoint,
     latest_checkpoint,
     save_checkpoint,
+    verify_checkpoint,
     wrap_saved_rng,
+)
+from theanompi_tpu.utils.faults import (  # noqa: F401
+    FaultInjector,
+    InjectedCrash,
+    Preempted,
+    parse_fault_spec,
 )

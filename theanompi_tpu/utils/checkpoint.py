@@ -20,6 +20,8 @@ from __future__ import annotations
 import os
 import re
 import tempfile
+import time
+import zlib
 from typing import Any, Optional
 
 import numpy as np
@@ -31,6 +33,27 @@ PyTree = Any
 
 _CKPT_RE = re.compile(r"ckpt_(\d+)\.npz$")
 _SHARD_RE = re.compile(r"ckpt_(\d+)\.proc(\d+)of(\d+)\.npz$")
+
+# per-array integrity manifest key (fault-tolerance PR): JSON map of
+# array name -> {crc32, nbytes}, embedded IN the .npz at save time so a
+# checkpoint copied anywhere carries its own verification chain
+_INTEGRITY_KEY = "__integrity__"
+
+
+def _array_crc(arr: np.ndarray) -> dict:
+    """{crc32, nbytes} of one saved array's raw bytes."""
+    buf = np.ascontiguousarray(arr).tobytes()
+    return {"crc32": zlib.crc32(buf) & 0xFFFFFFFF, "nbytes": len(buf)}
+
+
+def _with_integrity(flat: dict) -> dict:
+    """Append the CRC32 manifest over every entry already in ``flat``
+    (called LAST before np.savez, so the manifest covers rng/meta too)."""
+    import json as _json
+
+    manifest = {k: _array_crc(np.asarray(v)) for k, v in flat.items()}
+    flat[_INTEGRITY_KEY] = np.asarray(_json.dumps(manifest))
+    return flat
 
 
 def _pull_to_host(leaf) -> np.ndarray:
@@ -117,7 +140,7 @@ def save_checkpoint(
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
     try:
         with os.fdopen(fd, "wb") as f:
-            np.savez(f, **flat)
+            np.savez(f, **_with_integrity(flat))
         os.replace(tmp, path)  # atomic on POSIX
     except BaseException:
         if os.path.exists(tmp):
@@ -244,7 +267,7 @@ def save_checkpoint_sharded(
         # this host's shard files (distinct from the driver's
         # 'checkpoint' bracket — see save_checkpoint's gather span note)
         with obs_span("checkpoint_write"), os.fdopen(fd, "wb") as f:
-            np.savez(f, **flat)
+            np.savez(f, **_with_integrity(flat))
         os.replace(tmp, path)
     except BaseException:
         if os.path.exists(tmp):
@@ -256,12 +279,26 @@ def save_checkpoint_sharded(
     return path
 
 
+def _readable_nonempty(path: str) -> bool:
+    """False for a zero-byte or stat-unreadable file — on some
+    filesystems a host dying mid-``os.replace`` leaves a zero-length
+    entry under the final name; resume discovery must treat it as
+    ABSENT (an incomplete save), not raise on it."""
+    try:
+        return os.path.getsize(path) > 0
+    except OSError:
+        return False
+
+
 def _sharded_sets(directory: str) -> dict[int, list[str]]:
     """step -> sorted COMPLETE file sets (all n present); incomplete
-    sets (a host died mid-save) are excluded."""
+    sets (a host died mid-save) are excluded, and a zero-byte or
+    unreadable member counts as missing (see :func:`_readable_nonempty`)."""
     by_step: dict[int, dict[int, tuple[int, str]]] = {}
     for f in os.listdir(directory):
         if m := _SHARD_RE.search(f):
+            if not _readable_nonempty(os.path.join(directory, f)):
+                continue
             step, k, n = int(m.group(1)), int(m.group(2)), int(m.group(3))
             by_step.setdefault(step, {})[k] = (n, f)
     out = {}
@@ -378,21 +415,83 @@ def checkpoint_step(path: Optional[str]) -> int:
     return int(m.group(1))
 
 
-def latest_checkpoint(directory: str) -> Optional[str]:
+def _verify_npz(path: str) -> bool:
+    """One .npz member checks out: every array decompresses, and when an
+    integrity manifest is embedded (post-fault-tolerance saves) each
+    array's CRC32 matches it exactly. Truncation is caught either way
+    (np.savez's zip central directory lives at the END of the file);
+    the manifest adds end-to-end bit-corruption coverage and detects a
+    manifest/content mismatch. Never raises — a corrupt file is a False,
+    not an exception out of resume discovery."""
+    import json as _json
+
+    if not _readable_nonempty(path):
+        return False
+    try:
+        data = np.load(path)
+        manifest = None
+        if _INTEGRITY_KEY in data.files:
+            manifest = _json.loads(str(data[_INTEGRITY_KEY]))
+            if set(manifest) != {k for k in data.files if k != _INTEGRITY_KEY}:
+                return False
+        for k in data.files:
+            if k == _INTEGRITY_KEY:
+                continue
+            arr = data[k]  # decompress (zip-level CRC checked here)
+            if manifest is not None and _array_crc(arr) != manifest[k]:
+                return False
+        return True
+    except Exception:  # noqa: BLE001 — any read failure means corrupt
+        return False
+
+
+def verify_checkpoint(path: str) -> bool:
+    """True when ``path`` is a restorable checkpoint: for a single-file
+    save, the file itself verifies (:func:`_verify_npz`); for a per-host
+    sharded member, EVERY member of its complete set verifies (one
+    host's corrupt shard poisons the whole step). Filename-dispatched
+    like :func:`load_checkpoint`."""
+    if _SHARD_RE.search(os.path.basename(path)):
+        directory = os.path.dirname(path) or "."
+        m = _SHARD_RE.search(os.path.basename(path))
+        files = _sharded_sets(directory).get(int(m.group(1)))
+        if files is None:
+            return False
+        return all(_verify_npz(f) for f in files)
+    return _verify_npz(path)
+
+
+def latest_checkpoint(directory: str, verify: bool = False) -> Optional[str]:
     """Newest restorable checkpoint: single-file ``ckpt_N.npz`` or a
     COMPLETE per-host sharded set (returned as its proc-0 member path;
-    ``load_checkpoint`` dispatches on the name)."""
+    ``load_checkpoint`` dispatches on the name). Zero-byte files (a
+    host died mid-``os.replace``) are treated as absent.
+
+    ``verify=True`` walks BACK the keep-chain past corrupt/truncated
+    checkpoints (per-array CRC manifest + decompress check,
+    :func:`verify_checkpoint`) instead of returning a newest file that
+    will explode at load — the resume/rollback contract."""
     if not os.path.isdir(directory):
         return None
-    best_step, best_path = -1, None
+    # (step, tie_break, path): single-file wins a step tie with a
+    # sharded set (matches the pre-verify resolution order)
+    candidates: list[tuple[int, int, str]] = []
     for f in os.listdir(directory):
         if m := _CKPT_RE.search(f):
-            if int(m.group(1)) > best_step:
-                best_step, best_path = int(m.group(1)), os.path.join(directory, f)
+            p = os.path.join(directory, f)
+            if _readable_nonempty(p):
+                candidates.append((int(m.group(1)), 1, p))
     for step, files in _sharded_sets(directory).items():
-        if step > best_step:
-            best_step, best_path = step, files[0]
-    return best_path
+        candidates.append((step, 0, files[0]))
+    for step, _, path in sorted(candidates, reverse=True):
+        if not verify or verify_checkpoint(path):
+            return path
+        print(
+            f"[checkpoint] skipping corrupt/truncated {path!r} "
+            "(integrity check failed); walking back the keep-chain",
+            flush=True,
+        )
+    return None
 
 
 def load_checkpoint(
@@ -538,6 +637,54 @@ class AsyncCheckpointer:
             self.wait()
         finally:
             self._pool.shutdown(wait=True)
+
+
+# --------------------------------------------------------------------------
+# resumable-run marker (fault-tolerance PR): the SIGTERM grace path
+# (launch/worker.py) checkpoints and drops this marker; the supervisor
+# (launch/supervisor.py) reads it to auto-resume the next invocation.
+# --------------------------------------------------------------------------
+
+_RESUMABLE_MARKER = "resumable.json"
+
+
+def write_resumable_marker(ckpt_dir: str, step: int, reason: str) -> str:
+    """Atomically mark the run in ``ckpt_dir`` as cleanly-interrupted-
+    and-resumable (rank 0 only, like the checkpoint writes)."""
+    import json as _json
+
+    os.makedirs(ckpt_dir, exist_ok=True)
+    path = os.path.join(ckpt_dir, _RESUMABLE_MARKER)
+    fd, tmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            _json.dump({"step": int(step), "reason": str(reason),
+                        "t": time.time()}, f)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return path
+
+
+def read_resumable_marker(ckpt_dir: str) -> Optional[dict]:
+    """The marker dict, or None when absent/unreadable (an unreadable
+    marker is treated as absent — it only gates an auto-resume hint)."""
+    import json as _json
+
+    try:
+        with open(os.path.join(ckpt_dir, _RESUMABLE_MARKER)) as f:
+            return _json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def clear_resumable_marker(ckpt_dir: str) -> None:
+    try:
+        os.unlink(os.path.join(ckpt_dir, _RESUMABLE_MARKER))
+    except OSError:
+        pass
 
 
 def wrap_saved_rng(raw: np.ndarray, impl: Optional[str] = None) -> jax.Array:

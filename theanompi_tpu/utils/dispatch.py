@@ -179,6 +179,16 @@ class MetricsDispatcher:
         if self._on_step_seconds is not None and entries:
             self._on_step_seconds(self.last_step_seconds)
 
+    def discard(self) -> None:
+        """Drop every in-flight entry WITHOUT draining and close the
+        timing window. The anomaly-rollback path (launch/worker.py)
+        uses this: the buffered entries belong to steps the restore is
+        about to erase, and draining them would re-run anomaly
+        detection on the very rows that triggered the rollback."""
+        self._buf.clear()
+        self._t_mark = None
+        self._wait_s = 0.0
+
     # -- internals -----------------------------------------------------------
     def _drain_one(self) -> None:
         step, metrics, n_images, substeps = self._buf.popleft()

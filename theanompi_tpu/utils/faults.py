@@ -1,0 +1,195 @@
+"""Deterministic fault injection: every recovery path gets exercised.
+
+The reference framework had no failure story at all — a dead worker or
+a NaN burst killed the whole mpirun (SURVEY.md §5.4) — and a recovery
+path that is never executed is a recovery path that does not work. This
+module is the registry behind ``--inject-fault KIND@STEP`` (repeatable):
+a :class:`FaultInjector` armed with one or more :class:`FaultSpec`\\ s
+fires each of them exactly once, at a deterministic global step, so the
+supervisor's retry loop, the checkpoint integrity chain, the anomaly
+rollback policy, and the SIGTERM grace path are all proven by tier-1
+tests instead of trusted on faith.
+
+Fault kinds (``KIND@STEP`` or ``KIND@STEP:ARG``):
+
+- ``crash``        raise :class:`InjectedCrash` before dispatching STEP
+                   (an in-process worker-loop exception: OOM, loader
+                   bug, poisoned collective — the supervisor retries it)
+- ``sigterm``      ``os.kill(self, SIGTERM)`` before STEP (preemption;
+                   with ``--sigterm-grace`` the driver checkpoints and
+                   exits cleanly, marking the run resumable)
+- ``sigkill``      ``os.kill(self, SIGKILL)`` before STEP (hard host
+                   death: no finally, no grace — resume must come from
+                   the last durable checkpoint)
+- ``ckpt_truncate`` truncate the newest checkpoint file after the first
+                   save at/after STEP (torn write / died mid-replace:
+                   ``latest_checkpoint(verify=True)`` must walk back)
+- ``nan_batch``    poison the data batch feeding STEP with NaN (a bad
+                   shard / corrupted record: the numerics sentinels and
+                   the rollback policy must absorb it)
+- ``loader_stall`` sleep ARG seconds (default 2.0) before STEP (a hung
+                   data source: the stall watchdog's territory)
+
+Injection points live in ``launch/worker.py``'s train loops; all hooks
+are host-side and sync-free (``tools/check_hot_loop.py`` stays green).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from dataclasses import dataclass
+from typing import Optional, Sequence, Union
+
+
+class InjectedFault(RuntimeError):
+    """Base class for failures raised by the fault injector."""
+
+
+class InjectedCrash(InjectedFault):
+    """The ``crash`` fault: an ordinary worker-loop exception, exactly
+    what the run supervisor's bounded-retry loop exists to absorb."""
+
+
+class Preempted(RuntimeError):
+    """Graceful SIGTERM exit: the driver checkpointed inside the grace
+    window and marked the run resumable (``launch/worker.py``). The
+    supervisor records it as a resumable attempt and exits — the next
+    invocation auto-resumes from the marker."""
+
+    def __init__(self, step: int):
+        self.step = int(step)
+        super().__init__(
+            f"preempted (SIGTERM) at step {step}: checkpointed and "
+            "marked resumable"
+        )
+
+
+FAULT_KINDS = (
+    "crash", "sigterm", "sigkill", "ckpt_truncate", "nan_batch",
+    "loader_stall",
+)
+
+
+@dataclass
+class FaultSpec:
+    """One armed fault: ``kind`` fires once at global step ``step``."""
+
+    kind: str
+    step: int
+    arg: Optional[float] = None
+    fired: bool = False
+
+
+def parse_fault_spec(spec: Union[str, FaultSpec]) -> FaultSpec:
+    """``KIND@STEP`` / ``KIND@STEP:ARG`` -> :class:`FaultSpec`."""
+    if isinstance(spec, FaultSpec):
+        return spec
+    kind, sep, rest = str(spec).partition("@")
+    if not sep:
+        raise ValueError(
+            f"fault spec {spec!r} must be KIND@STEP (e.g. crash@5); "
+            f"kinds: {FAULT_KINDS}"
+        )
+    if kind not in FAULT_KINDS:
+        raise ValueError(f"unknown fault kind {kind!r}; kinds: {FAULT_KINDS}")
+    step_s, sep2, arg_s = rest.partition(":")
+    try:
+        step = int(step_s)
+    except ValueError:
+        raise ValueError(f"fault spec {spec!r}: step {step_s!r} is not an int")
+    if step < 1:
+        raise ValueError(f"fault spec {spec!r}: steps are 1-based")
+    arg = None
+    if sep2:
+        try:
+            arg = float(arg_s)
+        except ValueError:
+            raise ValueError(f"fault spec {spec!r}: arg {arg_s!r} is not a number")
+    return FaultSpec(kind=kind, step=step, arg=arg)
+
+
+class FaultInjector:
+    """Fires each armed :class:`FaultSpec` exactly once at its step.
+
+    The driver calls :meth:`check_step` with the 1-based step it is
+    ABOUT to dispatch (fused dispatch passes the group's step range),
+    :meth:`poison_batch` on the batch feeding that step, and
+    :meth:`truncate_due`/:meth:`truncate_newest` around checkpoint
+    saves. Deterministic by construction: same specs + same step
+    sequence = same failures.
+    """
+
+    def __init__(self, specs: Sequence[Union[str, FaultSpec]]):
+        self.specs = [parse_fault_spec(s) for s in (specs or [])]
+
+    def _take(self, kind: str, first: int, last: Optional[int] = None
+              ) -> Optional[FaultSpec]:
+        """The unfired spec of ``kind`` whose step falls in
+        ``[first, last]`` (marked fired), or None."""
+        last = first if last is None else last
+        for s in self.specs:
+            if s.kind == kind and not s.fired and first <= s.step <= last:
+                s.fired = True
+                return s
+        return None
+
+    def check_step(self, first: int, last: Optional[int] = None) -> None:
+        """Fire crash/sigterm/sigkill/loader_stall faults due before
+        dispatching steps ``[first, last]`` (a fused group passes its
+        whole substep range)."""
+        s = self._take("loader_stall", first, last)
+        if s is not None:
+            time.sleep(2.0 if s.arg is None else float(s.arg))
+        s = self._take("crash", first, last)
+        if s is not None:
+            raise InjectedCrash(f"injected crash before step {s.step}")
+        s = self._take("sigterm", first, last)
+        if s is not None:
+            os.kill(os.getpid(), signal.SIGTERM)
+        s = self._take("sigkill", first, last)
+        if s is not None:
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def poison_batch(self, x, first: int, last: Optional[int] = None):
+        """``nan_batch``: return ``x`` poisoned with NaN when a spec is
+        due in ``[first, last]``, else ``x`` unchanged. Device-side op
+        (adds NaN to the already-placed batch) — no host sync, and the
+        result keeps ``x``'s sharding. Float batches only (token
+        batches raise: an int stream cannot carry NaN)."""
+        import jax.numpy as jnp
+
+        s = self._take("nan_batch", first, last)
+        if s is None:
+            return x
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            raise ValueError(
+                f"nan_batch@{s.step}: batch dtype {x.dtype} cannot carry "
+                "NaN (token/int batches); inject on a float-input model"
+            )
+        return x + jnp.asarray(float("nan"), x.dtype)
+
+    def truncate_due(self, step: int) -> bool:
+        """True once when a ``ckpt_truncate`` spec is due at/after
+        ``step`` (the driver checks after each checkpoint save)."""
+        for s in self.specs:
+            if s.kind == "ckpt_truncate" and not s.fired and step >= s.step:
+                s.fired = True
+                return True
+        return False
+
+    @staticmethod
+    def truncate_newest(ckpt_dir: str) -> Optional[str]:
+        """Truncate the newest checkpoint file to half its size (a torn
+        write: the file exists, the zip central directory is gone).
+        Returns the mangled path."""
+        from theanompi_tpu.utils.checkpoint import latest_checkpoint
+
+        path = latest_checkpoint(ckpt_dir)  # unverified: the raw newest
+        if path is None:
+            return None
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(max(1, size // 2))
+        return path
