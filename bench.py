@@ -21,6 +21,10 @@ line is ONE JSON object {"metric", "value", "unit", "vs_baseline", ...}):
   python bench.py --mode scaling   # 1..8-device weak-scaling table on the
                                    #   virtual CPU mesh (comm-overhead audit);
                                    #   writes SCALING.json
+  python bench.py --serve-bench    # serving: closed-loop load over the
+                                   #   dynamic micro-batching inference
+                                   #   engine (serve/) — sustained req/s,
+                                   #   p50/p99 latency, batch-fill
 
 Beyond img/s, compute mode reports achieved TFLOP/s and MFU from XLA's
 cost analysis of the compiled program (utils/flops.py) — the reference
@@ -485,6 +489,87 @@ def bench_e2e(max_steps: int = 48, batch: int = 0,
     return result
 
 
+def bench_serve(duration_s: float = 2.0, clients: int = 8,
+                buckets=(1, 8, 32)) -> dict:
+    """Closed-loop serving benchmark (ISSUE 5): ``clients`` threads
+    hammer an in-process :class:`~theanompi_tpu.serve.engine.
+    ServeEngine` back-to-back for ``duration_s`` over a real saved
+    checkpoint (save -> verified load -> AOT warmup -> serve — the full
+    train→serve path), reporting sustained throughput, client-observed
+    p50/p99 latency, and the mean batch-fill fraction (how well the
+    dynamic micro-batcher coalesces a concurrent closed loop into the
+    bucketed shapes). Runs on JAX_PLATFORMS=cpu; like every bench mode
+    the result also rides the metrics-snapshot schema via
+    ``obs/metrics.result_to_snapshot``."""
+    import tempfile
+    import threading
+
+    import jax
+
+    from theanompi_tpu.models.cifar10 import Cifar10_model
+    from theanompi_tpu.serve.engine import ServeEngine
+    from theanompi_tpu.train import init_train_state
+    from theanompi_tpu.utils.checkpoint import save_checkpoint
+
+    model = Cifar10_model()
+    buckets = tuple(buckets)
+    with tempfile.TemporaryDirectory(prefix="tmpi_serve_bench_") as d:
+        state = init_train_state(model, jax.random.PRNGKey(0))
+        save_checkpoint(d, state, 1, rng=jax.random.PRNGKey(1))
+        engine = ServeEngine(
+            model, buckets=buckets,
+            max_queue=max(256, 8 * buckets[-1]),
+        )
+        engine.load_initial(d)
+        compiled = engine.warmup()
+        engine.start()
+        ishape = tuple(model.recipe.input_shape)
+        stop = threading.Event()
+        lats: list[list] = [[] for _ in range(clients)]
+
+        def client(i: int) -> None:
+            r = np.random.RandomState(i)
+            x = r.randn(*ishape).astype(np.float32)
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                engine.infer(x, timeout=60.0)
+                lats[i].append(time.perf_counter() - t0)
+
+        threads = [
+            threading.Thread(target=client, args=(i,), daemon=True)
+            for i in range(clients)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(duration_s)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60.0)
+        elapsed = time.perf_counter() - t0
+        engine.drain(timeout=30.0)
+        if not any(lats):
+            raise RuntimeError(
+                "serve bench completed zero requests — raise --serve-duration"
+            )
+        all_lat = np.concatenate([np.asarray(l) for l in lats if l])
+        return {
+            "metric": "serve_cifar10_requests_per_sec",
+            "value": round(all_lat.size / elapsed, 1),
+            "unit": "requests/sec",
+            "vs_baseline": None,  # no serving side existed before ISSUE 5
+            "p50_ms": round(1000 * float(np.percentile(all_lat, 50)), 3),
+            "p99_ms": round(1000 * float(np.percentile(all_lat, 99)), 3),
+            "batch_fill": round(engine.mean_batch_fill or 0.0, 4),
+            "served": int(all_lat.size),
+            "clients": clients,
+            "buckets": ",".join(str(b) for b in buckets),
+            "compiled_programs": compiled,
+            "duration_s": round(elapsed, 3),
+            "device_kind": jax.devices()[0].device_kind,
+        }
+
+
 _SCALING_PROBE = """
 # per-step timing, no scan fusion: XLA:CPU compiles a k-step scan of a
 # conv model pathologically slowly (~5 min measured), and CPU dispatch
@@ -624,6 +709,18 @@ def main() -> int:
                          "supervisor-resume runs and report "
                          "recovery_overhead_frac (the measured wall-"
                          "time cost of surviving one crash)")
+    ap.add_argument("--serve-bench", action="store_true",
+                    help="closed-loop serving benchmark over the "
+                         "dynamic micro-batching engine (serve/): "
+                         "sustained req/s + p50/p99 latency + batch-"
+                         "fill over a real checkpoint round-trip "
+                         "(overrides --mode)")
+    ap.add_argument("--serve-duration", type=float, default=2.0,
+                    help="serve bench: closed-loop load window seconds")
+    ap.add_argument("--serve-clients", type=int, default=8,
+                    help="serve bench: concurrent closed-loop clients")
+    ap.add_argument("--serve-buckets", default="1,8,32",
+                    help="serve bench: comma-separated batch buckets")
     ap.add_argument("--ns", default=None,
                     help="scaling mode: comma-separated device counts "
                          "(default 1,2,4,8; the verdict-3 extension runs "
@@ -635,7 +732,12 @@ def main() -> int:
                          "telemetry; schema: tools/check_obs_schema.py)")
     args = ap.parse_args()
 
-    if args.mode == "compute":
+    if args.serve_bench:
+        result = bench_serve(
+            duration_s=args.serve_duration, clients=args.serve_clients,
+            buckets=tuple(int(b) for b in args.serve_buckets.split(",")),
+        )
+    elif args.mode == "compute":
         result = bench_compute(steps=args.steps or 20, model_name=args.model)
     elif args.mode == "e2e":
         depths = (

@@ -40,6 +40,16 @@ def test_valid_records_pass():
         # anomaly rollback (--on-anomaly rollback, launch/worker.py)
         {"kind": "rollback", "rank": 0, "t": 1.0, "step": 7,
          "restore_step": 4, "budget_left": 1, "skipped": 1},
+        # serving engine telemetry (serve/engine.py, obs/serve.jsonl)
+        {"kind": "serve", "t": 1.0, "params_step": 4,
+         "metrics": {"tmpi_serve_queue_depth": 2.0,
+                     "tmpi_serve_p99_ms": 12.5,
+                     "tmpi_serve_served_total": 100.0}},
+        {"kind": "serve", "t": 1.0, "params_step": -1, "metrics": {}},
+        # checkpoint hot-reload (serve/reload.py)
+        {"kind": "reload", "t": 1.0, "from_step": 4, "to_step": 9,
+         "ms": 41.2},
+        {"kind": "reload", "t": 1.0, "from_step": -1, "to_step": 2},
     ]
     for rec in good:
         assert validate_record(rec) == [], rec
@@ -70,6 +80,17 @@ def test_valid_records_pass():
       "error": "x", "backoff_s": 0.5, "resumable": 1}, "want bool"),
     ({"kind": "rollback", "rank": 0, "t": 1.0, "step": 7,
       "budget_left": 1}, "missing required field 'restore_step'"),
+    ({"kind": "serve", "t": 1.0, "metrics": {}},
+     "missing required field 'params_step'"),
+    ({"kind": "serve", "t": 1.0, "params_step": 1,
+      "metrics": {"tmpi_serve_p50_ms": "fast"}}, "not numeric"),
+    # serve records carry ONLY the tmpi_serve_ name family
+    ({"kind": "serve", "t": 1.0, "params_step": 1,
+      "metrics": {"queue_depth": 1.0}}, "lacks the 'tmpi_serve_' prefix"),
+    ({"kind": "reload", "t": 1.0, "from_step": 1},
+     "missing required field 'to_step'"),
+    ({"kind": "reload", "t": 1.0, "from_step": 1.5, "to_step": 2},
+     "is float, want int"),
 ])
 def test_invalid_records_flagged(rec, frag):
     errs = validate_record(rec)

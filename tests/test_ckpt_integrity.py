@@ -145,3 +145,54 @@ def test_resume_skips_truncated_newest_end_to_end(tmp_path):
 @pytest.mark.parametrize("missing", ["nope", os.path.join("a", "b")])
 def test_latest_checkpoint_missing_dir(tmp_path, missing):
     assert latest_checkpoint(str(tmp_path / missing), verify=True) is None
+
+
+def test_newer_verified_checkpoint_short_circuit(tmp_path, monkeypatch):
+    """Satellite: the serving reloader's poll must SHORT-CIRCUIT at the
+    step it already holds — a steady-state poll verifies zero files
+    (never re-CRCing the checkpoint being served), and a corrupt newer
+    file is skipped without the walk ever reaching older entries."""
+    from theanompi_tpu.utils import checkpoint as ckpt_mod
+    from theanompi_tpu.utils.checkpoint import newer_verified_checkpoint
+
+    for s in (1, 2, 3):
+        save_checkpoint(str(tmp_path), STATE, s, keep=10)
+
+    verified = []
+    real = ckpt_mod.verify_checkpoint
+
+    def counting(path):
+        verified.append(path)
+        return real(path)
+
+    monkeypatch.setattr(ckpt_mod, "verify_checkpoint", counting)
+
+    # steady state: nothing newer than what is served -> NO verify work
+    assert newer_verified_checkpoint(str(tmp_path), than_step=3) is None
+    assert verified == []
+
+    # a newer verified save is found with exactly one verification
+    save_checkpoint(str(tmp_path), STATE, 5, keep=10)
+    got = newer_verified_checkpoint(str(tmp_path), than_step=3)
+    assert got.endswith("ckpt_5.npz")
+    assert len(verified) == 1
+
+    # corrupt newest: walked past, but the walk stops ABOVE the served
+    # step — ckpt_3 (the file in service) is never touched
+    verified.clear()
+    p7 = save_checkpoint(str(tmp_path), STATE, 7, keep=10)
+    open(p7, "r+b").truncate(os.path.getsize(p7) // 2)
+    got = newer_verified_checkpoint(str(tmp_path), than_step=3)
+    assert got.endswith("ckpt_5.npz")
+    assert [os.path.basename(p) for p in verified] == [
+        "ckpt_7.npz", "ckpt_5.npz"
+    ]
+
+    # all newer files corrupt -> None (keep serving), still no touch of
+    # the served step's file
+    verified.clear()
+    p5 = os.path.join(str(tmp_path), "ckpt_5.npz")
+    open(p5, "r+b").truncate(os.path.getsize(p5) // 2)
+    assert newer_verified_checkpoint(str(tmp_path), than_step=3) is None
+    assert all("ckpt_3" not in p and "ckpt_2" not in p and "ckpt_1" not in p
+               for p in verified)

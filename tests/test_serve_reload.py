@@ -1,0 +1,173 @@
+"""Checkpoint hot-reload under load (serve/reload.py): a thread
+hammering the engine while the reloader swaps checkpoints sees ZERO
+failed requests and a monotonically non-decreasing served-params step;
+a corrupt newest checkpoint is walked past (keep-chain) and the engine
+keeps serving the previous verified step."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from tinymodel import TinyCNN
+
+from theanompi_tpu.serve.engine import ServeEngine
+from theanompi_tpu.serve.reload import CheckpointReloader, load_for_serving
+from theanompi_tpu.train import init_train_state
+from theanompi_tpu.utils.checkpoint import latest_checkpoint, save_checkpoint
+
+
+def tiny_model():
+    return TinyCNN(
+        TinyCNN.default_recipe().replace(
+            input_shape=(8, 8, 3), batch_size=8
+        )
+    )
+
+
+def save_step(ckpt_dir, state, step):
+    """Checkpoint with step-dependent params so each swap is visible."""
+    bumped = state._replace(
+        params=jax.tree_util.tree_map(lambda p: p + 0.01 * step, state.params)
+    )
+    return save_checkpoint(str(ckpt_dir), bumped, step,
+                           rng=jax.random.PRNGKey(step), keep=10)
+
+
+@pytest.fixture
+def serving(tmp_path):
+    model = tiny_model()
+    state = init_train_state(model, jax.random.PRNGKey(0))
+    save_step(tmp_path, state, 1)
+    engine = ServeEngine(
+        model, buckets=(1, 4, 8), max_queue=256,
+        obs_dir=str(tmp_path / "obs"),
+    )
+    assert engine.load_initial(str(tmp_path)) == 1
+    engine.warmup()
+    engine.start()
+    yield model, state, engine, tmp_path
+    engine.drain(timeout=10.0)
+
+
+def test_hot_reload_under_load_zero_failures(serving):
+    """The tentpole acceptance: swaps mid-load lose nothing; the served
+    step only moves forward."""
+    model, state, engine, ckpt_dir = serving
+    reloader = CheckpointReloader(engine, str(ckpt_dir))
+    errors, steps = [], []
+    stop = threading.Event()
+
+    def hammer():
+        r = np.random.RandomState(7)
+        x = r.randn(8, 8, 3).astype(np.float32)
+        while not stop.is_set():
+            try:
+                steps.append(engine.infer(x, timeout=30.0).step)
+            except Exception as e:  # noqa: BLE001 — the assertion target
+                errors.append(e)
+                return
+
+    t = threading.Thread(target=hammer, daemon=True)
+    t.start()
+    try:
+        for new_step in (3, 5, 9):
+            time.sleep(0.08)  # let requests ride the current params
+            save_step(ckpt_dir, state, new_step)
+            assert reloader.poll_once() == new_step
+    finally:
+        time.sleep(0.08)
+        stop.set()
+        t.join(timeout=30.0)
+    assert errors == []
+    assert len(steps) > 0
+    # single FIFO hammer thread: served steps are non-decreasing and
+    # end on the newest swapped-in checkpoint
+    assert steps == sorted(steps)
+    assert steps[-1] == 9
+    assert engine.stats()["tmpi_serve_reloads_total"] == 3.0
+    assert engine.stats()["tmpi_serve_served_total"] == float(len(steps))
+
+
+def test_corrupt_newest_is_skipped_engine_keeps_serving(serving):
+    """A training host dying mid-write must not take serving down: the
+    keep-chain walk skips the corrupt newest file WITHOUT touching the
+    served one, and requests keep landing on the previous verified
+    step."""
+    model, state, engine, ckpt_dir = serving
+    reloader = CheckpointReloader(engine, str(ckpt_dir))
+    save_step(ckpt_dir, state, 2)
+    assert reloader.poll_once() == 2
+
+    p = save_step(ckpt_dir, state, 4)
+    open(p, "r+b").truncate(os.path.getsize(p) // 2)
+    assert reloader.poll_once() is None  # corrupt newer: no swap
+    x = np.random.RandomState(0).randn(8, 8, 3)
+    assert engine.infer(x, timeout=30.0).step == 2  # still serving
+
+    # a GOOD later save recovers without a restart
+    save_step(ckpt_dir, state, 6)
+    assert reloader.poll_once() == 6
+    assert engine.infer(x, timeout=30.0).step == 6
+
+
+def test_reload_records_and_params_actually_swap(serving):
+    """The reload JSONL record lands and validates; the served logits
+    change with the params (the swap is real, not just a step label)."""
+    from theanompi_tpu.tools.check_obs_schema import check_file
+
+    model, state, engine, ckpt_dir = serving
+    x = np.random.RandomState(3).randn(8, 8, 3).astype(np.float32)
+    before = engine.infer(x, timeout=30.0)
+    save_step(ckpt_dir, state, 5)
+    assert CheckpointReloader(engine, str(ckpt_dir)).poll_once() == 5
+    after = engine.infer(x, timeout=30.0)
+    assert after.step == 5
+    assert not np.array_equal(before.logits, after.logits)
+    engine.drain(timeout=10.0)
+    path = ckpt_dir / "obs" / "serve.jsonl"
+    assert check_file(str(path)) == []
+    recs = [json.loads(l) for l in path.read_text().splitlines()]
+    reloads = [r for r in recs if r["kind"] == "reload"]
+    assert len(reloads) == 1
+    assert reloads[0]["from_step"] == 1 and reloads[0]["to_step"] == 5
+
+
+def test_background_reloader_thread(serving):
+    model, state, engine, ckpt_dir = serving
+    reloader = CheckpointReloader(engine, str(ckpt_dir), interval=0.05)
+    reloader.start()
+    try:
+        save_step(ckpt_dir, state, 7)
+        deadline = time.monotonic() + 20.0
+        while engine.params_step < 7 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert engine.params_step == 7
+    finally:
+        reloader.stop()
+
+
+def test_set_params_never_regresses(serving):
+    model, state, engine, _ = serving
+    assert not engine.set_params(state.params, state.model_state, 0)
+    assert engine.params_step == 1
+
+
+def test_load_for_serving_roundtrip(tmp_path):
+    """load_for_serving restores exactly what was saved (params +
+    model_state), dropping optimizer state and rng."""
+    model = tiny_model()
+    state = init_train_state(model, jax.random.PRNGKey(2))
+    save_checkpoint(str(tmp_path), state, 11, rng=jax.random.PRNGKey(3))
+    params, model_state, step = load_for_serving(
+        latest_checkpoint(str(tmp_path)), model
+    )
+    assert step == 11
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -11,6 +11,11 @@ Usage::
     tmpi EASGD 8 theanompi_tpu.models.model_zoo.resnet50 ResNet50 --avg-freq 8
     tmpi GOSGD 8 theanompi_tpu.models.model_zoo.vgg VGG16
     tmpi BSP 8 my_model.py MyModel --strategy asa16 --epochs 5
+
+``tmpi serve`` is the inference subcommand (serve/cli.py): serve a
+training run's checkpoints with dynamic micro-batching and hot-reload::
+
+    tmpi serve --ckpt-dir runs/ck --model cifar10 --watch --port 8300
 """
 
 from __future__ import annotations
@@ -220,6 +225,18 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _force_platform() -> None:
+    """Honor TMPI_FORCE_PLATFORM before any backend use (the env var
+    alone is not enough once a site hook pre-selected a platform) —
+    shared by the training path and the serve subcommand."""
+    import os
+
+    if os.environ.get("TMPI_FORCE_PLATFORM"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["TMPI_FORCE_PLATFORM"])
+
+
 def _strip_flags(argv: list, flags: tuple) -> list:
     """Remove ``--flag value`` / ``--flag=value`` pairs from argv."""
     out, skip = [], False
@@ -239,6 +256,15 @@ def _strip_flags(argv: list, flags: tuple) -> list:
 def main(argv=None) -> int:
     import os
 
+    argv = list(argv) if argv is not None else sys.argv[1:]
+    if argv[:1] == ["serve"]:
+        # inference subcommand: its own parser + driver (serve/cli.py);
+        # dispatched before the training parser, whose first positional
+        # is a sync rule
+        _force_platform()
+        from theanompi_tpu.serve.cli import serve_main
+
+        return serve_main(argv[1:])
     args = build_parser().parse_args(argv)
 
     if args.nproc and args.nproc > 1 and (
@@ -276,10 +302,7 @@ def main(argv=None) -> int:
 
     # join the multi-controller world BEFORE any backend use (no-op when
     # not configured; reference: MPI_GPU_Process init at worker start)
-    if os.environ.get("TMPI_FORCE_PLATFORM"):
-        import jax
-
-        jax.config.update("jax_platforms", os.environ["TMPI_FORCE_PLATFORM"])
+    _force_platform()
 
     from theanompi_tpu.parallel.distributed import initialize_distributed
 

@@ -55,3 +55,26 @@ def zoo_entry(name: str):
 
         return TransformerLM_350M, 8
     raise ValueError(f"unknown bench model {name!r}")
+
+
+def infer_fn(entry):
+    """The eval-mode apply closure — ``(params, model_state, x) ->
+    logits`` with ``train=False``, no rng, fixed BatchNorm stats — the
+    ONE definition of "run this model for inference", shared by the
+    serving engine (serve/engine.py jits it per batch bucket) and the
+    eval loops (train.py ``make_eval_step``), so the two paths cannot
+    drift (e.g. one forgetting to freeze BN).
+
+    ``entry`` is a constructed :class:`~theanompi_tpu.models.contract.
+    Model` instance, or a bench-zoo short name (resolved through
+    :func:`zoo_entry` under its default recipe)."""
+    model = entry
+    if isinstance(entry, str):
+        model_cls, _ = zoo_entry(entry)
+        model = model_cls()
+
+    def fwd(params, model_state, x):
+        logits, _ = model.apply(params, model_state, x, train=False)
+        return logits
+
+    return fwd

@@ -59,6 +59,14 @@ On-disk layout under ``obs_dir`` (schemas:
                             appends a final kind=metrics snapshot
                             (source="supervisor") carrying
                             tmpi_retries_total to metrics.jsonl
+    serve.jsonl             serving engine telemetry (serve/engine.py,
+                            written when ``tmpi serve`` runs with
+                            --obs-dir): periodic + drain-time
+                            kind=serve stats records (params step,
+                            tmpi_serve_* latency p50/p99, queue depth,
+                            batch fill, request totals) + one
+                            kind=reload record per checkpoint
+                            hot-reload the engine applied
     anomaly_rank{r}/        flight-recorder triage bundle (ring.jsonl,
                             report.json, stacks.txt, span_summary.json,
                             optional state/ checkpoint + postmortem/

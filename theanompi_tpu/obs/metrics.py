@@ -172,6 +172,38 @@ class Histogram(_Metric):
             entry = self._hist.get(_label_key(labels))
             return sum(entry[0]) if entry else 0
 
+    def quantile(self, q: float, **labels) -> Optional[float]:
+        """Estimated q-quantile (0..1) from the cumulative buckets —
+        Prometheus ``histogram_quantile`` semantics: linear
+        interpolation inside the bucket the target rank falls in, so
+        the estimate's resolution is the bucket grid. Observations
+        beyond the last finite bound clamp to it (an +Inf bucket has no
+        upper edge to interpolate toward). None when nothing was
+        observed. Serving reads p50/p99 latency off this
+        (serve/engine.py's ``tmpi_serve_*`` histograms)."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        with self._lock:
+            entry = self._hist.get(_label_key(labels))
+            if entry is None:
+                return None
+            counts = list(entry[0])
+        n = sum(counts)
+        if n == 0:
+            return None
+        target = q * n
+        cum = 0
+        for i, c in enumerate(counts[:-1]):
+            prev = cum
+            cum += c
+            if cum >= target:
+                lo = self.buckets[i - 1] if i else 0.0
+                hi = self.buckets[i]
+                if c == 0:
+                    return hi
+                return lo + (hi - lo) * (target - prev) / c
+        return self.buckets[-1]  # rank lands in the +Inf bucket
+
 
 class MetricsRegistry:
     """Get-or-create registry of metric families. Name collisions across

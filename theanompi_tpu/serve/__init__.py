@@ -1,0 +1,46 @@
+"""Serving subsystem: dynamic micro-batching TPU inference with
+checkpoint hot-reload — the train→serve loop the ROADMAP's "serves
+heavy traffic from millions of users" north star needs (the reference,
+like the paper, stopped at training).
+
+Design rule, inherited from the training side's dispatch discipline:
+**never pay compilation or transfer cost on the request hot path.**
+
+- :mod:`~theanompi_tpu.serve.engine` — :class:`ServeEngine`: a bounded
+  request queue + batcher thread that coalesces waiting requests, pads
+  them to a small set of bucketed batch shapes (default 1/8/32/128) so
+  the jitted eval-mode ``apply`` (``models/zoo.infer_fn``: train=False,
+  no rng, fixed BN stats, donation-free) compiles exactly once per
+  bucket — AOT-warmed at startup, counted, and provable
+  (``compile_count``). Admission control: per-request deadlines,
+  reject-with-retry-after on a full queue, graceful drain on SIGTERM.
+  Telemetry: ``tmpi_serve_*`` latency histograms (p50/p99), queue-depth
+  and batch-fill gauges, request counters through the existing
+  :class:`~theanompi_tpu.obs.metrics.MetricsRegistry`, plus ``serve``/
+  ``reload`` JSONL records in ``<obs_dir>/serve.jsonl`` (schema:
+  ``tools/check_obs_schema.py``).
+- :mod:`~theanompi_tpu.serve.reload` — :class:`CheckpointReloader`:
+  polls a training run's checkpoint keep-chain via
+  ``utils/checkpoint.newer_verified_checkpoint`` (the short-circuit
+  walk: a steady-state poll verifies NOTHING, and a corrupt newest
+  checkpoint is skipped without touching the file already served) and
+  atomically swaps params between batches — in-flight requests finish
+  on the params they started with; the served step only moves forward.
+- :mod:`~theanompi_tpu.serve.frontend` — a stdlib-only HTTP front
+  (POST /infer, GET /healthz, GET /metrics) for the ``tmpi serve`` CLI
+  subcommand; the engine itself is transport-agnostic and in-process.
+"""
+
+from theanompi_tpu.serve.engine import (  # noqa: F401
+    DeadlineExceeded,
+    EngineDraining,
+    EngineOverloaded,
+    Rejected,
+    ServeEngine,
+    ServeResult,
+)
+from theanompi_tpu.serve.reload import (  # noqa: F401
+    CheckpointReloader,
+    load_for_serving,
+    serving_state_template,
+)

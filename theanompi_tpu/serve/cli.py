@@ -1,0 +1,190 @@
+"""``tmpi serve`` — the serving subcommand (dispatched from cli.py).
+
+Serve a training run's checkpoints over HTTP with dynamic
+micro-batching and (``--watch``) checkpoint hot-reload::
+
+    tmpi serve --ckpt-dir runs/ck --model cifar10 --watch \\
+               --buckets 1,8,32,128 --max-queue 256 --deadline-ms 250 \\
+               --obs-dir runs/obs --port 8300
+
+SIGTERM drains gracefully: admission stops (healthz flips 503, so a
+load balancer rotates the replica out), the queued backlog is served,
+then the process exits — the serving twin of the trainer's
+``--sigterm-grace``. ``--selftest N`` skips the HTTP server and drives
+N closed-loop local requests instead (smoke/CI path; prints the
+``serve`` stats line and exits).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_serve_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tmpi serve",
+        description="TPU inference: dynamic micro-batching engine with "
+                    "checkpoint hot-reload",
+        allow_abbrev=False,
+    )
+    p.add_argument("--ckpt-dir", required=True,
+                   help="training run's checkpoint dir; the newest "
+                        "VERIFIED checkpoint is served (keep-chain walk)")
+    p.add_argument("--model", required=True,
+                   help="zoo short name (cifar10, alexnet, ...), or "
+                        "module:Class / path.py:Class — must match the "
+                        "recipe that trained the checkpoints (the resume "
+                        "contract)")
+    p.add_argument("--recipe-arg", action="append", default=[], metavar="K=V",
+                   help="recipe override (repeatable, JSON values) — must "
+                        "mirror the overrides the training run used")
+    p.add_argument("--buckets", default="1,8,32,128",
+                   help="comma-separated batch buckets; requests pad UP to "
+                        "the smallest fitting bucket, one compiled program "
+                        "per bucket, all AOT-warmed at startup")
+    p.add_argument("--max-queue", type=int, default=256,
+                   help="admission bound: a full queue rejects with "
+                        "retry-after instead of growing latency unbounded")
+    p.add_argument("--deadline-ms", type=float, default=1000.0,
+                   help="default per-request deadline (0 = none): expired "
+                        "requests are rejected, not served")
+    p.add_argument("--watch", action="store_true",
+                   help="hot-reload: poll the keep-chain and atomically "
+                        "swap to newer verified checkpoints while serving")
+    p.add_argument("--poll-interval", type=float, default=2.0,
+                   help="--watch poll cadence in seconds")
+    p.add_argument("--obs-dir", default=None,
+                   help="telemetry dir: serve.jsonl records "
+                        "(kind=serve/reload; tools/check_obs_schema.py)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8300,
+                   help="HTTP port (serve/frontend.py)")
+    p.add_argument("--selftest", type=int, default=0, metavar="N",
+                   help="no HTTP: run N closed-loop local requests, print "
+                        "stats JSON, exit (smoke path)")
+    return p
+
+
+def _resolve_serve_model(spec: str, recipe_args: list):
+    """Model instance from a zoo short name or module:Class spec."""
+    import ast
+
+    from theanompi_tpu.launch.session import resolve_model
+    from theanompi_tpu.models import MODEL_REGISTRY
+
+    if ":" in spec:
+        modelfile, _, classname = spec.rpartition(":")
+        cls = resolve_model(modelfile, classname)
+    elif spec.lower() in MODEL_REGISTRY:
+        modelfile, classname = MODEL_REGISTRY[spec.lower()]
+        cls = resolve_model(modelfile, classname)
+    else:
+        raise SystemExit(
+            f"--model {spec!r}: not a zoo short name "
+            f"({sorted(MODEL_REGISTRY)}) and not module:Class"
+        )
+    overrides = {}
+    for kv in recipe_args:
+        k, sep, v = kv.partition("=")
+        if not sep:
+            raise SystemExit(f"--recipe-arg expects K=V, got {kv!r}")
+        try:
+            val = json.loads(v)
+        except json.JSONDecodeError:
+            try:
+                val = ast.literal_eval(v)
+            except (ValueError, SyntaxError):
+                val = v
+        overrides[k] = tuple(val) if isinstance(val, list) else val
+    recipe = cls.default_recipe()
+    if overrides:
+        recipe = recipe.replace(**overrides)
+    return cls(recipe)
+
+
+def serve_main(argv=None) -> int:
+    args = build_serve_parser().parse_args(argv)
+
+    from theanompi_tpu.serve.engine import ServeEngine
+    from theanompi_tpu.serve.reload import CheckpointReloader
+
+    model = _resolve_serve_model(args.model, args.recipe_arg)
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    engine = ServeEngine(
+        model,
+        buckets=buckets,
+        max_queue=args.max_queue,
+        default_deadline_ms=args.deadline_ms or None,
+        obs_dir=args.obs_dir,
+    )
+    step = engine.load_initial(args.ckpt_dir)
+    compiled = engine.warmup()
+    print(f"[serve] serving {model.name} step {step}; "
+          f"{compiled} programs AOT-warmed for buckets {buckets}",
+          flush=True)
+    engine.start()
+    reloader = None
+    if args.watch:
+        reloader = CheckpointReloader(
+            engine, args.ckpt_dir, interval=args.poll_interval
+        )
+        reloader.start()
+
+    def _shutdown():
+        # reloader FIRST: a poll landing after the final record would
+        # print past the "last stdout line is a schema-valid serve
+        # record" contract; then drain (idempotent, like stop)
+        if reloader is not None:
+            reloader.stop()
+        engine.drain(timeout=30.0)
+
+    try:
+        if args.selftest:
+            import numpy as np
+
+            rng = np.random.RandomState(0)
+            shape = tuple(model.recipe.input_shape)
+            for _ in range(args.selftest):
+                engine.infer(rng.randn(*shape))
+            _shutdown()
+            # LAST stdout line = one schema-valid serve stats record
+            print(json.dumps(engine.serve_record()))
+            return 0
+
+        from theanompi_tpu.serve.frontend import serve_http
+
+        httpd = serve_http(engine, host=args.host, port=args.port)
+
+        import signal
+        import threading
+
+        def _graceful(signum, frame):
+            # SIGTERM: flip to draining (healthz -> 503 rotates the
+            # replica out), serve the queued backlog, then stop the
+            # accept loop — all off the signal handler's thread
+            def _drain_then_stop():
+                engine.drain(timeout=30.0)
+                httpd.shutdown()
+
+            threading.Thread(target=_drain_then_stop, daemon=True).start()
+
+        signal.signal(signal.SIGTERM, _graceful)
+        print(f"[serve] http on {args.host}:{httpd.server_address[1]} "
+              "(POST /infer, GET /healthz, GET /metrics)", flush=True)
+        try:
+            httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            httpd.server_close()
+        _shutdown()
+        print(json.dumps(engine.serve_record()), flush=True)
+        return 0
+    finally:
+        _shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(serve_main())

@@ -1,0 +1,553 @@
+"""In-process TPU inference engine: request queue + dynamic micro-batcher.
+
+The serving analogue of the training side's dispatch pipeline
+(utils/dispatch.py): keep Python, compilation, and host syncs OFF the
+hot path. Three rules shape the implementation:
+
+1. **Bucketed shapes, compiled once.** XLA compiles one program per
+   input shape; letting request coalescing produce arbitrary batch
+   sizes would compile an unbounded program set and pay seconds of
+   latency on the first request of every new size. The engine instead
+   pads every micro-batch UP to a small ascending set of batch buckets
+   (default 1/8/32/128), so the jitted eval-mode apply
+   (``models/zoo.infer_fn`` — train=False, no rng, fixed BN stats, and
+   donation-FREE: the served params must survive the call) compiles at
+   most ``len(buckets)`` programs, all AOT-warmed in :meth:`warmup`
+   before the first request arrives. Padding is sound because
+   eval-mode forwards are row-independent (no cross-batch reduction:
+   BN uses running stats, dropout is off), so the padded rows cannot
+   perturb the real ones — proven bit-identical in
+   tests/test_serve_engine.py.
+
+2. **Coalesce what is waiting, never wait to coalesce.** The batcher
+   takes every queued request up to the largest bucket and serves them
+   as one forward. Under load, batches fill toward the big buckets
+   (throughput); when idle, a lone request rides the size-1 bucket
+   immediately (latency). No artificial batching window.
+
+3. **Swap params between batches.** Hot reload (serve/reload.py)
+   publishes a new :class:`ServedParams` by atomic reference swap; the
+   batcher reads the reference once per micro-batch, so every request
+   is served by exactly one coherent (params, model_state, step)
+   triple, the served step only moves forward, and zero requests fail
+   or drop during a swap (tests/test_serve_reload.py hammers this).
+
+Admission control: the queue is bounded (``max_queue``) — a full queue
+rejects with :class:`EngineOverloaded` carrying a ``retry_after_ms``
+estimate from the EWMA batch time, per-request deadlines expire queued
+requests with :class:`DeadlineExceeded` (rejected, never served), and
+:meth:`drain` (wired to SIGTERM by the CLI, reusing the training
+driver's grace discipline) stops admission, finishes the backlog, and
+only then stops the batcher.
+
+Telemetry rides the existing obs subsystem: ``tmpi_serve_*`` counters/
+gauges/histograms in a :class:`~theanompi_tpu.obs.metrics.
+MetricsRegistry` (p50/p99 via ``Histogram.quantile``), and periodic
+``serve`` JSONL records (plus the reloader's ``reload`` records) in
+``<obs_dir>/serve.jsonl`` — schemas in tools/check_obs_schema.py.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import NamedTuple, Optional, Sequence
+
+import numpy as np
+
+DEFAULT_BUCKETS = (1, 8, 32, 128)
+
+# latency histogram bounds: request latencies live in the 1ms..seconds
+# band (the obs DEFAULT_BUCKETS top out at 60s — step/checkpoint scale)
+LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+    2.5, 5.0, 10.0,
+)
+
+
+class Rejected(RuntimeError):
+    """Base: the engine refused to take (or serve) a request."""
+
+    retry_after_ms: Optional[float] = None
+
+
+class EngineOverloaded(Rejected):
+    """Admission control: the bounded queue is full. ``retry_after_ms``
+    estimates when capacity frees up (queue depth x EWMA batch time)."""
+
+    def __init__(self, depth: int, retry_after_ms: float):
+        self.retry_after_ms = float(retry_after_ms)
+        super().__init__(
+            f"serve queue full ({depth} waiting); retry in "
+            f"~{retry_after_ms:.0f} ms"
+        )
+
+
+class EngineDraining(Rejected):
+    """The engine is draining (SIGTERM / shutdown): backlog is being
+    served, new requests are not admitted."""
+
+    def __init__(self):
+        super().__init__("serve engine is draining; not admitting requests")
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline passed while it waited — rejected, not
+    served (serving a result the client stopped waiting for wastes a
+    batch slot someone else's deadline needed)."""
+
+
+class ServedParams(NamedTuple):
+    """One coherent serving triple, swapped by atomic reference."""
+
+    params: object
+    model_state: object
+    step: int
+
+
+class ServeResult(NamedTuple):
+    """Per-request result: the logits row and the checkpoint step of
+    the params that produced it (reload tests assert monotonicity)."""
+
+    logits: np.ndarray
+    step: int
+
+
+class ServeFuture:
+    """Minimal completion handle (threading.Event + slots — no
+    concurrent.futures machinery on the hot path)."""
+
+    __slots__ = ("_event", "_value", "_error", "t_submit")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value: Optional[ServeResult] = None
+        self._error: Optional[BaseException] = None
+        self.t_submit = time.monotonic()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> ServeResult:
+        if not self._event.wait(timeout):
+            raise TimeoutError("serve request still in flight")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    # -- engine side --------------------------------------------------------
+    def _resolve(self, value: ServeResult) -> None:
+        self._value = value
+        self._event.set()
+
+    def _reject(self, error: BaseException) -> None:
+        self._error = error
+        self._event.set()
+
+
+class _Request:
+    __slots__ = ("x", "deadline", "future")
+
+    def __init__(self, x, deadline: Optional[float], future: ServeFuture):
+        self.x = x
+        self.deadline = deadline  # absolute time.monotonic(), or None
+        self.future = future
+
+
+class ServeEngine:
+    """Dynamic micro-batching inference engine over one model.
+
+    ``model`` is a constructed :class:`~theanompi_tpu.models.contract.
+    Model`; requests are single examples shaped ``recipe.input_shape``
+    (float images, or int token rows for LM models). Params come from
+    :meth:`load_initial` / :meth:`set_params` (serve/reload.py swaps
+    them live). Lifecycle: construct → ``load_initial`` → ``warmup`` →
+    ``start`` → ``submit``/``infer`` ... → ``drain``.
+
+    ``default_deadline_ms``: applied to requests that don't carry their
+    own; None = requests wait indefinitely.
+    ``record_every``: write a ``serve`` JSONL record every N
+    micro-batches (obs_dir only); one final record lands at drain.
+    """
+
+    def __init__(
+        self,
+        model,
+        *,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        max_queue: int = 256,
+        default_deadline_ms: Optional[float] = None,
+        obs_dir: Optional[str] = None,
+        registry=None,
+        record_every: int = 50,
+    ):
+        from theanompi_tpu.models.zoo import infer_fn
+        from theanompi_tpu.obs.metrics import MetricsRegistry
+
+        self.model = model
+        self.buckets = tuple(sorted(int(b) for b in buckets))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError(f"buckets must be positive ints, got {buckets!r}")
+        if len(set(self.buckets)) != len(self.buckets):
+            raise ValueError(f"duplicate buckets in {buckets!r}")
+        self.max_queue = int(max_queue)
+        if self.max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+        self.default_deadline_ms = default_deadline_ms
+        self.obs_dir = obs_dir
+        self.record_every = max(1, int(record_every))
+
+        ishape = tuple(model.recipe.input_shape)
+        self._ishape = ishape
+        self._in_dtype = (
+            np.int32 if getattr(model, "is_lm", False) else np.float32
+        )
+
+        # the ONE inference definition (models/zoo.infer_fn), jitted
+        # donation-free; the host-side trace counter increments once per
+        # compiled program (jit retraces exactly when a new input
+        # signature arrives), so ``compile_count`` is the proof handle
+        # for "≤ len(buckets) programs" (tests/test_serve_engine.py)
+        import jax
+
+        self._trace_count = 0
+        fwd = infer_fn(model)
+
+        def _counted(params, model_state, x):
+            self._trace_count += 1  # trace-time only, never per call
+            return fwd(params, model_state, x)
+
+        self._fwd = jax.jit(_counted)
+
+        self._served: Optional[ServedParams] = None
+        self._swap_lock = threading.Lock()
+        self._q: collections.deque[_Request] = collections.deque()
+        self._cond = threading.Condition()
+        self._draining = False
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._batch_s_ewma: Optional[float] = None
+        self._batches = 0
+        self._fill_sum = 0.0
+        self._serve_f = None
+        self._sink_lock = threading.Lock()
+
+        self.registry = registry or MetricsRegistry()
+        self._h_latency = self.registry.histogram(
+            "tmpi_serve_latency_seconds",
+            help="request latency, submit -> result (serve/engine.py)",
+            buckets=LATENCY_BUCKETS,
+        )
+        self._g_queue = self.registry.gauge(
+            "tmpi_serve_queue_depth", help="requests waiting for a batch slot"
+        )
+        self._g_fill = self.registry.gauge(
+            "tmpi_serve_batch_fill",
+            help="real rows / bucket rows of the last micro-batch",
+        )
+        self._g_step = self.registry.gauge(
+            "tmpi_serve_params_step", help="checkpoint step currently served"
+        )
+        self._c_requests = self.registry.counter(
+            "tmpi_serve_requests_total",
+            help="requests by outcome (status=served|expired|rejected)",
+        )
+        self._c_batches = self.registry.counter(
+            "tmpi_serve_batches_total",
+            help="micro-batches by bucket size (bucket=N)",
+        )
+        self._c_reloads = self.registry.counter(
+            "tmpi_serve_reloads_total",
+            help="checkpoint hot-reloads applied (serve/reload.py)",
+        )
+
+    # -- params -------------------------------------------------------------
+    @property
+    def params_step(self) -> int:
+        """Checkpoint step currently served (-1 before load_initial)."""
+        served = self._served
+        return served.step if served is not None else -1
+
+    def load_initial(self, ckpt_dir: str) -> int:
+        """Load the newest VERIFIED checkpoint from a training run's
+        keep-chain (the same discovery resume uses) and serve it."""
+        from theanompi_tpu.serve.reload import load_for_serving
+        from theanompi_tpu.utils.checkpoint import latest_checkpoint
+
+        path = latest_checkpoint(ckpt_dir, verify=True)
+        if path is None:
+            raise FileNotFoundError(
+                f"no verified checkpoint under {ckpt_dir!r} to serve"
+            )
+        params, model_state, step = load_for_serving(path, self.model)
+        self.set_params(params, model_state, step)
+        return step
+
+    def set_params(self, params, model_state, step: int) -> bool:
+        """Atomically publish a serving triple. Refuses to move the
+        served step BACKWARD (a slow reload racing a fresh one must not
+        regress what is served); returns whether the swap happened.
+        In-flight micro-batches finish on the triple they read — the
+        swap is a reference assignment, nothing is mutated. The
+        device_put runs OUTSIDE the swap lock (it is the slow part),
+        and the step check re-runs under it, so two racing publishers
+        cannot interleave check and assignment."""
+        import jax
+
+        step = int(step)
+        current = self._served
+        if current is not None and step <= current.step:
+            return False
+        params = jax.device_put(params)
+        model_state = jax.device_put(model_state)
+        with self._swap_lock:
+            current = self._served
+            if current is not None and step <= current.step:
+                return False
+            self._served = ServedParams(params, model_state, step)
+            # gauge inside the lock: a racing older publisher must not
+            # leave the exported step regressed vs what is served
+            self._g_step.set(step)
+        return True
+
+    def note_reload(self, from_step: int, to_step: int, ms: float) -> None:
+        """Reloader hook: count the swap + write a ``reload`` record."""
+        self._c_reloads.inc()
+        self._write_record({
+            "kind": "reload", "t": time.time(),
+            "from_step": int(from_step), "to_step": int(to_step),
+            "ms": round(float(ms), 3),
+        })
+
+    # -- lifecycle ----------------------------------------------------------
+    def warmup(self) -> int:
+        """AOT-warm every bucket shape through the jitted apply, so no
+        request ever pays a compile. Returns the compile count (==
+        len(buckets) on a fresh engine; re-warms are free)."""
+        import jax.numpy as jnp
+
+        if self._served is None:
+            raise RuntimeError("warmup needs params (load_initial first)")
+        served = self._served
+        for b in self.buckets:
+            x = jnp.zeros((b, *self._ishape), self._in_dtype)
+            np.asarray(self._fwd(served.params, served.model_state, x))
+        return self.compile_count
+
+    @property
+    def compile_count(self) -> int:
+        """Programs compiled so far (trace-count of the jitted apply)."""
+        return self._trace_count
+
+    def start(self) -> None:
+        if self._thread is not None:
+            raise RuntimeError("engine already started")
+        self._thread = threading.Thread(
+            target=self._loop, name="tmpi-serve-batcher", daemon=True
+        )
+        self._thread.start()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful shutdown: reject new admissions, serve everything
+        already queued, stop the batcher, flush the final ``serve``
+        record. Idempotent. Returns True when the backlog fully
+        drained inside ``timeout``."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        drained = True
+        if self._thread is not None:
+            self._thread.join(
+                None if deadline is None else max(0.0, deadline - time.monotonic())
+            )
+            drained = not self._thread.is_alive()
+        if not self._stopped.is_set():
+            # one final serve record, then retire the sink (idempotent:
+            # a second drain finds _stopped set and skips both)
+            self._write_serve_record()
+            self._stopped.set()
+            with self._sink_lock:
+                if self._serve_f is not None:
+                    self._serve_f.close()
+                    self._serve_f = None
+        return drained
+
+    close = drain
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    # -- request path -------------------------------------------------------
+    def submit(self, x, deadline_ms: Optional[float] = None) -> ServeFuture:
+        """Enqueue one example; returns a :class:`ServeFuture`.
+        Raises :class:`EngineOverloaded` / :class:`EngineDraining`
+        synchronously (admission control); deadline expiry surfaces
+        from ``future.result()`` as :class:`DeadlineExceeded`."""
+        x = np.asarray(x, self._in_dtype)
+        if x.shape != self._ishape:
+            raise ValueError(
+                f"request shape {x.shape} != model input {self._ishape}"
+            )
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        deadline = (
+            time.monotonic() + float(deadline_ms) / 1000.0
+            if deadline_ms else None
+        )
+        fut = ServeFuture()
+        with self._cond:
+            if self._draining:
+                self._c_requests.inc(status="rejected")
+                raise EngineDraining()
+            if len(self._q) >= self.max_queue:
+                self._c_requests.inc(status="rejected")
+                batch_s = self._batch_s_ewma or 0.05
+                n_batches = -(-len(self._q) // self.buckets[-1])
+                raise EngineOverloaded(
+                    len(self._q), retry_after_ms=1000.0 * batch_s * n_batches
+                )
+            self._q.append(_Request(x, deadline, fut))
+            self._g_queue.set(len(self._q))
+            self._cond.notify()
+        return fut
+
+    def infer(self, x, deadline_ms: Optional[float] = None,
+              timeout: Optional[float] = 30.0) -> ServeResult:
+        """Blocking convenience: submit + wait."""
+        return self.submit(x, deadline_ms=deadline_ms).result(timeout)
+
+    # -- batcher ------------------------------------------------------------
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def _loop(self) -> None:
+        max_take = self.buckets[-1]
+        while True:
+            with self._cond:
+                while not self._q and not self._draining:
+                    self._cond.wait(0.05)
+                if not self._q and self._draining:
+                    return
+                reqs = [
+                    self._q.popleft()
+                    for _ in range(min(len(self._q), max_take))
+                ]
+                self._g_queue.set(len(self._q))
+            try:
+                self._serve_batch(reqs)
+            except BaseException as e:  # noqa: BLE001 — requests must
+                # never hang on an engine bug: fail THIS batch's futures
+                # and keep serving (a poisoned input must not take the
+                # engine down with it)
+                failed = 0
+                for r in reqs:
+                    if not r.future.done():
+                        r.future._reject(e)
+                        failed += 1
+                if failed:
+                    self._c_requests.inc(failed, status="rejected")
+
+    def _serve_batch(self, reqs: list) -> None:
+        import jax.numpy as jnp
+
+        now = time.monotonic()
+        live = []
+        for r in reqs:
+            if r.deadline is not None and now > r.deadline:
+                r.future._reject(DeadlineExceeded(
+                    f"deadline passed {1000 * (now - r.deadline):.1f} ms "
+                    "before a batch slot opened"
+                ))
+                self._c_requests.inc(status="expired")
+            else:
+                live.append(r)
+        if not live:
+            return
+        t0 = time.monotonic()
+        bucket = self._bucket_for(len(live))
+        batch = np.zeros((bucket, *self._ishape), self._in_dtype)
+        for i, r in enumerate(live):
+            batch[i] = r.x
+        served = self._served  # ONE read: the swap point for hot reload
+        logits = np.asarray(
+            self._fwd(served.params, served.model_state, jnp.asarray(batch))
+        )
+        t_done = time.monotonic()
+        for i, r in enumerate(live):
+            r.future._resolve(ServeResult(logits[i], served.step))
+            self._h_latency.observe(t_done - r.future.t_submit)
+        self._c_requests.inc(len(live), status="served")
+        self._c_batches.inc(bucket=bucket)
+        fill = len(live) / bucket
+        self._g_fill.set(fill)
+        self._fill_sum += fill
+        self._batches += 1
+        batch_s = t_done - t0
+        self._batch_s_ewma = (
+            batch_s if self._batch_s_ewma is None
+            else 0.8 * self._batch_s_ewma + 0.2 * batch_s
+        )
+        if self._batches % self.record_every == 0:
+            self._write_serve_record()
+
+    # -- stats / telemetry --------------------------------------------------
+    @property
+    def mean_batch_fill(self) -> Optional[float]:
+        return self._fill_sum / self._batches if self._batches else None
+
+    def latency_ms(self, q: float) -> Optional[float]:
+        s = self._h_latency.quantile(q)
+        return None if s is None else 1000.0 * s
+
+    def stats(self) -> dict:
+        """Flat numeric snapshot (the ``serve`` record's metrics map;
+        every key is ``tmpi_serve_``-prefixed — enforced by the schema
+        checker so serve records stay greppable by one prefix)."""
+        out = {
+            "tmpi_serve_queue_depth": float(len(self._q)),
+            "tmpi_serve_served_total": self._c_requests.value(status="served"),
+            "tmpi_serve_expired_total": self._c_requests.value(status="expired"),
+            "tmpi_serve_rejected_total": self._c_requests.value(status="rejected"),
+            "tmpi_serve_reloads_total": self._c_reloads.value(),
+            "tmpi_serve_batches_total": float(self._batches),
+        }
+        if self._batches:
+            out["tmpi_serve_batch_fill_mean"] = self.mean_batch_fill
+        for name, q in (("p50", 0.5), ("p99", 0.99)):
+            ms = self.latency_ms(q)
+            if ms is not None:
+                out[f"tmpi_serve_{name}_ms"] = ms
+        return out
+
+    def serve_record(self) -> dict:
+        """The one constructor of a ``kind=serve`` record (schema:
+        tools/check_obs_schema.py) — used for the periodic/drain-time
+        obs lines AND the CLI's final stdout line, so the two can never
+        drift apart on shape."""
+        return {"kind": "serve", "t": time.time(),
+                "params_step": self.params_step, "metrics": self.stats()}
+
+    def _write_serve_record(self) -> None:
+        self._write_record(self.serve_record())
+
+    def _write_record(self, rec: dict) -> None:
+        if self.obs_dir is None:
+            return
+        with self._sink_lock:
+            if self._stopped.is_set() and self._serve_f is None:
+                return
+            if self._serve_f is None:
+                os.makedirs(self.obs_dir, exist_ok=True)
+                self._serve_f = open(
+                    os.path.join(self.obs_dir, "serve.jsonl"), "a"
+                )
+            self._serve_f.write(json.dumps(rec) + "\n")
+            self._serve_f.flush()

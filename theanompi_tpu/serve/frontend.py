@@ -1,0 +1,119 @@
+"""Stdlib HTTP front for the serve engine (no new dependencies).
+
+The engine (serve/engine.py) is transport-agnostic; this module gives
+``tmpi serve`` a wire. ``ThreadingHTTPServer`` is enough because every
+handler thread just blocks on a :class:`ServeFuture` — the actual work
+is batched on the engine's single batcher thread, which is exactly the
+dynamic micro-batching story: N concurrent HTTP clients coalesce into
+bucket-shaped forwards.
+
+Routes::
+
+    POST /infer    {"input": <nested list, recipe.input_shape>,
+                    "deadline_ms": <optional>}
+                -> 200 {"logits": [...], "step": N}
+                   503 + Retry-After on overload/draining
+                   504 on deadline expiry
+    GET /healthz -> 200 {"params_step", "queue_depth", "draining"} —
+                   the load-balancer probe (draining -> 503 so a
+                   SIGTERM'd replica falls out of rotation while it
+                   finishes its backlog)
+    GET /metrics -> Prometheus text of the engine registry
+                   (tmpi_serve_* families)
+"""
+
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from theanompi_tpu.serve.engine import (
+    DeadlineExceeded,
+    Rejected,
+    ServeEngine,
+)
+
+
+def make_handler(engine: ServeEngine):
+    class Handler(BaseHTTPRequestHandler):
+        # request logging off the hot path: per-request stderr lines at
+        # serving rates are their own denial of service
+        def log_message(self, fmt, *args):  # noqa: D102
+            pass
+
+        def _reply(self, code: int, body: dict, headers: dict = ()):
+            data = json.dumps(body).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            for k, v in dict(headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+            if self.path == "/healthz":
+                body = {
+                    "params_step": engine.params_step,
+                    "queue_depth": int(engine.stats()["tmpi_serve_queue_depth"]),
+                    "draining": engine.draining,
+                }
+                self._reply(503 if engine.draining else 200, body)
+            elif self.path == "/metrics":
+                data = engine.registry.to_prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            else:
+                self._reply(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):  # noqa: N802
+            if self.path != "/infer":
+                self._reply(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n))
+                x = np.asarray(req["input"])
+                deadline_ms = req.get("deadline_ms")
+            except (ValueError, KeyError, TypeError) as e:
+                self._reply(400, {"error": f"bad request: {e!r}"})
+                return
+            try:
+                fut = engine.submit(x, deadline_ms=deadline_ms)
+                res = fut.result(timeout=None)
+            except Rejected as e:
+                headers = {}
+                if e.retry_after_ms is not None:
+                    # HTTP Retry-After is whole seconds; round up
+                    headers["Retry-After"] = str(
+                        max(1, int(-(-e.retry_after_ms // 1000)))
+                    )
+                self._reply(503, {"error": str(e)}, headers)
+            except DeadlineExceeded as e:
+                self._reply(504, {"error": str(e)})
+            except ValueError as e:  # shape mismatch
+                self._reply(400, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001 — a failed batch
+                # surfaces its raw error through the future (engine
+                # loop survives it); the client must get a JSON 500,
+                # not a reset socket
+                self._reply(500, {"error": f"inference failed: {e!r}"})
+            else:
+                self._reply(200, {
+                    "logits": np.asarray(res.logits, np.float64).tolist(),
+                    "step": res.step,
+                })
+
+    return Handler
+
+
+def serve_http(engine: ServeEngine, host: str = "127.0.0.1",
+               port: int = 8300) -> ThreadingHTTPServer:
+    """Bind and return the server (caller runs ``serve_forever`` — the
+    CLI does it on the main thread so SIGTERM lands there)."""
+    return ThreadingHTTPServer((host, port), make_handler(engine))

@@ -1,0 +1,121 @@
+"""Checkpoint hot-reload: follow a live training run without restarts.
+
+The supervisor (PR 4) keeps a verified checkpoint keep-chain current
+while training; this module closes the loop by letting a serving engine
+track it. :class:`CheckpointReloader` polls
+``utils/checkpoint.newer_verified_checkpoint(dir, than_step)`` — the
+factored keep-chain walk that short-circuits AT the served step, so a
+steady-state poll (no new saves) costs one ``os.listdir`` and ZERO
+verification work: it never re-decompresses the multi-hundred-MB file
+it already serves. When a strictly newer VERIFIED checkpoint exists,
+the reloader loads it off the hot path (the batcher keeps serving the
+old params), then publishes it with ``engine.set_params`` — an atomic
+reference swap between micro-batches. A corrupt newest checkpoint (a
+training host died mid-write) is walked past without ever touching the
+served file, and the engine simply keeps serving the previous verified
+step — zero failed requests either way (tests/test_serve_reload.py).
+
+The load template comes from ``jax.eval_shape`` over the model's
+``init_train_state`` — structure and dtypes without a single FLOP of
+real initialization — which also means the serving model's recipe
+(optimizer choice included) must match the training run's, exactly the
+resume contract the trainer already enforces.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+
+def serving_state_template(model):
+    """Abstract TrainState (ShapeDtypeStructs) matching what the
+    training driver checkpoints — the structure/dtype template
+    ``load_checkpoint`` needs, built without materializing anything."""
+    import jax
+
+    from theanompi_tpu.train import init_train_state
+
+    return jax.eval_shape(
+        lambda k: init_train_state(model, k), jax.random.PRNGKey(0)
+    )
+
+
+def load_for_serving(path: str, model):
+    """Restore ``(params, model_state, step)`` from a training
+    checkpoint — the optimizer state and rng are loaded (the file's
+    structure demands it) and dropped (serving needs neither)."""
+    from theanompi_tpu.utils.checkpoint import checkpoint_step, load_checkpoint
+
+    state, _rng = load_checkpoint(path, serving_state_template(model))
+    return state.params, state.model_state, checkpoint_step(path)
+
+
+class CheckpointReloader:
+    """Poll a training run's keep-chain; swap the engine's params.
+
+    ``poll_once()`` is the unit of work (tests drive it directly for
+    determinism); ``start()`` runs it on a background thread every
+    ``interval`` seconds until ``stop()``. Failures to LOAD a
+    checkpoint that verified a moment earlier (pruned underneath us, or
+    a structure mismatch from pointing at the wrong run) are logged and
+    skipped — the engine keeps serving; a reloader crash must never
+    take serving down.
+    """
+
+    def __init__(self, engine, ckpt_dir: str, *, interval: float = 2.0):
+        self.engine = engine
+        self.ckpt_dir = ckpt_dir
+        self.interval = float(interval)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def poll_once(self) -> Optional[int]:
+        """One poll: swap to the newest verified step newer than what
+        is served; returns the new step, or None when nothing newer
+        (or the newer files are all corrupt)."""
+        from theanompi_tpu.utils.checkpoint import newer_verified_checkpoint
+
+        current = self.engine.params_step
+        path = newer_verified_checkpoint(self.ckpt_dir, than_step=current)
+        if path is None:
+            return None
+        t0 = time.monotonic()
+        try:
+            params, model_state, step = load_for_serving(path, self.engine.model)
+        except Exception as e:  # noqa: BLE001 — keep serving on any load
+            # failure (the keep-chain pruned the file mid-load, etc.)
+            print(f"[serve.reload] load of {path!r} failed ({e!r}); "
+                  "keeping current params", flush=True)
+            return None
+        if not self.engine.set_params(params, model_state, step):
+            return None  # raced a newer swap; served step never regresses
+        ms = 1000.0 * (time.monotonic() - t0)
+        self.engine.note_reload(current, step, ms)
+        print(f"[serve.reload] now serving step {step} "
+              f"(was {current}; load+swap {ms:.0f} ms)", flush=True)
+        return step
+
+    # -- background polling -------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            raise RuntimeError("reloader already started")
+        self._thread = threading.Thread(
+            target=self._loop, name="tmpi-serve-reload", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.poll_once()
+            except Exception as e:  # noqa: BLE001
+                print(f"[serve.reload] poll failed ({e!r}); retrying",
+                      flush=True)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None

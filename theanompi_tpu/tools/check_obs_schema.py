@@ -3,8 +3,9 @@
 Every machine-readable line this framework emits — Recorder history
 (``<run>.jsonl``), span traces (``obs/spans_rank*.jsonl``), metric
 snapshots (``obs/metrics.jsonl``, bench.py's snapshot line), heartbeat
-and stall reports — must match ONE of the record kinds below, keyed by
-the ``kind`` field. Downstream parsing (bench.py drivers, BENCH_*.json
+and stall reports, the serving engine's ``serve``/``reload`` records
+(``obs/serve.jsonl``) — must match ONE of the record kinds below, keyed
+by the ``kind`` field. Downstream parsing (bench.py drivers, BENCH_*.json
 diffing, tools/plot_history.py) reads these streams; without an
 enforced schema they drift silently and the first symptom is a broken
 plot three PRs later. The schema table here is the single source of
@@ -146,7 +147,40 @@ SCHEMAS: dict[str, dict[str, tuple[tuple, bool]]] = {
         "budget_left": ((int,), True),
         "skipped": ((int,), False),
     },
+    # serving engine (serve/engine.py): periodic + drain-time stats
+    # records in <obs_dir>/serve.jsonl. `params_step` is the checkpoint
+    # step being served (-1 before the first load); `metrics` is a flat
+    # numeric map whose keys all carry the tmpi_serve_ prefix (latency
+    # p50/p99 ms, queue depth, batch-fill, request/batch/reload totals)
+    # — the prefix is ENFORCED below so serve telemetry stays greppable
+    # under one name family.
+    "serve": {
+        "t": (_NUM, True),
+        "params_step": ((int,), True),
+        "metrics": ((dict,), True),
+    },
+    # one record per checkpoint hot-reload applied by the serving
+    # engine (serve/reload.py): the step served before, the verified
+    # step swapped in, and the off-hot-path load+swap latency
+    "reload": {
+        "t": (_NUM, True),
+        "from_step": ((int,), True),
+        "to_step": ((int,), True),
+        "ms": (_NUM, False),
+    },
 }
+
+# the serving metric name family (serve records may only carry these-
+# prefixed keys; the engine's registry families are documented here so
+# dashboards and the schema stay in one place):
+#   tmpi_serve_latency_seconds   histogram  request submit->result
+#   tmpi_serve_queue_depth       gauge      requests waiting
+#   tmpi_serve_batch_fill        gauge      real/bucket rows, last batch
+#   tmpi_serve_params_step       gauge      checkpoint step served
+#   tmpi_serve_requests_total    counter    by status=served|expired|rejected
+#   tmpi_serve_batches_total     counter    by bucket=N
+#   tmpi_serve_reloads_total     counter    hot-reloads applied
+SERVE_METRIC_PREFIX = "tmpi_serve_"
 
 
 def _check_numeric_map(d: dict, what: str) -> list[str]:
@@ -195,6 +229,14 @@ def validate_record(obj: Any) -> list[str]:
     if not errs:
         if kind in ("metrics", "numerics"):
             errs += _check_numeric_map(obj["metrics"], "metrics")
+        elif kind == "serve":
+            errs += _check_numeric_map(obj["metrics"], "metrics")
+            for k in obj["metrics"]:
+                if isinstance(k, str) and not k.startswith(SERVE_METRIC_PREFIX):
+                    errs.append(
+                        f"serve.metrics key {k!r} lacks the "
+                        f"{SERVE_METRIC_PREFIX!r} prefix"
+                    )
         elif kind == "span_summary":
             errs += _check_numeric_map(obj["fractions"], "fractions")
             errs += _check_numeric_map(obj["totals_s"], "totals_s")

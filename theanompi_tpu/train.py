@@ -267,12 +267,20 @@ def make_eval_step(
     protocol — 4 corners + center, each mirrored). ``images`` carries
     ``len(labels) * views`` rows, view-major per image; per-image logits
     are the mean over views before loss/metrics (reference: the
-    published top-1 protocol the recipes were validated with)."""
+    published top-1 protocol the recipes were validated with).
+
+    The forward itself is :func:`theanompi_tpu.models.zoo.infer_fn` —
+    the same eval-mode closure the serving engine compiles, so train-
+    time validation and serving can never diverge on inference
+    semantics (train=False, no rng, fixed BN stats)."""
+    from theanompi_tpu.models.zoo import infer_fn
+
+    fwd = infer_fn(model)
 
     def eval_step(state: TrainState, images, labels):
         if input_transform is not None:
             images = input_transform(images)
-        logits, _ = model.apply(state.params, state.model_state, images, train=False)
+        logits = fwd(state.params, state.model_state, images)
         if views > 1:
             logits = logits.reshape(-1, views, logits.shape[-1]).mean(axis=1)
         return {"loss": model.loss(logits, labels), **model.metrics(logits, labels)}

@@ -7,6 +7,7 @@ from theanompi_tpu.utils.checkpoint import (  # noqa: F401
     checkpoint_step,
     load_checkpoint,
     latest_checkpoint,
+    newer_verified_checkpoint,
     save_checkpoint,
     verify_checkpoint,
     wrap_saved_rng,

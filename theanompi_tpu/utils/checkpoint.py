@@ -461,6 +461,42 @@ def verify_checkpoint(path: str) -> bool:
     return _verify_npz(path)
 
 
+def _keep_chain(directory: str) -> list[tuple[int, int, str]]:
+    """The keep-chain, newest first: every restorable-looking candidate
+    as ``(step, tie_break, path)`` — single-file ``ckpt_N.npz`` plus
+    COMPLETE per-host sharded sets (as their proc-0 member path).
+    Zero-byte files (a host died mid-``os.replace``) are absent. The
+    tie-break makes a single file win a step tie with a sharded set
+    (matches the pre-verify resolution order). Shared by
+    :func:`latest_checkpoint` and :func:`newer_verified_checkpoint` so
+    the two discovery paths can never order the chain differently."""
+    if not os.path.isdir(directory):
+        return []
+    candidates: list[tuple[int, int, str]] = []
+    for f in os.listdir(directory):
+        if m := _CKPT_RE.search(f):
+            p = os.path.join(directory, f)
+            if _readable_nonempty(p):
+                candidates.append((int(m.group(1)), 1, p))
+    for step, files in _sharded_sets(directory).items():
+        candidates.append((step, 0, files[0]))
+    return sorted(candidates, reverse=True)
+
+
+def _walk_verified(candidates, verify: bool) -> Optional[str]:
+    """First candidate that verifies (or the first outright when
+    ``verify`` is False); corrupt entries are skipped loudly."""
+    for _, _, path in candidates:
+        if not verify or verify_checkpoint(path):
+            return path
+        print(
+            f"[checkpoint] skipping corrupt/truncated {path!r} "
+            "(integrity check failed); walking back the keep-chain",
+            flush=True,
+        )
+    return None
+
+
 def latest_checkpoint(directory: str, verify: bool = False) -> Optional[str]:
     """Newest restorable checkpoint: single-file ``ckpt_N.npz`` or a
     COMPLETE per-host sharded set (returned as its proc-0 member path;
@@ -471,27 +507,24 @@ def latest_checkpoint(directory: str, verify: bool = False) -> Optional[str]:
     checkpoints (per-array CRC manifest + decompress check,
     :func:`verify_checkpoint`) instead of returning a newest file that
     will explode at load — the resume/rollback contract."""
-    if not os.path.isdir(directory):
-        return None
-    # (step, tie_break, path): single-file wins a step tie with a
-    # sharded set (matches the pre-verify resolution order)
-    candidates: list[tuple[int, int, str]] = []
-    for f in os.listdir(directory):
-        if m := _CKPT_RE.search(f):
-            p = os.path.join(directory, f)
-            if _readable_nonempty(p):
-                candidates.append((int(m.group(1)), 1, p))
-    for step, files in _sharded_sets(directory).items():
-        candidates.append((step, 0, files[0]))
-    for step, _, path in sorted(candidates, reverse=True):
-        if not verify or verify_checkpoint(path):
-            return path
-        print(
-            f"[checkpoint] skipping corrupt/truncated {path!r} "
-            "(integrity check failed); walking back the keep-chain",
-            flush=True,
-        )
-    return None
+    return _walk_verified(_keep_chain(directory), verify)
+
+
+def newer_verified_checkpoint(directory: str, than_step: int) -> Optional[str]:
+    """Newest VERIFIED checkpoint strictly newer than ``than_step``, or
+    None — the serving hot-reloader's poll (serve/reload.py): "is there
+    a newer verified step than the one I already serve?".
+
+    Short-circuits at ``than_step``: the walk stops BEFORE reaching the
+    file the caller already holds, so a steady-state poll (no new saves)
+    verifies nothing at all — it never re-decompresses and re-CRCs the
+    multi-hundred-MB checkpoint it is already serving, and a corrupt
+    NEWER file is skipped (walking back) without ever touching the
+    served one. Always verifies: an unverified path handed to a live
+    serving engine would explode mid-swap."""
+    return _walk_verified(
+        [c for c in _keep_chain(directory) if c[0] > than_step], verify=True
+    )
 
 
 def load_checkpoint(
