@@ -570,6 +570,115 @@ def bench_serve(duration_s: float = 2.0, clients: int = 8,
         }
 
 
+def bench_codec_sweep(engines=("bsp", "zero1", "easgd", "gosgd", "nd"),
+                      codecs=("none", "bf16", "int8", "int8:ef"),
+                      max_steps: int = 6) -> dict:
+    """Compressed-collectives sweep (codec x engine): run every engine's
+    exchange through every wire codec (parallel/codec.py) for a few
+    steps on the visible mesh, and read back each run's ``kind=comm``
+    wire declaration from its obs metrics.jsonl — so the table's
+    raw/wire bytes are the SAME records production telemetry emits, not
+    a side computation. Each row: effective vs raw per-step bytes,
+    compression ratio, throughput, final val loss (quantization noise
+    must not break the mini-run). Headline value: the MINIMUM
+    compression ratio across int8 rows — the acceptance floor (>= 3.5x
+    incl. scale overhead) every engine must clear."""
+    import json as _json
+    import tempfile
+
+    import jax
+
+    from theanompi_tpu.launch.worker import run_training
+    from theanompi_tpu.models.cifar10 import Cifar10_model
+    from theanompi_tpu.models.lm import TransformerLMModel
+
+    n_dev = len(jax.devices())
+    n = min(4, n_dev)
+    if n < 2:
+        # Single-device runs hit every engine's n==1 codec bypass, so
+        # every int8 row would read compression_ratio 1.0 — a spurious
+        # "floor failed" table. Refuse instead of reporting garbage.
+        raise RuntimeError(
+            "--codec-sweep needs >= 2 devices; on CPU set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=4 "
+            "(before jax import)")
+    if n % 2:
+        n -= n % 2  # the nd row runs tp=2
+    rows = []
+    img_recipe = {"batch_size": 16, "input_shape": (16, 16, 3),
+                  "sched_kwargs": {"lr": 0.05, "boundaries": [10 ** 9]}}
+    lm_recipe = {"batch_size": 8, "d_model": 32, "n_heads": 4,
+                 "n_layers": 2, "d_ff": 64, "input_shape": (16,),
+                 "num_classes": 32}
+    grid = {
+        "bsp": dict(rule="bsp", model_cls=Cifar10_model,
+                    recipe_overrides=img_recipe),
+        "zero1": dict(rule="bsp", zero=1, model_cls=Cifar10_model,
+                      recipe_overrides=img_recipe),
+        "easgd": dict(rule="easgd", avg_freq=2, model_cls=Cifar10_model,
+                      recipe_overrides=img_recipe),
+        "gosgd": dict(rule="gosgd", p_push=0.5, model_cls=Cifar10_model,
+                      recipe_overrides=img_recipe),
+        "nd": dict(rule="bsp", tp=2, model_cls=TransformerLMModel,
+                   recipe_overrides=lm_recipe),
+    }
+    with tempfile.TemporaryDirectory(prefix="tmpi_codec_sweep_") as d:
+        for engine in engines:
+            kw = dict(grid[engine])
+            if engine == "nd" and n < 2:
+                continue  # tp=2 needs at least 2 chips
+            for codec in codecs:
+                obs_dir = os.path.join(d, f"{engine}_{codec.replace(':', '_')}")
+                summary = run_training(
+                    devices=n, wire_codec=codec, max_steps=max_steps,
+                    n_epochs=100, dataset="synthetic",
+                    # n_val covers the per-worker-batch rules' global
+                    # val batch (n workers x recipe batch)
+                    dataset_kwargs={"n_train": 128, "n_val": 64,
+                                    "image_shape": (16, 16, 3)}
+                    if engine != "nd" else {"n_train": 64, "n_val": 32},
+                    obs_dir=obs_dir, print_freq=0, seed=7, **kw,
+                )
+                comm = None
+                with open(os.path.join(obs_dir, "metrics.jsonl")) as f:
+                    for line in f:
+                        rec = _json.loads(line)
+                        if rec.get("kind") == "comm":
+                            comm = rec  # last declaration wins
+                if comm is None:
+                    raise RuntimeError(
+                        f"{engine}/{codec}: no kind=comm record in "
+                        f"{obs_dir}/metrics.jsonl — the engine did not "
+                        "declare its wire model"
+                    )
+                rows.append({
+                    "engine": engine,
+                    "codec": codec,
+                    "raw_bytes_per_step": round(comm["raw_bytes"], 1),
+                    "wire_bytes_per_step": round(comm["wire_bytes"], 1),
+                    "compression_ratio": round(comm["compression_ratio"], 3),
+                    "images_per_sec": round(summary["images_per_sec"], 1),
+                    "val_loss": round(summary["val"]["loss"], 4)
+                    if "val" in summary else None,
+                    "steps": summary["steps"],
+                })
+    int8_ratios = [r["compression_ratio"] for r in rows
+                   if r["codec"].startswith("int8")]
+    return {
+        "metric": "codec_sweep_min_int8_compression",
+        "value": round(min(int8_ratios), 3) if int8_ratios else None,
+        "unit": "x raw wire bytes (min across int8 engine rows)",
+        "vs_baseline": round(min(int8_ratios) / 3.5, 4) if int8_ratios
+        else None,  # acceptance floor: >= 3.5x incl. scale overhead
+        "baseline_estimated": False,
+        "n_devices": n,
+        "engines": ",".join(engines),
+        "codecs": ",".join(codecs),
+        "max_steps": max_steps,
+        "table": rows,
+    }
+
+
 _SCALING_PROBE = """
 # per-step timing, no scan fusion: XLA:CPU compiles a k-step scan of a
 # conv model pathologically slowly (~5 min measured), and CPU dispatch
@@ -709,6 +818,18 @@ def main() -> int:
                          "supervisor-resume runs and report "
                          "recovery_overhead_frac (the measured wall-"
                          "time cost of surviving one crash)")
+    ap.add_argument("--codec-sweep", action="store_true",
+                    help="compressed-collectives sweep (codec x engine "
+                         "matrix over the wire codecs in "
+                         "parallel/codec.py): per-row effective vs raw "
+                         "wire bytes from each run's kind=comm record, "
+                         "compression ratio, throughput and mini-run "
+                         "val loss; headline = min int8 compression "
+                         "ratio (overrides --mode)")
+    ap.add_argument("--codec-engines", default="bsp,zero1,easgd,gosgd,nd",
+                    help="codec sweep: comma-separated engine subset")
+    ap.add_argument("--codecs", default="none,bf16,int8,int8:ef",
+                    help="codec sweep: comma-separated codec subset")
     ap.add_argument("--serve-bench", action="store_true",
                     help="closed-loop serving benchmark over the "
                          "dynamic micro-batching engine (serve/): "
@@ -732,7 +853,13 @@ def main() -> int:
                          "telemetry; schema: tools/check_obs_schema.py)")
     args = ap.parse_args()
 
-    if args.serve_bench:
+    if args.codec_sweep:
+        result = bench_codec_sweep(
+            engines=tuple(e for e in args.codec_engines.split(",") if e),
+            codecs=tuple(c for c in args.codecs.split(",") if c),
+            max_steps=args.steps or 6,
+        )
+    elif args.serve_bench:
         result = bench_serve(
             duration_s=args.serve_duration, clients=args.serve_clients,
             buckets=tuple(int(b) for b in args.serve_buckets.split(",")),

@@ -1,0 +1,65 @@
+"""Codec-coverage lint (tools/check_codec_coverage.py): every engine
+under parallel/ routes its exchange through parallel/codec.py or
+declares a written exemption."""
+
+import os
+import textwrap
+
+from theanompi_tpu.tools.check_codec_coverage import (
+    check_dir,
+    check_file,
+    main,
+)
+
+_ENGINE_BODY = """
+    class RogueEngine:
+        def train_step(self, state, x, y, rng):
+            return state, {}
+
+        def traffic_model(self, state):
+            return None
+"""
+
+
+def test_repo_parallel_dir_is_clean():
+    assert check_dir() == []
+    assert main([]) == 0
+
+
+def test_uncovered_engine_fails(tmp_path):
+    p = tmp_path / "rogue.py"
+    p.write_text(textwrap.dedent(_ENGINE_BODY))
+    err = check_file(str(p))
+    assert err is not None and "RogueEngine" in err
+    assert main([str(tmp_path)]) == 1
+
+
+def test_codec_import_covers(tmp_path):
+    p = tmp_path / "good.py"
+    p.write_text(
+        "from theanompi_tpu.parallel.codec import get_codec\n"
+        + textwrap.dedent(_ENGINE_BODY)
+    )
+    assert check_file(str(p)) is None
+
+
+def test_exempt_marker_covers(tmp_path):
+    p = tmp_path / "exempt.py"
+    p.write_text(
+        "# codec_exempt: exchange is host-side file I/O, no collective\n"
+        + textwrap.dedent(_ENGINE_BODY)
+    )
+    assert check_file(str(p)) is None
+    # a BARE marker with no reason does not count
+    p2 = tmp_path / "lazy.py"
+    p2.write_text("# codec_exempt:\n" + textwrap.dedent(_ENGINE_BODY))
+    assert check_file(str(p2)) is not None
+
+
+def test_library_modules_out_of_scope(tmp_path):
+    p = tmp_path / "lib.py"
+    p.write_text("def helper():\n    return 1\n")
+    assert check_file(str(p)) is None
+    assert check_file(os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "theanompi_tpu", "parallel", "mesh.py")) is None

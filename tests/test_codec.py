@@ -1,0 +1,397 @@
+"""Compressed-collectives codec layer (parallel/codec.py): registry,
+error-feedback algebra, per-engine wire integration, convergence parity
+at int8+error-feedback, checkpointed-residual exactness, and the
+traffic-model compression acceptance (effective <= ~0.3x raw for int8,
+scale overhead included) for EVERY engine."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tinymodel import TinyCNN
+from theanompi_tpu.parallel.codec import (
+    WireCodec,
+    get_codec,
+    gossip_decode,
+    gossip_encode,
+    gossip_wire_bytes,
+)
+
+
+# -- registry / parsing ------------------------------------------------------
+
+
+def test_get_codec_parsing():
+    assert get_codec(None).name == "none" and not get_codec(None).active
+    assert get_codec("bf16").wire_bytes_per_element == 2.0
+    c = get_codec("int8:ef")
+    assert c.name == "int8" and c.error_feedback
+    assert c.spec == "int8:ef" and get_codec(c) is c
+    # int8 wire bytes include the per-128-block f32 scale
+    assert c.wire_bytes_per_element == pytest.approx(1.0 + 4.0 / 128)
+    with pytest.raises(ValueError, match="unknown wire codec"):
+        get_codec("fp4")
+    with pytest.raises(ValueError, match="meaningless"):
+        get_codec("none:ef")
+    with pytest.raises(ValueError, match="suffix"):
+        get_codec("int8:feedback")
+
+
+def test_error_feedback_telescopes():
+    """EF invariant: v + r == Q(v + r) + r' — what the quantizer
+    discards this round is exactly what rides into the next."""
+    codec = get_codec("int8:ef")
+    r = np.random.RandomState(0)
+    v = jnp.asarray(r.randn(300).astype(np.float32)) * 5.0
+    ef = jnp.asarray(r.randn(300).astype(np.float32)) * 0.01
+    q, ef2 = codec.compress_leaf(v, ef)
+    np.testing.assert_allclose(
+        np.asarray(q + ef2), np.asarray(v + ef), rtol=0, atol=1e-6
+    )
+    # without :ef the residual passes through untouched
+    plain = get_codec("int8")
+    tree, ef_out = plain.compress({"w": v}, ())
+    assert ef_out == ()
+
+
+def test_qdq_edge_shapes_and_zero_buffer():
+    codec = get_codec("int8")
+    # 1-element leaf, odd lengths, exact zeros — no NaN/Inf anywhere
+    for arr in (np.ones(1), np.zeros(5), np.random.RandomState(1).randn(130),
+                np.zeros((3, 7))):
+        out = np.asarray(codec.qdq(jnp.asarray(arr, jnp.float32)))
+        assert out.shape == arr.shape
+        assert np.all(np.isfinite(out))
+        amax = np.abs(arr).max()
+        np.testing.assert_allclose(out, arr, atol=amax / 254 + 1e-9)
+    np.testing.assert_array_equal(
+        np.asarray(codec.qdq(jnp.zeros(200))), np.zeros(200)
+    )
+
+
+# -- gossip message packing --------------------------------------------------
+
+
+@pytest.mark.parametrize("spec", ["none", "bf16", "int8"])
+def test_gossip_message_roundtrip(spec):
+    codec = get_codec(spec)
+    r = np.random.RandomState(2)
+    L = 300  # deliberately not a 128 multiple
+    values = jnp.asarray(r.randn(L).astype(np.float32)) * 2.0
+    share = jnp.float32(0.12345678)
+    msg = gossip_encode(codec, values, share)
+    back, share2 = gossip_decode(codec, msg, L)
+    # the share weight is EXACT for every codec (mass conservation)
+    assert float(share2) == float(share)
+    amax = float(jnp.max(jnp.abs(values)))
+    tol = 0.0 if spec == "none" else (
+        amax / 254 + 1e-6 if spec == "int8" else amax * 2 ** -8
+    )
+    np.testing.assert_allclose(np.asarray(back), np.asarray(values),
+                               atol=tol)
+    if spec == "int8":
+        assert msg.dtype == jnp.int8  # the packed lanes ARE the wire
+        assert gossip_wire_bytes(codec, L) == msg.size
+
+
+# -- strategy integration ----------------------------------------------------
+
+
+def test_strategy_codec_validation():
+    from theanompi_tpu.parallel.strategies import (
+        checked_mode_strategy,
+        get_strategy,
+    )
+
+    # double compression refused
+    with pytest.raises(ValueError, match="already compresses"):
+        get_strategy("ring_int8", "data", 8, codec="int8")
+    with pytest.raises(ValueError, match="already compresses"):
+        get_strategy("asa16", "data", 8, codec="bf16")
+    # explicit ring has no leaf-stable residual mapping
+    with pytest.raises(ValueError, match="error feedback"):
+        get_strategy("ring", "data", 8, codec="int8:ef")
+    # checked mode has no exchanger wire at all
+    with pytest.raises(ValueError, match="no wire"):
+        checked_mode_strategy("psum", "data", 8, codec="int8")
+    # valid combos build
+    assert getattr(get_strategy("psum", "data", 8, codec="int8:ef"),
+                   "stateful", False)
+    assert not getattr(get_strategy("psum", "data", 8), "stateful", False)
+
+
+def test_ring_with_codec_matches_dedicated_ring(mesh8):
+    """``--wire-codec bf16`` on the explicit ring IS ring_bf16 (the
+    asa16 special case, now a codec consumer): bit-identical output,
+    replicas bit-identical — the bf16 bit-stability the existing ring
+    tests prove carries over to the codec spelling."""
+    from jax.sharding import PartitionSpec as P
+
+    from theanompi_tpu.parallel.strategies import get_strategy
+
+    n = 8
+    r = np.random.RandomState(3)
+    x = jnp.asarray(r.randn(n, 700).astype(np.float32))
+
+    def run(strat):
+        return np.asarray(jax.jit(
+            jax.shard_map(
+                lambda t: strat(t), mesh=mesh8,
+                in_specs=(P("data"),), out_specs=P("data"),
+                check_vma=False,
+            )
+        )(x))
+
+    via_codec = run(get_strategy("ring", "data", n, codec="bf16"))
+    dedicated = run(get_strategy("ring_bf16", "data", n))
+    np.testing.assert_array_equal(via_codec, dedicated)
+    for i in range(1, n):
+        np.testing.assert_array_equal(via_codec[0], via_codec[i])
+
+
+# -- traffic acceptance: every engine, int8 effective <= ~0.3x raw ----------
+
+
+def _tiny_model():
+    return TinyCNN(TinyCNN.default_recipe().replace(
+        batch_size=32, input_shape=(16, 16, 3)))
+
+
+def _assert_compressed(tm):
+    eff = tm.bytes_per_step_amortized
+    raw = tm.raw_bytes_per_step_amortized
+    assert raw > 0, tm
+    assert eff <= 0.3 * raw, (tm.rule, eff, raw)
+    assert tm.compression_ratio >= 3.5, (tm.rule, tm.compression_ratio)
+    assert tm.codec == "int8"
+
+
+def test_all_engines_report_compressed_traffic(mesh8, rng):
+    from theanompi_tpu.parallel.bsp import BSPEngine
+    from theanompi_tpu.parallel.easgd import EASGDEngine
+    from theanompi_tpu.parallel.gosgd import GOSGDEngine
+    from theanompi_tpu.parallel.zero import ZeroEngine
+
+    model = _tiny_model()
+    for cls, kw in ((BSPEngine, {}), (ZeroEngine, {}),
+                    (EASGDEngine, dict(avg_freq=4)),
+                    (GOSGDEngine, dict(gossip_every=2))):
+        engine = cls(model, mesh8, wire_codec="int8", **kw)
+        _assert_compressed(engine.traffic_model(engine.init_state(rng)))
+
+
+def test_nd_engine_reports_compressed_traffic():
+    from jax.sharding import Mesh
+
+    from theanompi_tpu.models.lm import TransformerLMModel
+    from theanompi_tpu.parallel.nd import DP_AXIS, NDEngine, TP_AXIS
+
+    recipe = TransformerLMModel.default_recipe().replace(
+        batch_size=8, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        input_shape=(16,), num_classes=32)
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                (DP_AXIS, TP_AXIS))
+    engine = NDEngine(TransformerLMModel(recipe), mesh, dp_axis=DP_AXIS,
+                      tp_axis=TP_AXIS, wire_codec="int8")
+    _assert_compressed(
+        engine.traffic_model(engine.init_state(jax.random.PRNGKey(0))))
+
+
+# -- convergence parity at int8 + error feedback -----------------------------
+
+_PARITY = dict(
+    devices=4,  # the CPU 2x2 virtual mesh
+    dataset="synthetic",
+    dataset_kwargs={"n_train": 64, "n_val": 64, "image_shape": (16, 16, 3)},
+    recipe_overrides={"batch_size": 16, "input_shape": (16, 16, 3),
+                      "sched_kwargs": {"lr": 0.05, "boundaries": [10 ** 9]}},
+    n_epochs=100,
+    max_steps=24,
+    print_freq=0,
+    seed=11,
+)
+
+
+def _parity_loss(**kw):
+    from theanompi_tpu.launch.worker import run_training
+
+    s = run_training(model_cls=TinyCNN, **_PARITY, **kw)
+    assert s["steps"] == _PARITY["max_steps"]
+    return s["val"]["loss"]
+
+
+@pytest.fixture(scope="module")
+def bsp_fp32_loss():
+    return _parity_loss(rule="bsp")
+
+
+def _check_parity(loss, dense):
+    # descended well below chance (ln 10 ~ 2.30) ...
+    assert loss < 0.85 * np.log(10), loss
+    # ... and to the fp32 run's level: error feedback keeps the
+    # quantized trajectory tracking the dense one. Absolute floor: the
+    # mini-run memorizes the 64-sample set to near-zero loss, where a
+    # pure relative band degenerates to measuring noise.
+    assert abs(loss - dense) < 0.08 * dense + 0.02, (loss, dense)
+
+
+def test_bsp_int8_ef_parity(bsp_fp32_loss):
+    _check_parity(_parity_loss(rule="bsp", wire_codec="int8:ef"),
+                  bsp_fp32_loss)
+
+
+def test_zero_int8_ef_parity(bsp_fp32_loss):
+    """ZeRO-1 compresses BOTH halves (grad scatter + param gather with
+    the master-correction residual) — against the plain-BSP fp32 run,
+    which the uncompressed ZeRO step is oracle-identical to."""
+    _check_parity(_parity_loss(rule="bsp", zero=1, wire_codec="int8:ef"),
+                  bsp_fp32_loss)
+
+
+def test_nd_int8_ef_parity():
+    """ND engine on the 2x2 (dp x tp) mesh: int8+EF mini-run descends
+    to the fp32 run's loss within tolerance."""
+    from theanompi_tpu.launch.worker import run_training
+    from theanompi_tpu.models.lm import TransformerLMModel
+
+    kw = dict(
+        model_cls=TransformerLMModel,
+        devices=4,
+        tp=2,
+        dataset_kwargs={"n_train": 64, "n_val": 32},
+        recipe_overrides={"batch_size": 8, "d_model": 32, "n_heads": 4,
+                          "n_layers": 2, "d_ff": 64, "input_shape": (16,),
+                          "num_classes": 32, "optimizer": "adam",
+                          "schedule": "step",
+                          "sched_kwargs": {"lr": 3e-3,
+                                           "boundaries": [10 ** 9]}},
+        n_epochs=100, max_steps=40, print_freq=0, seed=11,
+    )
+    dense = run_training(rule="bsp", **kw)["val"]["loss"]
+    q = run_training(rule="bsp", wire_codec="int8:ef", **kw)["val"]["loss"]
+    assert q < 0.9 * np.log(32), q  # descending below chance
+    assert abs(q - dense) < 0.08 * dense + 0.02, (q, dense)
+
+
+def test_gosgd_int8_keeps_share_mass(mesh8, rng):
+    """The gossip merge under the packed int8 wire conserves the
+    share-weight mass invariant sum(alpha) == 1 (the share rides exact
+    bytes) and keeps replicas' consensus finite."""
+    from theanompi_tpu.parallel.gosgd import GOSGDEngine
+    from theanompi_tpu.parallel.mesh import put_global_batch
+
+    model = _tiny_model()
+    engine = GOSGDEngine(model, mesh8, p_push=1.0, wire_codec="int8:ef")
+    state = engine.init_state(rng)
+    r = np.random.RandomState(0)
+    x = put_global_batch(mesh8, jnp.asarray(r.randn(256, 16, 16, 3),
+                                            jnp.float32))
+    y = put_global_batch(mesh8, jnp.asarray(r.randint(0, 10, 256),
+                                            jnp.int32))
+    for i in range(4):
+        state, metrics = engine.train_step(state, x, y,
+                                           jax.random.PRNGKey(i))
+    assert float(jnp.sum(state.alpha)) == pytest.approx(1.0, abs=1e-5)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+# -- error-feedback state: checkpoint round-trip exactness -------------------
+
+
+def _bsp_template(n=8):
+    from theanompi_tpu.parallel import make_mesh
+    from theanompi_tpu.parallel.bsp import BSPEngine
+
+    engine = BSPEngine(_tiny_model(), make_mesh(n), wire_codec="int8:ef")
+    return engine.init_state(jax.random.PRNGKey(0))
+
+
+def _final_state_leaves(ckpt_dir):
+    from theanompi_tpu.utils.checkpoint import (
+        latest_checkpoint,
+        load_checkpoint,
+    )
+
+    path = latest_checkpoint(ckpt_dir, verify=True)
+    assert path is not None, f"no verified checkpoint in {ckpt_dir}"
+    restored, _ = load_checkpoint(path, _bsp_template())
+    return path, jax.tree_util.tree_leaves(restored)
+
+
+def test_ef_state_checkpoint_resume_bit_identical(tmp_path):
+    """PR-4 kill-and-resume harness at ``--wire-codec int8:ef``: an
+    injected crash resumes from the newest VERIFIED checkpoint — the
+    error-feedback residuals restored with the params — and finishes
+    BIT-IDENTICAL to an uninterrupted compressed run. If the residuals
+    were dropped or zeroed on resume, the post-resume quantization
+    error would diverge the replay immediately."""
+    from theanompi_tpu.launch.supervisor import supervise_training
+    from theanompi_tpu.launch.worker import run_training
+    from theanompi_tpu.utils.checkpoint import checkpoint_step
+
+    tiny = dict(
+        rule="bsp",
+        model_cls=TinyCNN,
+        devices=8,
+        wire_codec="int8:ef",
+        recipe_overrides={"batch_size": 32, "input_shape": (16, 16, 3),
+                          "sched_kwargs": {"lr": 0.05,
+                                           "boundaries": [10 ** 9]}},
+        dataset="synthetic",
+        dataset_kwargs={"n_train": 64, "n_val": 32,
+                        "image_shape": (16, 16, 3)},
+        print_freq=0,
+        n_epochs=2,  # 2 steps/epoch -> 4 total steps
+    )
+    clean = run_training(ckpt_dir=str(tmp_path / "clean"), **tiny)
+    sup = supervise_training(
+        ckpt_dir=str(tmp_path / "sup"), max_retries=2, backoff_base=0.0,
+        inject_faults=["crash@3"], **tiny,
+    )
+    assert sup["retries"] == 1 and sup["steps"] == clean["steps"] == 4
+    pa, la = _final_state_leaves(str(tmp_path / "clean"))
+    pb, lb = _final_state_leaves(str(tmp_path / "sup"))
+    assert checkpoint_step(pa) == checkpoint_step(pb)
+    assert len(la) == len(lb)
+    for a, b in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # the checkpointed state really carries the residuals (non-trivial)
+    tmpl = _bsp_template()
+    n_param_leaves = len(jax.tree_util.tree_leaves(tmpl.params))
+    assert len(jax.tree_util.tree_leaves(tmpl.ef)) == n_param_leaves
+    assert any(np.abs(np.asarray(l)).sum() > 0
+               for l in jax.tree_util.tree_leaves(
+                   _final_state_leaves(str(tmp_path / "clean"))[1]))
+
+
+# -- comm telemetry: the kind=comm record ------------------------------------
+
+
+def test_comm_record_emitted_and_schema_valid(tmp_path):
+    from theanompi_tpu.launch.worker import run_training
+    from theanompi_tpu.tools.check_obs_schema import check_file
+
+    obs = str(tmp_path / "obs")
+    run_training(
+        rule="bsp", model_cls=TinyCNN, devices=4, wire_codec="int8:ef",
+        obs_dir=obs, max_steps=2, n_epochs=1, print_freq=0, seed=3,
+        dataset="synthetic",
+        dataset_kwargs={"n_train": 64, "n_val": 64,
+                        "image_shape": (16, 16, 3)},
+        recipe_overrides={"batch_size": 16, "input_shape": (16, 16, 3)},
+    )
+    metrics_path = os.path.join(obs, "metrics.jsonl")
+    comm = [json.loads(l) for l in open(metrics_path)
+            if json.loads(l).get("kind") == "comm"]
+    assert len(comm) == 1
+    rec = comm[0]
+    assert rec["rule"] == "bsp" and rec["codec"] == "int8:ef"
+    assert rec["wire_bytes"] <= 0.3 * rec["raw_bytes"]
+    assert rec["compression_ratio"] >= 3.5
+    # the whole file (comm record + snapshots) stays schema-green
+    assert check_file(metrics_path) == []

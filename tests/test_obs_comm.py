@@ -42,10 +42,16 @@ def test_bsp_traffic_strategies():
     # ring variants pad N to n equal segments
     ring = bsp_traffic(1001, n, "ring")
     assert ring.detail["elements"] == 8 * 126  # ceil(1001/8)=126
-    # int8: 128-multiple segments, 1 byte on the wire
+    # int8: 128-multiple segments, 1 byte on the wire plus the packed
+    # per-block f32 scale rows (1/32 B per element — codec layer format)
     ri8 = bsp_traffic(1000, n, "ring_int8")
     assert ri8.detail["elements"] == 8 * 128
-    assert ri8.bytes_per_step == pytest.approx(2 * 7 / 8 * 8 * 128 * 1)
+    assert ri8.bytes_per_step == pytest.approx(
+        2 * 7 / 8 * 8 * 128 * (1 + 4 / 128)
+    )
+    # raw vs effective: the strategy's own compression shows in the pair
+    assert ri8.raw_bytes_per_step == pytest.approx(2 * 7 / 8 * 8 * 128 * 4)
+    assert ri8.compression_ratio == pytest.approx(4 / (1 + 4 / 128))
     # single device: silence
     assert bsp_traffic(N, 1).bytes_per_step == 0.0
     with pytest.raises(ValueError, match="unknown strategy"):

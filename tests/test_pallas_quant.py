@@ -12,9 +12,12 @@ import jax.numpy as jnp
 
 from theanompi_tpu.ops.pallas_quant import (
     dequantize_int8,
+    dequantize_int8_block,
     quantize_int8,
+    quantize_int8_block,
     wire_decode,
     wire_encode,
+    wire_rows,
 )
 
 
@@ -48,6 +51,82 @@ def test_wire_encode_decode():
     assert back.shape == flat.shape
     amax = float(jnp.max(jnp.abs(flat)))
     assert float(jnp.max(jnp.abs(back - flat))) <= amax / 254 + 1e-6
+
+
+def test_block_quantize_per_row_scales():
+    """Per-128-block scales: a huge outlier costs only its OWN block
+    the dynamic range (the single-scale quantizer would flatten every
+    other block to ~0)."""
+    r = np.random.RandomState(7)
+    x = r.randn(4, 128).astype(np.float32)
+    x[0, 0] = 1e4  # outlier in block 0 only
+    vals, scales = quantize_int8_block(jnp.asarray(x))
+    assert vals.shape == (4, 128) and scales.shape == (4, 1)
+    back = np.asarray(dequantize_int8_block(vals, scales))
+    for row in range(4):
+        amax = np.abs(x[row]).max()
+        np.testing.assert_allclose(back[row], x[row],
+                                   atol=amax / 254 + 1e-6)
+    # rows 1..3 keep fine resolution despite the row-0 outlier
+    assert np.abs(back[1:] - x[1:]).max() < 0.05
+
+
+def test_block_quantize_matches_jnp_fallback(monkeypatch):
+    r = np.random.RandomState(8)
+    x = jnp.asarray(r.randn(6, 128).astype(np.float32))
+    v1, s1 = quantize_int8_block(x)
+    monkeypatch.setenv("TMPI_PALLAS", "0")
+    v2, s2 = quantize_int8_block(x)
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), rtol=1e-7)
+
+
+@pytest.mark.parametrize("length", [1, 5, 127, 128, 129, 300, 4096 + 3])
+def test_wire_roundtrip_non_multiple_lengths(length):
+    """Edge-shape hardening: ANY length >= 1 round-trips (internal
+    zero-pad; decode strips with the caller's static length)."""
+    r = np.random.RandomState(length)
+    flat = jnp.asarray(r.randn(length).astype(np.float32)) * 3.0
+    packed = wire_encode(flat)
+    rows, srows = wire_rows(length)
+    assert packed.shape == (rows + srows, 128)
+    back = wire_decode(packed, length=length)
+    assert back.shape == (length,)
+    amax = float(jnp.max(jnp.abs(flat)))
+    np.testing.assert_allclose(np.asarray(back), np.asarray(flat),
+                               atol=amax / 254 + 1e-6)
+
+
+def test_wire_zero_buffer_no_nan():
+    """A zero-filled buffer must decode to EXACT zeros — the scale
+    floor keeps the scale finite, so no 0/0 NaN can appear on either
+    side of the wire."""
+    packed = wire_encode(jnp.zeros(200, jnp.float32))
+    back = np.asarray(wire_decode(packed, length=200))
+    assert np.all(np.isfinite(back))
+    np.testing.assert_array_equal(back, np.zeros(200, np.float32))
+
+
+def test_wire_one_element_leaf():
+    x = jnp.asarray([3.14159], jnp.float32)
+    back = wire_decode(wire_encode(x), length=1)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x),
+                               atol=3.15 / 254)
+
+
+def test_wire_decode_under_jit():
+    """The packed geometry (rows from shape) must resolve statically —
+    wire_decode composes into jitted collectives (ring hops, gossip)."""
+    r = np.random.RandomState(9)
+    flat = jnp.asarray(r.randn(260).astype(np.float32))
+
+    @jax.jit
+    def roundtrip(v):
+        return wire_decode(wire_encode(v), length=260)
+
+    amax = float(jnp.max(jnp.abs(flat)))
+    np.testing.assert_allclose(np.asarray(roundtrip(flat)),
+                               np.asarray(flat), atol=amax / 254 + 1e-6)
 
 
 def test_ring_int8_strategy_close_to_mean_oracle():

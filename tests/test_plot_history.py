@@ -108,6 +108,36 @@ def test_load_obs_series_and_graceful_absence(tmp_path):
     assert o["fractions"]["step"] == 0.6
 
 
+def test_load_obs_raw_gbps_and_codec(tmp_path):
+    """Codec runs carry a raw-fp32 companion series plus a kind=comm
+    declaration; the plotter pairs them so the comm panel shows the
+    effective-vs-raw gap."""
+    from theanompi_tpu.tools.plot_history import load_obs, plot
+
+    p = _write_run(str(tmp_path / "runC"), "runC")
+    obs = os.path.join(str(tmp_path / "runC"), "obs")
+    os.makedirs(obs, exist_ok=True)
+    with open(os.path.join(obs, "metrics.jsonl"), "w") as f:
+        f.write(json.dumps({
+            "kind": "comm", "t": 1000.0, "rule": "bsp", "codec": "int8:ef",
+            "n_workers": 8, "raw_bytes": 4000.0, "wire_bytes": 1031.25,
+            "compression_ratio": 3.879,
+        }) + "\n")
+        for s in range(1, 4):
+            f.write(json.dumps({
+                "kind": "metrics", "t": 1000.0 + s, "step": s,
+                "metrics": {"tmpi_comm_gbps": 1.0 + s,
+                            "tmpi_comm_gbps_raw": (1.0 + s) * 3.879},
+            }) + "\n")
+    o = load_obs(p)
+    assert o["codec"] == "int8:ef"
+    assert o["comm_gbps_raw"] == [pytest.approx((1.0 + s) * 3.879)
+                                  for s in range(1, 4)]
+    # end-to-end render with the raw series present
+    out = plot({"runC": p}, str(tmp_path / "codec.png"))
+    assert os.path.exists(out)
+
+
 def test_load_obs_keeps_only_newest_rerun(tmp_path):
     """metrics.jsonl is append-mode: a rerun into the same obs dir
     restarts the step counter; the plotter keeps the newest run's

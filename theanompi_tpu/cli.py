@@ -42,6 +42,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="gradient exchange strategy (psum|ring|ring_bf16|ring_int8|"
                         "psum_bf16 or reference names ar|asa32|asa16|nccl32|"
                         "nccl16)")
+    p.add_argument("--wire-codec", default="none", metavar="CODEC[:ef]",
+                   help="compressed-collectives codec (parallel/codec.py) "
+                        "for EVERY engine's exchange: none|bf16|int8, "
+                        "optional ':ef' suffix for error-feedback "
+                        "residual accumulators (e.g. int8:ef — the "
+                        "convergence-safe default for int8). Applies to "
+                        "the BSP grad psum/ring wire, ZeRO's reduce-"
+                        "scatter + all-gather, EASGD's elastic psum, "
+                        "GoSGD's gossip message, and the ND engine's "
+                        "sharded-axis grad psums; traffic gauges report "
+                        "effective vs raw bytes")
     p.add_argument("--steps-per-dispatch", type=int, default=1,
                    help="fuse this many steps into one compiled dispatch "
                         "(one H2D transfer + one host dispatch per group) — "
@@ -392,6 +403,7 @@ def main(argv=None) -> int:
             model_cls=model_cls,
             devices=args.n_devices or None,
             strategy=args.strategy,
+            wire_codec=args.wire_codec,
             n_slices=args.slices,
             steps_per_dispatch=args.steps_per_dispatch,
             dispatch_depth=args.dispatch_depth,

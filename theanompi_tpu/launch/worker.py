@@ -139,6 +139,11 @@ def run_training(
     devices=None,
     *,
     strategy: str = "psum",
+    # compressed-collectives wire codec (parallel/codec.py):
+    # none|bf16|int8, optional ':ef' suffix for error feedback — every
+    # engine's exchange path consumes it (BSP psum/ring, ZeRO
+    # scatter+gather, EASGD elastic psum, GoSGD gossip, ND grad psums)
+    wire_codec: str = "none",
     n_slices: Optional[int] = None,
     steps_per_dispatch: int = 1,
     # async dispatch pipeline (utils/dispatch.py): keep up to this many
@@ -273,6 +278,9 @@ def run_training(
         if dataset == "lm_synthetic":
             dataset_kwargs.setdefault("vocab", recipe.num_classes)
     rule = rule.lower()
+    from theanompi_tpu.parallel.codec import get_codec
+
+    codec = get_codec(wire_codec)  # validate the spec before any build
     fuse = max(1, int(steps_per_dispatch))
     tp, sp, pp, expert = int(tp), int(sp), int(pp), int(expert)
     zero = int(zero or 0)
@@ -504,7 +512,8 @@ def run_training(
         from theanompi_tpu.parallel.nd import NDEngine
 
         engine = NDEngine(
-            model, mesh, steps_per_epoch=steps_per_epoch, **nd_axes,
+            model, mesh, steps_per_epoch=steps_per_epoch,
+            wire_codec=codec, **nd_axes,
         )
     elif zero:
         from theanompi_tpu.parallel.zero import ZeroEngine
@@ -512,6 +521,7 @@ def run_training(
         engine = ZeroEngine(
             model, mesh, steps_per_epoch=steps_per_epoch,
             input_transform=input_transform, eval_views=eval_views,
+            wire_codec=codec,
         )
     elif rule == "bsp":
         from theanompi_tpu.parallel.bsp import BSPEngine
@@ -519,7 +529,7 @@ def run_training(
         engine = BSPEngine(
             model, mesh, steps_per_epoch=steps_per_epoch, strategy=strategy,
             input_transform=input_transform, eval_views=eval_views,
-            accum_steps=accum_steps,
+            accum_steps=accum_steps, wire_codec=codec,
         )
     elif rule == "easgd":
         from theanompi_tpu.parallel.easgd import EASGDEngine
@@ -527,7 +537,7 @@ def run_training(
         engine = EASGDEngine(
             model, mesh, steps_per_epoch=steps_per_epoch,
             input_transform=input_transform, eval_views=eval_views,
-            accum_steps=accum_steps, **rule_kwargs,
+            accum_steps=accum_steps, wire_codec=codec, **rule_kwargs,
         )
     else:
         from theanompi_tpu.parallel.gosgd import GOSGDEngine
@@ -535,7 +545,7 @@ def run_training(
         engine = GOSGDEngine(
             model, mesh, steps_per_epoch=steps_per_epoch,
             input_transform=input_transform, eval_views=eval_views,
-            accum_steps=accum_steps, **rule_kwargs,
+            accum_steps=accum_steps, wire_codec=codec, **rule_kwargs,
         )
 
     # Multi-controller: this host produces only its slice of every

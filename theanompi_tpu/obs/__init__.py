@@ -32,7 +32,16 @@ training loop carries no conditionals.
 On-disk layout under ``obs_dir`` (schemas:
 ``theanompi_tpu/tools/check_obs_schema.py``)::
 
-    metrics.jsonl           rank-0 metric snapshots (kind=metrics)
+    metrics.jsonl           rank-0 metric snapshots (kind=metrics) +
+                            one kind=comm record per run: the engine's
+                            declared wire model — rule, wire codec,
+                            raw_bytes vs wire_bytes (sustained
+                            per-step, fp32 vs post-codec) and their
+                            compression_ratio; snapshots also carry
+                            the tmpi_comm_raw_bytes_per_step /
+                            tmpi_comm_compression_ratio /
+                            tmpi_comm_gbps_raw gauges next to the
+                            effective tmpi_comm_* family
     metrics.prom            rank-0 Prometheus text exposition (atomic)
     spans_rank{r}.jsonl     per-rank span + span_summary lines
     heartbeat_rank{r}.json  per-rank liveness (atomic rewrite; carries
@@ -215,7 +224,9 @@ class Observability:
         """Record the active sync rule's analytic wire model (engine-
         declared; see each engine's ``traffic_model``) as gauges, so
         every snapshot carries the per-step comm bytes next to the
-        measured throughput."""
+        measured throughput — raw AND effective (post-codec), plus one
+        ``kind=comm`` JSONL record naming the codec (strings cannot
+        ride the numeric gauge map)."""
         self.traffic = tm
         if tm is None or not self.enabled:
             return
@@ -227,6 +238,17 @@ class Observability:
         self.registry.gauge(
             "tmpi_comm_n_workers", help="sync-rule worker count"
         ).set(tm.n_workers)
+        if self._metrics_f is not None:
+            # one comm record per declaration (schema:
+            # tools/check_obs_schema.py kind=comm): the codec proof line
+            # bench --codec-sweep and plot_history read back
+            import json as _json
+            import time as _time
+
+            self._metrics_f.write(
+                _json.dumps({"t": _time.time(), **tm.as_record()}) + "\n"
+            )
+            self._metrics_f.flush()
 
     def set_numerics_model(self, nm: Optional["NumericsModel"]) -> None:
         """Record the active rule's numerics declaration (engine-
@@ -413,11 +435,7 @@ class Observability:
             if step_seconds:
                 gbps = self.traffic.achieved_gbps(step_seconds / substeps)
                 if gbps is not None:
-                    self.registry.gauge(
-                        "tmpi_comm_gbps",
-                        help="achieved per-device interconnect GB/s "
-                             "(analytic bytes / measured step time)",
-                    ).set(gbps)
+                    self._set_gbps_gauges(gbps)
         if (
             self.snapshot_freq
             and step - self._last_snapshot_step >= self.snapshot_freq
@@ -435,11 +453,26 @@ class Observability:
             return
         gbps = self.traffic.achieved_gbps(per_step_seconds)
         if gbps is not None:
+            self._set_gbps_gauges(gbps)
+
+    def _set_gbps_gauges(self, gbps: float) -> None:
+        """Effective GB/s gauge, plus the raw (uncompressed-equivalent)
+        companion whenever a codec shrinks the wire — the pair is what
+        makes codec runs visually distinguishable in plot_history's
+        comm panel."""
+        self.registry.gauge(
+            "tmpi_comm_gbps",
+            help="achieved per-device interconnect GB/s "
+                 "(analytic bytes / measured step time)",
+        ).set(gbps)
+        ratio = self.traffic.compression_ratio
+        if ratio != 1.0:
             self.registry.gauge(
-                "tmpi_comm_gbps",
-                help="achieved per-device interconnect GB/s "
-                     "(analytic bytes / measured step time)",
-            ).set(gbps)
+                "tmpi_comm_gbps_raw",
+                help="GB/s an UNCOMPRESSED (fp32) wire would need for "
+                     "the same step time — effective * compression "
+                     "ratio (obs/comm.py)",
+            ).set(gbps * ratio)
 
     def snapshot(self, step: Optional[int] = None) -> Optional[dict]:
         """Write one metrics snapshot line + refresh the Prometheus

@@ -26,12 +26,19 @@ element after wire compression):
   ``(share·w, share)`` buffer per gossip round: ``(N+1)·b``, amortized
   by ``gossip_every``
 
+**Codec accounting** (parallel/codec.py): every model reports BOTH the
+raw (uncompressed fp32) and the effective (post-codec) wire bytes —
+``bytes_per_step``/``bytes_per_exchange`` are the EFFECTIVE numbers
+(what the gauges divide by step time), ``raw_*`` the fp32 equivalents,
+and ``compression_ratio`` their quotient. int8 wire bytes INCLUDE the
+per-128-block f32 scale rows (1/32 B per element), so the claimed
+>= 3.8x ratio is the honest on-the-wire number.
+
 Known under-counts, flagged in ``detail`` rather than silently wrong:
-ring variants pad N up to a segment multiple (accounted), int8 wire
-carries a per-segment scale (~1% — ignored), and the ND engine's
-activation collectives (tp psum, sp ring/all-to-all, pp ppermute, MoE
-all-to-all) are NOT modeled — its figure covers the dp-axis grad sync
-only and is marked ``approx``.
+ring variants pad N up to a segment multiple (accounted), and the ND
+engine's activation collectives (tp psum, sp ring/all-to-all, pp
+ppermute, MoE all-to-all) are NOT modeled — its figure covers the
+dp-axis grad sync only and is marked ``approx``.
 """
 
 from __future__ import annotations
@@ -40,13 +47,16 @@ import math
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+from theanompi_tpu.parallel.codec import CODEC_WIRE_BYTES, get_codec
+
 # wire bytes per element after each strategy's compression
 # (parallel/strategies.py: packed ring variants cast/quantize the wire;
-# psum runs in the operand dtype — grads are fp32 here)
+# psum runs in the operand dtype — grads are fp32 here). ring_int8's
+# figure includes the packed per-block scale rows (codec layer format).
 STRATEGY_WIRE_BYTES = {
     "psum": 4, "ring": 4,
     "psum_bf16": 2, "ring_bf16": 2,
-    "ring_int8": 1,
+    "ring_int8": CODEC_WIRE_BYTES["int8"],
     # reference aliases (strategies._ALIASES)
     "ar": 4, "cudaaware": 4, "copper": 4, "nccl32": 4,
     "nccl16": 2, "asa32": 4, "asa16": 2,
@@ -55,14 +65,27 @@ STRATEGY_WIRE_BYTES = {
 
 @dataclass
 class TrafficModel:
-    """Per-device wire volume for one sync rule instance."""
+    """Per-device wire volume for one sync rule instance.
+
+    ``bytes_per_step``/``bytes_per_exchange`` are EFFECTIVE (post-
+    codec) bytes; ``raw_bytes_per_step``/``raw_bytes_per_exchange``
+    the uncompressed fp32 equivalents (default: equal — no codec)."""
 
     rule: str
     n_workers: int
     bytes_per_step: float  # every-step collectives (in-step grad sync)
     bytes_per_exchange: float = 0.0  # periodic exchange collectives
     exchange_every: int = 0  # steps between exchanges (0 = none)
+    codec: str = "none"  # wire codec spec (parallel/codec.py)
+    raw_bytes_per_step: Optional[float] = None
+    raw_bytes_per_exchange: Optional[float] = None
     detail: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.raw_bytes_per_step is None:
+            self.raw_bytes_per_step = self.bytes_per_step
+        if self.raw_bytes_per_exchange is None:
+            self.raw_bytes_per_exchange = self.bytes_per_exchange
 
     @property
     def bytes_per_step_amortized(self) -> float:
@@ -73,6 +96,22 @@ class TrafficModel:
             if self.exchange_every else 0.0
         )
         return self.bytes_per_step + amort
+
+    @property
+    def raw_bytes_per_step_amortized(self) -> float:
+        amort = (
+            self.raw_bytes_per_exchange / self.exchange_every
+            if self.exchange_every else 0.0
+        )
+        return self.raw_bytes_per_step + amort
+
+    @property
+    def compression_ratio(self) -> float:
+        """raw / effective sustained bytes (1.0 = uncompressed; a
+        zero-wire rule — single device — reports 1.0 too)."""
+        eff = self.bytes_per_step_amortized
+        raw = self.raw_bytes_per_step_amortized
+        return raw / eff if eff > 0 else 1.0
 
     def achieved_gbps(self, step_seconds: float) -> Optional[float]:
         """Sustained per-device interconnect GB/s implied by a measured
@@ -87,6 +126,26 @@ class TrafficModel:
             "comm_bytes_per_exchange": self.bytes_per_exchange,
             "comm_exchange_every": float(self.exchange_every),
             "comm_bytes_per_step_amortized": self.bytes_per_step_amortized,
+            # codec accounting: raw (fp32) wire next to the effective
+            # bytes above, plus their quotient — the compression proof
+            "comm_raw_bytes_per_step": self.raw_bytes_per_step,
+            "comm_raw_bytes_per_step_amortized":
+                self.raw_bytes_per_step_amortized,
+            "comm_compression_ratio": self.compression_ratio,
+        }
+
+    def as_record(self) -> dict:
+        """The ``kind=comm`` JSONL record body (schema:
+        tools/check_obs_schema.py) — one per run, written when the
+        engine declares its wire model."""
+        return {
+            "kind": "comm",
+            "rule": self.rule,
+            "codec": self.codec,
+            "n_workers": self.n_workers,
+            "raw_bytes": self.raw_bytes_per_step_amortized,
+            "wire_bytes": self.bytes_per_step_amortized,
+            "compression_ratio": self.compression_ratio,
         }
 
 
@@ -125,101 +184,150 @@ def reduce_scatter_bytes(n_elements: int, n: int, wire_bytes: int = 4) -> float:
 all_gather_bytes = reduce_scatter_bytes  # same wire volume, other half
 
 
-def bsp_traffic(n_elements: int, n: int, strategy: str = "psum") -> TrafficModel:
+def bsp_traffic(n_elements: int, n: int, strategy: str = "psum",
+                codec=None) -> TrafficModel:
     """BSP in-step gradient allreduce. Ring variants pad the flat buffer
     to ``n`` equal segments (128-multiples for int8) — accounted, since
-    the padding rides the wire."""
+    the padding rides the wire. ``codec``: the wire codec the exchange
+    runs through (parallel/codec.py) — its bytes-per-element replaces
+    the strategy's own when active (psum + codec, or ring whose wire
+    the codec selects)."""
+    codec = get_codec(codec)
     b = wire_bytes_per_element(strategy)
     canonical = {"ar": "psum", "cudaaware": "psum", "copper": "psum",
                  "nccl32": "psum", "nccl16": "psum_bf16", "asa32": "ring",
                  "asa16": "ring_bf16"}.get(strategy, strategy)
+    if codec.active:
+        b = codec.wire_bytes_per_element
+        if canonical == "ring":
+            canonical = {"bf16": "ring_bf16", "int8": "ring_int8"}[codec.name]
     elems = n_elements
-    if n > 1 and canonical.startswith("ring"):
+    if n > 1 and (canonical.startswith("ring")
+                  or (codec.active and codec.name == "int8")):
+        # ring variants pad to n segments; the int8 codec's block layout
+        # pads each leaf to 128-lane rows — approximate both with the
+        # segment rule (exact for the ring, <=1 row per leaf off for
+        # the psum path)
         seg = -(-n_elements // n)
-        if canonical == "ring_int8":
+        if canonical == "ring_int8" or codec.name == "int8":
             seg = -(-seg // 128) * 128
         elems = n * seg
     return TrafficModel(
         rule="bsp", n_workers=n,
         bytes_per_step=allreduce_bytes(elems, n, b),
+        codec=codec.spec,
+        raw_bytes_per_step=allreduce_bytes(elems, n),
         detail={"strategy": strategy, "elements": elems,
                 "wire_bytes_per_element": b},
     )
 
 
-def zero1_traffic(n_elements: int, n: int) -> TrafficModel:
+def zero1_traffic(n_elements: int, n: int, codec=None) -> TrafficModel:
     """ZeRO-1: psum_scatter + all_gather over the flat fp32 buffer
     padded to ``n`` equal segments (parallel/zero.py pads to
-    ``n * ceil(P/n)``) — same total wire as the plain allreduce."""
+    ``n * ceil(P/n)``) — same total wire as the plain allreduce. The
+    codec compresses BOTH halves (grad scatter and param gather —
+    parallel/zero.py quantizes each with its own error-feedback
+    residual), so the full volume shrinks."""
+    codec = get_codec(codec)
+    b = codec.wire_bytes_per_element
     seg = -(-n_elements // n) if n > 1 else n_elements
     padded = n * seg if n > 1 else n_elements
+    raw = reduce_scatter_bytes(padded, n) + all_gather_bytes(padded, n)
     return TrafficModel(
         rule="zero1", n_workers=n,
-        bytes_per_step=(
-            reduce_scatter_bytes(padded, n) + all_gather_bytes(padded, n)
-        ),
-        detail={"elements": padded, "wire_bytes_per_element": 4,
+        bytes_per_step=raw * b / 4.0,
+        codec=codec.spec,
+        raw_bytes_per_step=raw,
+        detail={"elements": padded, "wire_bytes_per_element": b,
                 "padded_from": n_elements},
     )
 
 
 def easgd_traffic(
-    n_elements: int, n_workers: int, avg_freq: int, group_size: int = 1
+    n_elements: int, n_workers: int, avg_freq: int, group_size: int = 1,
+    codec=None,
 ) -> TrafficModel:
     """EASGD: zero comm on local steps (the selling point) unless the
     worker is a chip GROUP (in-step grad psum over the group's data
     axis); every ``avg_freq`` steps one psum of the param-sized elastic
-    differences over the worker axis."""
+    differences over the worker axis. The codec compresses the ELASTIC
+    EXCHANGE only — the group-internal grad psum rides dense ICI and
+    stays fp32 (parallel/easgd.py)."""
+    codec = get_codec(codec)
     per_step = (
         allreduce_bytes(n_elements, group_size) if group_size > 1 else 0.0
     )
+    raw_exchange = allreduce_bytes(n_elements, n_workers)
     return TrafficModel(
         rule="easgd", n_workers=n_workers,
         bytes_per_step=per_step,
-        bytes_per_exchange=allreduce_bytes(n_elements, n_workers),
+        bytes_per_exchange=raw_exchange * codec.wire_bytes_per_element / 4.0,
         exchange_every=max(1, int(avg_freq)),
-        detail={"elements": n_elements, "wire_bytes_per_element": 4,
+        codec=codec.spec,
+        raw_bytes_per_step=per_step,
+        raw_bytes_per_exchange=raw_exchange,
+        detail={"elements": n_elements,
+                "wire_bytes_per_element": codec.wire_bytes_per_element,
                 "group_size": group_size},
     )
 
 
 def gosgd_traffic(
     n_elements: int, n_workers: int, gossip_every: int = 1,
-    group_size: int = 1,
+    group_size: int = 1, codec=None,
 ) -> TrafficModel:
     """GoSGD: every gossip round is ONE ppermute of the packed
     ``(share*w, share)`` buffer — ``(N+1)*4`` bytes per device per
     round regardless of n (parallel/gosgd.py), zero between rounds
     (plus the group grad psum when workers are chip groups). The
     Bernoulli push DECISION gates merging, not the wire: the ppermute
-    ships every round it runs."""
+    ships every round it runs. With a codec the round message is the
+    ACTUAL packed layout (codec.gossip_encode: quantized values +
+    scale rows + the exact-fp32 share tail)."""
+    from theanompi_tpu.parallel.codec import gossip_wire_bytes
+
+    codec = get_codec(codec)
     per_step = (
         allreduce_bytes(n_elements, group_size) if group_size > 1 else 0.0
     )
-    round_bytes = float((n_elements + 1) * 4) if n_workers > 1 else 0.0
+    raw_round = float((n_elements + 1) * 4) if n_workers > 1 else 0.0
+    round_bytes = (
+        gossip_wire_bytes(codec, n_elements) if n_workers > 1 else 0.0
+    )
     return TrafficModel(
         rule="gosgd", n_workers=n_workers,
         bytes_per_step=per_step,
         bytes_per_exchange=round_bytes,
         exchange_every=max(1, int(gossip_every)),
-        detail={"elements": n_elements, "wire_bytes_per_element": 4,
+        codec=codec.spec,
+        raw_bytes_per_step=per_step,
+        raw_bytes_per_exchange=raw_round,
+        detail={"elements": n_elements,
+                "wire_bytes_per_element": codec.wire_bytes_per_element,
                 "group_size": group_size},
     )
 
 
 def nd_traffic(
-    n_elements: int, dp: int, shard_ways: int = 1
+    n_elements: int, dp: int, shard_ways: int = 1, codec=None
 ) -> TrafficModel:
     """ND engine, dp-axis grad sync only: each device allreduces its
-    LOCAL (1/shard_ways) slice of the params over the dp axis.
-    Activation collectives (tp psum, sp ring, pp ppermute, MoE
-    all-to-all) are NOT modeled — marked ``approx`` so downstream
-    readers can't mistake this for a full wire audit."""
+    LOCAL (1/shard_ways) slice of the params over the dp axis; the
+    codec compresses exactly those sharded-axis grad psums
+    (parallel/nd.py). Activation collectives (tp psum, sp ring, pp
+    ppermute, MoE all-to-all) are NOT modeled — marked ``approx`` so
+    downstream readers can't mistake this for a full wire audit."""
+    codec = get_codec(codec)
+    b = codec.wire_bytes_per_element
     local = n_elements / max(1, shard_ways)
+    raw = allreduce_bytes(local, dp)
     return TrafficModel(
         rule="nd", n_workers=dp,
-        bytes_per_step=allreduce_bytes(local, dp),
-        detail={"elements": local, "wire_bytes_per_element": 4,
+        bytes_per_step=raw * b / 4.0,
+        codec=codec.spec,
+        raw_bytes_per_step=raw,
+        detail={"elements": local, "wire_bytes_per_element": b,
                 "approx": True, "shard_ways": shard_ways,
                 "note": "dp grad sync only; activation collectives "
                         "(tp/sp/pp/moe) not modeled"},

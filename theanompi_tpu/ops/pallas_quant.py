@@ -3,21 +3,33 @@ building block for gradient exchange.
 
 The reference's ``Exch_asa16`` cast ring segments to fp16 on the wire
 (reference: ``lib/exchanger_strategy.py``; SURVEY.md §2.3 "fp16-
-compressed comm"); the TPU-native escalation is int8 with a per-chunk
-scale (EQuARX-style, PAPERS.md): 4x wire compression vs fp32 with the
+compressed comm"); the TPU-native escalation is int8 with a per-block
+scale (EQuARX-style, PAPERS.md): ~4x wire compression vs fp32 with the
 accumulation still fp32. The quantize/dequantize hot loops are Pallas
 TPU kernels (VPU elementwise over VMEM tiles); off-TPU (CPU test
 meshes) the same kernels run through the Pallas interpreter, so the
 numerics are identical everywhere.
 
+Two scale granularities:
+
+- **per-buffer** (``quantize_int8``): one absmax scale for the whole
+  chunk — the original ring-segment scheme;
+- **per-block** (``quantize_int8_block``): one absmax scale per
+  (1, 128) lane row — the block-scaled recipe the codec layer
+  (``parallel/codec.py``) uses per leaf, so one huge outlier only
+  costs its own 128-element block the dynamic range.
+
 Layout: kernels take the flat buffer reshaped to (rows, 128) lanes —
-the natural VPU shape; callers pad to a multiple of 128 (the ring
-already pads segments).
+the natural VPU shape. ``wire_encode``/``wire_decode`` accept ANY
+length (internal zero-pad to a 128 multiple; 1-element leaves work)
+and pack values + block scales into ONE int8 message.
 
 ``TMPI_PALLAS=0`` switches to the pure-jnp fallback (same math).
 """
 
 from __future__ import annotations
+
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -26,6 +38,9 @@ from theanompi_tpu.ops.pallas_util import interpret_mode as _interpret
 from theanompi_tpu.ops.pallas_util import use_pallas as _use_pallas
 
 _LANES = 128
+# f32 scale bytes per value row packed into the wire tail (one f32 per
+# 128-lane block -> 32 block scales per 128-byte scale row)
+_SCALES_PER_ROW = _LANES // 4
 
 
 def _quant_kernel(x_ref, vals_ref, scale_ref):
@@ -90,24 +105,153 @@ def dequantize_int8(vals: jax.Array, scale: jax.Array) -> jax.Array:
     )(vals, scale)
 
 
+# --------------------------------------------------------------------------
+# block-scaled variants: one absmax scale per (1, 128) lane row — the
+# per-leaf block quantizer the codec layer builds on
+# --------------------------------------------------------------------------
+
+
+def _quant_block_kernel(x_ref, vals_ref, scale_ref):
+    # per-row reduction stays in VMEM (vector data, not a scalar):
+    # keepdims shapes line up with the (rows, 1) scale output
+    amax = jnp.max(jnp.abs(x_ref[:]), axis=1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-30) / 127.0
+    scale_ref[:] = scale
+    vals_ref[:] = jnp.clip(jnp.round(x_ref[:] / scale), -127, 127).astype(
+        jnp.int8
+    )
+
+
+def _dequant_block_kernel(vals_ref, scale_ref, out_ref):
+    out_ref[:] = vals_ref[:].astype(jnp.float32) * scale_ref[:]
+
+
+def _quantize_block_jnp(x2d):
+    amax = jnp.max(jnp.abs(x2d), axis=1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-30) / 127.0
+    vals = jnp.clip(jnp.round(x2d / scale), -127, 127).astype(jnp.int8)
+    return vals, scale
+
+
+def quantize_int8_block(x2d: jax.Array):
+    """``(rows, 128) f32 -> ((rows, 128) int8, (rows, 1) f32 scales)``
+    with one absmax scale PER ROW (128-element block)."""
+    if not _use_pallas():
+        return _quantize_block_jnp(x2d)
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pl.pallas_call(
+        _quant_block_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct(x2d.shape, jnp.int8),
+            jax.ShapeDtypeStruct((x2d.shape[0], 1), jnp.float32),
+        ),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ),
+        interpret=_interpret(),
+    )(x2d)
+
+
+def dequantize_int8_block(vals: jax.Array, scales: jax.Array) -> jax.Array:
+    """Inverse of :func:`quantize_int8_block`."""
+    if not _use_pallas():
+        return vals.astype(jnp.float32) * scales
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pl.pallas_call(
+        _dequant_block_kernel,
+        out_shape=jax.ShapeDtypeStruct(vals.shape, jnp.float32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(vals, scales)
+
+
+# --------------------------------------------------------------------------
+# packed wire format: values + block scales in ONE int8 message
+# --------------------------------------------------------------------------
+
+
+def _pad_rows(flat: jax.Array) -> jax.Array:
+    """Zero-pad a flat f32 vector to a (rows, 128) lane layout."""
+    L = flat.shape[0]
+    rows = -(-L // _LANES)
+    pad = rows * _LANES - L
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat.reshape(rows, _LANES)
+
+
+def wire_rows(length: int) -> tuple:
+    """``(value_rows, scale_rows)`` of the packed message for a flat
+    buffer of ``length`` elements — the static wire-geometry helper the
+    traffic accounting shares with the encoder."""
+    if length < 1:
+        raise ValueError(f"cannot wire-encode a length-{length} buffer")
+    rows = -(-length // _LANES)
+    srows = -(-rows // _SCALES_PER_ROW)
+    return rows, srows
+
+
+def _rows_from_packed(n_rows: int) -> int:
+    """Invert ``rows + ceil(rows/32) == n_rows`` (strictly increasing in
+    ``rows``, so the solution is unique); static shapes only."""
+    for rows in range(1, n_rows):
+        if rows + -(-rows // _SCALES_PER_ROW) == n_rows:
+            return rows
+    raise ValueError(f"not a packed wire message: {n_rows} rows")
+
+
 def wire_encode(chunk: jax.Array) -> jax.Array:
-    """Flat f32 chunk -> ONE packed int8 message ``(rows + 1, 128)``:
-    quantized lanes plus a final row carrying the f32 scale's 4 bytes —
-    a single ppermute per ring hop instead of a values+scale pair (the
-    hops are latency-bound, especially over DCN). Chunk length must be a
-    multiple of 128 (ring segments are padded)."""
-    rows = chunk.shape[0] // _LANES
-    vals, scale = quantize_int8(chunk.reshape(rows, _LANES))
-    scale_bytes = jax.lax.bitcast_convert_type(scale, jnp.int8).reshape(1, 4)
-    tail = jnp.zeros((1, _LANES), jnp.int8).at[:, :4].set(scale_bytes)
+    """Flat f32 chunk of ANY length >= 1 -> ONE packed int8 message
+    ``(rows + ceil(rows/32), 128)``: block-quantized lanes plus tail
+    rows carrying the per-block f32 scales' bytes — a single ppermute
+    per ring hop instead of a values+scales pair (the hops are
+    latency-bound, especially over DCN). Non-128-multiple lengths are
+    zero-padded internally (decode with ``length=`` to strip); a
+    zero-filled buffer encodes to zeros and decodes to exact zeros (the
+    scale floor keeps it finite — no NaN/Inf on decode)."""
+    rows, srows = wire_rows(chunk.shape[0])
+    vals, scales = quantize_int8_block(_pad_rows(chunk))
+    scale_bytes = jax.lax.bitcast_convert_type(
+        scales.reshape(rows), jnp.int8
+    ).reshape(-1)
+    tail = (
+        jnp.zeros((srows * _LANES,), jnp.int8)
+        .at[: rows * 4]
+        .set(scale_bytes)
+        .reshape(srows, _LANES)
+    )
     return jnp.concatenate([vals, tail], axis=0)
 
 
-def wire_decode(packed: jax.Array) -> jax.Array:
-    """Inverse of :func:`wire_encode` -> flat f32."""
-    vals = packed[:-1]
-    scale = jax.lax.bitcast_convert_type(
-        packed[-1, :4].reshape(1, 1, 4), jnp.float32
-    ).reshape(1, 1)
-    return dequantize_int8(vals, scale).reshape(-1)
-
+def wire_decode(packed: jax.Array, length: Optional[int] = None) -> jax.Array:
+    """Inverse of :func:`wire_encode` -> flat f32 of the padded length
+    ``rows * 128`` (callers that encoded a non-128-multiple buffer pass
+    their static ``length`` to strip the zero pad)."""
+    if length is not None:
+        rows, srows = wire_rows(length)
+        if rows + srows != packed.shape[0]:
+            raise ValueError(
+                f"packed message has {packed.shape[0]} rows but length="
+                f"{length} implies {rows + srows}"
+            )
+    else:
+        rows = _rows_from_packed(packed.shape[0])
+    vals = packed[:rows]
+    scales = jax.lax.bitcast_convert_type(
+        packed[rows:].reshape(-1)[: rows * 4].reshape(rows, 1, 4),
+        jnp.float32,
+    ).reshape(rows, 1)
+    flat = dequantize_int8_block(vals, scales).reshape(-1)
+    if length is not None:
+        flat = flat[:length]
+    return flat

@@ -53,6 +53,19 @@ def _axes_tuple(axis_name) -> tuple:
 from theanompi_tpu.parallel.mesh import fold_linear_index as _fold_linear_index
 
 
+def _bsp_state_spec(codec, axes):
+    """shard_map spec for the BSP TrainState: everything replicated,
+    EXCEPT the codec's error-feedback residuals, which are per-device
+    (stacked ``[n, ...]``) and must be declared sharded over the data
+    axes — a blanket ``P()`` would stamp device-varying residuals as
+    replicated with no error under ``check_vma=False``."""
+    from theanompi_tpu.train import TrainState as _TS
+
+    if codec is not None and codec.error_feedback:
+        return _TS(P(), P(), P(), P(), P(axes))
+    return P()
+
+
 def make_bsp_train_step(
     model: Model,
     mesh: Mesh,
@@ -63,6 +76,7 @@ def make_bsp_train_step(
     input_transform=None,
     accum_steps: int = 1,
     numerics: bool = False,
+    wire_codec=None,
 ):
     """Build the jitted BSP step: ``(state, images, labels, rng) ->
     (state, metrics)`` over global arrays. ``accum_steps``: gradient
@@ -79,12 +93,15 @@ def make_bsp_train_step(
     within each slice and DCN across slices — XLA lowers the hierarchy
     from the mesh layout (SURVEY.md §5.8 "topology split").
     """
+    from theanompi_tpu.parallel.codec import get_codec
+
+    codec = get_codec(wire_codec)
     axes = _axes_tuple(axis_name)
     n = 1
     for a in axes:
         n *= mesh.shape[a]
     if n == 1:
-        get_strategy(strategy, axis_name, n)  # validate the name early
+        get_strategy(strategy, axis_name, n, codec=codec)  # validate early
         # Single-device fast path: no collectives exist, so skip the
         # shard_map machinery entirely (it pays real dispatch overhead on
         # some backends) — the plain jitted step is semantically identical.
@@ -103,8 +120,8 @@ def make_bsp_train_step(
 
     checked = _checked_vma()
     grad_sync = (
-        checked_mode_strategy(strategy, axis_name, n) if checked
-        else get_strategy(strategy, axis_name, n)
+        checked_mode_strategy(strategy, axis_name, n, codec=codec) if checked
+        else get_strategy(strategy, axis_name, n, codec=codec)
     )
     base_step = make_train_step(
         model, steps_per_epoch, grad_sync=grad_sync,
@@ -130,11 +147,12 @@ def make_bsp_train_step(
     # make_train_step's note. TMPI_CHECKED_VMA=1 flips this engine to
     # the migrated checked-mode semantics (_checked_vma docstring).
     spec = P(axes)  # P accepts a 1-tuple identically to the bare name
+    sspec = _bsp_state_spec(codec, axes)
     mapped = jax.shard_map(
         sharded_step,
         mesh=mesh,
-        in_specs=(P(), spec, spec, P()),
-        out_specs=(P(), P()),
+        in_specs=(sspec, spec, spec, P()),
+        out_specs=(sspec, P()),
         check_vma=checked,
     )
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
@@ -149,6 +167,7 @@ def make_bsp_fused_step(
     input_transform=None,
     accum_steps: int = 1,
     numerics: bool = False,
+    wire_codec=None,
 ):
     """``k`` BSP steps fused into ONE compiled program via ``lax.scan``
     over stacked batches ``[k, batch, ...]`` — one host dispatch (and one
@@ -164,14 +183,17 @@ def make_bsp_fused_step(
     fusion choices accumulate ULP-level drift
     (tests/test_fused_dispatch.py). Returns ``(state, stacked_metrics)``.
     """
+    from theanompi_tpu.parallel.codec import get_codec
+
+    codec = get_codec(wire_codec)
     axes = _axes_tuple(axis_name)
     n = 1
     for a in axes:
         n *= mesh.shape[a]
     checked = _checked_vma()
     grad_sync = (  # also validates the name
-        checked_mode_strategy(strategy, axis_name, n) if checked
-        else get_strategy(strategy, axis_name, n)
+        checked_mode_strategy(strategy, axis_name, n, codec=codec) if checked
+        else get_strategy(strategy, axis_name, n, codec=codec)
     )
 
     if n == 1:
@@ -212,11 +234,12 @@ def make_bsp_fused_step(
     # second full params+opt copy (the n==1 no-donate rationale in
     # make_bsp_train_step applies to single-chip tunneled backends only)
     spec = P(None, axes)
+    sspec = _bsp_state_spec(codec, axes)
     mapped = jax.shard_map(
         sharded_step,
         mesh=mesh,
-        in_specs=(P(), spec, spec, P()),
-        out_specs=(P(), P()),
+        in_specs=(sspec, spec, spec, P()),
+        out_specs=(sspec, P()),
         check_vma=checked,
     )
     return jax.jit(mapped, donate_argnums=(0,))
@@ -245,17 +268,21 @@ class BSPEngine:
         input_transform=None,
         eval_views: int = 1,
         accum_steps: int = 1,
+        wire_codec=None,
     ):
+        from theanompi_tpu.parallel.codec import get_codec
+
         if axis_name is None:
             from theanompi_tpu.parallel.mesh import batch_axes
 
             axis_name = batch_axes(mesh)
         self.model = model
         self.mesh = mesh
+        self.codec = get_codec(wire_codec)
         self._build = dict(
             steps_per_epoch=steps_per_epoch, strategy=strategy,
             axis_name=axis_name, input_transform=input_transform,
-            accum_steps=accum_steps,
+            accum_steps=accum_steps, wire_codec=self.codec,
         )
         # per-flag variants, built lazily: {numerics_flag: jitted step}.
         # The numerics step is a SECOND compiled program (sentinels are
@@ -272,7 +299,17 @@ class BSPEngine:
         )
 
     def init_state(self, rng):
-        return init_train_state(self.model, rng)
+        state = init_train_state(self.model, rng)
+        n = 1
+        for a in _axes_tuple(self._build["axis_name"]):
+            n *= self.mesh.shape[a]
+        if n > 1 and self.codec.error_feedback:
+            # per-device quantization residuals, stacked [n, ...] and
+            # sharded over the data axes by the step's state spec —
+            # checkpointed with the rest of the state (exact resume)
+            state = state._replace(ef=self.codec.init_ef(state.params,
+                                                         stack=n))
+        return state
 
     def train_step(self, state, images, labels, rng, numerics: bool = False):
         numerics = bool(numerics)
@@ -300,7 +337,10 @@ class BSPEngine:
         return state
 
     def eval_step(self, state, images, labels):
-        return self._eval(state, images, labels)
+        # strip the codec residuals: eval's state spec is a blanket P()
+        # (replicated), and the sharded ef leaves are irrelevant to a
+        # forward pass — passing them would force a gather per val batch
+        return self._eval(state._replace(ef=()), images, labels)
 
     def get_step(self, state) -> int:
         from theanompi_tpu.parallel.mesh import first_local_value
@@ -310,8 +350,8 @@ class BSPEngine:
     def traffic_model(self, state):
         """Analytic per-step wire volume of this engine's gradient
         allreduce (obs/comm.py): the in-step psum/ring over the data
-        axes, sized by the grad pytree (= params) and the strategy's
-        wire compression."""
+        axes, sized by the grad pytree (= params) and the strategy's /
+        codec's wire compression — raw AND effective bytes."""
         from theanompi_tpu.obs.comm import bsp_traffic, pytree_num_elements
 
         axes = _axes_tuple(self._build["axis_name"])
@@ -320,7 +360,7 @@ class BSPEngine:
             n *= self.mesh.shape[a]
         return bsp_traffic(
             pytree_num_elements(state.params), n,
-            strategy=self._build["strategy"],
+            strategy=self._build["strategy"], codec=self.codec,
         )
 
     def numerics_model(self, state):

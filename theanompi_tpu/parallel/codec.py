@@ -1,0 +1,289 @@
+"""Compressed-collectives codec layer: pluggable quantized exchange
+with error feedback, for EVERY engine's wire.
+
+Theano-MPI shipped exactly one compressed exchange — the fp16 segmented
+ring (``Exch_asa16``) — and this repo reproduced it as a one-off inside
+``parallel/strategies.py``'s explicit ring. This module generalizes it
+the way EQuARX (arXiv:2506.17615) and "Efficient Communications in
+Training Large Scale Neural Networks" (arXiv:1611.04255) prescribe:
+
+    block-scaled low-bit quantize -> reduce -> dequant,
+    with error-feedback residual accumulators
+
+as a CODEC any exchange path opts into: BSP's gradient psum/ring, the
+ZeRO-1 reduce-scatter + all-gather, EASGD's elastic-difference psum,
+GoSGD's gossip ppermute, and the ND engine's sharded-axis grad psums —
+selected by one ``--wire-codec {none,bf16,int8}[:ef]`` knob.
+
+Codecs:
+
+- ``none``  — identity (fp32 wire);
+- ``bf16``  — round-to-nearest bf16 values (2 B/elem, the modern
+  ``asa16``);
+- ``int8``  — per-128-element-block absmax-scaled int8 via the Pallas
+  kernels in ``ops/pallas_quant.py`` (~1.03 B/elem incl. scales,
+  >= 3.8x wire compression).
+
+``:ef`` turns on error feedback (Seide et al. 2014; 1611.04255 §3):
+each device keeps the residual ``r' = (v + r) - Q(v + r)`` of what its
+quantizer discarded and re-injects it next round, so the quantization
+error telescopes instead of accumulating — the difference between int8
+exchange that tracks the fp32 trajectory and one that stalls. The
+residuals are an explicit field of ENGINE STATE (stacked per device,
+sharded over the exchange axes): donation-safe, checkpointed with the
+rest of the state, so a kill-and-resume run is bit-identical to an
+uninterrupted one.
+
+Wire honesty: on point-to-point exchanges (the explicit ring's hops,
+GoSGD's gossip ppermute) the packed int8 message itself rides the
+interconnect — physical compression. On XLA-owned reductions (psum,
+psum_scatter, all_gather) the codec quantizes the OPERAND VALUES (the
+algorithm and its numerics are exactly the compressed collective;
+accumulation stays fp32) while XLA moves fp32 lanes — the analytic
+traffic model (``obs/comm.py``) reports codec bytes, which is the wire
+an implementation lowering the reduction to quantized segments (EQuARX)
+would move. ``bf16`` values are exactly representable in bf16 either
+way.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from theanompi_tpu.ops.pallas_quant import (  # noqa: F401  (re-exported:
+    dequantize_int8_block,  # the strategies ring + gossip consume the
+    quantize_int8_block,  # packed wire through THIS layer)
+    wire_decode,
+    wire_encode,
+    wire_rows,
+)
+
+PyTree = Any
+
+_LANES = 128
+# wire bytes per payload element, scale overhead included (int8: 1 B
+# values + one 4 B f32 scale per 128-element block = 1/32 B amortized)
+CODEC_WIRE_BYTES = {
+    "none": 4.0,
+    "bf16": 2.0,
+    "int8": 1.0 + 4.0 / _LANES,
+}
+
+
+def _qdq_int8_block(x: jax.Array) -> jax.Array:
+    """Value-space block quantize-dequantize of an arbitrary-shape f32
+    array: flatten, zero-pad to (rows, 128) lanes, per-block absmax
+    int8 round trip (ops/pallas_quant.py kernels), un-pad."""
+    flat = x.reshape(-1)
+    L = flat.shape[0]
+    rows = -(-L // _LANES)
+    pad = rows * _LANES - L
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    vals, scales = quantize_int8_block(flat.reshape(rows, _LANES))
+    back = dequantize_int8_block(vals, scales).reshape(-1)
+    if pad:
+        back = back[:L]
+    return back.reshape(x.shape)
+
+
+@dataclass(frozen=True)
+class WireCodec:
+    """One wire codec: a value-space quantizer ``Q`` plus the
+    error-feedback policy and the analytic bytes-per-element it costs.
+    Instances are cheap, stateless, and hashable (safe to close over in
+    jitted step builders); the EF residual state lives in ENGINE state,
+    threaded through :meth:`compress`."""
+
+    name: str  # none | bf16 | int8
+    error_feedback: bool = False
+
+    def __post_init__(self):
+        if self.name not in CODEC_WIRE_BYTES:
+            raise ValueError(
+                f"unknown wire codec {self.name!r}; available: "
+                f"{sorted(CODEC_WIRE_BYTES)} (suffix ':ef' for error "
+                "feedback)"
+            )
+        if self.name == "none" and self.error_feedback:
+            raise ValueError(
+                "'none:ef' is meaningless: the identity codec discards "
+                "nothing, so there is no error to feed back"
+            )
+
+    # -- analytic wire cost ------------------------------------------------
+    @property
+    def active(self) -> bool:
+        return self.name != "none"
+
+    @property
+    def wire_bytes_per_element(self) -> float:
+        return CODEC_WIRE_BYTES[self.name]
+
+    @property
+    def spec(self) -> str:
+        """The CLI spelling that round-trips through :func:`get_codec`."""
+        return self.name + (":ef" if self.error_feedback else "")
+
+    # -- value-space quantization ------------------------------------------
+    def qdq(self, x: jax.Array) -> jax.Array:
+        """Quantize-dequantize one f32 array (any shape): the value the
+        far side of the wire reconstructs."""
+        if self.name == "bf16":
+            return x.astype(jnp.bfloat16).astype(jnp.float32)
+        if self.name == "int8":
+            return _qdq_int8_block(x)
+        return x
+
+    def compress_leaf(self, v: jax.Array, ef: Optional[jax.Array]):
+        """One leaf through the codec: ``(wire_value, residual')``.
+        With error feedback the carried residual is injected before
+        quantization and the new residual is what this round's
+        quantizer discarded (``r' = (v + r) - Q(v + r)``); without it
+        the residual passes through untouched."""
+        if not self.active:
+            return v, ef
+        x = v.astype(jnp.float32)
+        if self.error_feedback:
+            x = x + ef
+        q = self.qdq(x)
+        if self.error_feedback:
+            ef = x - q
+        return q.astype(v.dtype), ef
+
+    def compress(self, tree: PyTree, ef: PyTree):
+        """Tree-mapped :meth:`compress_leaf` -> ``(wire_tree, ef')``.
+        ``ef`` must match ``tree``'s structure when error feedback is
+        on (see :meth:`init_ef`); it is passed through untouched
+        otherwise."""
+        if not self.active:
+            return tree, ef
+        if not self.error_feedback:
+            return (
+                jax.tree_util.tree_map(
+                    lambda v: self.compress_leaf(v, None)[0], tree
+                ),
+                ef,
+            )
+        # flatten-zip-unflatten (NOT a tuple-leaved tree_map: trees with
+        # tuple internal nodes would confuse an is_leaf=tuple unzip)
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        ef_leaves = jax.tree_util.tree_leaves(ef)
+        if len(ef_leaves) != len(leaves):
+            raise ValueError(
+                f"error-feedback state has {len(ef_leaves)} leaves for a "
+                f"{len(leaves)}-leaf wire tree — engine state was not "
+                "initialized with init_ef (or a resumed checkpoint "
+                "predates the codec run)"
+            )
+        pairs = [self.compress_leaf(v, r) for v, r in zip(leaves, ef_leaves)]
+        wire = jax.tree_util.tree_unflatten(treedef, [p[0] for p in pairs])
+        new_ef = jax.tree_util.tree_unflatten(treedef, [p[1] for p in pairs])
+        return wire, new_ef
+
+    def compress_stacked(self, tree: PyTree, ef_stacked: PyTree):
+        """:meth:`compress` for engines that store per-device residuals
+        STACKED on a leading axis of size 1 inside ``shard_map`` (the
+        EASGD-worker convention: global ``[n, ...]`` sharded over the
+        exchange axis, local view ``[1, ...]``)."""
+        if not (self.active and self.error_feedback):
+            return self.compress(tree, ef_stacked)
+        ef_local = jax.tree_util.tree_map(lambda v: v[0], ef_stacked)
+        wire, new_ef = self.compress(tree, ef_local)
+        return wire, jax.tree_util.tree_map(lambda v: v[None], new_ef)
+
+    # -- error-feedback state ----------------------------------------------
+    def init_ef(self, tree: PyTree, stack: Optional[int] = None) -> PyTree:
+        """Zero residual accumulators for ``tree`` (f32, one per leaf),
+        or ``()`` when this codec carries no state — so codec-off
+        engines pay nothing in state size, checkpoints, or donation.
+        ``stack``: prepend a worker/replica axis of that size (the
+        per-device residuals of a replicated exchange, sharded over the
+        exchange axis by the engine's specs)."""
+        if not (self.active and self.error_feedback):
+            return ()
+        if stack is None:
+            return jax.tree_util.tree_map(
+                lambda p: jnp.zeros(jnp.shape(p), jnp.float32), tree
+            )
+        return jax.tree_util.tree_map(
+            lambda p: jnp.zeros((stack, *jnp.shape(p)), jnp.float32), tree
+        )
+
+
+def get_codec(spec: Union[str, WireCodec, None]) -> WireCodec:
+    """Resolve a ``--wire-codec`` spec (``none`` / ``bf16`` / ``int8``,
+    optional ``:ef`` suffix) to a :class:`WireCodec`; instances pass
+    through, ``None`` means ``none``."""
+    if isinstance(spec, WireCodec):
+        return spec
+    if spec is None:
+        return WireCodec("none")
+    name, _, flag = str(spec).partition(":")
+    if flag not in ("", "ef"):
+        raise ValueError(
+            f"bad wire-codec suffix {flag!r} in {spec!r} (only ':ef')"
+        )
+    return WireCodec(name or "none", error_feedback=flag == "ef")
+
+
+# --------------------------------------------------------------------------
+# gossip payload packing (GoSGD): values compressed, the share weight
+# rides EXACT — quantizing the share would leak the sum(alpha) == 1
+# mass invariant the merge algebra depends on
+# --------------------------------------------------------------------------
+
+
+def gossip_encode(codec: WireCodec, values: jax.Array, share: jax.Array):
+    """Pack one gossip message ``(flat f32 values, f32 share scalar)``
+    for a single ppermute. ``int8``: the packed block-quantized wire
+    message plus one tail row carrying the share's exact 4 bytes — the
+    int8 lanes ARE what crosses the interconnect. ``bf16``: bf16 values
+    with the share bitcast into two exact bf16 lanes. ``none``: the
+    classic fp32 ``concat(values, share)`` payload."""
+    if codec.name == "int8":
+        packed = wire_encode(values)
+        share_bytes = jax.lax.bitcast_convert_type(
+            share.reshape(1), jnp.int8
+        ).reshape(4)
+        tail = jnp.zeros((1, _LANES), jnp.int8).at[0, :4].set(share_bytes)
+        return jnp.concatenate([packed, tail], axis=0)
+    if codec.name == "bf16":
+        share_lanes = jax.lax.bitcast_convert_type(
+            share.reshape(1), jnp.bfloat16
+        ).reshape(2)
+        return jnp.concatenate(
+            [values.astype(jnp.bfloat16), share_lanes]
+        )
+    return jnp.concatenate([values, share.reshape(1)])
+
+
+def gossip_decode(codec: WireCodec, message: jax.Array, length: int):
+    """Inverse of :func:`gossip_encode` -> ``(values f32 [length],
+    share f32 scalar)``."""
+    if codec.name == "int8":
+        share = jax.lax.bitcast_convert_type(
+            message[-1, :4].reshape(1, 4), jnp.float32
+        ).reshape(())
+        return wire_decode(message[:-1], length=length), share
+    if codec.name == "bf16":
+        share = jax.lax.bitcast_convert_type(
+            message[-2:].reshape(1, 2), jnp.float32
+        ).reshape(())
+        return message[:-2].astype(jnp.float32), share
+    return message[:-1], message[-1]
+
+
+def gossip_wire_bytes(codec: WireCodec, n_elements: int) -> float:
+    """Analytic per-round gossip message size in bytes (values + share
+    + codec overhead), matching :func:`gossip_encode`'s actual layout."""
+    if codec.name == "int8":
+        rows, srows = wire_rows(max(1, n_elements))
+        return float((rows + srows + 1) * _LANES)  # +1 share tail row
+    if codec.name == "bf16":
+        return float((n_elements + 2) * 2)
+    return float((n_elements + 1) * 4)

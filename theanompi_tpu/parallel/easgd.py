@@ -60,6 +60,10 @@ class EASGDState(NamedTuple):
     workers: TrainState  # leaves stacked (n_workers, ...), sharded over the mesh
     center_params: PyTree  # replicated
     center_model_state: PyTree  # replicated (refreshed at exchange rounds)
+    # wire-codec error-feedback residuals of the elastic-difference psum
+    # (parallel/codec.py): per-worker, stacked (n_workers, ...) and
+    # sharded like the workers; () when the codec carries no state
+    ef: PyTree = ()
 
 
 class EASGDEngine:
@@ -90,9 +94,12 @@ class EASGDEngine:
         group_size: int = 1,
         accum_steps: int = 1,
         n_slices: Optional[int] = None,
+        wire_codec=None,
     ):
+        from theanompi_tpu.parallel.codec import get_codec
         from theanompi_tpu.parallel.mesh import make_worker_group_mesh
 
+        self.codec = get_codec(wire_codec)
         self.model = model
         self.group_size = g = max(1, int(group_size))
         # n_slices: validate the pod topology split — groups (per-step
@@ -104,6 +111,8 @@ class EASGDEngine:
         self.mesh = mesh
         self.axis_name = ax
         self.n = mesh.shape[ax]  # number of WORKERS
+        if self.n == 1:
+            self.codec = get_codec(None)  # no peers, no wire to compress
         self.avg_freq = max(1, avg_freq)
         self.alpha = alpha if alpha is not None else 0.9 / self.n
         base_eval = make_eval_step(
@@ -171,7 +180,10 @@ class EASGDEngine:
             return sharded_step
 
         self._make_sharded_step = make_sharded_step
-        self._state_spec = EASGDState(P(ax), P(), P())
+        # ef residuals are per-worker (stacked, sharded) like workers —
+        # P(ax) broadcasts over an empty () subtree when the codec is off
+        self._state_spec = EASGDState(P(ax), P(), P(), P(ax))
+        sspec = self._state_spec
         self._bspec = bspec
         self._fused: dict = {}
 
@@ -180,8 +192,8 @@ class EASGDEngine:
                 jax.shard_map(
                     make_sharded_step(numerics),
                     mesh=mesh,
-                    in_specs=(EASGDState(P(ax), P(), P()), bspec, bspec, P()),
-                    out_specs=(EASGDState(P(ax), P(), P()), P()),
+                    in_specs=(sspec, bspec, bspec, P()),
+                    out_specs=(sspec, P()),
                     check_vma=False,
                 ),
                 donate_argnums=(0,),
@@ -191,29 +203,36 @@ class EASGDEngine:
         self._steps = {False: jit_step(False)}
 
         # ---- elastic exchange: one psum of the elastic differences ----
+        codec = self.codec
+
         def sharded_exchange(state: EASGDState):
             local = jax.tree_util.tree_map(lambda v: v[0], state.workers)
             diff = jax.tree_util.tree_map(
                 lambda w, c: a * (w - c), local.params, state.center_params
             )
+            # wire codec (parallel/codec.py): only the psum'd elastic
+            # differences cross the worker axis — quantize them (error-
+            # feedback residual per worker); the worker applies its OWN
+            # exact difference locally, no wire involved
+            wire_diff, new_ef = codec.compress_stacked(diff, state.ef)
             new_params = jax.tree_util.tree_map(lambda w, d: w - d, local.params, diff)
             center = jax.tree_util.tree_map(
-                lambda c, d: c + lax.psum(d, ax), state.center_params, diff
+                lambda c, d: c + lax.psum(d, ax), state.center_params, wire_diff
             )
             # center BN/eval state: average of worker states at exchange time
             center_ms = lax.pmean(local.model_state, ax)
             workers = jax.tree_util.tree_map(
                 lambda v: v[None], local._replace(params=new_params)
             )
-            return EASGDState(workers, center, center_ms)
+            return EASGDState(workers, center, center_ms, new_ef)
 
         self._sharded_exchange_fn = sharded_exchange
         self._exchange = jax.jit(
             jax.shard_map(
                 sharded_exchange,
                 mesh=mesh,
-                in_specs=(EASGDState(P(ax), P(), P()),),
-                out_specs=EASGDState(P(ax), P(), P()),
+                in_specs=(sspec,),
+                out_specs=sspec,
                 check_vma=False,
             ),
             donate_argnums=(0,),
@@ -231,7 +250,7 @@ class EASGDEngine:
             jax.shard_map(
                 sharded_eval,
                 mesh=mesh,
-                in_specs=(EASGDState(P(ax), P(), P()), bspec, bspec),
+                in_specs=(sspec, bspec, bspec),
                 out_specs=P(),
                 check_vma=False,
             )
@@ -248,6 +267,7 @@ class EASGDEngine:
             workers=stack_replicas(ts, self.n),
             center_params=ts.params,
             center_model_state=ts.model_state,
+            ef=self.codec.init_ef(ts.params, stack=self.n),
         )
 
     def train_step(self, state, images, labels, rng, numerics: bool = False):
@@ -309,7 +329,8 @@ class EASGDEngine:
         # workers leaves are stacked (n_workers, ...): per-worker size
         per_worker = pytree_num_elements(state.workers.params) // self.n
         return easgd_traffic(
-            per_worker, self.n, self.avg_freq, group_size=self.group_size
+            per_worker, self.n, self.avg_freq, group_size=self.group_size,
+            codec=self.codec,
         )
 
     def numerics_model(self, state):

@@ -68,6 +68,12 @@ PyTree = Any
 class GOSGDState(NamedTuple):
     workers: TrainState  # stacked (n, ...), sharded over the mesh
     alpha: jax.Array  # (n,) share weights, sharded; sum == 1
+    # wire-codec error-feedback residuals of the gossip payload values
+    # (parallel/codec.py): (n, flat_params) sharded over the mesh; ()
+    # when the codec carries no state. The share weight itself always
+    # rides EXACT (gossip_encode) — quantizing it would leak the
+    # sum(alpha) == 1 mass invariant.
+    ef: PyTree = ()
 
 
 class GOSGDEngine:
@@ -96,9 +102,12 @@ class GOSGDEngine:
         group_size: int = 1,
         accum_steps: int = 1,
         n_slices: "int | None" = None,
+        wire_codec=None,
     ):
+        from theanompi_tpu.parallel.codec import get_codec
         from theanompi_tpu.parallel.mesh import make_worker_group_mesh
 
+        self.codec = get_codec(wire_codec)
         self.model = model
         self.group_size = g = max(1, int(group_size))
         # n_slices: pod topology validation (groups inside a slice, the
@@ -110,6 +119,8 @@ class GOSGDEngine:
         self.mesh = mesh
         self.axis_name = axis_name
         self.n = mesh.shape[axis_name]  # number of WORKERS
+        if self.n == 1:
+            self.codec = get_codec(None)  # gossip is the identity
         if avg_freq:  # reference-style configuration: p = 1/avg_freq
             p_push = 1.0 / avg_freq
         self.p_push = float(p_push)
@@ -128,16 +139,27 @@ class GOSGDEngine:
             model, input_transform=input_transform, views=eval_views
         )
         ax, n, p = axis_name, self.n, float(p_push)
+        codec = self.codec
+        use_ef = codec.active and codec.error_feedback
         all_axes = tuple(mesh.axis_names)
 
-        def gossip(params: PyTree, alpha: jax.Array, rng: jax.Array):
+        def gossip(params: PyTree, alpha: jax.Array, rng: jax.Array,
+                   ef: PyTree):
             """One gossip round: ONE executed ppermute; returns merged
-            (params, alpha). ``rng`` must be identical across devices —
-            the shared shift comes straight from it, per-device push
-            decisions from folding in the device index. Identity on a
-            1-device mesh (no recipient exists)."""
+            (params, alpha, ef'). ``rng`` must be identical across
+            devices — the shared shift comes straight from it,
+            per-device push decisions from folding in the device index.
+            Identity on a 1-device mesh (no recipient exists).
+
+            With a wire codec the message IS the packed quantized
+            layout (codec.gossip_encode — for int8 the int8 lanes ride
+            the interconnect); the share weight travels exact. Error
+            feedback applies only on rounds this worker PUSHES: a
+            silent round ships exact zeros (a residual injected into a
+            zero-share payload would hand the receiver mass-less junk
+            values)."""
             if n == 1:
-                return params, alpha
+                return params, alpha, ef
             me = lax.axis_index(ax)
             hop_key, push_base = jax.random.split(rng)
             # shared across devices: every replica draws the same shift
@@ -151,8 +173,17 @@ class GOSGDEngine:
             # whole round is a single collective
             from jax.flatten_util import ravel_pytree
 
+            from theanompi_tpu.parallel.codec import (
+                gossip_decode,
+                gossip_encode,
+            )
+
             flat, unravel = ravel_pytree(params)
-            payload = jnp.concatenate([send_share * flat, send_share[None]])
+            L = flat.shape[0]
+            values = send_share * flat
+            if use_ef:
+                values = values + jnp.where(push, ef[0], 0.0)
+            payload = gossip_encode(codec, values, send_share)
             # one ppermute, shift chosen at runtime: lax.switch over the
             # n-1 static shift permutations (ppermute's perm is static).
             # Uniform predicate across replicas => same branch everywhere.
@@ -163,9 +194,17 @@ class GOSGDEngine:
                 for s in range(1, n)
             ]
             received = lax.switch(hop - 1, branches, payload)
-            acc = keep_share * flat + received[:-1]
-            acc_share = keep_share + received[-1]
-            return unravel(acc / acc_share), acc_share
+            recv_values, recv_share = gossip_decode(codec, received, L)
+            new_ef = ef
+            if use_ef:
+                # residual = what MY quantizer discarded this round
+                # (decode my own message — dequant is cheap; identical
+                # to what my receiver reconstructs)
+                sent_values, _ = gossip_decode(codec, payload, L)
+                new_ef = jnp.where(push, values - sent_values, ef[0])[None]
+            acc = keep_share * flat + recv_values
+            acc_share = keep_share + recv_share
+            return unravel(acc / acc_share), acc_share, new_ef
 
         def make_flag_fn(numerics: bool):
             """Factory per numerics flag: the sentinel variant adds the
@@ -202,15 +241,18 @@ class GOSGDEngine:
                     # stages BOTH branches even for a concrete predicate
                     # (verified), which would put a dead ppermute switch in
                     # the local step and lean on XLA to simplify it out
-                    merged, a_new = (
-                        gossip(new_local.params, a_local, gossip_rng)
-                        if with_gossip else (new_local.params, a_local)
+                    merged, a_new, ef_new = (
+                        gossip(new_local.params, a_local, gossip_rng,
+                               state.ef)
+                        if with_gossip
+                        else (new_local.params, a_local, state.ef)
                     )
                 else:
-                    merged, a_new = lax.cond(
+                    merged, a_new, ef_new = lax.cond(
                         with_gossip,
-                        lambda: gossip(new_local.params, a_local, gossip_rng),
-                        lambda: (new_local.params, a_local),
+                        lambda: gossip(new_local.params, a_local,
+                                       gossip_rng, state.ef),
+                        lambda: (new_local.params, a_local, state.ef),
                     )
                 new_local = new_local._replace(params=merged)
                 if numerics:
@@ -232,7 +274,8 @@ class GOSGDEngine:
                 metrics = lax.pmean(metrics, all_axes)
                 return (
                     GOSGDState(
-                        jax.tree_util.tree_map(lambda v: v[None], new_local), a_new[None]
+                        jax.tree_util.tree_map(lambda v: v[None], new_local),
+                        a_new[None], ef_new,
                     ),
                     metrics,
                 )
@@ -241,7 +284,7 @@ class GOSGDEngine:
 
         self._make_flag_fn = make_flag_fn
         self._sharded_step_flag = make_flag_fn(False)
-        self._state_spec = GOSGDState(P(ax), P(ax))
+        self._state_spec = GOSGDState(P(ax), P(ax), P(ax))
         self._bspec = bspec
         self._fused: dict = {}
 
@@ -257,8 +300,8 @@ class GOSGDEngine:
                 jax.shard_map(
                     sharded_step,
                     mesh=mesh,
-                    in_specs=(GOSGDState(P(ax), P(ax)), bspec, bspec, P()),
-                    out_specs=(GOSGDState(P(ax), P(ax)), P()),
+                    in_specs=(self._state_spec, bspec, bspec, P()),
+                    out_specs=(self._state_spec, P()),
                     check_vma=False,
                 ),
                 donate_argnums=(0,),
@@ -288,7 +331,7 @@ class GOSGDEngine:
             jax.shard_map(
                 sharded_eval,
                 mesh=mesh,
-                in_specs=(GOSGDState(P(ax), P(ax)), bspec, bspec),
+                in_specs=(self._state_spec, bspec, bspec),
                 out_specs=P(),
                 check_vma=False,
             )
@@ -306,9 +349,20 @@ class GOSGDEngine:
         # swaps in a restored checkpoint after init_state (resume keeps
         # the gossip cadence aligned with the global step).
         self._count = None
+        ef = ()
+        if self.codec.active and self.codec.error_feedback:
+            # one flat residual per worker, sized like the packed
+            # gossip payload's values (ravel of the param pytree)
+            from jax.flatten_util import ravel_pytree
+
+            flat_size = jax.eval_shape(
+                lambda p: ravel_pytree(p)[0], ts.params
+            ).shape[0]
+            ef = jnp.zeros((self.n, flat_size), jnp.float32)
         return GOSGDState(
             workers=stack_replicas(ts, self.n),
             alpha=jnp.full((self.n,), 1.0 / self.n),
+            ef=ef,
         )
 
     def train_step(self, state, images, labels, rng, numerics: bool = False):
@@ -380,7 +434,7 @@ class GOSGDEngine:
         per_worker = pytree_num_elements(state.workers.params) // self.n
         return gosgd_traffic(
             per_worker, self.n, gossip_every=self.gossip_every,
-            group_size=self.group_size,
+            group_size=self.group_size, codec=self.codec,
         )
 
     def numerics_model(self, state):

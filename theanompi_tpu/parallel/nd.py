@@ -60,11 +60,19 @@ class NDTrainState(NamedTuple):
     """Params + optimizer state + step. ``params`` leaves are sharded
     per the engine's param specs (tp/pipe/expert sharding or
     replicated); ``opt_state`` accumulators shard exactly like their
-    parameters (transformer.py::opt_state_specs)."""
+    parameters (transformer.py::opt_state_specs).
+
+    ``ef``: wire-codec error-feedback residuals (parallel/codec.py) of
+    the sharded-axis grad psums. Each leaf carries a leading stack dim
+    covering exactly the axes that leaf is PSUMMED over (the complement
+    of its sharded axes), so every device owns its own residual block —
+    spec ``P(psum_axes, *leaf_spec)``. ``()`` when the codec carries no
+    state."""
 
     params: PyTree
     opt_state: PyTree
     step: jax.Array
+    ef: PyTree = ()
 
 
 class NDEngine:
@@ -97,6 +105,7 @@ class NDEngine:
         microbatches: Optional[int] = None,
         pp_interleave: int = 1,
         donate: bool = True,
+        wire_codec=None,
     ):
         if not hasattr(model, "arch"):
             raise ValueError(
@@ -183,11 +192,51 @@ class NDEngine:
             tok_spec = P(dp_axis, sp_axis)
             batch_axes = (dp_axis,) if dp_axis else ()
 
+        from theanompi_tpu.parallel.codec import get_codec
+
+        codec = get_codec(wire_codec)
+        if n_total == 1:
+            codec = get_codec(None)  # no sync collectives, no wire
+        self.codec = codec
+        use_ef = codec.active and codec.error_feedback
+
+        def _psum_axes(spec):
+            """The participating axes a leaf's grad is psummed over —
+            the complement of its sharded axes (the same rule
+            transformer.sync_grads_by_spec applies)."""
+            sharded_on = set()
+            for entry in spec:
+                if isinstance(entry, (tuple, list)):
+                    sharded_on.update(entry)
+                elif entry is not None:
+                    sharded_on.add(entry)
+            return tuple(a for a in axes if a not in sharded_on)
+
+        _is_spec = lambda x: isinstance(x, P)  # noqa: E731
+        self._spec_leaves = jax.tree_util.tree_leaves(
+            param_specs, is_leaf=_is_spec
+        )
+        # which leaves actually cross a wire (psummed over >= 1 axis)
+        self._wire_axes = [_psum_axes(s) for s in self._spec_leaves]
+        ef_specs: Any = ()
+        if use_ef:
+            # one residual block per device: leading stack dim sharded
+            # over exactly the psummed axes (a leaf's own sharded axes
+            # cannot reappear in its ef spec)
+            ef_specs = jax.tree_util.tree_map(
+                lambda spec: P(_psum_axes(spec) or None, *spec),
+                param_specs, is_leaf=_is_spec,
+            )
+        self._ef_stack = [
+            int(np.prod([sizes[a] for a in ax_t])) if ax_t else 1
+            for ax_t in self._wire_axes
+        ]
+
         opt_template = jax.eval_shape(
             lambda: opt.init(jax.eval_shape(init_params, jax.random.PRNGKey(0)))
         )
         opt_specs = opt_state_specs(opt_template, param_specs)
-        state_specs = NDTrainState(param_specs, opt_specs, P())
+        state_specs = NDTrainState(param_specs, opt_specs, P(), ef_specs)
         self._state_specs = state_specs
         self._init_params = init_params
         self._opt = opt
@@ -204,10 +253,44 @@ class NDEngine:
         # tokens, or the pipeline's interleaved microbatch-major layout)
         self._part = None
 
+        wire_flags = [bool(ax_t) for ax_t in self._wire_axes]
+
+        def compress_grads(grads, ef):
+            """Wire codec over the leaves that actually cross an axis
+            (per-leaf block quantize + error feedback); fully-sharded
+            leaves (no psum) pass through untouched."""
+            g_leaves, treedef = jax.tree_util.tree_flatten(grads)
+            if use_ef:
+                ef_leaves = jax.tree_util.tree_leaves(ef)
+                out_g, out_ef = [], []
+                for g, r, w in zip(g_leaves, ef_leaves, wire_flags):
+                    if not w:
+                        out_g.append(g)
+                        out_ef.append(r)
+                        continue
+                    q, nr = codec.compress_leaf(g, r[0])
+                    out_g.append(q)
+                    out_ef.append(nr[None])
+                return (
+                    jax.tree_util.tree_unflatten(treedef, out_g),
+                    jax.tree_util.tree_unflatten(treedef, out_ef),
+                )
+            out_g = [
+                codec.compress_leaf(g, None)[0] if w else g
+                for g, w in zip(g_leaves, wire_flags)
+            ]
+            return jax.tree_util.tree_unflatten(treedef, out_g), ef
+
         def make_sharded_step(numerics: bool):
             def sharded_step(state: NDTrainState, tokens, rng):
                 del rng  # no dropout in the LM stack; kept for protocol parity
                 loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
+                new_ef = state.ef
+                if codec.active:
+                    # quantize each device's LOCAL contribution BEFORE
+                    # the sharded-axis psums (EQuARX recipe; fp32
+                    # accumulation inside the collective)
+                    grads, new_ef = compress_grads(grads, state.ef)
                 grads = sync_grads_by_spec(grads, param_specs, axes, n_total)
                 for a in batch_axes:
                     loss = lax.pmean(loss, a)  # report the global batch mean
@@ -228,7 +311,8 @@ class NDEngine:
                                             param_specs),
                     }
                 return (
-                    NDTrainState(new_params, new_opt, state.step + 1),
+                    NDTrainState(new_params, new_opt, state.step + 1,
+                                 new_ef),
                     metrics,
                 )
 
@@ -287,8 +371,16 @@ class NDEngine:
         # full parameter set per device)
         def build(rng):
             params = self._init_params(rng)
+            ef: Any = ()
+            if self.codec.active and self.codec.error_feedback:
+                leaves, treedef = jax.tree_util.tree_flatten(params)
+                ef = jax.tree_util.tree_unflatten(
+                    treedef,
+                    [jnp.zeros((stk, *p.shape), jnp.float32)
+                     for p, stk in zip(leaves, self._ef_stack)],
+                )
             return NDTrainState(
-                params, self._opt.init(params), jnp.zeros((), jnp.int32)
+                params, self._opt.init(params), jnp.zeros((), jnp.int32), ef
             )
 
         return jax.jit(build, out_shardings=self.state_shardings)(rng)
@@ -460,7 +552,8 @@ class NDEngine:
         dp = sizes.get(self._dp_axis, 1) if self._dp_axis else 1
         shard_ways = max(1, self.mesh.devices.size // dp)
         return nd_traffic(
-            pytree_num_elements(state.params), dp, shard_ways=shard_ways
+            pytree_num_elements(state.params), dp, shard_ways=shard_ways,
+            codec=self.codec,
         )
 
     def numerics_model(self, state):

@@ -138,7 +138,9 @@ def _ring_allreduce_flat(
 
     def send(chunk):
         if wire == "int8":
-            from theanompi_tpu.ops.pallas_quant import wire_decode, wire_encode
+            # the codec layer owns the packed int8 wire format (block-
+            # scaled values + scale tail rows); the ring is a consumer
+            from theanompi_tpu.parallel.codec import wire_decode, wire_encode
 
             # ONE packed message per hop (values + scale bytes)
             return wire_decode(lax.ppermute(wire_encode(chunk), axis_name, fwd))
@@ -166,7 +168,7 @@ def _ring_allreduce_flat(
         # replicas at different hop distances holding different values
         # and break BSP's replicated-state invariant. Packed forwarding
         # is also cheaper: one quantize total instead of one per hop.
-        from theanompi_tpu.ops.pallas_quant import wire_decode, wire_encode
+        from theanompi_tpu.parallel.codec import wire_decode, wire_encode
 
         own = jnp.mod(rank + 1, n)
         packed = wire_encode(jnp.take(buf, own, axis=0))
@@ -226,6 +228,29 @@ def ring_int8(axis_name: str, axis_size: int) -> Strategy:
 
 
 # --------------------------------------------------------------------------
+# codec-compressed psum — the codec layer (parallel/codec.py) applied to
+# the default in-step gradient allreduce: quantize each device's LOCAL
+# grads (error-feedback residual threaded through engine state), mean
+# in fp32. The stateful form is the generalization of psum_bf16 /
+# ring_int8 that EVERY engine's exchange shares.
+# --------------------------------------------------------------------------
+
+
+def codec_psum_mean(axis_name, codec) -> Strategy:
+    """Compressed allreduce ``(grads, ef) -> (mean grads, ef')``; the
+    error-feedback residuals arrive STACKED ``[1, ...]`` per device
+    (engine-state convention — see codec.compress_stacked). Marked
+    ``stateful`` so train.make_train_step threads ``state.ef``."""
+
+    def strategy(grads, ef):
+        wire, ef = codec.compress_stacked(grads, ef)
+        return lax.pmean(wire, axis_name), ef
+
+    strategy.stateful = True
+    return strategy
+
+
+# --------------------------------------------------------------------------
 # registry — reference config names kept as aliases (SURVEY.md §5.6:
 # exch_strategy: 'ar'|'cudaaware'|'asa32'|'asa16'|'nccl32')
 # --------------------------------------------------------------------------
@@ -249,7 +274,41 @@ _ALIASES = {
 }
 
 
-def checked_mode_strategy(name: str, axis_name, axis_size: int) -> Strategy:
+_ALREADY_COMPRESSED = ("psum_bf16", "ring_bf16", "ring_int8")
+
+
+def _resolve_codec(name: str, codec):
+    """Validate a (strategy, codec) pair -> WireCodec. Strategies that
+    hard-code their own wire compression refuse a second codec; the
+    explicit ring takes its wire FROM the codec (the asa16 special case
+    generalized) but has no leaf-level residual to feed back — each hop
+    re-quantizes partial sums per segment — so ``:ef`` needs the psum
+    path."""
+    from theanompi_tpu.parallel.codec import get_codec
+
+    codec = get_codec(codec)
+    key = _ALIASES.get(name, name)
+    if not codec.active:
+        return codec
+    if key in _ALREADY_COMPRESSED:
+        raise ValueError(
+            f"strategy {name!r} already compresses its wire; composing it "
+            f"with --wire-codec {codec.spec!r} would quantize twice — use "
+            "strategy 'psum' (or 'ring') with the codec, or the strategy "
+            "alone"
+        )
+    if key == "ring" and codec.error_feedback:
+        raise ValueError(
+            "error feedback needs a per-leaf residual, but the explicit "
+            "ring quantizes per segment per hop (no stable leaf mapping) "
+            f"— use --wire-codec {codec.name!r} on the ring, or "
+            f"{codec.spec!r} with strategy 'psum'"
+        )
+    return codec
+
+
+def checked_mode_strategy(name: str, axis_name, axis_size: int,
+                          codec=None) -> Strategy:
     """The ``check_vma=True`` exchanger (migration plan above, executed
     for the BSP engine in round 5 — ``parallel/bsp.py::_checked_vma``):
     AD already delivers the replicated-param cotangent globally SUMMED,
@@ -259,6 +318,12 @@ def checked_mode_strategy(name: str, axis_name, axis_size: int) -> Strategy:
     are refused — per the plan they survive only as weight-exchange
     collectives (EASGD/GoSGD averaging)."""
     del axis_name
+    if _resolve_codec(name, codec).active:
+        raise ValueError(
+            "checked-mode (check_vma=True) gradient sync has no exchanger "
+            "collective — there is no wire for a codec to compress; drop "
+            "--wire-codec or run the classic semantics"
+        )
     key = _ALIASES.get(name, name)
     if key in ("psum", "psum_bf16"):
         return lambda grads: jax.tree_util.tree_map(
@@ -272,16 +337,33 @@ def checked_mode_strategy(name: str, axis_name, axis_size: int) -> Strategy:
     )
 
 
-def get_strategy(name: str, axis_name, axis_size: int) -> Strategy:
+def get_strategy(name: str, axis_name, axis_size: int,
+                 codec=None) -> Strategy:
     """``axis_name`` may be a tuple of mesh axes (multi-slice BSP): the
     psum family reduces over all of them (XLA lowers ICI-then-DCN); the
-    explicit ring variants are single-axis algorithms by construction."""
+    explicit ring variants are single-axis algorithms by construction.
+
+    ``codec``: a wire codec spec/instance (parallel/codec.py). On the
+    psum path it returns the STATEFUL compressed strategy (error
+    feedback threaded through engine state); on the explicit ring it
+    selects the ring's wire compression (the asa16 special case,
+    generalized); strategies that already compress refuse it."""
+    codec = _resolve_codec(name, codec)
     key = _ALIASES.get(name, name)
     if not isinstance(axis_name, str) and key in ("ring", "ring_bf16", "ring_int8"):
         raise ValueError(
             f"strategy {name!r} is a single-axis ring; on a multi-slice "
             "mesh use 'psum'/'psum_bf16' (XLA lowers the ICI/DCN "
             "hierarchy from the mesh layout)"
+        )
+    if codec.active:
+        if key == "psum":
+            return codec_psum_mean(axis_name, codec)
+        # key == "ring" (every other pairing raised in _resolve_codec)
+        return _packed(
+            lambda flat: _ring_allreduce_flat(
+                flat, axis_name, axis_size, wire=codec.name
+            ) / axis_size
         )
     try:
         return _CANONICAL[key](axis_name, axis_size)

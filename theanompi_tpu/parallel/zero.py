@@ -48,12 +48,21 @@ PyTree = Any
 class ZeroTrainState(NamedTuple):
     """Like train.TrainState, but ``opt_state`` holds accumulators over
     the flat 1/n parameter segment owned by each rank (global leaves are
-    ``[n * seg]`` sharded over the data axis)."""
+    ``[n * seg]`` sharded over the data axis).
+
+    ``ef``: wire-codec error-feedback residuals (parallel/codec.py) —
+    ``{"g": [n, n*seg], "p": [n, seg]}`` sharded over the data axis:
+    per-device residuals of the quantized grad reduce-scatter, and the
+    per-owner master-correction residual of the quantized param
+    all-gather (exact = gathered + ef_p, so the fp32 trajectory
+    survives quantized replication). ``()`` when the codec carries no
+    state."""
 
     params: PyTree  # replicated pytree
     model_state: PyTree
     opt_state: PyTree  # flat-segment accumulators, sharded
     step: jax.Array
+    ef: PyTree = ()  # codec error-feedback residuals (or ())
 
 
 def make_zero1_train_step(
@@ -67,6 +76,7 @@ def make_zero1_train_step(
     donate: bool = True,
     fused: bool = False,
     numerics: bool = False,
+    wire_codec=None,
 ):
     """Build ``(init_state, train_step)`` for ZeRO-1 BSP training over
     ``mesh``'s ``axis_name``.
@@ -91,7 +101,13 @@ def make_zero1_train_step(
             "(for multi-slice, flatten to one data axis — XLA still "
             "routes the collectives hierarchically over ICI/DCN)"
         )
+    from theanompi_tpu.parallel.codec import get_codec
+
     n = sizes[axis_name]
+    codec = get_codec(wire_codec)
+    if n == 1:
+        codec = get_codec(None)  # no peers, no wire to compress
+    use_ef = codec.active and codec.error_feedback
     opt = (
         get_optimizer(optimizer)
         if isinstance(optimizer, str)
@@ -119,11 +135,19 @@ def make_zero1_train_step(
     def sharded_init(key):
         params, model_state = model.init(key)
         opt_state = opt.init(jnp.zeros((seg,), jnp.float32))
+        ef = (
+            {"g": jnp.zeros((1, n * seg), jnp.float32),
+             "p": jnp.zeros((1, seg), jnp.float32)}
+            if use_ef else ()
+        )
         return ZeroTrainState(
-            params, model_state, opt_state, jnp.zeros((), jnp.int32)
+            params, model_state, opt_state, jnp.zeros((), jnp.int32), ef
         )
 
-    state_specs = ZeroTrainState(P(), P(), opt_specs, P())
+    ef_specs = (
+        {"g": P(axis_name), "p": P(axis_name)} if use_ef else ()
+    )
+    state_specs = ZeroTrainState(P(), P(), opt_specs, P(), ef_specs)
     init_state = jax.jit(
         jax.shard_map(
             sharded_init,
@@ -152,18 +176,44 @@ def make_zero1_train_step(
         rank = lax.axis_index(axis_name)
         flat_g, _ = ravel_pytree(grads)
         flat_g = jnp.pad(flat_g.astype(jnp.float32), (0, n * seg - flat_size))
+        new_ef = state.ef
+        if codec.active:
+            # compressed reduce-scatter: quantize this rank's LOCAL
+            # contribution (error-feedback residual re-injected first),
+            # accumulate in fp32 — the 1611.04255 recipe on the scatter
+            # half of the exchange
+            if use_ef:
+                flat_g = flat_g + state.ef["g"][0]
+            g_wire = codec.qdq(flat_g)
+            if use_ef:
+                new_ef = dict(new_ef, g=(flat_g - g_wire)[None])
+            flat_g = g_wire
         # reduce-scatter: each rank receives the SUM of its segment
         g_seg = lax.psum_scatter(flat_g, axis_name, scatter_dimension=0,
                                  tiled=True) / n
 
         flat_p, unravel = ravel_pytree(state.params)
         p_seg = _seg_slice(flat_p.astype(jnp.float32), rank)
+        if use_ef:
+            # master correction: the replicated params are the QUANTIZED
+            # gather of last step; exact segment = quantized + residual,
+            # so the optimizer walks the fp32 trajectory while replicas
+            # carry the compressed copy
+            p_seg = p_seg + state.ef["p"][0]
 
         lr = schedule_lr(state.step)
         updates, new_opt = opt.update(g_seg, state.opt_state, p_seg, lr)
         new_p_seg = apply_updates(p_seg, updates)
 
-        new_flat = lax.all_gather(new_p_seg, axis_name, tiled=True)[:flat_size]
+        gather_seg = new_p_seg
+        if codec.active:
+            # compressed all-gather: every rank (owner included) adopts
+            # the dequantized segment, so params stay bit-replicated;
+            # the owner's residual preserves the exact master above
+            gather_seg = codec.qdq(new_p_seg)
+            if use_ef:
+                new_ef = dict(new_ef, p=(new_p_seg - gather_seg)[None])
+        new_flat = lax.all_gather(gather_seg, axis_name, tiled=True)[:flat_size]
         new_params = unravel(new_flat.astype(flat_p.dtype))
 
         metrics = {
@@ -197,7 +247,8 @@ def make_zero1_train_step(
                 "nm_nonfinite": nonf,
             }
         return (
-            ZeroTrainState(new_params, new_model_state, new_opt, state.step + 1),
+            ZeroTrainState(new_params, new_model_state, new_opt,
+                           state.step + 1, new_ef),
             metrics,
         )
 
@@ -249,13 +300,17 @@ class ZeroEngine:
         steps_per_epoch: int = 1,
         input_transform=None,
         eval_views: int = 1,
+        wire_codec=None,
     ):
         from theanompi_tpu.parallel.bsp import make_bsp_eval_step
+        from theanompi_tpu.parallel.codec import get_codec
 
         self.model = model
         self.mesh = mesh
+        self.codec = get_codec(wire_codec)
         self._build = dict(steps_per_epoch=steps_per_epoch,
-                           input_transform=input_transform)
+                           input_transform=input_transform,
+                           wire_codec=self.codec)
         self._init, step = make_zero1_train_step(model, mesh, **self._build)
         self._steps = {False: step}
         self._fused: dict = {}
@@ -303,11 +358,13 @@ class ZeroEngine:
     def traffic_model(self, state):
         """ZeRO-1 wire model (obs/comm.py): psum_scatter + all_gather
         over the flat fp32 buffer padded to n segments — same volume as
-        the plain allreduce, which is the module's headline claim."""
+        the plain allreduce, which is the module's headline claim; the
+        codec compresses both halves."""
         from theanompi_tpu.obs.comm import pytree_num_elements, zero1_traffic
 
         return zero1_traffic(
-            pytree_num_elements(state.params), self.mesh.devices.size
+            pytree_num_elements(state.params), self.mesh.devices.size,
+            codec=self.codec,
         )
 
     def numerics_model(self, state):

@@ -1,0 +1,121 @@
+"""Codec-coverage lint: every engine routes its exchange through the
+codec layer (or says, in writing, why not).
+
+The compressed-collectives codec (``parallel/codec.py``) only pays off
+if it stays UNIVERSAL — the moment a new engine hand-rolls its own
+exchange without the codec hook, ``--wire-codec`` silently stops
+covering part of the fleet and the comm-bytes win erodes one special
+case at a time (exactly how the original int8 ring became a one-off).
+This lint fails CI when any engine module under ``parallel/`` neither
+references ``parallel.codec`` nor declares an explicit exemption::
+
+    # codec_exempt: <reason the exchange cannot ride the codec>
+
+Scope: an "engine module" is any ``parallel/*.py`` defining a class
+with BOTH ``train_step`` and ``traffic_model`` methods (the driver
+protocol every sync rule implements — bsp/zero/easgd/gosgd/nd today).
+Library modules (mesh, fused, pipeline, strategies, codec itself) are
+out of scope by construction.
+
+Usage::
+
+    python -m theanompi_tpu.tools.check_codec_coverage           # repo
+    python -m theanompi_tpu.tools.check_codec_coverage DIR       # that dir
+
+Exit code 1 on any uncovered engine (CI gate via tools/lint_all.py;
+tests/test_check_codec_coverage.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import Optional
+
+PARALLEL_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "parallel"
+)
+
+# either import spelling counts as routing through the codec layer
+_CODEC_REF = re.compile(
+    r"from\s+theanompi_tpu\.parallel\.codec\s+import"
+    r"|from\s+theanompi_tpu\.parallel\s+import\s+[^\n]*\bcodec\b"
+    r"|theanompi_tpu\.parallel\.codec"
+)
+_EXEMPT = re.compile(r"codec_exempt:[ \t]*(\S[^\n]*)")  # reason required,
+# on the SAME line — a bare marker doesn't count as an exemption
+
+
+def _engine_classes(source: str) -> list:
+    """Names of classes defining BOTH train_step and traffic_model —
+    the driver-protocol engines this lint covers."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        methods = {
+            n.name for n in node.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        if {"train_step", "traffic_model"} <= methods:
+            out.append(node.name)
+    return out
+
+
+def check_file(path: str) -> Optional[str]:
+    """A violation string for ``path``, or None (clean / not an engine
+    module / explicitly exempt)."""
+    with open(path) as f:
+        source = f.read()
+    engines = _engine_classes(source)
+    if not engines:
+        return None
+    if _CODEC_REF.search(source):
+        return None
+    m = _EXEMPT.search(source)
+    if m:
+        return None  # declared exemption, reason on record
+    return (
+        f"{path}: engine class(es) {', '.join(sorted(engines))} neither "
+        "import theanompi_tpu.parallel.codec nor declare a "
+        "'codec_exempt: <reason>' marker — every engine's exchange must "
+        "route through the codec layer (parallel/codec.py) so "
+        "--wire-codec keeps covering the whole fleet"
+    )
+
+
+def check_dir(parallel_dir: str = PARALLEL_DIR) -> list:
+    errs = []
+    for name in sorted(os.listdir(parallel_dir)):
+        if not name.endswith(".py"):
+            continue
+        err = check_file(os.path.join(parallel_dir, name))
+        if err:
+            errs.append(err)
+    return errs
+
+
+def main(argv: Optional[list] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    target = argv[0] if argv else PARALLEL_DIR
+    errs = (
+        [e for e in [check_file(target)] if e] if os.path.isfile(target)
+        else check_dir(target)
+    )
+    for e in errs:
+        print(e)
+    print(
+        f"codec-coverage lint on {os.path.relpath(target)}: "
+        + ("OK" if not errs else f"{len(errs)} uncovered engines")
+    )
+    return 1 if errs else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

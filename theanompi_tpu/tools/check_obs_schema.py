@@ -73,6 +73,21 @@ SCHEMAS: dict[str, dict[str, tuple[tuple, bool]]] = {
         "source": ((str,), False),
         "labels": ((dict,), False),
     },
+    # compressed-collectives wire declaration (obs/comm.py, written by
+    # Observability.set_traffic_model when the engine declares its
+    # traffic model): the sustained per-step bytes a codec run moves
+    # (`wire_bytes`) next to the fp32 equivalent (`raw_bytes`) and the
+    # codec that did it — the per-run compression proof line bench.py
+    # --codec-sweep reads back.
+    "comm": {
+        "t": (_NUM, True),
+        "rule": ((str,), True),
+        "codec": ((str,), True),
+        "n_workers": ((int,), True),
+        "raw_bytes": (_NUM, True),
+        "wire_bytes": (_NUM, True),
+        "compression_ratio": (_NUM, True),
+    },
     "heartbeat": {
         "rank": ((int,), True),
         "t": (_NUM, True),

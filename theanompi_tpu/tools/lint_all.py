@@ -1,4 +1,5 @@
-"""Run every repo lint in one pass: hot-loop + telemetry schemas.
+"""Run every repo lint in one pass: hot-loop + codec coverage +
+telemetry schemas.
 
 One entry point for CI and the tier-1 suite (tests/test_lint_all.py):
 
@@ -6,11 +7,16 @@ One entry point for CI and the tier-1 suite (tests/test_lint_all.py):
    must contain no host-materializing calls — the invariant the async
    dispatch pipeline (and the numerics sentinels that ride it) depend
    on;
-2. **schema lint** (tools/check_obs_schema.py): every telemetry
+2. **codec-coverage lint** (tools/check_codec_coverage.py): every
+   engine module under ``parallel/`` routes its exchange through the
+   codec layer (``parallel/codec.py``) or carries an explicit
+   ``codec_exempt: <reason>`` marker — ``--wire-codec`` must keep
+   covering the whole fleet;
+3. **schema lint** (tools/check_obs_schema.py): every telemetry
    ``*.jsonl`` (plus heartbeat/stall ``.json``) found under the given
    paths — default: the repo tree — must match the documented record
    schemas, including the ``numerics``/``anomaly`` kinds the flight
-   recorder emits.
+   recorder emits and the ``comm`` wire-declaration records.
 
 A tree with no telemetry files passes the schema step vacuously (fresh
 checkouts hold none until a run writes some); a single invalid line
@@ -29,7 +35,11 @@ import os
 import sys
 from typing import Optional
 
-from theanompi_tpu.tools import check_hot_loop, check_obs_schema
+from theanompi_tpu.tools import (
+    check_codec_coverage,
+    check_hot_loop,
+    check_obs_schema,
+)
 
 # never telemetry; test fixtures under tests/ may hold deliberately
 # invalid lines for the schema checker's own tests
@@ -66,7 +76,10 @@ def main(argv: Optional[list] = None) -> int:
     # 1. hot-loop lint on the worker train loops
     rc |= check_hot_loop.main([])
 
-    # 2. schema lint over every telemetry file found
+    # 2. codec-coverage lint over the parallel/ engine modules
+    rc |= check_codec_coverage.main([])
+
+    # 3. schema lint over every telemetry file found
     files = telemetry_files(argv or None)
     if not files:
         print("schema lint: no telemetry files found (OK)")

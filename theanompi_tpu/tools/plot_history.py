@@ -73,7 +73,8 @@ def load_obs(jsonl_path: str) -> dict:
     "comm_gbps": [...], "fractions": {kind: frac}}`` — empty lists/dict
     when the run has no (or unreadable) obs data, so callers degrade
     gracefully."""
-    out: dict = {"comm_step": [], "comm_gbps": [], "fractions": {}}
+    out: dict = {"comm_step": [], "comm_gbps": [], "comm_gbps_raw": [],
+                 "codec": None, "fractions": {}}
     obs_dir = os.path.join(os.path.dirname(os.path.abspath(jsonl_path)), "obs")
     metrics = os.path.join(obs_dir, "metrics.jsonl")
     if os.path.exists(metrics):
@@ -84,9 +85,16 @@ def load_obs(jsonl_path: str) -> dict:
                     if not line:
                         continue
                     row = json.loads(line)
+                    if row.get("kind") == "comm":
+                        # the run's wire declaration (last wins, like
+                        # the span summary): names the codec for the
+                        # legend of the raw-vs-effective pair
+                        out["codec"] = row.get("codec")
+                        continue
                     if row.get("kind") != "metrics" or "step" not in row:
                         continue
                     gbps = row.get("metrics", {}).get("tmpi_comm_gbps")
+                    raw = row.get("metrics", {}).get("tmpi_comm_gbps_raw")
                     if gbps is not None:
                         if out["comm_step"] and row["step"] < out["comm_step"][-1]:
                             # append-mode rerun into the same obs dir:
@@ -94,13 +102,18 @@ def load_obs(jsonl_path: str) -> dict:
                             # newest run's series (mirrors the
                             # last-summary-wins rule below)
                             out["comm_step"], out["comm_gbps"] = [], []
+                            out["comm_gbps_raw"] = []
                         if out["comm_step"] and row["step"] == out["comm_step"][-1]:
                             # epoch-end snapshot repeats the step of the
                             # last per-step snapshot: newest value wins
                             out["comm_gbps"][-1] = gbps
+                            out["comm_gbps_raw"][-1] = raw
                         else:
                             out["comm_step"].append(row["step"])
                             out["comm_gbps"].append(gbps)
+                            # paired with comm_step even when absent
+                            # (codec-off runs): None rows drop at plot
+                            out["comm_gbps_raw"].append(raw)
         except (OSError, ValueError):
             pass  # partial/corrupt telemetry: plot what parses
     # rank 0's trace is the driver view; one bar set per run
@@ -254,8 +267,25 @@ def plot(runs: dict[str, str], out: str, show: bool = False,
         t, v = h["train"], h["val"]
         o = obs[label]
         if ax_comm is not None and o["comm_gbps"]:
-            ax_comm.plot(*smoothed(o["comm_step"], o["comm_gbps"], smooth),
-                         label=label)
+            eff_label = (
+                f"{label} ({o['codec']} wire)"
+                if o.get("codec") and o["codec"] != "none" else label
+            )
+            line, = ax_comm.plot(
+                *smoothed(o["comm_step"], o["comm_gbps"], smooth),
+                label=eff_label,
+            )
+            raw_pairs = [
+                (s, v) for s, v in zip(o["comm_step"], o["comm_gbps_raw"])
+                if v is not None
+            ]
+            if raw_pairs:
+                # effective vs raw: the vertical gap IS the codec win —
+                # dashed raw in the same color so runs stay grouped
+                rs, rv = zip(*raw_pairs)
+                ax_comm.plot(*smoothed(list(rs), list(rv), smooth),
+                             linestyle="--", color=line.get_color(),
+                             alpha=0.6, label=f"{label} raw fp32")
         if ax_frac is not None and o["fractions"]:
             # grouped bars: one cluster per span kind, one bar per run
             width = 0.8 / max(1, len(runs))
@@ -298,7 +328,8 @@ def plot(runs: dict[str, str], out: str, show: bool = False,
     ax_lr.set(title="learning rate", xlabel="step")
     all_axes = [ax_loss, ax_val, ax_ips, ax_lr]
     if ax_comm is not None:
-        ax_comm.set(title="interconnect GB/s (analytic bytes / step time)",
+        ax_comm.set(title="interconnect GB/s (effective solid, raw fp32 "
+                          "dashed — gap = codec win)",
                     xlabel="step")
         ax_frac.set(title="span time fractions (of run wall clock)")
         if frac_kinds:

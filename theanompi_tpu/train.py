@@ -30,12 +30,21 @@ GradSync = Callable[[PyTree], PyTree]  # raw grads -> synced grads
 class TrainState(NamedTuple):
     """The complete training state pytree — the analogue of the
     reference's Theano shared variables (params + vels) plus the step
-    counter that drives the LR schedule."""
+    counter that drives the LR schedule.
+
+    ``ef``: the wire codec's error-feedback residual accumulators
+    (parallel/codec.py) — per-device quantization residuals of the
+    gradient exchange, stacked ``[n_devices, ...]`` and sharded over
+    the exchange axes. ``()`` (the default, zero leaves) whenever the
+    codec carries no state, so codec-off runs pay nothing in state
+    size, donation, or checkpoints; when present it is checkpointed
+    with the rest of the state, making compressed-run resume exact."""
 
     params: PyTree
     model_state: PyTree  # BatchNorm running stats etc.
     opt_state: PyTree
     step: jax.Array  # int32 global step
+    ef: PyTree = ()  # wire-codec error-feedback residuals (or ())
 
 
 def init_train_state(model: Model, key: jax.Array) -> TrainState:
@@ -201,8 +210,15 @@ def make_train_step(
             )
             metrics = jax.tree_util.tree_map(lambda m: jnp.mean(m, axis=0), ms)
 
+        new_ef = state.ef
         if grad_sync is not None:
-            grads = grad_sync(grads)
+            if getattr(grad_sync, "stateful", False):
+                # compressed exchange with error feedback: the strategy
+                # threads the codec residuals through engine state
+                # (parallel/strategies.py::codec_psum_mean)
+                grads, new_ef = grad_sync(grads, state.ef)
+            else:
+                grads = grad_sync(grads)
 
         lr = schedule_lr(state.step)
         updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params, lr)
@@ -214,7 +230,8 @@ def make_train_step(
 
             metrics = {**metrics,
                        **sentinel_metrics(grads, updates, new_params)}
-        new_state = TrainState(new_params, new_model_state, new_opt_state, state.step + 1)
+        new_state = TrainState(new_params, new_model_state, new_opt_state,
+                               state.step + 1, new_ef)
         return new_state, metrics
 
     return train_step
