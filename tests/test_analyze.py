@@ -1,0 +1,316 @@
+"""SPMD safety analyzer (tools/analyze/, ISSUE 7): mutation
+self-tests — one seeded defect per rule family, each caught by its
+rule ID — plus the clean-tree zero-findings gate and the golden
+signature inventory.
+
+The defects seeded here are the exact classes the analyzer exists for:
+a collective that only one side of a rank-divergent branch posts (the
+deadlock class), a traffic model that drifts from the traced program,
+an engine claiming donation it doesn't perform, and host code deciding
+resume agreement from an unsorted directory listing (the PR 4 rollback
+bug class).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from theanompi_tpu.tools.analyze import harness
+from theanompi_tpu.tools.analyze.astlint import (
+    donation_findings,
+    rank_divergence_findings,
+)
+from theanompi_tpu.tools.analyze.golden import (
+    compare_golden,
+    golden_path,
+    load_golden,
+    signature_payload,
+)
+from theanompi_tpu.tools.analyze.rules import (
+    analyze_engines,
+    axis_findings,
+    donation_findings_for,
+    traffic_findings,
+)
+from theanompi_tpu.tools.analyze.signature import (
+    donated_flags,
+    extract_signature,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh2(devices):
+    return Mesh(np.array(devices[:2]), ("data",))
+
+
+@pytest.fixture(scope="module")
+def mesh22(devices):
+    return Mesh(np.array(devices[:4]).reshape(2, 2), ("data", "aux"))
+
+
+# --------------------------------------------------------------------------
+# rule family 1: collective safety (SPMD001 / SPMD002)
+# --------------------------------------------------------------------------
+
+
+def test_mismatched_psum_axis_in_cond_branch_caught(mesh22):
+    """Seeded defect: a cond whose predicate is derived from SHARDED
+    data (each rank can see a different value) with a psum on one
+    branch only — and over a different axis than the other branch's
+    collective. The uniformity analysis must flag it as SPMD002's
+    cond-mismatch (the deadlock class)."""
+
+    def inner(flag, x):
+        return lax.cond(
+            flag[0] > 0,
+            lambda: lax.psum(x, "data"),
+            lambda: lax.psum(x, "aux") * 0.5,
+        )
+
+    def f(flag, x):
+        return jax.shard_map(
+            inner, mesh=mesh22, in_specs=(P("data"), P()), out_specs=P(),
+            check_vma=False,
+        )(flag, x)
+
+    jaxpr = jax.make_jaxpr(f)(
+        jax.ShapeDtypeStruct((2,), jnp.int32),
+        jax.ShapeDtypeStruct((8,), jnp.float32),
+    )
+    sig, _ = extract_signature(jaxpr)
+    kinds = [i.kind for i in sig.issues]
+    assert "cond-mismatch" in kinds, kinds
+
+
+def test_uniform_predicate_cond_is_not_flagged(mesh22):
+    """Control: the same asymmetric cond under a REPLICATED predicate
+    is safe (every rank takes the same branch) and must not fire."""
+
+    def inner(flag, x):
+        return lax.cond(
+            flag[0] > 0,
+            lambda: lax.psum(x, "data"),
+            lambda: x,
+        )
+
+    def f(flag, x):
+        return jax.shard_map(
+            inner, mesh=mesh22, in_specs=(P(), P()), out_specs=P(),
+            check_vma=False,
+        )(flag, x)
+
+    jaxpr = jax.make_jaxpr(f)(
+        jax.ShapeDtypeStruct((2,), jnp.int32),
+        jax.ShapeDtypeStruct((8,), jnp.float32),
+    )
+    sig, _ = extract_signature(jaxpr)
+    assert sig.issues == []
+    assert [c.prim for c in sig.collectives] == ["psum"]
+
+
+def test_varying_trip_count_while_with_collective_caught(mesh2):
+    """A while-loop whose trip count each rank decides from its own
+    shard, with a psum in the body: ranks disagree on iteration count
+    and deadlock mid-loop (SPMD002 while-collective)."""
+
+    def inner(x):
+        def cond(c):
+            i, acc = c
+            return i < jnp.sum(x).astype(jnp.int32)
+
+        def body(c):
+            i, acc = c
+            return i + 1, acc + lax.psum(acc, "data")
+
+        return lax.while_loop(cond, body, (0, x))[1]
+
+    def f(x):
+        return jax.shard_map(inner, mesh=mesh2, in_specs=(P("data"),),
+                             out_specs=P("data"), check_vma=False)(x)
+
+    jaxpr = jax.make_jaxpr(f)(jax.ShapeDtypeStruct((8,), jnp.float32))
+    sig, _ = extract_signature(jaxpr)
+    assert any(i.kind == "while-collective" for i in sig.issues)
+
+
+def test_unbound_axis_becomes_spmd001():
+    """A collective naming an axis no mesh binds fails at trace time;
+    the harness converts that into an SPMD001 finding instead of
+    crashing the lint."""
+    trace = harness.EngineTrace(engine="bsp", codec="none",
+                                error="NameError: unbound axis 'ghost'",
+                                module_file="parallel/bsp.py")
+    found = axis_findings(trace)
+    assert [f.rule for f in found] == ["SPMD001"]
+    assert "ghost" in found[0].message
+
+
+# --------------------------------------------------------------------------
+# rule family 2: traffic-model cross-check (SPMD101)
+# --------------------------------------------------------------------------
+
+
+def test_traffic_model_byte_drift_caught():
+    """Seeded defect: an engine whose declared traffic_model() reports
+    2x the wire the traced program moves — the gauge-drift class."""
+    import dataclasses
+
+    trace = harness.trace_engine("bsp", "none")
+    assert trace.error is None
+    drifted = dataclasses.replace(
+        trace.traffic,
+        raw_bytes_per_step=trace.traffic.raw_bytes_per_step * 2.0,
+    )
+    found = traffic_findings(trace, declared=drifted)
+    assert [f.rule for f in found] == ["SPMD101"]
+    # ... and the honest model passes
+    assert traffic_findings(trace) == []
+
+
+# --------------------------------------------------------------------------
+# rule family 3: donation audit (SPMD201)
+# --------------------------------------------------------------------------
+
+
+def test_missing_donation_caught(mesh2):
+    """Seeded defect: a BSP step built with donate=False behind an
+    engine that still declares donates_state=True."""
+    from theanompi_tpu.parallel.bsp import make_bsp_train_step
+
+    model = harness._tiny_model()
+    step = make_bsp_train_step(model, mesh2, donate=False)
+    from theanompi_tpu.train import init_train_state
+
+    rng = jax.random.PRNGKey(0)
+    state = jax.eval_shape(lambda k: init_train_state(model, k), rng)
+    n_state = len(jax.tree_util.tree_leaves(state))
+    jaxpr = jax.make_jaxpr(step)(
+        state, jax.ShapeDtypeStruct((16, 8, 8, 3), jnp.float32),
+        jax.ShapeDtypeStruct((16,), jnp.int32), rng,
+    )
+    sig, axis_sizes = extract_signature(jaxpr)
+    part = harness.TracePart(
+        name="step", signature=sig, axis_sizes=axis_sizes,
+        donated=donated_flags(jaxpr, n_state),
+    )
+    bad = harness.EngineTrace(engine="bsp", codec="none", parts=[part],
+                              declared_donates=True,
+                              module_file="parallel/bsp.py")
+    found = donation_findings_for(bad)
+    assert [f.rule for f in found] == ["SPMD201"]
+
+
+def test_real_engines_do_donate():
+    for name in harness.ENGINE_NAMES:
+        trace = harness.trace_engine(name, "none")
+        assert trace.error is None, trace.error
+        assert donation_findings_for(trace) == [], name
+
+
+# --------------------------------------------------------------------------
+# rule family 4: rank-divergence lint (SPMD301/302) + donation alias
+# (SPMD202)
+# --------------------------------------------------------------------------
+
+_RESUME_AGREEMENT_BAD = '''
+import os
+def resolve_resume(d, engine, state):
+    names = os.listdir(d)          # unsorted: NFS order differs per host
+    newest = names[-1]
+    if newest:
+        steps = multihost_utils.process_allgather(parse_step(newest))
+    return steps
+'''
+
+
+def test_unsorted_listdir_feeding_resume_agreement_caught():
+    found = rank_divergence_findings("snippet.py", _RESUME_AGREEMENT_BAD)
+    rules = {f.rule for f in found}
+    assert "SPMD302" in rules  # the unsorted listing itself
+    assert "SPMD301" in rules  # its value gating the agreement collective
+    spmd301 = [f for f in found if f.rule == "SPMD301"][0]
+    assert "process_allgather" in spmd301.message
+
+
+def test_sorted_listing_and_uniform_gate_pass():
+    clean = '''
+import os
+def resolve_resume(d, state):
+    names = sorted(os.listdir(d))
+    if state.step > 0:
+        steps = multihost_utils.process_allgather(state.step)
+    return names
+'''
+    assert rank_divergence_findings("snippet.py", clean) == []
+
+
+def test_use_after_donation_alias_caught():
+    bad = '''
+import numpy as np
+def loop(engine, state, batch, rng):
+    snap = np.asarray(state.params)   # zero-copy view of donated buffers
+    state, metrics = engine.train_step(state, batch, batch, rng)
+    return snap
+'''
+    found = donation_findings("snippet.py", bad)
+    assert [f.rule for f in found] == ["SPMD202"]
+    # np.array (a copy) is the sanctioned snapshot and must pass
+    ok = bad.replace("np.asarray", "np.array")
+    assert donation_findings("snippet.py", ok) == []
+
+
+def test_scanned_tree_sources_are_clean():
+    from theanompi_tpu.tools.analyze.astlint import run_ast_lints
+
+    assert run_ast_lints() == []
+
+
+# --------------------------------------------------------------------------
+# goldens + suppressions + the clean-tree gate
+# --------------------------------------------------------------------------
+
+
+def test_golden_signatures_exist_for_every_engine_and_codec():
+    import os
+
+    for name in harness.ENGINE_NAMES:
+        for codec in harness.CODEC_SPECS:
+            assert os.path.exists(golden_path(name, codec)), (name, codec)
+
+
+def test_golden_drift_is_caught():
+    trace = harness.trace_engine("gosgd", "none")
+    gold = load_golden("gosgd", "none")
+    assert compare_golden(trace, gold) == []
+    # tamper: drop the gossip ppermute from the snapshot
+    tampered = signature_payload(trace)
+    tampered["parts"]["step"] = [
+        c for c in tampered["parts"]["step"] if c["prim"] != "ppermute"
+    ]
+    assert compare_golden(trace, tampered) != []
+
+
+def test_spmd_exempt_needs_a_reason(tmp_path):
+    from theanompi_tpu.tools.lint import _exemption_reason
+
+    f = tmp_path / "x.py"
+    f.write_text(
+        "a = 1  # spmd_exempt: ordering provably irrelevant here\n"
+        "b = 2  # spmd_exempt:\n"
+        "c = 3\n"
+    )
+    assert _exemption_reason(str(f), 1) == "ordering provably irrelevant here"
+    assert _exemption_reason(str(f), 2) is None  # bare marker: no waiver
+    assert _exemption_reason(str(f), 3) is None
+
+
+def test_clean_tree_has_zero_findings():
+    """The acceptance gate: the committed tree analyzes clean — every
+    engine's signature matches its golden, traffic models agree with
+    the traces, donation claims hold, and the host sources carry no
+    unexempted divergence."""
+    assert analyze_engines(update_golden=False) == []

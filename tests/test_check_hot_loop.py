@@ -6,7 +6,9 @@ dispatch pipeline removed."""
 import pytest
 
 from theanompi_tpu.tools.check_hot_loop import (
+    SERVE_PATH,
     WORKER_PATH,
+    check_serve_source,
     check_source,
     main as lint_main,
     train_loop_segments,
@@ -72,3 +74,64 @@ def test_cli_gate_fails_on_violation(tmp_path, capsys):
     bad.write_text(_BAD)
     assert lint_main([str(bad)]) == 1
     assert "forbidden host sync" in capsys.readouterr().out
+
+
+# -- serve hot path (ISSUE 7 satellite) -------------------------------------
+
+_SERVE_BAD = '''
+class Engine:
+    def _loop(self):
+        while True:
+            reqs = [self._q.popleft() for _ in range(4)]
+            depth = float(self._g_queue.value)  # sync in the dequeue loop
+            self._serve_batch(reqs)
+
+    def _serve_batch(self, reqs):
+        import numpy as np
+        logits = np.asarray(self._fwd(self.params, reqs))  # sanctioned
+        for r in reqs:
+            r.resolve(np.asarray(r.view))  # per-request materialization
+            s = r.score.item()
+'''
+
+_SERVE_CLEAN = '''
+class Engine:
+    def _loop(self):
+        while True:
+            reqs = [self._q.popleft() for _ in range(4)]
+            self._serve_batch(reqs)
+
+    def _serve_batch(self, reqs):
+        import numpy as np
+        logits = np.asarray(self._fwd(self.params, reqs))  # ONE per batch
+        for i, r in enumerate(reqs):
+            r.resolve(logits[i])
+'''
+
+
+def test_live_serve_source_is_clean():
+    with open(SERVE_PATH) as f:
+        assert check_serve_source(f.read()) == []
+
+
+def test_serve_per_request_sync_detected():
+    errs = check_serve_source(_SERVE_BAD)
+    assert len(errs) == 3
+    assert any("dequeue loop" in e and "float(" in e for e in errs)
+    assert any("per-request loop" in e and "np.asarray(" in e for e in errs)
+    assert any(".item(" in e for e in errs)
+
+
+def test_serve_single_batch_fetch_is_sanctioned():
+    assert check_serve_source(_SERVE_CLEAN) == []
+
+
+def test_serve_anchor_guard():
+    with pytest.raises(ValueError, match="anchors"):
+        check_serve_source("class Engine:\n    def _loop(self):\n        pass\n")
+
+
+def test_default_cli_covers_worker_and_serve(capsys):
+    assert lint_main([]) == 0
+    out = capsys.readouterr().out
+    assert "worker.py" in out and "engine.py" in out

@@ -1,9 +1,13 @@
-"""tools/lint_all.py: the one-command CI lint (hot-loop + telemetry
-schemas) — wired as a tier-1 test so the tree can never merge with a
-train-loop host sync or a schema-drifting telemetry emitter."""
+"""tools/lint_all.py + tools/lint.py: the one-command CI lint
+(hot-loop + serve hot path + codec coverage + telemetry schemas + the
+SPMD safety analyzer) — wired as a tier-1 test so the tree can never
+merge with a train-loop host sync, a schema-drifting telemetry
+emitter, or a collective-schedule change nobody reviewed."""
 
 import json
+import time
 
+from theanompi_tpu.tools.lint import RULES, main as lint_main, run_lint
 from theanompi_tpu.tools.lint_all import main, telemetry_files
 
 
@@ -11,6 +15,28 @@ def test_lint_all_passes_on_the_tree():
     """The committed tree must be lint-clean: worker train loops free of
     host syncs, every committed telemetry JSONL schema-valid."""
     assert main([]) == 0
+
+
+def test_full_lint_includes_analyzer_and_stays_in_budget():
+    """`tmpi lint` runs the SPMD analyzer (golden signatures, traffic
+    cross-check, donation audit, AST lints) and the whole pass stays
+    tier-1-runnable: well under the 60 s CPU budget (the analyzer only
+    TRACES — nothing compiles)."""
+    t0 = time.monotonic()
+    report = run_lint()
+    elapsed = time.monotonic() - t0
+    assert report.ok, [f.as_json() for f in report.findings]
+    assert elapsed < 60.0, f"tmpi lint took {elapsed:.1f}s"
+
+
+def test_lint_json_report_shape(capsys):
+    assert lint_main(["--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["ok"] is True
+    assert out["counts"]["findings"] == 0
+    # stable rule IDs ship with the report so CI can key on them
+    assert "SPMD002" in out["rules"] and "HOT002" in out["rules"]
+    assert set(out["rules"]) == set(RULES)
 
 
 def test_telemetry_discovery_skips_caches(tmp_path):

@@ -16,6 +16,13 @@ Usage::
 training run's checkpoints with dynamic micro-batching and hot-reload::
 
     tmpi serve --ckpt-dir runs/ck --model cifar10 --watch --port 8300
+
+``tmpi lint`` runs every repo lint plus the SPMD safety analyzer
+(tools/lint.py): collective-signature verification against goldens,
+traffic-model cross-checks, donation audit, rank-divergence lint::
+
+    tmpi lint --json            # CI report with stable rule IDs
+    tmpi lint --update-golden   # accept a reviewed signature change
 """
 
 from __future__ import annotations
@@ -268,6 +275,14 @@ def main(argv=None) -> int:
     import os
 
     argv = list(argv) if argv is not None else sys.argv[1:]
+    if argv[:1] == ["lint"]:
+        # static analysis subcommand (tools/lint.py); it sets up its own
+        # multi-device virtual CPU platform before tracing, so every
+        # entry point (tmpi lint, python -m, the lint_all alias) works
+        # on a bare environment
+        from theanompi_tpu.tools.lint import main as lint_main
+
+        return lint_main(argv[1:])
     if argv[:1] == ["serve"]:
         # inference subcommand: its own parser + driver (serve/cli.py);
         # dispatched before the training parser, whose first positional

@@ -84,6 +84,13 @@ On-disk layout under ``obs_dir`` (schemas:
                             own anomaly_rank{r}-stall/ bundle, so a
                             benign stall never consumes the anomaly's
                             forensic budget
+
+Every file above is schema-linted by ``tmpi lint`` (tools/lint.py),
+whose ``--json`` report carries one SCHEMA001 finding per invalid
+record — the same pass that statically cross-checks the declared
+``kind=comm`` wire models against each engine's traced collective
+schedule (rules SPMD101/SPMD102), so the telemetry this layout
+promises cannot silently drift from the programs that emit it.
 """
 
 from __future__ import annotations

@@ -39,6 +39,13 @@ ring variants pad N up to a segment multiple (accounted), and the ND
 engine's activation collectives (tp psum, sp ring/all-to-all, pp
 ppermute, MoE all-to-all) are NOT modeled — its figure covers the
 dp-axis grad sync only and is marked ``approx``.
+
+**Statically cross-checked** (ISSUE 7): the SPMD analyzer
+(tools/analyze/) sums wire bytes from each engine's traced jaxpr and
+fails ``tmpi lint`` if these closed forms drift from the program —
+raw bytes within tolerance (SPMD101) and, codec-on, the claimed
+``compression_ratio`` realized in-graph (SPMD102). Edit a formula here
+or an exchange in ``parallel/`` and the other side must follow.
 """
 
 from __future__ import annotations

@@ -247,7 +247,14 @@ def make_bsp_fused_step(
 
 class BSPEngine:
     """Rule-engine wrapper over the BSP step (uniform driver protocol
-    shared with EASGDEngine/GOSGDEngine)."""
+    shared with EASGDEngine/GOSGDEngine).
+
+    Collective schedule pinned by the SPMD analyzer (ISSUE 7): the
+    in-step grad psum + metrics pmean signature is golden-snapshotted
+    (tools/analyze/golden/bsp_*.json) and ``traffic_model()`` is
+    cross-checked against the traced wire bytes — changing the
+    exchange or the analytic model alone fails ``tmpi lint``
+    (SPMD003/SPMD101); regenerate with ``tmpi lint --update-golden``."""
 
     name = "bsp"
     exchange_every = 0  # the allreduce is inside every step

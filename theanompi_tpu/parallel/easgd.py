@@ -78,7 +78,11 @@ class EASGDEngine:
     name = "easgd"
     # donation audit (ISSUE 2): local steps and the elastic exchange
     # both donate the stacked worker state, so async in-flight steps
-    # reuse buffers instead of doubling HBM
+    # reuse buffers instead of doubling HBM. Verified statically by the
+    # SPMD analyzer (ISSUE 7, rule SPMD201); the silent-local-step +
+    # every-avg_freq elastic-psum schedule is pinned by
+    # tools/analyze/golden/easgd_*.json — both the step AND exchange
+    # traces, amortized, must match traffic_model() (SPMD101).
     donates_state = True
 
     def __init__(

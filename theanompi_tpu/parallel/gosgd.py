@@ -85,7 +85,12 @@ class GOSGDEngine:
 
     name = "gosgd"
     # donation audit (ISSUE 2): the gossip step donates its stacked
-    # per-worker state — in-flight async dispatches reuse buffers
+    # per-worker state — in-flight async dispatches reuse buffers.
+    # Verified statically (ISSUE 7, SPMD201). The one-ppermute-per-round
+    # gossip schedule is pinned by tools/analyze/golden/gosgd_*.json;
+    # note the int8 gossip payload is PHYSICAL compression (the packed
+    # int8 message is the ppermute operand), which the analyzer prices
+    # by dtype, vs the value-space codec psums priced analytically.
     donates_state = True
 
     def __init__(

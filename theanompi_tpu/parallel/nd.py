@@ -89,7 +89,11 @@ class NDEngine:
 
     name = "nd"
     exchange_every = 0
-    donates_state = True  # overridden per-instance from the donate flag
+    # overridden per-instance from the donate flag; the SPMD analyzer
+    # (ISSUE 7) verifies whatever is declared against the lowered step's
+    # donated_invars (SPMD201) and pins the per-leaf dp-axis psum
+    # schedule in tools/analyze/golden/nd_*.json
+    donates_state = True
 
     def __init__(
         self,

@@ -1,0 +1,47 @@
+"""SPMD safety analyzer: static verification of every engine's
+collective schedule (ISSUE 7).
+
+Theano-MPI's canonical failure mode was the mismatched collective —
+one worker enters an exchange its peers never post, and the whole gang
+deadlocks (reference: every ``Exch_*`` strategy assumed all ranks call
+``exchange()`` on the same iteration; SURVEY.md §3). The TPU rebuild
+inherits the same class through SPMD: a collective under rank-divergent
+control flow, a donated buffer read after the step consumed it, or
+host code whose decisions depend on rank-divergent inputs (NFS listing
+order, wall clock) feeding a cross-rank agreement. PR 4 shipped exactly
+one of these for real — the rollback path needed a checkpoint-step
+allgather because different hosts resolved different "newest"
+checkpoints.
+
+This package finds that class BEFORE it runs, by abstract
+interpretation rather than execution:
+
+- :mod:`~theanompi_tpu.tools.analyze.signature` traces each engine's
+  train step with ``jax.make_jaxpr`` (tiny model, 2-device CPU mesh —
+  nothing is compiled or executed) and walks the equations into an
+  ordered **collective signature**: (primitive, axis names, dtype,
+  shape, static trip count) per collective, plus a replicated-vs-
+  varying dataflow analysis that flags collectives under control flow
+  whose predicate can differ across ranks;
+- :mod:`~theanompi_tpu.tools.analyze.harness` owns the tiny engine
+  builds (all five rules: BSP, ZeRO-1, EASGD, GoSGD, ND — codec off
+  and ``int8:ef``);
+- :mod:`~theanompi_tpu.tools.analyze.rules` runs the four rule
+  families over the traces (collective safety, traffic-model
+  cross-check, donation audit, golden-signature drift);
+- :mod:`~theanompi_tpu.tools.analyze.astlint` is the host-side half:
+  rank-divergence taint lint and the use-after-donation alias lint
+  over the launch/checkpoint sources;
+- :mod:`~theanompi_tpu.tools.analyze.golden` stores the per-engine
+  signature snapshots (``tmpi lint --update-golden`` regenerates).
+
+Everything surfaces through ``tmpi lint`` (tools/lint.py) with stable
+rule IDs and per-line ``spmd_exempt: <reason>`` suppressions; rule
+catalog in :data:`theanompi_tpu.tools.lint.RULES`.
+"""
+
+from theanompi_tpu.tools.analyze.rules import Finding, analyze_engines  # noqa: F401
+from theanompi_tpu.tools.analyze.astlint import (  # noqa: F401
+    donation_findings,
+    rank_divergence_findings,
+)

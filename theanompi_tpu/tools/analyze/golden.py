@@ -1,0 +1,83 @@
+"""Golden collective-signature snapshots.
+
+One JSON file per (engine, codec) pair under
+``theanompi_tpu/tools/analyze/golden/`` pins the exact ordered
+collective schedule the engine's traced step posts — primitive, axis
+names, operand dtype/shape, static trip count, per traced part
+(``step``; EASGD adds ``exchange``). Any change to an engine's
+collective schedule — a new psum, a reordered exchange, a dtype change
+on the wire — fails ``tmpi lint`` (rule SPMD003) until the author
+regenerates the snapshot with ``tmpi lint --update-golden`` and the
+diff is reviewed as a deliberate wire-protocol change.
+
+The snapshots are traced on the harness's fixed tiny-model 2-device
+configuration, so shapes are stable; they pin the SCHEDULE, not the
+model.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+GOLDEN_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "golden")
+
+
+def golden_path(engine: str, codec: str) -> str:
+    tag = codec.replace(":", "_")
+    return os.path.join(GOLDEN_DIR, f"{engine}_{tag}.json")
+
+
+def signature_payload(trace) -> dict:
+    """Serializable snapshot of an EngineTrace's collective schedule."""
+    return {
+        "engine": trace.engine,
+        "codec": trace.codec,
+        "parts": {
+            p.name: p.signature.as_json() for p in trace.parts
+        },
+    }
+
+
+def load_golden(engine: str, codec: str) -> Optional[dict]:
+    path = golden_path(engine, codec)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def write_golden(trace) -> str:
+    os.makedirs(GOLDEN_DIR, exist_ok=True)
+    path = golden_path(trace.engine, trace.codec)
+    with open(path, "w") as f:
+        json.dump(signature_payload(trace), f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def compare_golden(trace, golden: dict) -> list:
+    """Human-readable mismatch strings ([] = signatures identical)."""
+    current = signature_payload(trace)
+    errs = []
+    cur_parts, gold_parts = current["parts"], golden.get("parts", {})
+    for name in sorted(set(cur_parts) | set(gold_parts)):
+        cur = cur_parts.get(name)
+        gold = gold_parts.get(name)
+        if cur is None or gold is None:
+            errs.append(f"part {name!r} {'appeared' if gold is None else 'disappeared'}")
+            continue
+        if cur == gold:
+            continue
+        if len(cur) != len(gold):
+            errs.append(
+                f"part {name!r}: {len(gold)} collectives in golden, "
+                f"{len(cur)} traced"
+            )
+        for i, (c, g) in enumerate(zip(cur, gold)):
+            if c != g:
+                errs.append(f"part {name!r} collective #{i}: golden {g} "
+                            f"!= traced {c}")
+    return errs

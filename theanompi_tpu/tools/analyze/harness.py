@@ -1,0 +1,230 @@
+"""Engine build + abstract-trace harness for the SPMD analyzer.
+
+Every engine is built against a TINY model on a 2-device mesh and
+traced with ``jax.make_jaxpr`` over ``ShapeDtypeStruct`` operands —
+nothing is compiled or executed, so the full 5-engine × 2-codec sweep
+takes a few seconds on CPU (the ``tmpi lint`` budget is 60 s).
+
+The harness needs >= 2 devices to exist (a 1-device mesh has no
+collectives to verify). Under pytest that's the conftest's 8-way
+virtual CPU platform; the ``tmpi lint`` CLI sets
+``--xla_force_host_platform_device_count`` itself before jax
+initializes (see tools/lint.py).
+
+Traces are memoized per process: the analyzed tree cannot change
+mid-run, and the lint entrypoints are called repeatedly by the test
+suite.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+from theanompi_tpu.tools.analyze.signature import (
+    Signature,
+    extract_signature,
+    donated_flags,
+)
+
+# the analyzed engine configurations: every driver rule, codec off and
+# the error-feedback int8 codec (the convergence-safe compressed
+# default) — golden signatures exist for each pair
+ENGINE_NAMES = ("bsp", "zero1", "easgd", "gosgd", "nd")
+CODEC_SPECS = ("none", "int8:ef")
+EASGD_AVG_FREQ = 4  # harness exchange cadence (amortization weight)
+
+
+@dataclass
+class TracePart:
+    """One traced program of an engine (train step; EASGD adds the
+    elastic exchange) with its amortization weight — the fraction of
+    training steps on which it runs."""
+
+    name: str
+    signature: Signature
+    axis_sizes: dict
+    weight: float = 1.0
+    donated: tuple = ()  # donated_invars over the state arg's leaves
+
+
+@dataclass
+class EngineTrace:
+    engine: str
+    codec: str
+    parts: list = field(default_factory=list)
+    traffic: Any = None  # obs.comm.TrafficModel (declared wire model)
+    declared_donates: bool = False
+    module_file: str = ""
+    error: Optional[str] = None  # trace failure (e.g. unbound axis)
+
+
+def _tiny_model():
+    """Smallest contract model with a multi-leaf param pytree big
+    enough (~6.5k elements) that the int8 codec's 128-block padding is
+    noise relative to the traffic tolerances."""
+    from theanompi_tpu import nn
+    from theanompi_tpu.models.contract import Model, Recipe
+
+    class _AnalyzeTinyMLP(Model):
+        name = "analyze-tiny"
+
+        @classmethod
+        def default_recipe(cls):
+            return Recipe(batch_size=8, input_shape=(8, 8, 3),
+                          num_classes=10, optimizer="momentum",
+                          dataset="synthetic")
+
+        def build(self):
+            return nn.Sequential(
+                [nn.Flatten(), nn.Dense(32, name="h"),
+                 nn.Activation("relu"),
+                 nn.Dense(self.recipe.num_classes, name="out")],
+                name="analyze_tiny_mlp",
+            )
+
+    return _AnalyzeTinyMLP()
+
+
+def _tiny_lm():
+    from theanompi_tpu.models.lm import TransformerLMModel
+
+    recipe = TransformerLMModel.default_recipe().replace(
+        batch_size=8, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        input_shape=(16,), num_classes=32,
+    )
+    return TransformerLMModel(recipe)
+
+
+def _mesh2():
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        raise RuntimeError(
+            "SPMD analyzer needs >= 2 devices to trace collectives; "
+            "run under the test conftest (8-way virtual CPU) or let "
+            "`tmpi lint` set --xla_force_host_platform_device_count"
+        )
+    return Mesh(np.array(devs[:2]), ("data",))
+
+
+def _abstract_state(engine, rng):
+    import jax
+
+    return jax.eval_shape(engine.init_state, rng)
+
+
+def _trace(fn, *args) -> tuple:
+    """make_jaxpr over abstract args -> (Signature, axis_sizes,
+    donated_flags, jaxpr)."""
+    import jax
+
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    sig, axis_sizes = extract_signature(jaxpr)
+    return sig, axis_sizes, jaxpr
+
+
+def _build_one(name: str, codec: str) -> EngineTrace:
+    import inspect
+
+    import jax
+    import jax.numpy as jnp
+
+    sds = jax.ShapeDtypeStruct
+    wire_codec = None if codec == "none" else codec
+    out = EngineTrace(engine=name, codec=codec)
+    try:
+        # inside the try: a device/mesh setup failure must surface as a
+        # per-engine finding (SPMD001), not crash the whole lint
+        rng = jax.random.PRNGKey(0)
+        mesh = _mesh2()
+        if name == "bsp":
+            from theanompi_tpu.parallel.bsp import BSPEngine
+
+            model = _tiny_model()
+            eng = BSPEngine(model, mesh, wire_codec=wire_codec)
+            state = _abstract_state(eng, rng)
+            x = sds((16, 8, 8, 3), jnp.float32)
+            y = sds((16,), jnp.int32)
+            step_parts = [("step", eng._steps[False], (state, x, y, rng), 1.0)]
+        elif name == "zero1":
+            from theanompi_tpu.parallel.zero import ZeroEngine
+
+            model = _tiny_model()
+            eng = ZeroEngine(model, mesh, wire_codec=wire_codec)
+            state = _abstract_state(eng, rng)
+            x = sds((16, 8, 8, 3), jnp.float32)
+            y = sds((16,), jnp.int32)
+            step_parts = [("step", eng._steps[False], (state, x, y, rng), 1.0)]
+        elif name == "easgd":
+            from theanompi_tpu.parallel.easgd import EASGDEngine
+
+            model = _tiny_model()
+            eng = EASGDEngine(model, mesh, avg_freq=EASGD_AVG_FREQ,
+                              wire_codec=wire_codec)
+            state = _abstract_state(eng, rng)
+            x = sds((16, 8, 8, 3), jnp.float32)
+            y = sds((16,), jnp.int32)
+            step_parts = [
+                ("step", eng._steps[False], (state, x, y, rng), 1.0),
+                ("exchange", eng._exchange, (state,),
+                 1.0 / EASGD_AVG_FREQ),
+            ]
+        elif name == "gosgd":
+            from theanompi_tpu.parallel.gosgd import GOSGDEngine
+
+            model = _tiny_model()
+            eng = GOSGDEngine(model, mesh, wire_codec=wire_codec)
+            state = _abstract_state(eng, rng)
+            x = sds((16, 8, 8, 3), jnp.float32)
+            y = sds((16,), jnp.int32)
+            # the with-gossip step variant: gossip_every=1, so the
+            # ppermute rides EVERY step (weight 1 == its exchange_every)
+            step_parts = [("step", eng._steps[(True, False)],
+                           (state, x, y, rng), 1.0)]
+        elif name == "nd":
+            from theanompi_tpu.parallel.nd import NDEngine
+
+            model = _tiny_lm()
+            eng = NDEngine(model, mesh, dp_axis="data",
+                           wire_codec=wire_codec)
+            state = _abstract_state(eng, rng)
+            tok = sds((16, 16), jnp.int32)
+            step_parts = [("step", eng._steps[False], (state, tok, rng), 1.0)]
+        else:
+            raise ValueError(f"unknown engine {name!r}")
+
+        out.declared_donates = bool(getattr(eng, "donates_state", False))
+        out.module_file = inspect.getsourcefile(type(eng)) or ""
+        out.traffic = eng.traffic_model(state)
+        n_state = len(jax.tree_util.tree_leaves(state))
+        for part_name, fn, args, weight in step_parts:
+            sig, axis_sizes, jaxpr = _trace(fn, *args)
+            out.parts.append(TracePart(
+                name=part_name, signature=sig, axis_sizes=axis_sizes,
+                weight=weight,
+                donated=donated_flags(jaxpr, n_state),
+            ))
+    except Exception as e:  # noqa: BLE001 — surfaced as a finding
+        out.error = f"{type(e).__name__}: {e}"
+    return out
+
+
+_TRACE_CACHE: dict = {}
+
+
+def trace_engine(name: str, codec: str) -> EngineTrace:
+    key = (name, codec)
+    if key not in _TRACE_CACHE:
+        _TRACE_CACHE[key] = _build_one(name, codec)
+    return _TRACE_CACHE[key]
+
+
+def trace_all() -> dict:
+    """{(engine, codec): EngineTrace} for the full analyzed matrix."""
+    return {(n, c): trace_engine(n, c)
+            for n in ENGINE_NAMES for c in CODEC_SPECS}

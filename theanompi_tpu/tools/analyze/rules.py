@@ -1,0 +1,268 @@
+"""The four jaxpr-level rule families over the engine traces.
+
+Rule IDs (full catalog incl. AST/host rules: tools/lint.py RULES):
+
+- **SPMD001 collective safety / axis existence** — an engine whose
+  step cannot even be traced because a collective names an axis the
+  mesh does not bind (jax raises at trace time; the analyzer converts
+  the failure into a finding instead of crashing the lint).
+- **SPMD002 divergent control flow** — a collective under a ``cond``
+  whose predicate may differ across ranks with branch collective
+  sequences that differ, or under a ``while`` whose trip count
+  depends on rank-varying data (signature.py's uniformity analysis).
+  The deadlock class.
+- **SPMD003 golden-signature drift** — the traced ordered collective
+  schedule differs from the reviewed snapshot (golden.py).
+- **SPMD101 traffic-model drift** — wire bytes summed from the traced
+  (codec-off) jaxpr disagree with the engine's declared
+  ``traffic_model()`` raw bytes beyond tolerance.
+- **SPMD102 codec realization** — the ``int8:ef`` trace shows no
+  quantization evidence, or the compression ratio implied by the
+  traces disagrees with the declared ``compression_ratio`` beyond
+  tolerance — the ``tmpi_comm_*`` gauges would be advertising a win
+  the program doesn't implement.
+- **SPMD201 donation audit** — an engine declaring
+  ``donates_state=True`` whose lowered step does not actually donate
+  the state buffers (HBM doubles silently under the async pipeline).
+
+Tolerances: the traced totals include the engines' scalar metric
+pmeans (loss/error), which the analytic models deliberately exclude,
+and the int8 codec's declared model pads to 128-element blocks the
+value-space trace doesn't reshape — both are sub-percent on the
+harness model, so the tolerances below are drift detectors (2x-wrong
+formulas, forgotten amortization, dead codecs), not byte-exact
+assertions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from theanompi_tpu.tools.analyze import harness
+from theanompi_tpu.tools.analyze.signature import (
+    has_quantized_collective,
+    signature_effective_bytes,
+    signature_raw_bytes,
+)
+
+TRAFFIC_REL_TOL = 0.08  # SPMD101: traced vs declared raw bytes
+TRAFFIC_ABS_TOL = 512.0  # small-model scalar-metrics slack (bytes)
+RATIO_REL_TOL = 0.08  # SPMD102: traced vs declared compression ratio
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+    engine: str = ""
+
+    def as_json(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "engine": self.engine, "message": self.message}
+
+
+def control_flow_findings(trace) -> list:
+    """SPMD002 from the uniformity analysis of every traced part."""
+    out = []
+    for part in trace.parts:
+        for issue in part.signature.issues:
+            out.append(Finding(
+                rule="SPMD002", path=issue.file, line=issue.line,
+                engine=trace.engine,
+                message=f"[{trace.engine}/{trace.codec}:{part.name}] "
+                        f"{issue.detail}",
+            ))
+    return out
+
+
+def axis_findings(trace) -> list:
+    """SPMD001: trace failures (unbound axis etc.) and collectives
+    naming axes the engine mesh does not carry."""
+    if trace.error is not None:
+        hint = (" — a collective likely names an axis the engine mesh "
+                "does not bind") if "axis" in trace.error.lower() else ""
+        return [Finding(
+            rule="SPMD001", path=trace.module_file, line=0,
+            engine=trace.engine,
+            message=f"[{trace.engine}/{trace.codec}] step could not be "
+                    f"traced: {trace.error}{hint}",
+        )]
+    out = []
+    for part in trace.parts:
+        known = set(part.axis_sizes)
+        for c in part.signature.collectives:
+            missing = [a for a in c.axes if a not in known]
+            if missing:
+                out.append(Finding(
+                    rule="SPMD001", path=c.file, line=c.line,
+                    engine=trace.engine,
+                    message=f"[{trace.engine}/{trace.codec}:{part.name}] "
+                            f"{c.prim} over axis {missing} not present on "
+                            f"the engine mesh (axes: {sorted(known)})",
+                ))
+    return out
+
+
+def donation_findings_for(trace) -> list:
+    """SPMD201: declared donates_state vs the lowered programs."""
+    if trace.error is not None or not trace.declared_donates:
+        return []
+    out = []
+    for part in trace.parts:
+        if part.donated and not all(part.donated):
+            undonated = sum(1 for d in part.donated if not d)
+            out.append(Finding(
+                rule="SPMD201", path=trace.module_file, line=0,
+                engine=trace.engine,
+                message=(
+                    f"[{trace.engine}/{trace.codec}:{part.name}] engine "
+                    f"declares donates_state=True but {undonated}/"
+                    f"{len(part.donated)} state buffers are NOT donated "
+                    "in the lowered step — every in-flight dispatch "
+                    "holds a second state copy in HBM"
+                ),
+            ))
+        elif not part.donated:
+            out.append(Finding(
+                rule="SPMD201", path=trace.module_file, line=0,
+                engine=trace.engine,
+                message=f"[{trace.engine}/{trace.codec}:{part.name}] "
+                        "engine declares donates_state=True but the "
+                        "traced step carries no donation markers at all",
+            ))
+    return out
+
+
+def _traced_raw_amortized(trace) -> float:
+    return sum(
+        signature_raw_bytes(p.signature, p.axis_sizes) * p.weight
+        for p in trace.parts
+    )
+
+
+def _traced_effective_amortized(trace, codec_bytes: float) -> float:
+    return sum(
+        signature_effective_bytes(p.signature, p.axis_sizes, codec_bytes)
+        * p.weight
+        for p in trace.parts
+    )
+
+
+def traffic_findings(trace_off, declared=None) -> list:
+    """SPMD101 on the codec-off trace: traced raw bytes vs the
+    engine's declared ``traffic_model()`` raw bytes (amortized).
+    ``declared`` overrides the trace's own TrafficModel (tests)."""
+    if trace_off.error is not None:
+        return []
+    tm = declared if declared is not None else trace_off.traffic
+    traced = _traced_raw_amortized(trace_off)
+    want = float(tm.raw_bytes_per_step_amortized)
+    tol = max(TRAFFIC_ABS_TOL, TRAFFIC_REL_TOL * max(traced, want))
+    if abs(traced - want) <= tol:
+        return []
+    return [Finding(
+        rule="SPMD101", path=trace_off.module_file, line=0,
+        engine=trace_off.engine,
+        message=(
+            f"[{trace_off.engine}] traffic_model() declares "
+            f"{want:.0f} raw B/step (amortized) but the traced jaxpr "
+            f"moves {traced:.0f} B/step — the tmpi_comm_* gauges are "
+            "drifting from the program; fix the analytic model or the "
+            "exchange"
+        ),
+    )]
+
+
+def codec_findings(trace_off, trace_on, declared=None) -> list:
+    """SPMD102 on the codec-on trace: quantization evidence must exist
+    and the traced compression ratio must match the declared one."""
+    if trace_off.error is not None or trace_on.error is not None:
+        return []
+    tm = declared if declared is not None else trace_on.traffic
+    out = []
+    if not any(has_quantized_collective(p.signature)
+               for p in trace_on.parts):
+        out.append(Finding(
+            rule="SPMD102", path=trace_on.module_file, line=0,
+            engine=trace_on.engine,
+            message=(
+                f"[{trace_on.engine}/{trace_on.codec}] codec-on trace "
+                "shows NO quantization evidence on any collective — the "
+                "codec is configured but the exchange never routes "
+                "through it"
+            ),
+        ))
+        return out
+    from theanompi_tpu.parallel.codec import get_codec
+
+    codec = get_codec(trace_on.codec)
+    raw = _traced_raw_amortized(trace_off)
+    eff = _traced_effective_amortized(trace_on,
+                                      codec.wire_bytes_per_element)
+    traced_ratio = raw / eff if eff > 0 else 1.0
+    want = float(tm.compression_ratio)
+    if want > 0 and abs(traced_ratio - want) / want > RATIO_REL_TOL:
+        out.append(Finding(
+            rule="SPMD102", path=trace_on.module_file, line=0,
+            engine=trace_on.engine,
+            message=(
+                f"[{trace_on.engine}/{trace_on.codec}] declared "
+                f"compression_ratio {want:.2f} but the traces realize "
+                f"{traced_ratio:.2f} (raw {raw:.0f} B -> effective "
+                f"{eff:.0f} B) — the gauges' claimed win and the "
+                "program disagree"
+            ),
+        ))
+    return out
+
+
+def golden_findings(trace, update: bool = False) -> list:
+    """SPMD003: traced signature vs the reviewed snapshot (or rewrite
+    it under ``--update-golden``)."""
+    from theanompi_tpu.tools.analyze import golden as G
+
+    if trace.error is not None:
+        return []
+    if update:
+        G.write_golden(trace)
+        return []
+    gold = G.load_golden(trace.engine, trace.codec)
+    if gold is None:
+        return [Finding(
+            rule="SPMD003", path=G.golden_path(trace.engine, trace.codec),
+            line=0, engine=trace.engine,
+            message=(
+                f"no golden collective signature for "
+                f"{trace.engine}/{trace.codec} — run "
+                "`tmpi lint --update-golden` and review the snapshot"
+            ),
+        )]
+    errs = G.compare_golden(trace, gold)
+    return [Finding(
+        rule="SPMD003", path=G.golden_path(trace.engine, trace.codec),
+        line=0, engine=trace.engine,
+        message=f"[{trace.engine}/{trace.codec}] collective signature "
+                f"drifted from golden: {e} — if deliberate, regenerate "
+                "with `tmpi lint --update-golden` and review the diff",
+    ) for e in errs]
+
+
+def analyze_engines(update_golden: bool = False,
+                    engines: Optional[tuple] = None) -> list:
+    """Run all jaxpr-level rule families over the engine matrix."""
+    findings: list = []
+    names = engines or harness.ENGINE_NAMES
+    for name in names:
+        t_off = harness.trace_engine(name, "none")
+        t_on = harness.trace_engine(name, "int8:ef")
+        for t in (t_off, t_on):
+            findings.extend(axis_findings(t))
+            findings.extend(control_flow_findings(t))
+            findings.extend(donation_findings_for(t))
+            findings.extend(golden_findings(t, update=update_golden))
+        findings.extend(traffic_findings(t_off))
+        findings.extend(codec_findings(t_off, t_on))
+    return findings

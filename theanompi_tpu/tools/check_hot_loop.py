@@ -16,10 +16,21 @@ eval's single end-of-epoch ``float(v)`` drain, checkpoint enqueue,
 is deliberately out of scope: those are per-epoch / per-exchange syncs,
 not per-step ones.
 
+**Serve hot path** (ISSUE 7 satellite): the same guard now covers the
+serving engine's micro-batch loop (``serve/engine.py`` —
+``ServeEngine._loop`` / ``_serve_batch``). The contract there is ONE
+host materialization per micro-batch: the batched logits fetch at
+``_serve_batch``'s top level is the sanctioned sync point, so
+``check_serve_source`` flags host-materializing calls anywhere in the
+dequeue loop (``_loop``) and inside any per-request ``for`` loop of
+``_serve_batch`` — the "fetch each request's logits separately" patch
+that would turn one device round trip per batch into one per request.
+
 Usage::
 
-    python -m theanompi_tpu.tools.check_hot_loop            # lint worker.py
-    python -m theanompi_tpu.tools.check_hot_loop path.py    # lint that file
+    python -m theanompi_tpu.tools.check_hot_loop            # worker + serve
+    python -m theanompi_tpu.tools.check_hot_loop path.py    # train-loop lint
+                                                            # on that file
 
 Exit code 1 on any violation (CI gate; tests/test_check_hot_loop.py).
 """
@@ -48,6 +59,12 @@ WORKER_PATH = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "launch", "worker.py",
 )
+SERVE_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "serve", "engine.py",
+)
+# the serve micro-batch hot path: the dequeue loop and the batch server
+_SERVE_FUNCS = ("_loop", "_serve_batch")
 
 
 def _forbidden_call(node: ast.Call) -> Optional[str]:
@@ -116,19 +133,83 @@ def check_source(source: str, func: str = "run_training") -> list[str]:
     return errs
 
 
+def _serve_funcs(tree: ast.Module) -> list:
+    fns = [node for node in ast.walk(tree)
+           if isinstance(node, ast.FunctionDef)
+           and node.name in _SERVE_FUNCS]
+    if len(fns) < len(_SERVE_FUNCS):
+        found = {f.name for f in fns}
+        raise ValueError(
+            f"serve hot-path anchors {sorted(set(_SERVE_FUNCS) - found)} "
+            "not found — the micro-batch loop moved; update "
+            "tools/check_hot_loop.py"
+        )
+    return fns
+
+
+def check_serve_source(source: str) -> list:
+    """Violation strings for the serve micro-batch hot path (empty =
+    clean). ``_loop`` must never materialize host values (it holds the
+    queue lock and gates every request's latency); ``_serve_batch`` may
+    materialize ONCE per batch at its top level (the batched logits
+    fetch) but never inside a per-request ``for`` loop."""
+    errs = []
+    for fn in _serve_funcs(ast.parse(source)):
+        if fn.name == "_loop":
+            nodes = ast.walk(fn)
+        else:
+            # outermost For loops only: a nested loop's subtree is
+            # already covered by its ancestor's walk (double-reporting
+            # would inflate the violation count)
+            fors = [n for n in ast.walk(fn) if isinstance(n, ast.For)]
+            inner = {id(sub) for loop in fors
+                     for sub in ast.walk(loop) if sub is not loop
+                     and isinstance(sub, ast.For)}
+            nodes = (n for loop in fors if id(loop) not in inner
+                     for n in ast.walk(loop))
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            tok = _forbidden_call(node)
+            if tok is not None:
+                where = ("the serve dequeue loop" if fn.name == "_loop"
+                         else "a per-request loop of _serve_batch")
+                errs.append(
+                    f"line {node.lineno}: forbidden host sync {tok!r} "
+                    f"inside {where}: {ast.unparse(node)} "
+                    "(one materialization per micro-batch, at "
+                    "_serve_batch top level, is the sanctioned sync "
+                    "point)"
+                )
+    return errs
+
+
 def main(argv: Optional[list] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
-    path = argv[0] if argv else WORKER_PATH
-    with open(path) as f:
-        source = f.read()
-    errs = check_source(source)
-    for e in errs:
-        print(f"{path}:{e}")
-    print(
-        f"hot-loop lint on {os.path.relpath(path)}: "
-        + ("OK" if not errs else f"{len(errs)} violations")
-    )
-    return 1 if errs else 0
+    if argv:
+        path = argv[0]
+        with open(path) as f:
+            errs = check_source(f.read())
+        for e in errs:
+            print(f"{path}:{e}")
+        print(
+            f"hot-loop lint on {os.path.relpath(path)}: "
+            + ("OK" if not errs else f"{len(errs)} violations")
+        )
+        return 1 if errs else 0
+    rc = 0
+    for path, checker in ((WORKER_PATH, check_source),
+                          (SERVE_PATH, check_serve_source)):
+        with open(path) as f:
+            errs = checker(f.read())
+        for e in errs:
+            print(f"{path}:{e}")
+        print(
+            f"hot-loop lint on {os.path.relpath(path)}: "
+            + ("OK" if not errs else f"{len(errs)} violations")
+        )
+        rc |= 1 if errs else 0
+    return rc
 
 
 if __name__ == "__main__":

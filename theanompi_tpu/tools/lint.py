@@ -1,0 +1,294 @@
+"""``tmpi lint`` — every repo lint plus the SPMD safety analyzer,
+behind one command with stable rule IDs.
+
+The three long-standing lints (hot-loop, codec coverage, telemetry
+schemas) and the jaxpr/AST analyzer (tools/analyze/) run as one pass::
+
+    tmpi lint                       # whole tree, human output
+    tmpi lint --json                # machine-readable CI report
+    tmpi lint --update-golden       # regenerate collective signatures
+    tmpi lint --no-analyze runs/    # fast path: classic lints only
+    python -m theanompi_tpu.tools.lint_all   # thin alias (legacy CI)
+
+Exit codes: 0 clean, 1 findings, 2 internal lint failure.
+
+Rule catalog (:data:`RULES`):
+
+======== ================================================================
+HOT001   host-materializing call inside a worker train loop
+HOT002   host-materializing call inside the serve micro-batch loop's
+         per-request paths
+CODEC001 engine module bypasses the wire-codec layer without exemption
+SCHEMA001 telemetry record violates its documented schema
+SPMD001 collective names an axis the engine mesh does not bind
+SPMD002 collective under potentially rank-divergent control flow
+SPMD003 collective signature drifted from the reviewed golden
+SPMD101 traced wire bytes disagree with the declared traffic_model()
+SPMD102 codec-on trace does not realize the claimed compression
+SPMD201 donates_state declared but the lowered step does not donate
+SPMD202 host np.asarray aliases state donated to an engine step
+SPMD301 rank-divergent value gates cross-rank work (host taint)
+SPMD302 unsorted directory listing (shared-storage order divergence)
+======== ================================================================
+
+**Suppressions**: any SPMD finding can be waived per line with an
+end-of-line (or immediately preceding) comment carrying a written
+reason::
+
+    files = os.listdir(d)  # spmd_exempt: order-insensitive dict fill
+
+A bare ``spmd_exempt:`` with no reason does not count. Suppressed
+findings still appear in the ``--json`` report under ``suppressed``.
+The HOT/CODEC/SCHEMA rules keep their own exemption mechanics
+(``codec_exempt:`` markers, loop scoping) and do not honor
+``spmd_exempt``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Optional
+
+RULES = {
+    "HOT001": "host sync inside a worker train loop "
+              "(tools/check_hot_loop.py)",
+    "HOT002": "host sync inside the serve micro-batch loop's per-request "
+              "paths (tools/check_hot_loop.py)",
+    "CODEC001": "engine exchange bypasses the wire-codec layer "
+                "(tools/check_codec_coverage.py)",
+    "SCHEMA001": "telemetry record violates its schema "
+                 "(tools/check_obs_schema.py)",
+    "SPMD001": "collective names an axis not bound on the engine mesh",
+    "SPMD002": "collective under potentially rank-divergent control flow",
+    "SPMD003": "collective signature drifted from golden "
+               "(tmpi lint --update-golden to accept)",
+    "SPMD101": "traced wire bytes disagree with declared traffic_model()",
+    "SPMD102": "codec-on trace does not realize the claimed compression",
+    "SPMD201": "donates_state declared but lowered step does not donate",
+    "SPMD202": "host asarray aliases donated engine state",
+    "SPMD301": "rank-divergent value gates cross-rank work",
+    "SPMD302": "unsorted directory listing on possibly-shared storage",
+}
+
+_EXEMPT_RE = re.compile(r"spmd_exempt:[ \t]*(\S[^\n]*)")
+
+
+@dataclass
+class LintFinding:
+    rule: str
+    path: str
+    line: int
+    message: str
+    suppressed: bool = False
+    exempt_reason: str = ""
+
+    def as_json(self) -> dict:
+        d = {"rule": self.rule, "path": self.path, "line": self.line,
+             "message": self.message}
+        if self.suppressed:
+            d["suppressed"] = True
+            d["exempt_reason"] = self.exempt_reason
+        return d
+
+
+@dataclass
+class LintReport:
+    findings: list = field(default_factory=list)
+    suppressed: list = field(default_factory=list)
+    notes: list = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def as_json(self) -> dict:
+        return {
+            "ok": self.ok,
+            "counts": {
+                "findings": len(self.findings),
+                "suppressed": len(self.suppressed),
+            },
+            "findings": [f.as_json() for f in self.findings],
+            "suppressed": [f.as_json() for f in self.suppressed],
+            "notes": list(self.notes),
+            "rules": RULES,
+        }
+
+
+def _exemption_reason(path: str, line: int) -> Optional[str]:
+    """The written ``spmd_exempt`` reason covering ``path:line`` — on
+    the line itself or the line immediately above (comment-only line)."""
+    if not path or line <= 0 or not os.path.isfile(path):
+        return None
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError:
+        return None
+    if 1 <= line <= len(lines):
+        m = _EXEMPT_RE.search(lines[line - 1])
+        if m:
+            return m.group(1).strip()
+    # a standalone comment line immediately above also covers the line
+    if 2 <= line <= len(lines) + 1:
+        prev = lines[line - 2].strip()
+        if prev.startswith("#"):
+            m = _EXEMPT_RE.search(prev)
+            if m:
+                return m.group(1).strip()
+    return None
+
+
+def _add(report: LintReport, rule: str, path: str, line: int,
+         message: str, suppressible: bool = True) -> None:
+    f = LintFinding(rule=rule, path=path, line=line, message=message)
+    reason = _exemption_reason(path, line) if (
+        suppressible and rule.startswith("SPMD")) else None
+    if reason:
+        f.suppressed = True
+        f.exempt_reason = reason
+        report.suppressed.append(f)
+    else:
+        report.findings.append(f)
+
+
+_LINE_RE = re.compile(r"line (\d+):")
+
+
+def _run_hot_loop(report: LintReport) -> None:
+    from theanompi_tpu.tools import check_hot_loop as H
+
+    with open(H.WORKER_PATH) as f:
+        for err in H.check_source(f.read()):
+            m = _LINE_RE.search(err)
+            _add(report, "HOT001", H.WORKER_PATH,
+                 int(m.group(1)) if m else 0, err)
+    with open(H.SERVE_PATH) as f:
+        for err in H.check_serve_source(f.read()):
+            m = _LINE_RE.search(err)
+            _add(report, "HOT002", H.SERVE_PATH,
+                 int(m.group(1)) if m else 0, err)
+
+
+def _run_codec_coverage(report: LintReport) -> None:
+    from theanompi_tpu.tools import check_codec_coverage as C
+
+    for err in C.check_dir():
+        path = err.split(":", 1)[0]
+        _add(report, "CODEC001", path, 0, err)
+
+
+def _run_schema(report: LintReport, paths: Optional[list]) -> None:
+    from theanompi_tpu.tools import check_obs_schema as S
+    from theanompi_tpu.tools.lint_all import telemetry_files
+
+    files = telemetry_files(paths)
+    if not files:
+        report.notes.append("schema lint: no telemetry files found (OK)")
+        return
+    loc = re.compile(r"^(.*?):(\d+): ")
+    for f in files:
+        for err in S.check_file(f):
+            m = loc.match(err)
+            _add(report, "SCHEMA001", m.group(1) if m else f,
+                 int(m.group(2)) if m else 0, err)
+
+
+def _ensure_virtual_devices() -> None:
+    """Give the analyzer a multi-device CPU platform to trace over,
+    regardless of entry point (``tmpi lint``, ``python -m ...lint``,
+    the ``lint_all`` alias). XLA_FLAGS is read at BACKEND init —
+    setting it here works as long as nothing touched devices yet, and
+    is a harmless no-op under pytest's conftest (backend already up
+    with 8 virtual devices and the same flag)."""
+    os.environ.setdefault(
+        "JAX_PLATFORMS", os.environ.get("TMPI_FORCE_PLATFORM") or "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
+def _run_analyzer(report: LintReport, update_golden: bool) -> None:
+    _ensure_virtual_devices()
+    from theanompi_tpu.tools.analyze.astlint import run_ast_lints
+    from theanompi_tpu.tools.analyze.rules import analyze_engines
+
+    for f in analyze_engines(update_golden=update_golden):
+        _add(report, f.rule, f.path, f.line, f.message)
+    for f in run_ast_lints():
+        _add(report, f.rule, f.path, f.line, f.message)
+
+
+def run_lint(paths: Optional[list] = None, update_golden: bool = False,
+             analyze: bool = True) -> LintReport:
+    report = LintReport()
+    _run_hot_loop(report)
+    _run_codec_coverage(report)
+    _run_schema(report, paths)
+    if analyze:
+        _run_analyzer(report, update_golden)
+    return report
+
+
+def _rel(path: str) -> str:
+    try:
+        return os.path.relpath(path)
+    except ValueError:
+        return path
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tmpi lint", description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="telemetry dirs/files for the schema lint "
+                         "(default: the repo tree)")
+    ap.add_argument("--json", action="store_true", dest="json_out",
+                    help="machine-readable report on stdout (CI)")
+    ap.add_argument("--update-golden", action="store_true",
+                    help="regenerate the per-engine collective-signature "
+                         "snapshots instead of diffing against them")
+    ap.add_argument("--no-analyze", action="store_true",
+                    help="skip the SPMD analyzer (classic lints only)")
+    args = ap.parse_args(argv)
+    try:
+        report = run_lint(paths=args.paths or None,
+                          update_golden=args.update_golden,
+                          analyze=not args.no_analyze)
+    except Exception as e:  # noqa: BLE001 — rc 2 = the lint itself broke
+        print(f"tmpi lint: internal failure: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        if args.json_out:
+            print(json.dumps({"ok": False, "internal_error": repr(e)}))
+        return 2
+    if args.json_out:
+        print(json.dumps(report.as_json(), indent=1))
+        return 0 if report.ok else 1
+    for note in report.notes:
+        print(note)
+    for f in report.findings:
+        loc = f"{_rel(f.path)}:{f.line}: " if f.path else ""
+        print(f"{f.rule} {loc}{f.message}")
+    for f in report.suppressed:
+        print(f"{f.rule} {_rel(f.path)}:{f.line}: suppressed "
+              f"(spmd_exempt: {f.exempt_reason})")
+    if args.update_golden:
+        from theanompi_tpu.tools.analyze.golden import GOLDEN_DIR
+
+        print(f"golden signatures regenerated under {_rel(GOLDEN_DIR)}")
+    print("tmpi lint: " + ("OK" if report.ok else
+                           f"{len(report.findings)} findings"))
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,31 +1,21 @@
-"""Run every repo lint in one pass: hot-loop + codec coverage +
-telemetry schemas.
+"""Legacy alias for ``tmpi lint`` (tools/lint.py).
 
-One entry point for CI and the tier-1 suite (tests/test_lint_all.py):
-
-1. **hot-loop lint** (tools/check_hot_loop.py): the worker train loops
-   must contain no host-materializing calls — the invariant the async
-   dispatch pipeline (and the numerics sentinels that ride it) depend
-   on;
-2. **codec-coverage lint** (tools/check_codec_coverage.py): every
-   engine module under ``parallel/`` routes its exchange through the
-   codec layer (``parallel/codec.py``) or carries an explicit
-   ``codec_exempt: <reason>`` marker — ``--wire-codec`` must keep
-   covering the whole fleet;
-3. **schema lint** (tools/check_obs_schema.py): every telemetry
-   ``*.jsonl`` (plus heartbeat/stall ``.json``) found under the given
-   paths — default: the repo tree — must match the documented record
-   schemas, including the ``numerics``/``anomaly`` kinds the flight
-   recorder emits and the ``comm`` wire-declaration records.
-
-A tree with no telemetry files passes the schema step vacuously (fresh
-checkouts hold none until a run writes some); a single invalid line
-fails the whole lint.
-
-Usage::
+ISSUE 7 folded the three classic lints (hot-loop, codec coverage,
+telemetry schemas) together with the SPMD safety analyzer behind the
+``tmpi lint`` subcommand; this module stays as a thin alias so
+existing CI invocations keep working::
 
     python -m theanompi_tpu.tools.lint_all              # repo tree
-    python -m theanompi_tpu.tools.lint_all runs/ exp/   # specific dirs
+    python -m theanompi_tpu.tools.lint_all runs/ exp/   # telemetry dirs
+
+Positional arguments remain telemetry paths for the schema step. A
+tree with no telemetry files passes the schema step vacuously (fresh
+checkouts hold none until a run writes some); a single invalid line
+fails the whole lint. Rule IDs, ``--json`` output, and ``spmd_exempt``
+suppressions are documented in :mod:`theanompi_tpu.tools.lint`.
+
+:func:`telemetry_files` (the discovery walk the schema step uses)
+lives here and is shared with tools/lint.py.
 """
 
 from __future__ import annotations
@@ -34,12 +24,6 @@ import fnmatch
 import os
 import sys
 from typing import Optional
-
-from theanompi_tpu.tools import (
-    check_codec_coverage,
-    check_hot_loop,
-    check_obs_schema,
-)
 
 # never telemetry; test fixtures under tests/ may hold deliberately
 # invalid lines for the schema checker's own tests
@@ -70,24 +54,17 @@ def telemetry_files(paths: Optional[list] = None) -> list[str]:
 
 
 def main(argv: Optional[list] = None) -> int:
+    """Thin alias over ``tmpi lint`` (tools/lint.py): positional args
+    remain telemetry paths for the schema step, and the full pass now
+    includes the serve hot-path lint and the SPMD safety analyzer
+    (tools/analyze/). Kept so existing CI invocations of
+    ``python -m theanompi_tpu.tools.lint_all`` keep working."""
     argv = sys.argv[1:] if argv is None else argv
-    rc = 0
+    from theanompi_tpu.tools.lint import main as lint_main
 
-    # 1. hot-loop lint on the worker train loops
-    rc |= check_hot_loop.main([])
-
-    # 2. codec-coverage lint over the parallel/ engine modules
-    rc |= check_codec_coverage.main([])
-
-    # 3. schema lint over every telemetry file found
-    files = telemetry_files(argv or None)
-    if not files:
-        print("schema lint: no telemetry files found (OK)")
-    else:
-        rc |= check_obs_schema.main([*files, "-q"])
-
+    rc = lint_main(list(argv))
     print("lint_all: " + ("OK" if rc == 0 else "FAILED"))
-    return 1 if rc else 0
+    return rc
 
 
 if __name__ == "__main__":

@@ -295,7 +295,10 @@ def _sharded_sets(directory: str) -> dict[int, list[str]]:
     sets (a host died mid-save) are excluded, and a zero-byte or
     unreadable member counts as missing (see :func:`_readable_nonempty`)."""
     by_step: dict[int, dict[int, tuple[int, str]]] = {}
-    for f in os.listdir(directory):
+    # sorted: listing order is filesystem/attribute-cache dependent per
+    # host; the dict fill is order-insensitive today, but resume-step
+    # agreement across controllers must not hinge on that staying true
+    for f in sorted(os.listdir(directory)):
         if m := _SHARD_RE.search(f):
             if not _readable_nonempty(os.path.join(directory, f)):
                 continue
@@ -473,7 +476,9 @@ def _keep_chain(directory: str) -> list[tuple[int, int, str]]:
     if not os.path.isdir(directory):
         return []
     candidates: list[tuple[int, int, str]] = []
-    for f in os.listdir(directory):
+    # sorted for cross-host determinism: every controller must walk the
+    # keep-chain in the same order (rank-divergence lint SPMD302)
+    for f in sorted(os.listdir(directory)):
         if m := _CKPT_RE.search(f):
             p = os.path.join(directory, f)
             if _readable_nonempty(p):
