@@ -248,6 +248,58 @@ def resolve_resume(d, state):
     assert rank_divergence_findings("snippet.py", clean) == []
 
 
+def test_unsorted_device_probe_gating_reshard_caught():
+    """Elastic PR mutation: jax.devices() enumeration order (and, mid-
+    failure, membership) is rank-divergent; deriving the reshard gate
+    from the raw probe means controllers can compute DIFFERENT transfer
+    plans around the gang-scheduled load — SPMD301, same class as a
+    gated collective."""
+    bad = '''
+import jax
+def elastic_resume(path, template, saved_world):
+    devs = jax.devices()
+    if len(devs) != saved_world:
+        state = load_resharded(path, template, devs)
+    return state
+'''
+    found = rank_divergence_findings("snippet.py", bad)
+    assert [f.rule for f in found] == ["SPMD301"]
+    assert "load_resharded" in found[0].message
+    assert "jax.devices()" in found[0].message
+
+
+def test_sorted_device_probe_passes():
+    """The clean form — enumeration pinned by sorted(...) BEFORE the
+    plan derives from it (supervisor._probe_world's shape)."""
+    clean = '''
+import jax
+def elastic_resume(path, template, saved_world):
+    devs = sorted(jax.devices(), key=lambda d: d.id)
+    if len(devs) != saved_world:
+        state = load_resharded(path, template, devs)
+    return state
+'''
+    assert rank_divergence_findings("snippet.py", clean) == []
+
+
+def test_sorted_clock_read_still_tainted():
+    """sorted(...) launders ORDER, not VALUE: the escape applies only
+    to listing/device-enumeration sources. A clock read is just as
+    rank-divergent after a sort, so wrapping it must NOT silence
+    SPMD301 on the gated reshard."""
+    bad = '''
+import time
+def elastic_resume(path, template, mesh, deadline):
+    t = sorted([time.time()])[0]
+    if t < deadline:
+        state = load_resharded(path, template, mesh)
+    return state
+'''
+    found = rank_divergence_findings("snippet.py", bad)
+    assert [f.rule for f in found] == ["SPMD301"]
+    assert "time.time()" in found[0].message
+
+
 def test_use_after_donation_alias_caught():
     bad = '''
 import numpy as np

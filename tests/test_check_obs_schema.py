@@ -50,6 +50,22 @@ def test_valid_records_pass():
         {"kind": "reload", "t": 1.0, "from_step": 4, "to_step": 9,
          "ms": 41.2},
         {"kind": "reload", "t": 1.0, "from_step": -1, "to_step": 2},
+        # elastic world size (launch/supervisor.py + launch/worker.py):
+        # retries carry the attempt's world; one topology record per
+        # elastic attempt; one reshard record per checkpoint moved onto
+        # a changed mesh
+        {"kind": "retry", "rank": 0, "t": 1.0, "attempt": 1, "step": 2,
+         "error": "TopologyChanged('shrink')", "backoff_s": 0.0,
+         "resumable": False, "world": 4},
+        {"kind": "topology", "rank": 0, "t": 1.0, "attempt": 1,
+         "world": 4},
+        {"kind": "topology", "rank": 0, "t": 1.0, "attempt": 2,
+         "world": 2, "prev_world": 4},
+        {"kind": "reshard", "rank": 0, "t": 1.0, "step": 2,
+         "from_world": 4, "to_world": 2, "seconds": 0.01, "leaves": 9,
+         "per_replica_batch": 16},
+        {"kind": "reshard", "rank": 0, "t": 1.0, "step": 2,
+         "from_world": 2, "to_world": 4, "seconds": 0.2},
     ]
     for rec in good:
         assert validate_record(rec) == [], rec
@@ -91,6 +107,17 @@ def test_valid_records_pass():
      "missing required field 'to_step'"),
     ({"kind": "reload", "t": 1.0, "from_step": 1.5, "to_step": 2},
      "is float, want int"),
+    ({"kind": "topology", "rank": 0, "t": 1.0, "attempt": 1},
+     "missing required field 'world'"),
+    ({"kind": "topology", "rank": 0, "t": 1.0, "attempt": 1,
+      "world": 4.5}, "is float, want int"),
+    ({"kind": "reshard", "rank": 0, "t": 1.0, "step": 2, "to_world": 2,
+      "seconds": 0.1}, "missing required field 'from_world'"),
+    ({"kind": "reshard", "rank": 0, "t": 1.0, "step": 2, "from_world": 4,
+      "to_world": 2}, "missing required field 'seconds'"),
+    ({"kind": "retry", "rank": 0, "t": 1.0, "attempt": 1, "step": 4,
+      "error": "x", "backoff_s": 0.5, "world": "four"},
+     "is str, want int"),
 ])
 def test_invalid_records_flagged(rec, frag):
     errs = validate_record(rec)

@@ -101,3 +101,76 @@ def test_injector_accepts_prebuilt_specs():
     inj = FaultInjector([FaultSpec(kind="crash", step=1)])
     with pytest.raises(InjectedCrash):
         inj.check_step(1)
+
+
+def test_parse_topology_fault_specs():
+    s = parse_fault_spec("shrink@3:2")
+    assert (s.kind, s.step, s.arg) == ("shrink", 3, 2.0)
+    s = parse_fault_spec("grow@5:4")
+    assert (s.kind, s.step, s.arg) == ("grow", 5, 4.0)
+
+
+@pytest.mark.parametrize("bad", [
+    "shrink@3",      # the target world is the whole point
+    "grow@3",
+    "shrink@3:0",    # worlds are >= 1
+    "shrink@3:1.5",  # integral device counts only
+])
+def test_parse_topology_fault_specs_reject(bad):
+    with pytest.raises(ValueError, match="world size"):
+        parse_fault_spec(bad)
+
+
+def test_shrink_fires_once_and_override_is_sticky():
+    from theanompi_tpu.utils.faults import TopologyChanged
+
+    inj = FaultInjector(["shrink@3:2"])
+    assert inj.world_override() is None  # nothing fired yet
+    inj.check_step(1)
+    inj.check_step(2)
+    with pytest.raises(TopologyChanged) as ei:
+        inj.check_step(3)
+    assert ei.value.new_world == 2 and ei.value.kind == "shrink"
+    # fired once: the replayed step is clean, but the world STAYS
+    # shrunk for every later probe (the supervisor reuses one injector
+    # across attempts — a dead slice does not resurrect on retry)
+    inj.check_step(3)
+    inj.check_step(4)
+    assert inj.world_override() == 2
+
+
+def test_grow_after_shrink_latest_fired_wins():
+    from theanompi_tpu.utils.faults import TopologyChanged
+
+    inj = FaultInjector(["shrink@2:2", "grow@4:6"])
+    with pytest.raises(TopologyChanged):
+        inj.check_step(2)
+    assert inj.world_override() == 2
+    with pytest.raises(TopologyChanged) as ei:
+        inj.check_step(4)
+    assert ei.value.new_world == 6 and ei.value.kind == "grow"
+    assert inj.world_override() == 6
+
+
+def test_world_override_follows_firing_order_not_spec_order():
+    """The sticky world is the LAST FIRED topology fault's — even when
+    the specs were listed out of step order on the command line (the
+    naive last-in-list answer would be wrong here)."""
+    from theanompi_tpu.utils.faults import TopologyChanged
+
+    inj = FaultInjector(["grow@5:4", "shrink@2:2"])
+    with pytest.raises(TopologyChanged):
+        inj.check_step(2)          # shrink fires first despite being listed second
+    assert inj.world_override() == 2
+    with pytest.raises(TopologyChanged):
+        inj.check_step(5)          # grow fires last -> its world wins
+    assert inj.world_override() == 4
+
+
+def test_topology_fault_fires_inside_fused_group_range():
+    from theanompi_tpu.utils.faults import TopologyChanged
+
+    inj = FaultInjector(["shrink@6:2"])
+    inj.check_step(1, 4)
+    with pytest.raises(TopologyChanged):
+        inj.check_step(5, 8)

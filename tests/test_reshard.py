@@ -1,0 +1,560 @@
+"""Elastic world size (ISSUE 8): topology-stamped checkpoints +
+reshard-on-resume.
+
+Layers under test:
+
+- manifest plumbing: every save (single-file and sharded-set) carries a
+  versioned ``__topology__`` manifest (mesh identity, per-leaf
+  PartitionSpecs, the engine's elastic policies);
+- back-compat: pre-elastic (unstamped) checkpoints still load on an
+  identical mesh, and FAIL with an error naming the missing metadata
+  when a reshard would be needed — proven on a stamped-vs-unstamped
+  pair;
+- the transfer plan: region reads under every reshard policy, and the
+  no-full-materialization guarantee of the sharded-set path (max single
+  read per sharded leaf is bounded by a target shard, never the leaf);
+- numerics: a 4->2 and a 2->4 device CPU-mesh elastic resume under the
+  supervisor reaches parity with an uninterrupted baseline — for BSP
+  (replicated state: exact up to reduction order) AND ZeRO-1 (the hard
+  case: mesh-dependent padded optimizer segments, moved by the
+  ``flat_padded`` policy);
+- telemetry: ``topology`` records + ``world``-stamped retries in
+  supervisor.jsonl, the ``reshard`` record + ``tmpi_reshard_seconds``
+  in metrics.jsonl, all schema-valid.
+"""
+
+import json
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tinymodel import TinyCNN
+from theanompi_tpu.launch.supervisor import supervise_training
+from theanompi_tpu.launch.worker import run_training
+from theanompi_tpu.parallel.mesh import (
+    make_mesh,
+    mesh_topology,
+    spec_from_json,
+    spec_to_json,
+)
+from theanompi_tpu.utils.checkpoint import (
+    checkpoint_step,
+    latest_checkpoint,
+    load_resharded,
+    read_topology_manifest,
+    save_checkpoint,
+    save_checkpoint_sharded,
+)
+
+_RECIPE = {"batch_size": 32, "input_shape": (16, 16, 3),
+           "sched_kwargs": {"lr": 0.05, "boundaries": [10**9]}}
+
+_TINY = dict(
+    rule="bsp",
+    model_cls=TinyCNN,
+    recipe_overrides=_RECIPE,
+    dataset="synthetic",
+    dataset_kwargs={"n_train": 64, "n_val": 32, "image_shape": (16, 16, 3)},
+    print_freq=0,
+    n_epochs=3,  # 2 steps/epoch -> ckpts at steps 2/4/6
+)
+
+
+def _model():
+    return TinyCNN(TinyCNN.default_recipe().replace(**_RECIPE))
+
+
+def _final_params(ckpt_dir):
+    """``.params/*`` leaf arrays of the newest verified checkpoint,
+    template-free (works for BSP and ZeRO state layouts, single-file
+    and sharded-set formats alike)."""
+    import os
+
+    from theanompi_tpu.utils.checkpoint import (
+        _SHARD_RE,
+        _ShardedSource,
+        _SingleFileSource,
+    )
+
+    path = latest_checkpoint(ckpt_dir, verify=True)
+    assert path is not None, f"no verified checkpoint in {ckpt_dir}"
+    if _SHARD_RE.search(os.path.basename(path)):
+        src = _ShardedSource(path)
+        keys = sorted(src.catalogue)
+    else:
+        src = _SingleFileSource(path)
+        keys = sorted(src._data.files)
+    out = {}
+    for key in keys:
+        if not key.startswith(".params"):
+            continue
+        out[key] = src.read(key, tuple((0, d) for d in src.shape(key)))
+    assert out
+    return path, out
+
+
+def _assert_parity(dir_a, dir_b, rtol=1e-4, atol=1e-5):
+    """Final checkpoints agree up to cross-world reduction-order noise
+    (the elastic contract: parity, while same-mesh resume is exact)."""
+    pa, la = _final_params(dir_a)
+    pb, lb = _final_params(dir_b)
+    assert checkpoint_step(pa) == checkpoint_step(pb)
+    assert la.keys() == lb.keys()
+    for k in la:
+        np.testing.assert_allclose(la[k], lb[k], rtol=rtol, atol=atol,
+                                   err_msg=k)
+
+
+# -------------------------------------------------------------------------
+# manifest plumbing
+# -------------------------------------------------------------------------
+
+
+def test_partition_spec_json_roundtrip():
+    from jax.sharding import PartitionSpec as P
+
+    for spec in (P(), P("data"), P(None, "data"), P(("worker", "data")),
+                 P("a", None, ("b", "c"))):
+        assert spec_from_json(spec_to_json(spec)) == spec
+    assert spec_to_json(None) is None
+    assert spec_from_json(None) == P()
+
+
+@pytest.mark.parametrize("sharded", [False, True])
+def test_save_stamps_topology_manifest(tmp_path, sharded):
+    from theanompi_tpu.parallel.zero import ZeroEngine
+
+    mesh = make_mesh(4)
+    eng = ZeroEngine(_model(), mesh, steps_per_epoch=2)
+    state = eng.init_state(jax.random.PRNGKey(0))
+    topo = {"mesh": mesh_topology(mesh), "elastic": eng.elastic_spec()}
+    save_fn = save_checkpoint_sharded if sharded else save_checkpoint
+    path = save_fn(str(tmp_path), state, 1, topology=topo)
+    m = read_topology_manifest(path)
+    assert m["version"] == 1
+    assert m["mesh"] == {"shape": [4], "axes": ["data"]}
+    assert m["elastic"]["policies"][".opt_state"]["policy"] == "flat_padded"
+    # per-leaf PartitionSpecs were read off the LIVE arrays: the sharded
+    # flat accumulators record the data axis, replicated params record
+    # no partitioning
+    momentum = next(k for k, v in m["leaves"].items()
+                    if k.startswith(".opt_state") and v["spec"])
+    assert m["leaves"][momentum]["spec"] == [["data"]]
+    param = next(k for k in m["leaves"] if k.startswith(".params"))
+    assert m["leaves"][param]["spec"] in (None, [])
+
+
+def test_unstamped_checkpoint_still_loads_on_identical_mesh(tmp_path):
+    """Back-compat half of the stamped-vs-unstamped pair: a pre-PR-8
+    save (no topology kwarg) resumes fine when the mesh is unchanged."""
+    from theanompi_tpu.train import init_train_state
+
+    state = init_train_state(_model(), jax.random.PRNGKey(0))
+    path = save_checkpoint(str(tmp_path), state, 1)
+    assert read_topology_manifest(path) is None
+    mesh = make_mesh(4)
+    restored, _, info = load_resharded(path, state, mesh)
+    assert info["resharded"] is False and info["reason"] == "no-manifest"
+    for a, b in zip(jax.tree_util.tree_leaves(state),
+                    jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_stamped_vs_unstamped_pair_under_reshard(tmp_path):
+    """The regression pair: the SAME ZeRO state saved stamped and
+    unstamped. The stamped file reshards 4->2; the unstamped one fails
+    with an error NAMING the missing ``__topology__`` metadata (its
+    mesh-dependent opt segments cannot be re-planned without it)."""
+    from theanompi_tpu.parallel.zero import ZeroEngine
+
+    m4, m2 = make_mesh(4), make_mesh(2)
+    eng4 = ZeroEngine(_model(), m4, steps_per_epoch=2)
+    eng2 = ZeroEngine(_model(), m2, steps_per_epoch=2)
+    state4 = eng4.init_state(jax.random.PRNGKey(0))
+    template2 = eng2.init_state(jax.random.PRNGKey(0))
+    topo = {"mesh": mesh_topology(m4), "elastic": eng4.elastic_spec()}
+    stamped = save_checkpoint(str(tmp_path / "stamped"), state4, 1,
+                              topology=topo)
+    unstamped = save_checkpoint(str(tmp_path / "plain"), state4, 1)
+
+    _, _, info = load_resharded(stamped, template2, m2)
+    assert info["resharded"] is True
+    with pytest.raises(ValueError, match="__topology__"):
+        load_resharded(unstamped, template2, m2)
+
+
+# -------------------------------------------------------------------------
+# region readers / policies
+# -------------------------------------------------------------------------
+
+
+class _FakeSource:
+    def __init__(self, arrays):
+        self.arrays = {k: np.asarray(v) for k, v in arrays.items()}
+
+    def shape(self, key):
+        return self.arrays[key].shape
+
+    def read(self, key, bounds):
+        return self.arrays[key][tuple(slice(lo, hi) for lo, hi in bounds)]
+
+
+def test_region_reader_policies():
+    from theanompi_tpu.utils.checkpoint import _region_reader
+
+    src = _FakeSource({
+        "flat": np.concatenate([np.arange(10.0), np.zeros(2)]),  # F=10, pad 12
+        "stack": np.stack([np.full(4, 1.0), np.full(4, 3.0)]),   # 2 workers
+        "steps": np.array([7, 7], np.int32),
+    })
+    # flat_padded: logical prefix moves, target pad re-zeroed (12 -> 10+pad)
+    rd = _region_reader(src, "flat", {"policy": "flat_padded", "logical": 10},
+                        (14,), np.float32)
+    np.testing.assert_array_equal(rd(((8, 14),)),
+                                  [8, 9, 0, 0, 0, 0])
+    # worker_consensus: float mean over the saved stack, any new count
+    rd = _region_reader(src, "stack", {"policy": "worker_consensus"},
+                        (3, 4), np.float32)
+    np.testing.assert_array_equal(rd(((0, 3), (0, 4))),
+                                  np.full((3, 4), 2.0))
+    # ... int leaves take the first worker (a mean would round steps)
+    rd = _region_reader(src, "steps", {"policy": "worker_consensus"},
+                        (5,), np.int32)
+    np.testing.assert_array_equal(rd(((0, 5),)), np.full(5, 7, np.int32))
+    # worker_uniform: fresh 1/W mass, exactly summing to one
+    rd = _region_reader(src, "alpha", {"policy": "worker_uniform"},
+                        (4,), np.float32)
+    np.testing.assert_allclose(rd(((0, 4),)), np.full(4, 0.25))
+    # reset: zeros at the target shape, source never touched
+    rd = _region_reader(src, "missing", {"policy": "reset"}, (2, 2),
+                        np.float32)
+    np.testing.assert_array_equal(rd(((0, 2), (0, 2))), np.zeros((2, 2)))
+    # global with a shape mismatch and no adapting policy: loud error
+    with pytest.raises(ValueError, match="elastic policy"):
+        _region_reader(src, "flat", {"policy": "global"}, (99,), np.float32)
+
+
+def test_bsp_single_file_reshard_exact_values(tmp_path):
+    """Replicated BSP state moves bit-exactly through a 4->2 reshard,
+    and the restored leaves land committed to the TARGET mesh."""
+    from jax.sharding import NamedSharding
+    from theanompi_tpu.parallel.bsp import BSPEngine
+
+    m4, m2 = make_mesh(4), make_mesh(2)
+    eng4 = BSPEngine(_model(), m4, steps_per_epoch=2)
+    eng2 = BSPEngine(_model(), m2, steps_per_epoch=2)
+    state4 = eng4.init_state(jax.random.PRNGKey(1))
+    topo = {"mesh": mesh_topology(m4), "elastic": eng4.elastic_spec()}
+    path = save_checkpoint(str(tmp_path), state4, 1,
+                           rng=jax.random.PRNGKey(2), topology=topo)
+    template2 = eng2.init_state(jax.random.PRNGKey(0))
+    state2, rng, info = load_resharded(path, template2, m2)
+    assert info["resharded"] and info["from_world"] == 4
+    assert info["to_world"] == 2 and rng is not None
+    for a, b in zip(jax.tree_util.tree_leaves(state4),
+                    jax.tree_util.tree_leaves(state2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert isinstance(b.sharding, NamedSharding)
+        assert b.sharding.mesh == m2
+
+
+@pytest.mark.parametrize("worlds", [(4, 2), (2, 4)])
+def test_zero_sharded_set_reshard_bounded_reads(tmp_path, worlds):
+    """The hard case both ways: ZeRO-1's flat accumulators have
+    mesh-dependent global length (n * ceil(F/n)). After a sharded-set
+    reshard the logical F-prefix is preserved exactly, the target's own
+    padding is zero, params move bit-exactly — and no single read of a
+    SHARDED leaf ever materialized the full leaf (the arXiv:2112.01075
+    memory guarantee of the sharded-set path)."""
+    from theanompi_tpu.parallel.zero import ZeroEngine
+
+    n_src, n_tgt = worlds
+    msrc, mtgt = make_mesh(n_src), make_mesh(n_tgt)
+    eng_src = ZeroEngine(_model(), msrc, steps_per_epoch=2)
+    eng_tgt = ZeroEngine(_model(), mtgt, steps_per_epoch=2)
+    rng = jax.random.PRNGKey(0)
+    state = eng_src.init_state(rng)
+    # one real step so the accumulators hold nonzero content
+    x = jnp.ones((32, 16, 16, 3))
+    y = jnp.zeros((32,), jnp.int32)
+    state, _ = eng_src.train_step(state, x, y, rng)
+    topo = {"mesh": mesh_topology(msrc), "elastic": eng_src.elastic_spec()}
+    path = save_checkpoint_sharded(str(tmp_path), state, 1, rng=rng,
+                                   topology=topo)
+    template = eng_tgt.init_state(jax.random.PRNGKey(0))
+    restored, _, info = load_resharded(path, template, mtgt)
+    assert info["resharded"] is True
+
+    F = sum(math.prod(l.shape) for l in jax.tree_util.tree_leaves(
+        jax.eval_shape(lambda: _model().init(jax.random.PRNGKey(0))[0])))
+    for a, b in zip(jax.tree_util.tree_leaves(state.opt_state),
+                    jax.tree_util.tree_leaves(restored.opt_state)):
+        a, b = np.asarray(a), np.asarray(b)
+        if a.ndim:
+            assert a.shape == (n_src * -(-F // n_src),)
+            assert b.shape == (n_tgt * -(-F // n_tgt),)
+            np.testing.assert_array_equal(a[:F], b[:F])
+            assert not b[F:].any()
+        else:
+            np.testing.assert_array_equal(a, b)
+    for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                    jax.tree_util.tree_leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # memory guarantee: every read of the big sharded accumulators was a
+    # target-shard region, never the whole leaf
+    seg_tgt = -(-F // n_tgt)
+    big_reads = {k: v for k, v in info["reads"].items()
+                 if k.startswith(".opt_state") and v > 1}
+    assert big_reads, "expected region reads of the sharded accumulators"
+    assert max(big_reads.values()) <= seg_tgt < F
+
+
+def test_gosgd_policies_resize_workers_and_reseed_alpha(tmp_path):
+    """worker_consensus + worker_uniform end-to-end: a 4-worker GoSGD
+    state reshards to 2 workers with every new replica at the saved
+    consensus (mean) and fresh uniform share mass summing to 1."""
+    from theanompi_tpu.parallel.gosgd import GOSGDEngine
+
+    m4, m2 = make_mesh(4), make_mesh(2)
+    eng4 = GOSGDEngine(_model(), m4, steps_per_epoch=2)
+    eng2 = GOSGDEngine(_model(), m2, steps_per_epoch=2)
+    state4 = eng4.init_state(jax.random.PRNGKey(3))
+    # make the replicas distinct so the consensus is a REAL mean
+    w = state4.workers
+    spread = jax.tree_util.tree_map(
+        lambda l: l + jnp.arange(4, dtype=l.dtype).reshape(
+            (4,) + (1,) * (l.ndim - 1))
+        if jnp.issubdtype(l.dtype, jnp.floating) else l,
+        w.params,
+    )
+    state4 = state4._replace(workers=w._replace(params=spread))
+    topo = {"mesh": mesh_topology(m4), "elastic": eng4.elastic_spec()}
+    path = save_checkpoint(str(tmp_path), state4, 1, topology=topo)
+    template2 = eng2.init_state(jax.random.PRNGKey(0))
+    restored, _, info = load_resharded(path, template2, m2)
+    assert info["resharded"] is True
+    for a, b in zip(jax.tree_util.tree_leaves(state4.workers.params),
+                    jax.tree_util.tree_leaves(restored.workers.params)):
+        a, b = np.asarray(a), np.asarray(b)
+        assert b.shape[0] == 2
+        np.testing.assert_allclose(b, np.broadcast_to(a.mean(0), b.shape),
+                                   rtol=1e-6)
+    alpha = np.asarray(restored.alpha)
+    np.testing.assert_allclose(alpha, np.full(2, 0.5))
+
+
+# -------------------------------------------------------------------------
+# end-to-end: elastic supervision (shrink and grow, BSP and ZeRO-1)
+# -------------------------------------------------------------------------
+
+
+def _run_elastic(tmp_path, faults, start_devices, zero=0):
+    kw = dict(_TINY)
+    if zero:
+        kw["zero"] = zero
+    return supervise_training(
+        ckpt_dir=str(tmp_path / "sup"), obs_dir=str(tmp_path / "obs"),
+        max_retries=2, backoff_base=0.0, elastic=True,
+        devices=start_devices, inject_faults=list(faults), **kw,
+    )
+
+
+def _elastic_faults(a, b):
+    """The fault script, expected per-attempt topology, and expected
+    reshard (from, to) sequence for an a->b elastic reshard. Shrink is
+    one fault; a GROW cannot outrun the operator's requested device cap
+    (``_probe_world``: growth never exceeds ``devices``), so it is
+    provoked by first shrinking BELOW the requested count — shrink@1
+    kills attempt 1 at step 0 (its crash checkpoint reshards DOWN onto
+    the small world for attempt 2), then grow@3 reshards the
+    small-world checkpoint back up to the requested budget."""
+    if b < a:
+        return [f"shrink@3:{b}"], [a, b], [(a, b)]
+    return ([f"shrink@1:{a}", f"grow@3:{b}"], [b, a, b],
+            [(b, a), (a, b)])
+
+
+def _check_obs(tmp_path, topo_worlds, reshards):
+    from theanompi_tpu.tools.check_obs_schema import check_file
+
+    sup_log = tmp_path / "obs" / "supervisor.jsonl"
+    recs = [json.loads(l) for l in sup_log.read_text().splitlines()]
+    assert check_file(str(sup_log)) == []
+    topo = [r for r in recs if r["kind"] == "topology"]
+    assert [t["world"] for t in topo] == list(topo_worlds)
+    for prev, t in zip(topo_worlds, topo[1:]):
+        assert t["prev_world"] == prev
+    # each failed attempt's retry record carries THAT attempt's world
+    retry = [r for r in recs if r["kind"] == "retry"]
+    assert [r["world"] for r in retry] == list(topo_worlds[:-1])
+    mlog = tmp_path / "obs" / "metrics.jsonl"
+    mrecs = [json.loads(l) for l in mlog.read_text().splitlines()]
+    assert check_file(str(mlog)) == []
+    reshard = [r for r in mrecs if r.get("kind") == "reshard"]
+    assert [(r["from_world"], r["to_world"]) for r in reshard] == \
+        list(reshards)
+    assert all(r["seconds"] >= 0 for r in reshard)
+    snaps = [r for r in mrecs if r.get("kind") == "metrics"
+             and "tmpi_reshard_seconds" in r.get("metrics", {})]
+    assert snaps, "tmpi_reshard_seconds gauge never snapshotted"
+    # the counter is attempt-local (each attempt is a fresh registry,
+    # like a process restart); every successful final attempt did
+    # exactly one reshard — the JSONL record sequence above is the
+    # cross-attempt history
+    assert snaps[-1]["metrics"]["tmpi_reshards_total"] == 1.0
+
+
+@pytest.mark.parametrize("worlds", [(4, 2), (2, 4)])
+def test_elastic_supervisor_bsp_topology_change_parity(tmp_path, worlds):
+    """Acceptance: a run checkpointed at world A, killed by a topology
+    fault, auto-resumes under supervise_training(elastic=True) at world
+    B and finishes at parity with an uninterrupted 4-device baseline
+    (BSP's global batch is mesh-invariant, so only float reduction
+    order may differ)."""
+    a, b = worlds
+    clean = run_training(ckpt_dir=str(tmp_path / "clean"), devices=4,
+                         **_TINY)
+    faults, topo_worlds, reshards = _elastic_faults(a, b)
+    sup = _run_elastic(tmp_path, faults, max(worlds))
+    assert sup["retries"] == len(topo_worlds) - 1
+    assert sup["attempts"] == len(topo_worlds)
+    assert sup["steps"] == clean["steps"] == 6
+    assert sup["resharded_from_world"] == a
+    assert sup["resharded_to_world"] == b
+    _assert_parity(str(tmp_path / "clean"), str(tmp_path / "sup"))
+    _check_obs(tmp_path, topo_worlds, reshards)
+
+
+@pytest.mark.parametrize("worlds", [(4, 2), (2, 4)])
+def test_elastic_supervisor_zero1_topology_change_parity(tmp_path, worlds):
+    """Same acceptance for ZeRO-1 — the sharded-optimizer hard case:
+    the resharded accumulators must continue the SAME Adam/momentum
+    trajectory (parity with the uninterrupted baseline), not restart."""
+    a, b = worlds
+    clean = run_training(ckpt_dir=str(tmp_path / "clean"), devices=4,
+                         zero=1, **_TINY)
+    faults, topo_worlds, reshards = _elastic_faults(a, b)
+    sup = _run_elastic(tmp_path, faults, max(worlds), zero=1)
+    assert sup["steps"] == clean["steps"] == 6
+    assert sup["resharded_from_world"] == a
+    _assert_parity(str(tmp_path / "clean"), str(tmp_path / "sup"))
+    _check_obs(tmp_path, topo_worlds, reshards)
+
+
+def test_elastic_sharded_set_supervised_resume(tmp_path):
+    """The sharded-checkpoint elastic path end-to-end: per-host shard
+    files reshard 4->2 under the supervisor with parity intact (this is
+    the format the no-full-materialization guarantee applies to)."""
+    clean = run_training(ckpt_dir=str(tmp_path / "clean"), devices=4,
+                         sharded_ckpt=True, **_TINY)
+    kw = dict(_TINY)
+    sup = supervise_training(
+        ckpt_dir=str(tmp_path / "sup"), obs_dir=str(tmp_path / "obs"),
+        max_retries=2, backoff_base=0.0, elastic=True, devices=4,
+        sharded_ckpt=True, inject_faults=["shrink@3:2"], **kw,
+    )
+    assert sup["steps"] == clean["steps"] == 6
+    assert sup["resharded_to_world"] == 2
+    _assert_parity(str(tmp_path / "clean"), str(tmp_path / "sup"))
+
+
+def test_elastic_lr_scale_linear_rescales_schedule(tmp_path, capsys):
+    """elastic_lr_scale='linear' scales the recipe's base LR by
+    n_new/n_old on the resharded attempt (and leaves same-world resumes
+    alone)."""
+    run_training(ckpt_dir=str(tmp_path / "ck"), devices=4, n_epochs=1,
+                 **{k: v for k, v in _TINY.items() if k != "n_epochs"})
+    out = run_training(ckpt_dir=str(tmp_path / "ck"), devices=2,
+                       resume=True, elastic=True, elastic_lr_scale="linear",
+                       n_epochs=2,
+                       **{k: v for k, v in _TINY.items() if k != "n_epochs"})
+    assert out["resharded_from_world"] == 4
+    assert "linear LR rescale" in capsys.readouterr().out
+    # the resumed run trains at half the base LR: its post-resume step
+    # must differ from a no-rescale elastic resume
+    run_training(ckpt_dir=str(tmp_path / "ck2"), devices=4, n_epochs=1,
+                 **{k: v for k, v in _TINY.items() if k != "n_epochs"})
+    out2 = run_training(ckpt_dir=str(tmp_path / "ck2"), devices=2,
+                        resume=True, elastic=True, n_epochs=2,
+                        **{k: v for k, v in _TINY.items()
+                           if k != "n_epochs"})
+    assert out2.get("resharded_from_world") == 4
+    _, la = _final_params(str(tmp_path / "ck"))
+    _, lb = _final_params(str(tmp_path / "ck2"))
+    assert any(not np.array_equal(la[k], lb[k]) for k in la)
+
+
+def test_elastic_lr_scale_anchors_to_base_world(tmp_path, capsys):
+    """The linear LR scale anchors to the run's ORIGINAL world, carried
+    through every manifest as ``elastic.base_world`` — NOT to the
+    resumed checkpoint's own world. A second resume at the already-
+    shrunk world must re-apply the same 4->2 scale; anchoring to the
+    post-reshard checkpoint (stamped world 2) would silently revert the
+    LR to the unscaled base mid-run."""
+    kw = {k: v for k, v in _TINY.items() if k != "n_epochs"}
+    run_training(ckpt_dir=str(tmp_path / "ck"), devices=4, n_epochs=1, **kw)
+    out = run_training(ckpt_dir=str(tmp_path / "ck"), devices=2,
+                       resume=True, elastic=True,
+                       elastic_lr_scale="linear", n_epochs=2, **kw)
+    assert out["resharded_from_world"] == 4
+    assert "world 4 -> 2" in capsys.readouterr().out
+    # the post-reshard checkpoint is stamped with the NEW world but
+    # keeps forwarding the original anchor
+    m = read_topology_manifest(
+        latest_checkpoint(str(tmp_path / "ck"), verify=True))
+    assert m["mesh"]["shape"] == [2]
+    assert m["elastic"]["base_world"] == 4
+    # same-world resume of the shrunk run: plain load (no reshard), but
+    # the 2/4 scale re-applies against the anchor
+    out2 = run_training(ckpt_dir=str(tmp_path / "ck"), devices=2,
+                        resume=True, elastic=True,
+                        elastic_lr_scale="linear", n_epochs=3, **kw)
+    assert "resharded_from_world" not in out2
+    assert "world 4 -> 2" in capsys.readouterr().out
+
+
+def test_elastic_lr_scale_device_list_target(tmp_path, capsys):
+    """An explicit device LIST pins the LR-rescale target world to the
+    mesh actually built over it: resuming a world-4 checkpoint on a
+    2-device list (with more devices live on the host) scales by 2/4 —
+    probing all live devices here would scale by the wrong ratio."""
+    assert len(jax.devices()) > 2
+    kw = {k: v for k, v in _TINY.items() if k != "n_epochs"}
+    run_training(ckpt_dir=str(tmp_path / "ck"), devices=4, n_epochs=1, **kw)
+    out = run_training(ckpt_dir=str(tmp_path / "ck"),
+                       devices=list(jax.devices())[:2], resume=True,
+                       elastic=True, elastic_lr_scale="linear",
+                       n_epochs=2, **kw)
+    assert out["resharded_from_world"] == 4
+    assert out["resharded_to_world"] == 2
+    assert "world 4 -> 2" in capsys.readouterr().out
+
+
+def test_load_resharded_validates_stamped_leaf_set(tmp_path):
+    """The manifest's per-leaf block is load-bearing for the plan: a
+    target template with a source-reading leaf the save never stamped
+    fails up front naming the leaf, while readless-policy leaves
+    (reset/worker_uniform) may legitimately appear fresh in the
+    target."""
+    m4, m2 = make_mesh(4), make_mesh(2)
+    state = {"a": jnp.arange(8.0)}
+    template = {"a": jnp.zeros(8), "extra": jnp.zeros(3)}
+    path = save_checkpoint(
+        str(tmp_path / "p1"), state, 1,
+        topology={"mesh": mesh_topology(m4), "elastic": {}})
+    with pytest.raises(ValueError, match="never stamped.*extra"):
+        load_resharded(path, template, m2)
+    # the same fresh leaf under a readless policy reshards fine
+    path2 = save_checkpoint(
+        str(tmp_path / "p2"), state, 1,
+        topology={"mesh": mesh_topology(m4),
+                  "elastic": {"policies": {"extra": {"policy": "reset"}}}})
+    restored, _, info = load_resharded(path2, template, m2)
+    assert info["resharded"] is True
+    np.testing.assert_array_equal(np.asarray(restored["extra"]),
+                                  np.zeros(3))
+    np.testing.assert_array_equal(np.asarray(restored["a"]),
+                                  np.arange(8.0))

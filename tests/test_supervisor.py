@@ -116,6 +116,38 @@ def test_supervisor_walks_back_past_truncated_checkpoint(tmp_path):
     assert recs[0]["step"] == 2  # resumed from the VERIFIED step, not 4
 
 
+def test_supervisor_elastic_same_mesh_resume_bit_identical(tmp_path):
+    """Elastic mode must cost NOTHING when the topology does not
+    change: a crash-retry under elastic=True on the same world loads
+    the plain (non-reshard) path and stays bit-identical to an
+    uninterrupted run — while supervisor.jsonl gains the topology
+    records and the world-stamped retry."""
+    clean = run_training(ckpt_dir=str(tmp_path / "clean"), **_TINY)
+    sup = supervise_training(
+        ckpt_dir=str(tmp_path / "sup"), obs_dir=str(tmp_path / "obs"),
+        max_retries=2, backoff_base=0.0, elastic=True,
+        inject_faults=["crash@3"], **_TINY,
+    )
+    assert sup["retries"] == 1 and sup["steps"] == clean["steps"] == 4
+    assert "resharded_from_world" not in sup  # same mesh: no reshard
+    _assert_bit_identical(str(tmp_path / "clean"), str(tmp_path / "sup"))
+    from theanompi_tpu.tools.check_obs_schema import check_file
+
+    sup_log = tmp_path / "obs" / "supervisor.jsonl"
+    assert check_file(str(sup_log)) == []
+    recs = [json.loads(l) for l in sup_log.read_text().splitlines()]
+    topo = [r for r in recs if r["kind"] == "topology"]
+    assert [t["world"] for t in topo] == [8, 8]  # one per attempt
+    retry = [r for r in recs if r["kind"] == "retry"]
+    assert retry[0]["world"] == 8
+    # no reshard record: the same-mesh load is the bit-identical path
+    mlog = tmp_path / "obs" / "metrics.jsonl"
+    assert not any(
+        json.loads(l).get("kind") == "reshard"
+        for l in mlog.read_text().splitlines()
+    )
+
+
 def test_supervisor_exhausts_retries_and_raises(tmp_path):
     from theanompi_tpu.utils.faults import InjectedCrash
 

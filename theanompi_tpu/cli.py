@@ -210,6 +210,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--retry-backoff", type=float, default=1.0,
                    help="supervisor backoff base in seconds: retry k "
                         "sleeps base * 2**(k-1), capped at 60s")
+    p.add_argument("--elastic", action="store_true",
+                   help="elastic world size (launch/supervisor.py + "
+                        "utils/checkpoint.load_resharded): with "
+                        "--max-retries, every retry re-probes the live "
+                        "device world and RESHARDS the newest verified "
+                        "checkpoint onto the new mesh instead of dying "
+                        "on a topology change (n_devices acts as a "
+                        "cap); with --resume alone, one-shot: resume a "
+                        "checkpoint saved under a different topology "
+                        "onto the current mesh (e.g. train-on-pod -> "
+                        "serve-on-one-chip handoff). Requires "
+                        "--ckpt-dir; checkpoints are always stamped "
+                        "with their topology manifest, elastic or not")
+    p.add_argument("--elastic-lr-scale", choices=["none", "linear"],
+                   default="none",
+                   help="with --elastic: rescale the recipe's base LR "
+                        "by n_new/n_old on a world change (linear "
+                        "scaling rule — meant for the per-worker-batch "
+                        "rules whose GLOBAL batch grows with the "
+                        "world; BSP's global batch is mesh-invariant, "
+                        "so 'none' keeps its trajectory comparable)")
     p.add_argument("--sigterm-grace", type=float, default=0.0,
                    help="preemption grace window in seconds: > 0 "
                         "installs a SIGTERM handler that checkpoints, "
@@ -391,6 +412,11 @@ def main(argv=None) -> int:
     if args.max_retries and not args.ckpt_dir:
         raise SystemExit("--max-retries requires --ckpt-dir (retries "
                          "auto-resume from the newest verified checkpoint)")
+    if args.elastic and not args.ckpt_dir:
+        raise SystemExit("--elastic requires --ckpt-dir (an elastic "
+                         "resume reshards a checkpoint; without one "
+                         "there is nothing to carry across the "
+                         "topology change)")
     if args.sigterm_grace and not args.ckpt_dir:
         # without a ckpt dir the grace path has nothing to save and no
         # marker to drop — exiting 75/"resumable" would promise a
@@ -409,6 +435,10 @@ def main(argv=None) -> int:
                 backoff_base=args.retry_backoff,
                 **kw,
             )
+        # elastic binds to the SUPERVISOR's kwarg (it re-probes the
+        # world per attempt and forwards elastic=True to run_training
+        # itself); the unsupervised branch below hands it straight to
+        # run_training for the one-shot reshard-resume case
     else:
         _run = run_training
 
@@ -456,6 +486,8 @@ def main(argv=None) -> int:
             rollback_skip=args.rollback_skip,
             sigterm_grace=args.sigterm_grace,
             inject_faults=args.inject_fault or None,
+            elastic=args.elastic,
+            elastic_lr_scale=args.elastic_lr_scale,
             **rule_kwargs,
         )
     except _Preempted as e:

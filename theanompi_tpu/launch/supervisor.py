@@ -29,11 +29,30 @@ contract a production run needs:
   override an explicit stop-the-run policy, so it propagates.
   ``KeyboardInterrupt``/``SystemExit`` likewise.
 
+- **Elastic world size** (``elastic=True`` / ``tmpi --elastic``): the
+  reference's process grid was fixed at launch — losing or gaining a
+  device killed the run even with a good checkpoint on disk. In elastic
+  mode every attempt RE-PROBES the live device world (deterministically:
+  the enumeration is sorted before anything is derived from it — the
+  cross-rank reshard plan must be identical on every controller) and
+  passes the probed size to ``run_training(elastic=True)``, whose
+  resume path reshards the newest verified checkpoint onto the new mesh
+  (``utils/checkpoint.load_resharded``: topology-stamped manifests +
+  bounds-based transfer plan, arXiv:2112.01075 style). A topology
+  change is thereby one retry, not a dead run. Fault injection covers
+  it end-to-end: ``--inject-fault shrink@K:W`` / ``grow@K:W`` kill the
+  attempt with :class:`~theanompi_tpu.utils.faults.TopologyChanged`
+  and pin the probed world to W for the rest of the supervised run.
+
 Telemetry rides the existing obs stack: one ``kind=retry`` JSONL record
 per failed/preempted attempt in ``<obs_dir>/supervisor.jsonl`` (schema:
-tools/check_obs_schema.py) and a final ``kind=metrics`` snapshot line
+tools/check_obs_schema.py) — carrying the attempt's ``world`` size so
+the log shows topology across retries — plus one ``kind=topology``
+record per elastic attempt, and a final ``kind=metrics`` snapshot line
 carrying ``tmpi_retries_total`` / ``tmpi_preempt_resumes_total``
-appended to ``<obs_dir>/metrics.jsonl``.
+appended to ``<obs_dir>/metrics.jsonl``. The reshard itself (when one
+happens) is recorded by the worker: a ``kind=reshard`` record and the
+``tmpi_reshard_seconds`` gauge in the obs metrics stream.
 """
 
 from __future__ import annotations
@@ -70,13 +89,30 @@ class _SupervisorLog:
             f.write(json.dumps(rec) + "\n")
 
     def retry(self, attempt: int, step: int, error: BaseException,
-              backoff_s: float, resumable: bool = False) -> None:
-        self._append("supervisor.jsonl", {
+              backoff_s: float, resumable: bool = False,
+              world: Optional[int] = None) -> None:
+        rec = {
             "kind": "retry", "rank": self.rank, "t": time.time(),
             "attempt": int(attempt), "step": int(step),
             "error": repr(error), "backoff_s": float(backoff_s),
             "resumable": bool(resumable),
-        })
+        }
+        if world is not None:
+            # the attempt's world size: supervisor.jsonl alone shows
+            # the topology trajectory across retries
+            rec["world"] = int(world)
+        self._append("supervisor.jsonl", rec)
+
+    def topology(self, attempt: int, world: int,
+                 prev_world: Optional[int] = None) -> None:
+        """One record per elastic attempt: the device world it runs in
+        (``prev_world`` present from the second attempt on, so a world
+        change reads directly off the pair)."""
+        rec = {"kind": "topology", "rank": self.rank, "t": time.time(),
+               "attempt": int(attempt), "world": int(world)}
+        if prev_world is not None:
+            rec["prev_world"] = int(prev_world)
+        self._append("supervisor.jsonl", rec)
 
     def snapshot(self, retries: int, preempts: int,
                  step: Optional[int] = None) -> None:
@@ -88,6 +124,28 @@ class _SupervisorLog:
         self._append("metrics.jsonl", rec)
 
 
+def _probe_world(requested: Optional[int], injector) -> int:
+    """The device world size the next elastic attempt should run in:
+    the LIVE device count (enumerated deterministically — sorted by
+    (slice, id), the canonical mesh order — before anything is derived
+    from it, so every controller computes the identical value and the
+    reshard transfer plan it gates), capped by what the caller asked
+    for (``requested`` is the operator's budget; growth never exceeds
+    it). A fired shrink/grow fault's ``world_override`` substitutes for
+    the live count in tests — the cap still applies to it."""
+    import jax
+
+    devs = sorted(jax.devices(),
+                  key=lambda d: (getattr(d, "slice_index", 0), d.id))
+    n_live = len(devs)
+    override = None
+    if injector is not None and hasattr(injector, "world_override"):
+        override = injector.world_override()
+    live = override if override is not None else n_live
+    want = min(int(live), int(requested)) if requested else int(live)
+    return max(1, min(n_live, want))
+
+
 def supervise_training(
     *,
     max_retries: int = 2,
@@ -96,6 +154,7 @@ def supervise_training(
     ckpt_dir: Optional[str] = None,
     obs_dir: Optional[str] = None,
     resume: bool = False,
+    elastic: bool = False,
     **run_kwargs: Any,
 ) -> dict:
     """Run :func:`run_training` under the supervisor (module docstring).
@@ -104,6 +163,13 @@ def supervise_training(
     a checkpoint to resume from silently restarts training from scratch,
     which is never what a recovery path should do quietly. All other
     kwargs forward to ``run_training`` unchanged.
+
+    ``elastic=True``: re-probe the device world before every attempt
+    (``requested`` = the caller's ``devices`` count, honored as a cap)
+    and let the resume path reshard the checkpoint onto a changed mesh
+    instead of dying on it — see the module docstring. ``devices`` must
+    be an int or None in elastic mode (an explicit device LIST pins the
+    topology, which is the opposite of elastic).
 
     Returns the successful attempt's summary dict, extended with
     ``retries`` (failed attempts absorbed), ``preempt_resumes``
@@ -127,10 +193,23 @@ def supervise_training(
             run_kwargs["inject_faults"] = FaultInjector(
                 run_kwargs["inject_faults"]
             )
+    injector = run_kwargs.get("inject_faults")
+    requested_world = run_kwargs.get("devices")
+    if elastic:
+        if requested_world is not None and not isinstance(requested_world, int):
+            raise ValueError(
+                "elastic supervision takes devices as a count (or None "
+                "= all live devices) — an explicit device list pins the "
+                "topology the elastic mode exists to renegotiate"
+            )
+        # the worker's resume path must reshard (not die) on a mesh
+        # mismatch against the checkpoint's topology manifest
+        run_kwargs["elastic"] = True
     log = _SupervisorLog(obs_dir)
     retries = 0
     preempts = 0
     attempt = 0
+    world: Optional[int] = None
     if ckpt_dir and read_resumable_marker(ckpt_dir) is not None:
         # a previous invocation was preempted mid-run and checkpointed
         # inside its grace window: auto-resume, no flag needed
@@ -140,6 +219,19 @@ def supervise_training(
               "auto-resuming", flush=True)
     while True:
         attempt += 1
+        if elastic:
+            # re-probe the live world EVERY attempt (sorted enumeration
+            # + injected-fault override; see _probe_world) and record it
+            # — the attempt may run in a different topology than the one
+            # that just died, and resume reshards onto it
+            new_world = _probe_world(requested_world, injector)
+            log.topology(attempt, new_world, prev_world=world)
+            if world is not None and new_world != world:
+                print(f"[supervisor] elastic: world {world} -> "
+                      f"{new_world} device(s) for attempt {attempt}",
+                      flush=True)
+            run_kwargs["devices"] = new_world
+            world = new_world
         if ckpt_dir:
             # consumed: if THIS attempt is preempted too it rewrites it
             clear_resumable_marker(ckpt_dir)
@@ -152,7 +244,7 @@ def supervise_training(
             # worker. Do NOT resume in-process — SIGTERM means the kill
             # is imminent; record the attempt and let the exit happen.
             # The next supervise_training() sees the marker and resumes.
-            log.retry(attempt, e.step, e, 0.0, resumable=True)
+            log.retry(attempt, e.step, e, 0.0, resumable=True, world=world)
             log.snapshot(retries, preempts, step=e.step)
             raise
         except NumericsAnomaly:
@@ -171,12 +263,12 @@ def supervise_training(
             path = latest_checkpoint(ckpt_dir, verify=True) if ckpt_dir else None
             step = checkpoint_step(path)
             if retries > max_retries:
-                log.retry(attempt, step, e, 0.0)
+                log.retry(attempt, step, e, 0.0, world=world)
                 log.snapshot(retries, preempts)
                 raise
             backoff = min(float(backoff_max),
                           float(backoff_base) * (2 ** (retries - 1)))
-            log.retry(attempt, step, e, backoff)
+            log.retry(attempt, step, e, backoff, world=world)
             print(
                 f"[supervisor] attempt {attempt} failed ({e!r}); retry "
                 f"{retries}/{max_retries} resumes from "

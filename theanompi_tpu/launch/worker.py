@@ -205,6 +205,18 @@ def run_training(
     # re-poison every attempt)
     rollback_budget: int = 2,
     rollback_skip: int = 1,
+    # elastic world size (elastic PR): resume may land on a DIFFERENT
+    # mesh than the checkpoint was saved under — instead of dying on
+    # the shape/sharding mismatch, reshard the state onto the current
+    # mesh via the checkpoint's topology manifest
+    # (utils/checkpoint.load_resharded). Per-replica batch rescales
+    # implicitly (the BSP global batch is mesh-invariant);
+    # elastic_lr_scale='linear' additionally scales the recipe's base
+    # LR by n_new/n_saved (the per-worker-batch rules grow their
+    # GLOBAL batch with the world, where linear scaling is the
+    # standard correction; default 'none' leaves the schedule alone).
+    elastic: bool = False,
+    elastic_lr_scale: str = "none",
     # SIGTERM grace (preemption): > 0 installs a handler; the train
     # loop then checkpoints, marks the run resumable, and exits cleanly
     # (Preempted) instead of dying mid-step
@@ -242,6 +254,60 @@ def run_training(
     recipe = model_cls.default_recipe()
     if recipe_overrides:
         recipe = recipe.replace(**recipe_overrides)
+    if elastic_lr_scale not in ("none", "linear"):
+        raise ValueError(
+            f"elastic_lr_scale must be 'none' or 'linear', "
+            f"got {elastic_lr_scale!r}"
+        )
+    # Elastic resume: peek the newest verified checkpoint's topology
+    # manifest BEFORE the model/engine build — the saved world size
+    # drives the LR-rescale hook (and nothing else; the reshard itself
+    # happens against the live state template at resume time below).
+    saved_world = None
+    # The LR-rescale anchor: the world size the run's base LR was tuned
+    # for. Forwarded through every manifest as elastic.base_world so the
+    # scale stays n_target/base across ANY number of reshard/resume
+    # cycles — anchoring to the resumed checkpoint's own world instead
+    # would silently drop the scale after the first post-reshard save
+    # (that checkpoint is stamped with the NEW world).
+    base_world = None
+    # Peek on EVERY resume (not just elastic ones): a plain --resume in
+    # the middle of an elastic sequence must keep forwarding the
+    # original anchor, or the next elastic resume rescales against the
+    # wrong base.
+    if resume and ckpt_dir:
+        from theanompi_tpu.utils.checkpoint import read_topology_manifest
+
+        _peek = latest_checkpoint(ckpt_dir, verify=True)
+        _manifest = read_topology_manifest(_peek) if _peek else None
+        if _manifest and _manifest.get("mesh"):
+            saved_world = int(np.prod(_manifest["mesh"]["shape"]))
+            base_world = int(
+                (_manifest.get("elastic") or {}).get("base_world")
+                or saved_world
+            )
+    if elastic and saved_world and elastic_lr_scale == "linear":
+        # deterministic probe (sorted device enumeration) shared with
+        # the supervisor — the scale must be rank-uniform
+        from theanompi_tpu.launch.supervisor import _probe_world
+
+        if isinstance(devices, int) and devices:
+            _n_target = devices
+        elif devices is not None:
+            # explicit device list: the mesh below is built over exactly
+            # these (make_mesh supports lists) — probing ALL live
+            # devices here would scale the LR by the wrong ratio
+            _n_target = len(devices)
+        else:
+            _n_target = _probe_world(None, None)
+        if _n_target != base_world and "lr" in (recipe.sched_kwargs or {}):
+            _sk = dict(recipe.sched_kwargs)
+            _sk["lr"] = float(_sk["lr"]) * _n_target / base_world
+            recipe = recipe.replace(sched_kwargs=_sk)
+            print(
+                f"[elastic] linear LR rescale: world {base_world} -> "
+                f"{_n_target}, base lr now {_sk['lr']:g}", flush=True,
+            )
     if (
         rule.lower() in ("easgd", "gosgd")
         and int(rule_kwargs.get("group_size", 1)) > 1
@@ -548,6 +614,26 @@ def run_training(
             accum_steps=accum_steps, wire_codec=codec, **rule_kwargs,
         )
 
+    # Topology stamp for every checkpoint this run writes (elastic PR):
+    # the ENGINE's mesh identity (EASGD/GoSGD group mode reshapes the
+    # driver mesh internally) + the engine's per-leaf elastic reshard
+    # policies — what load_resharded needs to move the checkpoint onto
+    # a different world later. Stamping is unconditional and cheap (a
+    # small JSON entry per save); elasticity is an attribute of the
+    # RESUME, not the save.
+    from theanompi_tpu.parallel.mesh import mesh_topology
+
+    topo_meta = {"mesh": mesh_topology(getattr(engine, "mesh", mesh))}
+    _espec = getattr(engine, "elastic_spec", None)
+    if _espec is not None:
+        topo_meta["elastic"] = _espec()
+    # Forward the run's LR-scale anchor (see base_world above): resumed
+    # runs keep the ORIGINAL world; fresh runs anchor to the world they
+    # launch on.
+    topo_meta.setdefault("elastic", {})["base_world"] = int(
+        base_world or getattr(engine, "mesh", mesh).devices.size
+    )
+
     # Multi-controller: this host produces only its slice of every
     # global batch (reference: per-rank loader feed, lib/proc_load_mpi.py)
     n_proc = jax.process_count()
@@ -588,6 +674,9 @@ def run_training(
     state = engine.init_state(rng)
     start_epoch = 0
     summary_resumed_from = None
+    # set when an elastic resume actually resharded: the obs facade is
+    # built later, so the reshard record/metrics are emitted then
+    pending_reshard = None
     # data batches skipped by anomaly rollbacks in this training
     # timeline (restored from checkpoint meta on resume): every replay
     # position below must count BATCHES CONSUMED = step + skipped, or a
@@ -662,8 +751,41 @@ def run_training(
                     f"{layout_meta['pipeline_layout']} — rerun with the "
                     "matching --pp/--pp-interleave"
                 )
-            restored, saved_rng = load_checkpoint(path, state)
-            state = _place_restored(restored)
+            if elastic:
+                # mesh-portable restore: same saved/live topology loads
+                # exactly like the plain path (bit-identical resume); a
+                # topology mismatch reshards each leaf onto the live
+                # mesh under the manifest's elastic policies —
+                # returning device-placed global arrays directly (the
+                # sharded-set path never assembles a full array here)
+                from theanompi_tpu.utils.checkpoint import load_resharded
+
+                _t0 = time.perf_counter()
+                restored, saved_rng, rs_info = load_resharded(
+                    path, state, getattr(engine, "mesh", mesh)
+                )
+                if rs_info["resharded"]:
+                    state = restored
+                    pending_reshard = {
+                        "step": engine.get_step(state),
+                        "from_world": rs_info["from_world"],
+                        "to_world": rs_info["to_world"],
+                        "seconds": time.perf_counter() - _t0,
+                        "leaves": rs_info["leaves"],
+                        "per_replica_batch": batch // n_dev,
+                    }
+                    print(
+                        f"[elastic] resharded {path} onto the live mesh: "
+                        f"world {rs_info['from_world']} -> "
+                        f"{rs_info['to_world']}, {rs_info['leaves']} "
+                        f"leaves, per-replica batch {batch // n_dev}",
+                        flush=True,
+                    )
+                else:
+                    state = _place_restored(restored)
+            else:
+                restored, saved_rng = load_checkpoint(path, state)
+                state = _place_restored(restored)
             if saved_rng is not None:
                 # already wrapped with the impl that wrote it — a
                 # pre-rbg-default threefry checkpoint keeps resuming
@@ -756,6 +878,12 @@ def run_training(
         flight_window=flight_window,
         on_anomaly=on_anomaly,
     )
+    if pending_reshard is not None:
+        # the reshard ran before the obs facade existed; emit its
+        # kind=reshard record + tmpi_reshard_* metrics now
+        obs.note_reshard(**pending_reshard)
+        summary["resharded_from_world"] = pending_reshard["from_world"]
+        summary["resharded_to_world"] = pending_reshard["to_world"]
     if obs.enabled:
         # bracket delegation: timing histograms into the obs registry,
         # wait/step/comm brackets doubling as trace spans
@@ -785,7 +913,8 @@ def run_training(
         # anomalous step's params/opt state, NaNs and all); closure
         # reads the CURRENT state/step — the dump happens at drain
         # time, on the driver thread
-        sync_save(dump_dir, state, step_count, rng=rng, keep=1)
+        sync_save(dump_dir, state, step_count, rng=rng, keep=1,
+                  topology=topo_meta)
 
     obs.set_flight_state_saver(_flight_state_saver)
     from theanompi_tpu.utils.dispatch import MetricsDispatcher
@@ -1105,10 +1234,11 @@ def run_training(
                     # bracket times only the enqueue; the real write is
                     # spanned inside utils/checkpoint.py on its thread
                     ckpt_writer.save(ckpt_dir, state, step_count, rng=rng,
-                                     extra_meta=_save_meta())
+                                     extra_meta=_save_meta(),
+                                     topology=topo_meta)
                 else:
                     sync_save(ckpt_dir, state, step_count, rng=rng,
-                              extra_meta=_save_meta())
+                              extra_meta=_save_meta(), topology=topo_meta)
                 rec.end("checkpoint")
                 last_ckpt_step = step_count
                 if faults is not None and faults.truncate_due(step_count):
@@ -1246,7 +1376,8 @@ def run_training(
                     # point, and the marker below records it
                     try:
                         sync_save(ckpt_dir, state, step_count, rng=rng,
-                                  extra_meta=_save_meta())
+                                  extra_meta=_save_meta(),
+                                  topology=topo_meta)
                         last_ckpt_step = step_count
                     except Exception as e:  # noqa: BLE001
                         print(f"final preemption checkpoint failed "
@@ -1316,7 +1447,7 @@ def run_training(
                     if ckpt_writer is not None:
                         ckpt_writer.wait()
                     sync_save(ckpt_dir, state, step_count, rng=rng,
-                              extra_meta=_save_meta())
+                              extra_meta=_save_meta(), topology=topo_meta)
                     last_ckpt_step = step_count
                     print(
                         f"[rank {jax.process_index()}] crash checkpoint "

@@ -41,7 +41,13 @@ On-disk layout under ``obs_dir`` (schemas:
                             the tmpi_comm_raw_bytes_per_step /
                             tmpi_comm_compression_ratio /
                             tmpi_comm_gbps_raw gauges next to the
-                            effective tmpi_comm_* family
+                            effective tmpi_comm_* family; an elastic
+                            resume that resharded a checkpoint onto a
+                            changed mesh adds one kind=reshard record
+                            (from_world/to_world, wall seconds, leaf
+                            count, per-replica batch) next to the
+                            tmpi_reshard_seconds / tmpi_reshards_total
+                            gauges
     metrics.prom            rank-0 Prometheus text exposition (atomic)
     spans_rank{r}.jsonl     per-rank span + span_summary lines
     heartbeat_rank{r}.json  per-rank liveness (atomic rewrite; carries
@@ -64,8 +70,13 @@ On-disk layout under ``obs_dir`` (schemas:
                             (launch/supervisor.py): one per failed or
                             preempted attempt — attempt index, the
                             verified resume-from step, the error, the
-                            backoff applied; the supervisor also
-                            appends a final kind=metrics snapshot
+                            backoff applied, and the attempt's device
+                            world size; elastic supervision adds one
+                            kind=topology record per attempt (world +
+                            prev_world: the probed device count each
+                            attempt ran in, so the file alone shows
+                            topology across retries); the supervisor
+                            also appends a final kind=metrics snapshot
                             (source="supervisor") carrying
                             tmpi_retries_total to metrics.jsonl
     serve.jsonl             serving engine telemetry (serve/engine.py,
@@ -392,6 +403,46 @@ class Observability:
                 f"({len(anomalies)} trigger(s); triage bundle: "
                 f"{self.flight.dir if self.flight else 'no obs_dir'})"
             )
+
+    def note_reshard(self, step: int, from_world: int, to_world: int,
+                     seconds: float, leaves: int,
+                     per_replica_batch: Optional[int] = None) -> None:
+        """Driver hook (elastic resume, launch/worker.py): one
+        checkpoint was resharded onto a different mesh. Sets the
+        ``tmpi_reshard_seconds`` gauge, counts ``tmpi_reshards_total``,
+        and writes a ``kind=reshard`` JSONL record into metrics.jsonl
+        (rank 0) — the per-run proof line the elastic acceptance test
+        reads back."""
+        if self.enabled:
+            self.registry.gauge(
+                "tmpi_reshard_seconds",
+                help="wall seconds of the last checkpoint reshard "
+                     "(elastic resume, utils/checkpoint.load_resharded)",
+            ).set(float(seconds))
+            self.registry.gauge(
+                "tmpi_reshard_world",
+                help="device world size after the last elastic reshard",
+            ).set(int(to_world))
+            self.registry.counter(
+                "tmpi_reshards_total",
+                help="checkpoints resharded onto a changed mesh "
+                     "(elastic resume)",
+            ).inc()
+        import json as _json
+        import time as _time
+
+        line = {"kind": "reshard", "rank": self.rank, "t": _time.time(),
+                "step": int(step), "from_world": int(from_world),
+                "to_world": int(to_world), "seconds": float(seconds),
+                "leaves": int(leaves)}
+        if per_replica_batch is not None:
+            line["per_replica_batch"] = int(per_replica_batch)
+        if self._metrics_f is not None and not self._closed:
+            self._metrics_f.write(_json.dumps(line) + "\n")
+            self._metrics_f.flush()
+        else:
+            print(f"[rank {self.rank}] elastic reshard: {line}",
+                  file=sys.stderr, flush=True)
 
     def note_rollback(self, anomaly_step: int, restore_step: int,
                       budget_left: int, skipped: int = 0) -> None:

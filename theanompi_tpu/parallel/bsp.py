@@ -354,6 +354,15 @@ class BSPEngine:
 
         return int(first_local_value(state.step))
 
+    def elastic_spec(self) -> dict:
+        """Per-leaf reshard policies stamped into every checkpoint's
+        topology manifest (utils/checkpoint.load_resharded). BSP state
+        is replicated — mesh-invariant global content, the default
+        ``global`` policy — except the codec's per-device error-feedback
+        residuals, which pair with each device's own quantization
+        history and are meaningless on a different world: reset."""
+        return {"policies": {".ef": {"policy": "reset"}}}
+
     def traffic_model(self, state):
         """Analytic per-step wire volume of this engine's gradient
         allreduce (obs/comm.py): the in-step psum/ring over the data

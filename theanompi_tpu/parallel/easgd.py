@@ -323,6 +323,21 @@ class EASGDEngine:
 
         return int(first_local_value(state.workers.step))
 
+    def elastic_spec(self) -> dict:
+        """Per-leaf reshard policies for the topology manifest
+        (utils/checkpoint.load_resharded). The center is replicated
+        (``global``, exact across any world); the per-worker replicas
+        are stacked ``(n_workers, ...)`` so a world change resizes the
+        stack — ``worker_consensus`` re-seeds every new worker from the
+        mean of the saved ones (int leaves like the per-worker step
+        counter take the first worker's value), a parity-preserving
+        approximation of the elastic consensus, not an exact resume.
+        Error-feedback residuals are per-worker and reset."""
+        return {"policies": {
+            ".workers": {"policy": "worker_consensus"},
+            ".ef": {"policy": "reset"},
+        }}
+
     def traffic_model(self, state):
         """EASGD wire model (obs/comm.py): silent local steps (plus the
         group-internal grad psum when workers are chip groups), one

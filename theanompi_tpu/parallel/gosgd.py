@@ -429,6 +429,20 @@ class GOSGDEngine:
 
         return int(first_local_value(state.workers.step))
 
+    def elastic_spec(self) -> dict:
+        """Per-leaf reshard policies for the topology manifest
+        (utils/checkpoint.load_resharded). Worker replicas resize by
+        ``worker_consensus`` (mean over the saved stack — the unweighted
+        stand-in for the alpha-weighted gossip consensus; parity, not
+        exact); the share weights restart uniform at ``1/W`` so the
+        ``sum(alpha) == 1`` mass invariant holds EXACTLY on the new
+        world; error-feedback residuals are per-worker and reset."""
+        return {"policies": {
+            ".workers": {"policy": "worker_consensus"},
+            ".alpha": {"policy": "worker_uniform"},
+            ".ef": {"policy": "reset"},
+        }}
+
     def traffic_model(self, state):
         """GoSGD wire model (obs/comm.py): one ppermute of the packed
         ``(share*w, share)`` buffer per gossip round (every

@@ -215,6 +215,94 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
+# --------------------------------------------------------------------------
+# topology serialization + host-mediated redistribution (elastic PR):
+# checkpoints stamp the mesh/spec metadata these helpers produce, and
+# load_resharded (utils/checkpoint.py) rebuilds per-device shards on a
+# DIFFERENT mesh from it — the collective-based redistribution scheme of
+# "Memory-efficient array redistribution" (arXiv:2112.01075): every host
+# materializes only the shards it owns under a computed transfer plan,
+# never a full array.
+# --------------------------------------------------------------------------
+
+
+def mesh_topology(mesh: Mesh) -> dict:
+    """JSON-serializable identity of a mesh: shape + axis names. Two
+    meshes with equal topology dicts produce identical shard layouts
+    for any PartitionSpec, so a checkpoint stamped with one can load on
+    the other without resharding (bit-identical resume)."""
+    return {
+        "shape": [int(s) for s in mesh.devices.shape],
+        "axes": [str(a) for a in mesh.axis_names],
+    }
+
+
+def spec_to_json(spec) -> Optional[list]:
+    """``PartitionSpec -> per-dim JSON``: each entry is ``None``
+    (replicated dim) or a list of axis names. ``None`` for a non-spec
+    (fully replicated / non-NamedSharding leaf)."""
+    if spec is None:
+        return None
+    out = []
+    for dim in tuple(spec):
+        if dim is None:
+            out.append(None)
+        elif isinstance(dim, str):
+            out.append([dim])
+        else:
+            out.append([str(a) for a in dim])
+    return out
+
+
+def spec_from_json(dims: Optional[list]) -> PartitionSpec:
+    if not dims:
+        return PartitionSpec()
+    return PartitionSpec(*[
+        None if d is None else (d[0] if len(d) == 1 else tuple(d))
+        for d in dims
+    ])
+
+
+def leaf_spec_json(leaf) -> Optional[list]:
+    """The serialized PartitionSpec of one live array leaf, or None when
+    the leaf carries no NamedSharding (host numpy, single-device plain
+    placement) — which a reshard treats as replicated."""
+    sharding = getattr(leaf, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    return spec_to_json(spec) if spec is not None else None
+
+
+def put_resharded(mesh: Mesh, spec: PartitionSpec, shape, dtype, read_fn):
+    """Build a global array on ``mesh`` where each addressable shard's
+    content comes from ``read_fn(bounds)`` (bounds = ((start, stop), ...)
+    in GLOBAL index space). This is the placement half of the
+    arXiv:2112.01075 redistribution: each host materializes only the
+    shards it owns — the cross-host "all-to-all" data movement happens
+    through the shared checkpoint storage the read_fn reads from, so no
+    host ever allocates the full array for a sharded leaf."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    sharding = NamedSharding(mesh, spec)
+
+    def cb(idx):
+        bounds = tuple(
+            sl.indices(dim)[:2] for sl, dim in zip(idx, shape)
+        )
+        return np.asarray(read_fn(bounds), dtype=dtype)
+
+    out = jax.make_array_from_callback(tuple(shape), sharding, cb)
+    # The assembled shards can zero-copy-BORROW their host buffers
+    # (checkpoint views / numpy temporaries) on the CPU backend, and
+    # every engine donates its state into the first train step —
+    # donating a borrowed buffer frees memory XLA does not own, which
+    # surfaces as flaky heap corruption at the next compile. The jitted
+    # per-shard copy re-materializes the array into XLA-owned,
+    # donation-safe buffers; it is sharding-preserving, so still no
+    # full-array gather on any host.
+    return jax.jit(jnp.copy)(out)
+
+
 def batch_sharding(mesh: Mesh, axis: Union[str, tuple, None] = None) -> NamedSharding:
     """Shard the leading (batch) dim across the data axis (1-D mesh) or
     across ALL mesh axes (multi-slice mesh)."""

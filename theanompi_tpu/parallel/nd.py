@@ -544,6 +544,16 @@ class NDEngine:
 
         return int(first_local_value(state.step))
 
+    def elastic_spec(self) -> dict:
+        """Per-leaf reshard policies for the topology manifest
+        (utils/checkpoint.load_resharded). ND params and their
+        like-sharded optimizer accumulators keep mesh-invariant GLOBAL
+        shapes (the sharding divides them, it never pads them), so the
+        default ``global`` bounds-based move is exact for any axis
+        regrouping; only the per-device error-feedback residual stacks
+        are topology-bound and reset."""
+        return {"policies": {".ef": {"policy": "reset"}}}
+
     def traffic_model(self, state):
         """Approximate ND wire model (obs/comm.py): the dp-axis grad
         allreduce over each device's local (1/shard_ways) param slice.

@@ -360,6 +360,30 @@ class ZeroEngine:
 
         return int(first_local_value(state.step))
 
+    def elastic_spec(self) -> dict:
+        """Per-leaf reshard policies for the topology manifest
+        (utils/checkpoint.load_resharded). ZeRO is THE shape-changing
+        case: the flat optimizer accumulators are padded to ``n``
+        equal segments, so their global length is mesh-dependent
+        (``n * ceil(F/n)``) — the ``flat_padded`` policy moves the
+        logical ``F``-element prefix and re-pads for the target world.
+        Params/BN state are replicated (``global``); error-feedback
+        residuals are per-device and reset."""
+        import math
+
+        params_shapes = jax.eval_shape(
+            lambda: self.model.init(jax.random.PRNGKey(0))[0]
+        )
+        flat_size = sum(
+            math.prod(l.shape)
+            for l in jax.tree_util.tree_leaves(params_shapes)
+        )
+        return {"policies": {
+            ".opt_state": {"policy": "flat_padded",
+                           "logical": int(flat_size)},
+            ".ef": {"policy": "reset"},
+        }}
+
     def traffic_model(self, state):
         """ZeRO-1 wire model (obs/comm.py): psum_scatter + all_gather
         over the flat fp32 buffer padded to n segments — same volume as

@@ -17,6 +17,12 @@ rank-divergent values:
   (``os.listdir``/``os.scandir``/``glob.glob``: shared-storage
   ordering is filesystem- and cache-dependent per host — the PR 4
   rollback bug class);
+- device enumeration not wrapped in ``sorted(...)``
+  (``jax.devices()``/``jax.local_devices()``: backend enumeration
+  order is unspecified across processes, and the elastic PR derives
+  the cross-rank reshard transfer plan from the probed world — an
+  unsorted probe gating ``load_resharded``/``put_resharded`` is the
+  PR 8 divergence class);
 - iteration over freshly-built sets (hash order).
 
 SPMD302 flags every unsorted listing outright (any consumer of an
@@ -76,7 +82,19 @@ _SINK_NAMES = {
     "train_step", "fused_train_step", "exchange", "eval_step",
     "save_checkpoint", "save_checkpoint_sharded", "load_checkpoint",
     "psum", "pmean", "all_gather",
+    # elastic PR: the reshard transfer plan is cross-rank gang work —
+    # every controller must compute the identical plan, so a
+    # rank-divergent value gating it is the same bug class as a gated
+    # collective
+    "load_resharded", "put_resharded",
 }
+# device-enumeration calls: order (and, mid-failure, membership) is
+# rank-divergent until pinned by sorted(...)
+_DEVICE_FUNCS = {"devices", "local_devices"}
+# the sources whose divergence is purely ORDERING — these (and only
+# these) are laundered by a lexically-enclosing sorted(...); clock and
+# random reads diverge by VALUE and no sort fixes that
+_ORDERING_FUNCS = _LISTING_FUNCS | _DEVICE_FUNCS
 # engine-protocol calls whose FIRST positional argument is donated
 _DONATING_CALLS = {"train_step", "fused_train_step", "exchange"}
 
@@ -115,6 +133,8 @@ def _is_source_call(node: ast.Call) -> Optional[str]:
         return f"time.{name}()"
     if name in _LISTING_FUNCS and qual in ("os", "glob"):
         return f"{qual}.{name}()"
+    if name in _DEVICE_FUNCS and qual == "jax":
+        return f"jax.{name}()"
     if qual in ("random",) and isinstance(node.func, ast.Attribute):
         return f"random.{name}()"
     if isinstance(node.func, ast.Attribute) and isinstance(
@@ -188,6 +208,19 @@ def rank_divergence_findings(path: str, source: str) -> list:
                 ))
 
     # ---- SPMD301: taint -> gated cross-rank work -------------------------
+    def _src_label(call: ast.Call):
+        """Source label, unless the call is an ORDERING-divergent
+        source (directory listing / device enumeration) lexically under
+        a ``sorted(...)`` — the ordering dependence dies at the sort,
+        exactly as in SPMD302. VALUE-divergent sources (time.*,
+        unseeded random) stay tainted: sorting a clock read does not
+        make it rank-uniform."""
+        lbl = _is_source_call(call)
+        if lbl and _terminal_name(call.func) in _ORDERING_FUNCS \
+                and _inside_sorted(call, parents):
+            return None
+        return lbl
+
     for fn in [n for n in ast.walk(tree)
                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
         tainted: set = set()
@@ -203,7 +236,7 @@ def rank_divergence_findings(path: str, source: str) -> list:
                     src_label = None
                     for sub in ast.walk(value):
                         if isinstance(sub, ast.Call):
-                            src_label = src_label or _is_source_call(sub)
+                            src_label = src_label or _src_label(sub)
                     used = _names_in(value) & tainted
                     if src_label or used:
                         targets = (node.targets if isinstance(
@@ -233,7 +266,7 @@ def rank_divergence_findings(path: str, source: str) -> list:
             test_sources = []
             for sub in ast.walk(node.test):
                 if isinstance(sub, ast.Call):
-                    lbl = _is_source_call(sub)
+                    lbl = _src_label(sub)
                     if lbl:
                         test_sources.append(lbl)
             hit = _names_in(node.test) & tainted

@@ -148,6 +148,37 @@ SCHEMAS: dict[str, dict[str, tuple[tuple, bool]]] = {
         "error": ((str,), True),
         "backoff_s": (_NUM, True),
         "resumable": ((bool,), False),
+        # the attempt's device world size (elastic PR): present on
+        # every elastic-supervised record so supervisor.jsonl alone
+        # shows the topology trajectory across retries
+        "world": ((int,), False),
+    },
+    # elastic supervision (launch/supervisor.py): one record per
+    # attempt — the device world size the attempt was launched in,
+    # probed from the live (sorted) device enumeration; prev_world
+    # appears from the second attempt on, so a topology change reads
+    # directly off the pair
+    "topology": {
+        "rank": ((int,), True),
+        "t": (_NUM, True),
+        "attempt": ((int,), True),
+        "world": ((int,), True),
+        "prev_world": ((int,), False),
+    },
+    # elastic resume (launch/worker.py + utils/checkpoint.py
+    # load_resharded): one record per checkpoint actually resharded
+    # onto a changed mesh — saved vs live world size, the reshard's
+    # wall seconds, how many state leaves moved, and the implied
+    # per-replica batch after the move
+    "reshard": {
+        "rank": ((int,), True),
+        "t": (_NUM, True),
+        "step": ((int,), True),
+        "from_world": ((int,), True),
+        "to_world": ((int,), True),
+        "seconds": (_NUM, True),
+        "leaves": ((int,), False),
+        "per_replica_batch": ((int,), False),
     },
     # anomaly rollback (--on-anomaly rollback, launch/worker.py): one
     # record per restore, written to numerics_rank{r}.jsonl next to the

@@ -39,6 +39,49 @@ _SHARD_RE = re.compile(r"ckpt_(\d+)\.proc(\d+)of(\d+)\.npz$")
 # checkpoint copied anywhere carries its own verification chain
 _INTEGRITY_KEY = "__integrity__"
 
+# versioned topology manifest key (elastic PR): JSON record of the mesh
+# the state was saved under (shape + axis names), the per-leaf
+# PartitionSpecs, and the engine's elastic reshard policies — what
+# :func:`load_resharded` needs to move a checkpoint onto a DIFFERENT
+# mesh without ever materializing a full array on one host. Single-file
+# saves carry it as an .npz entry; per-host sharded saves embed it in
+# their ``__meta__`` JSON.
+_TOPOLOGY_KEY = "__topology__"
+TOPOLOGY_VERSION = 1
+
+
+def _path_key(path) -> str:
+    """Tree path -> the flat '/'-joined leaf key used by every format."""
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+
+
+def _topology_manifest(state: PyTree, topology: Optional[dict]) -> Optional[dict]:
+    """The versioned ``__topology__`` manifest for one save: the caller's
+    mesh identity + elastic policies (``topology`` =
+    ``{"mesh": parallel.mesh.mesh_topology(mesh), "elastic": {...}}``)
+    extended with the per-leaf PartitionSpec of every LIVE leaf (read
+    off the arrays before the host pull — a NamedSharding-less leaf
+    records None = replicated). :func:`load_resharded` validates its
+    transfer plan against the stamped leaf SET (an unstamped leaf in
+    the target template is a structure mismatch); the spec values are
+    for inspection/debugging — the plan's source bounds come from the
+    sharded-set ``__meta__`` catalogues, not from here. None when the
+    caller stamps nothing (API users saving plain host trees keep the
+    pre-elastic format)."""
+    if topology is None:
+        return None
+    from theanompi_tpu.parallel.mesh import leaf_spec_json
+
+    leaves = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        leaves[_path_key(path)] = {"spec": leaf_spec_json(leaf)}
+    return {
+        "version": TOPOLOGY_VERSION,
+        "mesh": topology.get("mesh"),
+        "elastic": topology.get("elastic") or {},
+        "leaves": leaves,
+    }
+
 
 def _array_crc(arr: np.ndarray) -> dict:
     """{crc32, nbytes} of one saved array's raw bytes."""
@@ -84,6 +127,7 @@ def save_checkpoint(
     rng: Optional[jax.Array] = None,
     keep: int = 3,
     extra_meta: Optional[dict] = None,
+    topology: Optional[dict] = None,
 ) -> Optional[str]:
     """Atomically write ``ckpt_{step}.npz``; prune to the newest ``keep``.
     COLLECTIVE in multi-host runs: every process must call it (sharded
@@ -94,9 +138,15 @@ def save_checkpoint(
     readable via :func:`read_checkpoint_meta` — the driver records the
     pipeline stack layout here so a checkpoint copied into a fresh dir
     (without its ``pipeline_layout.json`` sidecar) still refuses to load
-    layer-permuted."""
+    layer-permuted.
+
+    ``topology`` (``{"mesh": mesh_topology(mesh), "elastic": {...}}``)
+    stamps the versioned ``__topology__`` manifest that makes the
+    checkpoint mesh-portable via :func:`load_resharded`; the per-leaf
+    PartitionSpecs are read off the live state before the host pull."""
     from theanompi_tpu.obs.spans import obs_span
 
+    topo = _topology_manifest(state, topology)
     # checkpoint_gather span (obs/spans.py): the device->host gather,
     # the expensive half of a save — runs on whichever thread calls
     # (the AsyncCheckpointer's writer thread under async saves). Named
@@ -104,6 +154,10 @@ def save_checkpoint(
     # not double-count the same wall time under one kind.
     with obs_span("checkpoint_gather"):
         flat = _flatten_with_paths(state)
+    if topo is not None:
+        import json as _json
+
+        flat[_TOPOLOGY_KEY] = np.asarray(_json.dumps(topo))
     if extra_meta:
         import json as _json
 
@@ -185,6 +239,7 @@ def save_checkpoint_sharded(
     rng: Optional[jax.Array] = None,
     keep: int = 3,
     extra_meta: Optional[dict] = None,
+    topology: Optional[dict] = None,
 ) -> Optional[str]:
     """Per-host sharded save: each process writes ONLY the shards it
     holds — no cross-host gather and no rank-0 host-memory spike, unlike
@@ -213,9 +268,14 @@ def save_checkpoint_sharded(
         # every member file carries it: read_checkpoint_meta must work
         # from any process's file under any later process count
         meta["user"] = extra_meta
+    topo = _topology_manifest(state, topology)
+    if topo is not None:
+        # every member carries the full manifest (like "user"): the
+        # reshard plan must be computable from any one member file
+        meta["topology"] = topo
     leaves_with_paths = jax.tree_util.tree_flatten_with_path(state)[0]
     for path, leaf in leaves_with_paths:
-        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        key = _path_key(path)
         if not isinstance(leaf, jax.Array):
             if me == 0:  # host scalars/numpy: rank 0 records them whole
                 arr = np.asarray(leaf)
@@ -588,6 +648,367 @@ _KEY_IMPL_BY_WIDTH = {2: "threefry2x32", 4: "rbg"}
 _KEY_WIDTH_BY_IMPL = {"threefry2x32": 2, "rbg": 4, "unsafe_rbg": 4}
 
 
+# --------------------------------------------------------------------------
+# mesh-portable restore (elastic PR): read the __topology__ manifest and
+# rebuild the state on a DIFFERENT mesh via a computed transfer plan —
+# the collective-based redistribution scheme of "Memory-efficient array
+# redistribution" (arXiv:2112.01075). Each host materializes only the
+# shard regions its target devices own; the cross-host data movement
+# rides the shared checkpoint storage (the npz members double as the
+# all-to-all buffers), so no host ever assembles a full array for a
+# sharded leaf in the per-host sharded-set format.
+# --------------------------------------------------------------------------
+
+
+def read_topology_manifest(path: str) -> Optional[dict]:
+    """The versioned ``__topology__`` manifest stamped at save time, or
+    None for a pre-elastic checkpoint. Filename-dispatched like
+    :func:`load_checkpoint`; any member of a sharded set carries the
+    full manifest."""
+    import json as _json
+
+    data = np.load(path)
+    if _SHARD_RE.search(os.path.basename(path)):
+        meta = _json.loads(str(data["__meta__"]))
+        return meta.get("topology")
+    if _TOPOLOGY_KEY in data.files:
+        return _json.loads(str(data[_TOPOLOGY_KEY]))
+    return None
+
+
+def _intersect(a, b):
+    """Intersection of two ``((start, stop), ...)`` bound tuples, or
+    None when empty along any dim."""
+    out = []
+    for (a0, a1), (b0, b1) in zip(a, b):
+        lo, hi = max(a0, b0), min(a1, b1)
+        if lo >= hi:
+            return None
+        out.append((lo, hi))
+    return tuple(out)
+
+
+class _ShardedSource:
+    """Region reader over a per-host sharded checkpoint set: the member
+    files' ``__meta__`` catalogues record every saved shard's GLOBAL
+    bounds, so any ``(key, bounds)`` region is assembled from exactly
+    the overlapping pieces — never the whole leaf. ``reads`` records the
+    largest single region fetched per key (the no-full-materialization
+    proof hook tests assert on)."""
+
+    def __init__(self, path: str):
+        import json as _json
+
+        m = _SHARD_RE.search(os.path.basename(path))
+        directory = os.path.dirname(path) or "."
+        step = int(m.group(1))
+        files = _sharded_sets(directory).get(step)
+        if files is None:
+            raise FileNotFoundError(
+                f"sharded checkpoint set for step {step} in {directory} "
+                "is incomplete"
+            )
+        self._datas = [np.load(f) for f in files]
+        self._metas = [_json.loads(str(d["__meta__"])) for d in self._datas]
+        # key -> {shape, dtype, pieces: [(bounds, file_idx, array_key)]}
+        self.catalogue: dict[str, Any] = {}
+        for fi, meta in enumerate(self._metas):
+            for key, entry in meta["leaves"].items():
+                cat = self.catalogue.setdefault(
+                    key, {"shape": tuple(entry["shape"]),
+                          "dtype": entry["dtype"], "pieces": []}
+                )
+                for j, sh in enumerate(entry["shards"]):
+                    cat["pieces"].append(
+                        (tuple(tuple(b) for b in sh["bounds"]), fi,
+                         f"{key}::s{j}")
+                    )
+        self._cache: dict = {}
+        self.reads: dict[str, int] = {}
+
+    def shape(self, key):
+        return self.catalogue[key]["shape"]
+
+    def read(self, key: str, bounds) -> np.ndarray:
+        if key not in self.catalogue:
+            raise KeyError(
+                f"sharded checkpoint is missing {key!r} — structure "
+                f"mismatch (available: {sorted(self.catalogue)[:8]}...)"
+            )
+        cat = self.catalogue[key]
+        bounds = tuple(tuple(b) for b in bounds)
+        shape = tuple(hi - lo for lo, hi in bounds)
+        out = np.zeros(shape, dtype=cat["dtype"])
+        want = int(np.prod(shape)) if shape else 1
+        self.reads[key] = max(self.reads.get(key, 0), want)
+        covered = 0
+        for pbounds, fi, akey in cat["pieces"]:
+            inter = _intersect(pbounds, bounds) if bounds else ()
+            if bounds and inter is None:
+                continue
+            piece = self._cache.get((fi, akey))
+            if piece is None:
+                piece = self._cache[(fi, akey)] = self._datas[fi][akey]
+            if not bounds:  # scalar leaf
+                return np.asarray(piece)
+            dst = tuple(slice(lo - b[0], hi - b[0])
+                        for (lo, hi), b in zip(inter, bounds))
+            srcsl = tuple(slice(lo - p[0], hi - p[0])
+                          for (lo, hi), p in zip(inter, pbounds))
+            out[dst] = piece[srcsl]
+            covered += int(np.prod([hi - lo for lo, hi in inter]))
+        if covered < want:
+            raise ValueError(
+                f"checkpoint leaf {key!r}: saved shards cover only "
+                f"{covered} of {want} requested elements — incomplete set"
+            )
+        return out
+
+    def end_leaf(self) -> None:
+        """Drop decompressed piece buffers between leaves — the reshard
+        holds at most one leaf's touched pieces in host memory."""
+        self._cache.clear()
+
+    def rng(self):
+        if "__rng__" in self._datas[0].files:
+            return wrap_saved_rng(self._datas[0]["__rng__"],
+                                  impl=self._metas[0].get("rng_impl"))
+        return None
+
+
+class _SingleFileSource:
+    """Region reader over a single-file checkpoint. The npz member IS
+    the full array, so a read materializes the whole leaf on this host
+    (the format already implies that — it was saved by a rank-0 gather);
+    the per-host memory guarantee belongs to the sharded-set format."""
+
+    def __init__(self, path: str):
+        self._data = np.load(path)
+        self._cache: dict = {}
+        self.reads: dict[str, int] = {}
+
+    def shape(self, key):
+        if key not in self._data.files:
+            raise KeyError(
+                f"checkpoint is missing {key!r} — structure mismatch"
+            )
+        arr = self._cache.get(key)
+        if arr is None:
+            arr = self._cache[key] = self._data[key]
+        return tuple(arr.shape)
+
+    def read(self, key: str, bounds) -> np.ndarray:
+        arr = self._cache.get(key)
+        if arr is None:
+            arr = self._cache[key] = self._data[key]
+        shape = tuple(hi - lo for lo, hi in bounds)
+        self.reads[key] = max(self.reads.get(key, 0),
+                              int(np.prod(shape)) if shape else 1)
+        return arr[tuple(slice(lo, hi) for lo, hi in bounds)]
+
+    def end_leaf(self) -> None:
+        self._cache.clear()
+
+    def rng(self):
+        if "__rng__" in self._data.files:
+            impl = (str(self._data["__rng_impl__"])
+                    if "__rng_impl__" in self._data.files else None)
+            return wrap_saved_rng(self._data["__rng__"], impl=impl)
+        return None
+
+
+def _policy_for(key: str, policies: dict) -> dict:
+    """Longest-prefix policy entry for one leaf key (prefixes are leaf-
+    path prefixes like ``.opt_state``); default is ``global`` — the
+    leaf's global content is mesh-invariant and moves by bounds."""
+    best, best_len = {"policy": "global"}, -1
+    for prefix, entry in policies.items():
+        if (key == prefix or key.startswith(prefix + "/")) and \
+                len(prefix) > best_len:
+            best, best_len = entry, len(prefix)
+    return best
+
+
+def _region_reader(src, key: str, policy: dict, tgt_shape, tgt_dtype):
+    """``read_fn(bounds) -> np.ndarray`` for one target leaf under its
+    reshard policy (bounds in TARGET global index space):
+
+    - ``global``: source and target global shapes are identical; the
+      region is read straight through.
+    - ``flat_padded``: a flat 1-D buffer whose logical content is its
+      first ``logical`` elements, zero-padded to a mesh-dependent
+      length (ZeRO's per-rank segment padding) — reads clip to the
+      logical prefix and zero-fill the target's own padding.
+    - ``reset``: state that is meaningless across a topology change
+      (wire-codec error-feedback residuals): zeros at the target shape.
+    - ``worker_consensus``: leading worker/replica axis resized by
+      consensus — float leaves get the mean over the saved workers,
+      integer leaves (per-worker step counters) the first worker's
+      value, broadcast to the new worker count.
+    - ``worker_uniform``: fresh uniform share weights ``1/W`` (GoSGD's
+      ``alpha``; re-seeding mass uniformly keeps ``sum == 1`` exact).
+    """
+    kind = policy.get("policy", "global")
+    if kind == "reset":
+        def read_reset(bounds):
+            return np.zeros(tuple(hi - lo for lo, hi in bounds), tgt_dtype)
+        return read_reset
+    if kind == "worker_uniform":
+        w = int(tgt_shape[0]) if tgt_shape else 1
+
+        def read_uniform(bounds):
+            return np.full(tuple(hi - lo for lo, hi in bounds),
+                           1.0 / w, tgt_dtype)
+        return read_uniform
+    src_shape = src.shape(key)
+    if kind == "worker_consensus" and tuple(src_shape) != tuple(tgt_shape):
+        w_src = int(src_shape[0])
+
+        def read_consensus(bounds):
+            (w0, w1), rest = bounds[0], tuple(bounds[1:])
+            stack = src.read(key, ((0, w_src),) + rest)
+            one = (stack[:1] if np.issubdtype(np.dtype(tgt_dtype), np.integer)
+                   else stack.mean(axis=0, keepdims=True))
+            return np.broadcast_to(
+                one.astype(tgt_dtype), (w1 - w0, *one.shape[1:])
+            )
+        return read_consensus
+    if kind == "flat_padded" and tuple(src_shape) != tuple(tgt_shape):
+        logical = int(policy["logical"])
+
+        def read_flat(bounds):
+            (a, b), = bounds
+            out = np.zeros((b - a,), tgt_dtype)
+            hi = min(b, logical)
+            if a < hi:
+                out[: hi - a] = src.read(key, ((a, hi),))
+            return out
+        return read_flat
+    # identical global shape (covers same-shape leaves under any policy)
+    if tuple(src_shape) != tuple(tgt_shape):
+        raise ValueError(
+            f"checkpoint leaf {key!r} has global shape {src_shape}, "
+            f"expected {tuple(tgt_shape)} and no shape-adapting elastic "
+            "policy covers it — the saving engine must declare one in "
+            "its elastic_spec()"
+        )
+
+    def read_global(bounds):
+        return src.read(key, tuple(bounds))
+    return read_global
+
+
+def load_resharded(
+    path: str, state_template: PyTree, target_mesh,
+) -> tuple[PyTree, Optional[jax.Array], dict]:
+    """Restore a checkpoint onto ``target_mesh``, resharding if the mesh
+    it was saved under differs. Returns ``(state, rng, info)``.
+
+    - Saved and target topologies equal (or the checkpoint predates
+      topology manifests but loads cleanly): behaves exactly like
+      :func:`load_checkpoint` — host arrays the caller places, so a
+      same-mesh resume stays bit-identical. ``info['resharded']`` is
+      False.
+    - Topologies differ: every leaf of ``state_template`` (whose live
+      arrays define the TARGET shapes and shardings — build it with the
+      engine's ``init_state`` on the target mesh) is rebuilt with
+      :func:`~theanompi_tpu.parallel.mesh.put_resharded`: each
+      addressable target shard's content is read from the checkpoint by
+      GLOBAL bounds under the leaf's elastic policy (see
+      ``_region_reader``), so the sharded-set format never assembles a
+      full array on one host. Returns device-placed global arrays;
+      ``info`` carries from/to world sizes, the leaf count, and the
+      per-key max read sizes (``reads``).
+
+    A pre-elastic checkpoint (no ``__topology__`` manifest) that does
+    NOT load on the target mesh raises a ValueError naming the missing
+    metadata — there is no plan to compute without it.
+    """
+    manifest = read_topology_manifest(path)
+    from theanompi_tpu.parallel.mesh import mesh_topology, put_resharded
+
+    tgt_topo = mesh_topology(target_mesh)
+    if manifest is None:
+        try:
+            state, rng = load_checkpoint(path, state_template)
+        except (KeyError, ValueError) as e:
+            raise ValueError(
+                f"checkpoint {path!r} carries no {_TOPOLOGY_KEY!r} "
+                "topology manifest (it was saved before elastic-resume "
+                "stamping) and its leaves do not match the current mesh "
+                f"{tgt_topo} — a reshard cannot be planned without the "
+                "saved mesh/PartitionSpec metadata. Resume on the "
+                "original topology, or re-save once with a stamped "
+                "save_checkpoint(..., topology=...) first."
+            ) from e
+        return state, rng, {"resharded": False, "reason": "no-manifest"}
+    if manifest.get("mesh") == tgt_topo:
+        state, rng = load_checkpoint(path, state_template)
+        return state, rng, {"resharded": False, "reason": "same-mesh"}
+
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    src = (_ShardedSource(path)
+           if _SHARD_RE.search(os.path.basename(path))
+           else _SingleFileSource(path))
+    policies = (manifest.get("elastic") or {}).get("policies") or {}
+    leaves_with_paths, treedef = \
+        jax.tree_util.tree_flatten_with_path(state_template)
+    # The stamped per-leaf block describes the SOURCE layout — validate
+    # the plan against it before any region read: every target leaf
+    # whose policy reads the checkpoint must have been stamped at save
+    # time, so an engine/structure mismatch fails as one batched error
+    # naming the leaves instead of a KeyError deep in the first read.
+    stamped = manifest.get("leaves")
+    if stamped is not None:
+        _READLESS = ("reset", "worker_uniform")
+        missing = sorted(
+            k for k in (_path_key(p) for p, _ in leaves_with_paths)
+            if k not in stamped
+            and _policy_for(k, policies).get("policy", "global")
+            not in _READLESS
+        )
+        if missing:
+            raise ValueError(
+                f"cannot plan a reshard of {path!r}: the target state "
+                f"template has leaves the checkpoint's {_TOPOLOGY_KEY!r} "
+                f"manifest never stamped: {missing} — the saving and "
+                "resuming engines disagree on the state structure "
+                "(same rule/model/wire-codec on both sides?)"
+            )
+    new_leaves = []
+    for p, leaf in leaves_with_paths:
+        key = _path_key(p)
+        policy = _policy_for(key, policies)
+        tgt_shape = tuple(getattr(leaf, "shape", np.shape(leaf)))
+        tgt_dtype = getattr(leaf, "dtype", None) or np.result_type(leaf)
+        read_fn = _region_reader(src, key, policy, tgt_shape, tgt_dtype)
+        if not isinstance(leaf, jax.Array):
+            new_leaves.append(
+                read_fn(tuple((0, d) for d in tgt_shape)).astype(tgt_dtype)
+            )
+            src.end_leaf()
+            continue
+        sharding = getattr(leaf, "sharding", None)
+        spec = (sharding.spec if isinstance(sharding, NamedSharding)
+                else PartitionSpec())
+        new_leaves.append(
+            put_resharded(target_mesh, spec, tgt_shape, tgt_dtype, read_fn)
+        )
+        src.end_leaf()
+    state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    saved_shape = (manifest.get("mesh") or {}).get("shape") or [0]
+    info = {
+        "resharded": True,
+        "from_world": int(np.prod(saved_shape)),
+        "to_world": int(target_mesh.devices.size),
+        "from_mesh": manifest.get("mesh"),
+        "leaves": len(new_leaves),
+        "reads": dict(src.reads),
+    }
+    return state, src.rng(), info
+
+
 class AsyncCheckpointer:
     """Checkpoint writes overlapped with training (beyond-parity: the
     reference saved synchronously from rank 0 each epoch, stalling the
@@ -638,6 +1059,7 @@ class AsyncCheckpointer:
         rng: Optional[jax.Array] = None,
         keep: int = 3,
         extra_meta: Optional[dict] = None,
+        topology: Optional[dict] = None,
     ) -> None:
         self.wait()
         save_fn = save_checkpoint_sharded if self._sharded else save_checkpoint
@@ -649,18 +1071,20 @@ class AsyncCheckpointer:
             ):
                 # cross-host gather required -> synchronous, on this thread
                 save_checkpoint(directory, state, step, rng=rng, keep=keep,
-                                extra_meta=extra_meta)
+                                extra_meta=extra_meta, topology=topology)
                 return
 
         def snap(leaf):
             # new device buffer: immune to donation of the original
+            # (jnp.copy preserves the sharding, so the topology
+            # manifest's per-leaf specs read identically off the copy)
             return jnp.copy(leaf) if isinstance(leaf, jax.Array) else leaf
 
         state = jax.tree_util.tree_map(snap, state)
         if rng is not None:
             rng = snap(rng)
         self._pending = self._pool.submit(
-            save_fn, directory, state, step, rng, keep, extra_meta
+            save_fn, directory, state, step, rng, keep, extra_meta, topology
         )
 
     def wait(self) -> None:

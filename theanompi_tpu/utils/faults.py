@@ -29,6 +29,12 @@ Fault kinds (``KIND@STEP`` or ``KIND@STEP:ARG``):
                    the rollback policy must absorb it)
 - ``loader_stall`` sleep ARG seconds (default 2.0) before STEP (a hung
                    data source: the stall watchdog's territory)
+- ``shrink``       raise :class:`TopologyChanged` before STEP with the
+                   world shrunk to ARG devices (a slice died; the
+                   elastic supervisor must reshard-and-resume onto the
+                   smaller mesh — ``shrink@3:2``)
+- ``grow``         like ``shrink`` but ARG grows the world (capacity
+                   returned; ``grow@3:4``)
 
 Injection points live in ``launch/worker.py``'s train loops; all hooks
 are host-side and sync-free (``tools/check_hot_loop.py`` stays green).
@@ -52,6 +58,25 @@ class InjectedCrash(InjectedFault):
     what the run supervisor's bounded-retry loop exists to absorb."""
 
 
+class TopologyChanged(InjectedFault):
+    """The ``shrink``/``grow`` faults: the visible device world changed
+    mid-run (a slice died, or capacity came back). The attempt dies like
+    any infrastructure fault; under ``supervise_training(elastic=True)``
+    the retry re-probes the world (honoring :meth:`FaultInjector.
+    world_override` in tests), rebuilds the mesh at ``new_world``
+    devices, and reshards the checkpoint onto it
+    (utils/checkpoint.load_resharded)."""
+
+    def __init__(self, kind: str, step: int, new_world: int):
+        self.kind = str(kind)
+        self.step = int(step)
+        self.new_world = int(new_world)
+        super().__init__(
+            f"injected {kind} before step {step}: world is now "
+            f"{new_world} device(s)"
+        )
+
+
 class Preempted(RuntimeError):
     """Graceful SIGTERM exit: the driver checkpointed inside the grace
     window and marked the run resumable (``launch/worker.py``). The
@@ -68,18 +93,22 @@ class Preempted(RuntimeError):
 
 FAULT_KINDS = (
     "crash", "sigterm", "sigkill", "ckpt_truncate", "nan_batch",
-    "loader_stall",
+    "loader_stall", "shrink", "grow",
 )
 
 
 @dataclass
 class FaultSpec:
-    """One armed fault: ``kind`` fires once at global step ``step``."""
+    """One armed fault: ``kind`` fires once at global step ``step``.
+    ``fired_seq`` stamps the ORDER the injector fired specs in (-1 =
+    not fired) — what "the LAST fired topology fault" means cannot
+    depend on the order specs were listed on the command line."""
 
     kind: str
     step: int
     arg: Optional[float] = None
     fired: bool = False
+    fired_seq: int = -1
 
 
 def parse_fault_spec(spec: Union[str, FaultSpec]) -> FaultSpec:
@@ -107,6 +136,14 @@ def parse_fault_spec(spec: Union[str, FaultSpec]) -> FaultSpec:
             arg = float(arg_s)
         except ValueError:
             raise ValueError(f"fault spec {spec!r}: arg {arg_s!r} is not a number")
+    if kind in ("shrink", "grow"):
+        # the arg IS the post-fault world size — elastic recovery is
+        # only testable against a deterministic target topology
+        if arg is None or int(arg) != arg or arg < 1:
+            raise ValueError(
+                f"fault spec {spec!r}: {kind} needs an integer target "
+                f"world size >= 1 (e.g. {kind}@{step}:2)"
+            )
     return FaultSpec(kind=kind, step=step, arg=arg)
 
 
@@ -123,6 +160,7 @@ class FaultInjector:
 
     def __init__(self, specs: Sequence[Union[str, FaultSpec]]):
         self.specs = [parse_fault_spec(s) for s in (specs or [])]
+        self._fire_seq = 0
 
     def _take(self, kind: str, first: int, last: Optional[int] = None
               ) -> Optional[FaultSpec]:
@@ -132,6 +170,8 @@ class FaultInjector:
         for s in self.specs:
             if s.kind == kind and not s.fired and first <= s.step <= last:
                 s.fired = True
+                s.fired_seq = self._fire_seq
+                self._fire_seq += 1
                 return s
         return None
 
@@ -145,6 +185,10 @@ class FaultInjector:
         s = self._take("crash", first, last)
         if s is not None:
             raise InjectedCrash(f"injected crash before step {s.step}")
+        for kind in ("shrink", "grow"):
+            s = self._take(kind, first, last)
+            if s is not None:
+                raise TopologyChanged(kind, s.step, int(s.arg))
         s = self._take("sigterm", first, last)
         if s is not None:
             os.kill(os.getpid(), signal.SIGTERM)
@@ -169,6 +213,19 @@ class FaultInjector:
                 "NaN (token/int batches); inject on a float-input model"
             )
         return x + jnp.asarray(float("nan"), x.dtype)
+
+    def world_override(self) -> Optional[int]:
+        """The world size the MOST RECENTLY FIRED shrink/grow fault
+        left behind (by firing order, not command-line spec order), or
+        None when no topology fault has fired. Sticky by design: the
+        supervisor reuses ONE injector across attempts, so a shrunk
+        world stays shrunk for every subsequent elastic retry — the
+        CPU-simulation stand-in for re-probing real device liveness."""
+        fired = [s for s in self.specs
+                 if s.kind in ("shrink", "grow") and s.fired]
+        if not fired:
+            return None
+        return int(max(fired, key=lambda s: s.fired_seq).arg)
 
     def truncate_due(self, step: int) -> bool:
         """True once when a ``ckpt_truncate`` spec is due at/after
