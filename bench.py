@@ -174,7 +174,7 @@ def bench_compute(steps: int = 20, trials: int = 5, model_name: str = "alexnet")
     from theanompi_tpu.parallel.mesh import put_global_batch
     from theanompi_tpu.parallel.strategies import get_strategy
     from theanompi_tpu.train import init_train_state, make_multi_step, make_train_step
-    from theanompi_tpu.utils.flops import compiled_flops, peak_flops
+    from theanompi_tpu.utils.flops import compiled_cost, peak_flops
 
     n_dev = len(jax.devices())
     model_cls, base_batch = _zoo_entry(model_name)
@@ -225,8 +225,11 @@ def bench_compute(steps: int = 20, trials: int = 5, model_name: str = "alexnet")
     args = (state, x, y, jax.random.PRNGKey(1))
 
     # XLA's cost analysis counts a scan body ONCE regardless of trip
-    # count (measured), so take one step's FLOPs and multiply
-    flops_step = compiled_flops(single, *args)
+    # count (measured), so take one step's cost and multiply — via the
+    # SHARED CostModel (utils/flops.py), the same object the live
+    # attribution gauges and `tmpi profile` consume
+    cost = compiled_cost(single, *args)
+    flops_step = cost.flops if cost is not None else None
     flops_total = flops_step * steps if flops_step else None
     peak_bound = peak_flops()
     if thread_state:
@@ -294,7 +297,11 @@ def bench_compute(steps: int = 20, trials: int = 5, model_name: str = "alexnet")
                 f"{max_img_s:.0f} — backend not actually executing"
             )
     flops_s = flops_total / med if flops_total else None
-    peak = peak_flops()
+    # per-step seconds for the utilization views (the k-step window
+    # divided by its trip count)
+    sps = med / steps if med else None
+    mfu_val = cost.mfu(sps) if cost is not None else None
+    hbm_gbps = cost.hbm_gbps(sps) if cost is not None else None
     result = {
         "metric": f"{model_name}_{model.recipe.dataset}_bsp_images_per_sec_{n_dev}chip",
         "value": round(img_s, 1),
@@ -306,7 +313,8 @@ def bench_compute(steps: int = 20, trials: int = 5, model_name: str = "alexnet")
         "n_devices": n_dev,
         "device_kind": jax.devices()[0].device_kind,
         "tflops_per_sec": round(flops_s / 1e12, 2) if flops_s else None,
-        "mfu": round(flops_s / peak, 4) if (flops_s and peak) else None,
+        "mfu": round(mfu_val, 4) if mfu_val is not None else None,
+        "hbm_gbps": round(hbm_gbps, 2) if hbm_gbps is not None else None,
         "batch": batch,
         "timing": timing,  # {k, median_s, spread_frac}: value quotes the median
     }
@@ -391,6 +399,10 @@ def bench_e2e(max_steps: int = 48, batch: int = 0,
                 numerics_freq=numerics_freq,
                 print_freq=0,
                 return_recorder=True,
+                # obs on: the engine's cost model then rides the run,
+                # so every e2e row reports mfu from the SHARED
+                # attribution module (None on spec-less devices)
+                obs_dir=os.path.join(d, f"obs_d{depth}_n{numerics_freq}"),
             )
 
         def one_run(depth, numerics_freq=0):
@@ -421,6 +433,7 @@ def bench_e2e(max_steps: int = 48, batch: int = 0,
                 "step_ms": round(1000 * step_t, 2),
                 "wait_frac": round(wait_t / (step_t + wait_t), 4) if step_t else None,
                 "host_blocked_frac": summary.get("host_blocked_frac"),
+                "mfu": summary.get("mfu"),
             })
         nm_overhead = None
         if numerics:
@@ -476,6 +489,8 @@ def bench_e2e(max_steps: int = 48, batch: int = 0,
         "step_ms": head["step_ms"],
         "wait_frac": head["wait_frac"],
         "host_blocked_frac": head["host_blocked_frac"],
+        "mfu": head["mfu"],  # shared cost model (launch/worker.py
+        # summary; None where the device has no spec peak)
         "dispatch_depth": head["dispatch_depth"],
         "batch": batch,
         "max_steps": max_steps,
@@ -658,6 +673,9 @@ def bench_codec_sweep(engines=("bsp", "zero1", "easgd", "gosgd", "nd"),
                     "wire_bytes_per_step": round(comm["wire_bytes"], 1),
                     "compression_ratio": round(comm["compression_ratio"], 3),
                     "images_per_sec": round(summary["images_per_sec"], 1),
+                    # shared attribution module's utilization reading
+                    # (run_training summary; None on spec-less devices)
+                    "mfu": summary.get("mfu"),
                     "val_loss": round(summary["val"]["loss"], 4)
                     if "val" in summary else None,
                     "steps": summary["steps"],
@@ -795,9 +813,10 @@ def main() -> int:
     ap.add_argument("--mode", choices=["compute", "e2e", "scaling"], default="compute")
     ap.add_argument("--model", default="alexnet",
                     choices=["alexnet", "googlenet", "resnet50", "vgg16", "wrn",
-                             "transformer_lm", "transformer_lm_350m"],
+                             "transformer_lm", "transformer_lm_350m", "mlp"],
                     help="compute mode: which zoo model to benchmark "
-                         "(the driver contract stays the AlexNet default)")
+                         "(the driver contract stays the AlexNet default; "
+                         "mlp is the CPU-runnable smoke entry)")
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--dispatch-depth", type=int, default=1,
                     help="e2e mode: async dispatch pipeline depth "

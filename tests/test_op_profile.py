@@ -133,3 +133,20 @@ def test_cpu_capture_degrades_gracefully(tmp_path):
 def test_missing_trace_dir_raises(tmp_path):
     with pytest.raises(FileNotFoundError, match="trace.json.gz"):
         op_table(str(tmp_path))
+
+
+def test_checked_in_fixture_parses():
+    """The committed synthetic trace fixture (tests/fixtures/
+    op_profile_trace/ — also the attribution join's input,
+    tests/test_attribution.py) parses stably: container dropped, host
+    track ignored, instance numbers collapsed, shares summing to 1."""
+    fixture = os.path.join(os.path.dirname(__file__), "fixtures",
+                           "op_profile_trace")
+    rows = op_table(fixture, steps=4)
+    ops = {r["op"]: r for r in rows}
+    assert set(ops) == {"conv_fusion.#", "convert_reduce_fusion.#",
+                        "all-reduce.#"}
+    assert "while.#" not in ops and "python_overhead" not in ops
+    assert sum(r["share"] for r in rows) == pytest.approx(1.0)
+    assert generalize("all-reduce.3") == "all-reduce.#"
+    assert "all-reduce.#" in format_table(rows)

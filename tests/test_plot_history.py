@@ -173,3 +173,47 @@ def test_end_to_end_png_with_obs_panel(tmp_path):
     out2 = str(tmp_path / "out2.png")
     assert main([str(tmp_path / "b"), "-o", out2]) == 0
     assert os.path.getsize(out2) > 10_000
+
+
+def _write_profile_records(run_dir, steps=(2, 4, 6), calibrated=True):
+    obs = os.path.join(run_dir, "obs")
+    os.makedirs(obs, exist_ok=True)
+    with open(os.path.join(obs, "metrics.jsonl"), "a") as f:
+        for s in steps:
+            rec = {
+                "kind": "profile", "rank": 0, "t": 1000.0 + s, "step": s,
+                "step_seconds": 0.01, "rule": "bsp",
+                "fractions": {"compute": 0.7, "comm": 0.1, "host": 0.15,
+                              "residual": 0.05},
+                "classification": "compute-bound",
+                "peak_source": "calibrated" if calibrated else "spec",
+                "hbm_gbps": 5.0,
+            }
+            if calibrated:
+                rec["mfu_calibrated"] = 0.7
+            else:
+                rec["mfu"] = 0.38 + 0.01 * s
+            f.write(json.dumps(rec) + "\n")
+
+
+def test_load_obs_profile_series_and_attribution_panel(tmp_path):
+    """kind=profile records parse into the attribution series (stacked
+    fractions + MFU trend) and the extra panel row renders; append-mode
+    reruns keep only the newest series, like the comm panel."""
+    from theanompi_tpu.tools.plot_history import load_obs, plot
+
+    p = _write_run(str(tmp_path / "runP"), "runP")
+    _write_profile_records(str(tmp_path / "runP"), steps=(2, 4, 6))
+    o = load_obs(p)
+    assert o["prof_step"] == [2, 4, 6]
+    assert o["prof_fracs"][0]["compute"] == 0.7
+    assert o["prof_mfu_calibrated"] == [0.7, 0.7, 0.7]
+    assert o["prof_mfu"] == [None, None, None]
+    # rerun appended on top: step counter restarts, newest wins
+    _write_profile_records(str(tmp_path / "runP"), steps=(1, 2),
+                           calibrated=False)
+    o = load_obs(p)
+    assert o["prof_step"] == [1, 2]
+    assert o["prof_mfu"] == [pytest.approx(0.39), pytest.approx(0.40)]
+    out = plot({"runP": p}, str(tmp_path / "attr.png"))
+    assert os.path.getsize(out) > 10_000

@@ -23,6 +23,14 @@ traffic-model cross-checks, donation audit, rank-divergence lint::
 
     tmpi lint --json            # CI report with stable rule IDs
     tmpi lint --update-golden   # accept a reviewed signature change
+
+``tmpi profile`` is the step-time attribution profiler
+(tools/profile.py): warm steps of one engine+model, reconciled against
+the XLA cost model, the declared traffic model and the traced jaxpr
+into a compute/comm/host/residual split with a roofline verdict::
+
+    tmpi profile --model mlp --steps 8            # CPU-runnable
+    tmpi profile --model alexnet --steps 20 --trace
 """
 
 from __future__ import annotations
@@ -304,6 +312,13 @@ def main(argv=None) -> int:
         from theanompi_tpu.tools.lint import main as lint_main
 
         return lint_main(argv[1:])
+    if argv[:1] == ["profile"]:
+        # step-time attribution profiler (tools/profile.py): its own
+        # parser + driver, dispatched before the training parser
+        _force_platform()
+        from theanompi_tpu.tools.profile import profile_main
+
+        return profile_main(argv[1:])
     if argv[:1] == ["serve"]:
         # inference subcommand: its own parser + driver (serve/cli.py);
         # dispatched before the training parser, whose first positional

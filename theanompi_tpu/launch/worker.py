@@ -907,6 +907,19 @@ def run_training(
             except Exception as e:  # noqa: BLE001
                 print(f"[obs] numerics model unavailable for {rule!r}: "
                       f"{e!r}", flush=True)
+        if hasattr(engine, "cost_model") and n_proc == 1:
+            # ... and the compiled-step cost model (utils/flops.py):
+            # FLOPs + HBM bytes of the step executable, feeding the
+            # live tmpi_mfu / tmpi_hbm_gbps / tmpi_step_*_frac gauges
+            # and the per-snapshot kind=profile attribution record
+            # (obs/attribution.py). The lowering compiles (persistent-
+            # cache-friendly) but never executes; single-controller
+            # only — abstract lowering has no multihost story yet.
+            try:
+                obs.set_cost_model(engine.cost_model(state, batch))
+            except Exception as e:  # noqa: BLE001
+                print(f"[obs] cost model unavailable for {rule!r}: "
+                      f"{e!r}", flush=True)
 
     def _flight_state_saver(dump_dir):
         # best-effort param-state capture into the triage bundle (the
@@ -1527,6 +1540,15 @@ def run_training(
         (sum(dispatch_images[-k_recent:]) / k_recent) / t_recent
         if (k_recent and t_recent) else 0.0
     )
+    if obs.cost is not None and summary["images_per_sec"]:
+        # achieved utilization from the SHARED cost model (the same
+        # numbers the live gauges carry; bench e2e/codec-sweep read
+        # these off the summary): per-step seconds recovered from the
+        # throughput ledger so fused dispatches amortize correctly
+        _sps = batch / summary["images_per_sec"]
+        _mfu = obs.cost.mfu(_sps)
+        summary["mfu"] = round(_mfu, 4) if _mfu is not None else None
+        summary["tflops_per_sec"] = round(obs.cost.flops / _sps / 1e12, 6)
     if return_recorder:
         summary["recorder"] = rec
     return summary

@@ -1,0 +1,46 @@
+"""Tiny MLP — the CPU-profileable smoke model.
+
+The attribution profiler (``tmpi profile``, tools/profile.py) needs a
+model whose compiled step is cheap enough to lower + run warm on a CPU
+test mesh in seconds, yet has a multi-leaf param pytree so every
+engine's exchange/codec paths carry real (if small) wire volume. The
+convnets in the zoo compile for minutes on XLA:CPU; this two-hidden-
+layer MLP compiles in well under a second and is the ``--model mlp``
+default the acceptance path exercises (it is also a perfectly ordinary
+contract model — ``tmpi BSP 8 theanompi_tpu.models.mlp MLP`` trains)."""
+
+from __future__ import annotations
+
+from theanompi_tpu import nn
+from theanompi_tpu.models.contract import Model, Recipe
+
+
+class MLP(Model):
+    name = "mlp"
+
+    @classmethod
+    def default_recipe(cls) -> Recipe:
+        return Recipe(
+            batch_size=64,
+            n_epochs=5,
+            optimizer="momentum",
+            opt_kwargs={"momentum": 0.9},
+            schedule="constant",
+            sched_kwargs={"lr": 0.01},
+            input_shape=(16, 16, 3),
+            num_classes=10,
+            dataset="synthetic",
+        )
+
+    def build(self):
+        return nn.Sequential(
+            [
+                nn.Flatten(),
+                nn.Dense(128, name="fc1"),
+                nn.Activation("relu"),
+                nn.Dense(64, name="fc2"),
+                nn.Activation("relu"),
+                nn.Dense(self.recipe.num_classes, name="out"),
+            ],
+            name="mlp",
+        )

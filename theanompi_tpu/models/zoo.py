@@ -21,7 +21,12 @@ from __future__ import annotations
 
 def zoo_entry(name: str):
     """``(model_cls, single_chip_global_batch)`` for the benchable zoo
-    (alexnet / googlenet / resnet50 / vgg16 / wrn)."""
+    (alexnet / googlenet / resnet50 / vgg16 / wrn; ``mlp`` is the
+    CPU-profileable smoke entry ``tmpi profile`` defaults exercise)."""
+    if name == "mlp":
+        from theanompi_tpu.models.mlp import MLP
+
+        return MLP, 64
     if name == "alexnet":
         from theanompi_tpu.models.alex_net import AlexNet
 

@@ -47,7 +47,19 @@ On-disk layout under ``obs_dir`` (schemas:
                             (from_world/to_world, wall seconds, leaf
                             count, per-replica batch) next to the
                             tmpi_reshard_seconds / tmpi_reshards_total
-                            gauges
+                            gauges; runs whose engine declared a cost
+                            model (obs/attribution.py) add one
+                            kind=profile record per snapshot — the
+                            step-time attribution: measured
+                            step_seconds, the compute/comm/host/
+                            residual fractions (sum 1.0 by
+                            construction), roofline classification
+                            (compute/hbm/comm/host-bound), mfu (or
+                            mfu_calibrated on spec-less devices) and
+                            achieved hbm_gbps — next to the live
+                            tmpi_mfu / tmpi_hbm_gbps /
+                            tmpi_step_*_frac gauges the dispatcher's
+                            drain cadence refreshes
     metrics.prom            rank-0 Prometheus text exposition (atomic)
     spans_rank{r}.jsonl     per-rank span + span_summary lines
     heartbeat_rank{r}.json  per-rank liveness (atomic rewrite; carries
@@ -182,6 +194,14 @@ class Observability:
         self.traffic: Optional[TrafficModel] = None
         self.numerics: Optional[NumericsModel] = None
         self.flight: Optional[FlightRecorder] = None
+        # step-time attribution (obs/attribution.py): the engine's
+        # compiled-step cost model, the dispatcher handle the live
+        # host-blocked fraction reads off, and the newest attribution
+        # (refreshed at each drain sync, emitted at snapshot time)
+        self.cost = None
+        self._disp = None
+        self._host_mark: Optional[tuple] = None  # (blocked_s, wall_t)
+        self._last_attr = None
         # detection is a host-side float check per drained row — active
         # whenever sentinels are requested, even with no obs_dir (the
         # halt policy must work without telemetry output)
@@ -286,6 +306,22 @@ class Observability:
             help="sentinel cadence (steps; 0 = numerics off)",
         ).set(self.numerics_freq)
 
+    def set_cost_model(self, cm) -> None:
+        """Record the engine's compiled-step cost model (utils/flops.py
+        ``CostModel``, engine-declared via ``cost_model()``) as static
+        ``tmpi_cost_*`` gauges and arm the live attribution path: every
+        dispatcher drain sync then refreshes ``tmpi_mfu`` /
+        ``tmpi_hbm_gbps`` / ``tmpi_step_*_frac`` (obs/attribution.py)
+        from values the drain already fetched — zero new host syncs."""
+        self.cost = cm
+        if cm is None or not self.enabled:
+            return
+        for key, value in cm.as_metrics().items():
+            self.registry.gauge(
+                f"tmpi_{key}",
+                help="compiled-step cost model (utils/flops.py)",
+            ).set(value)
+
     def set_flight_state_saver(self, saver) -> None:
         """Install the driver's ``saver(dump_dir)`` that checkpoints the
         current train state into an anomaly bundle (skipped for
@@ -299,6 +335,10 @@ class Observability:
         stall-report reader tell a wedged DEVICE program (dispatches
         advance then stop with the ring pinned full) from a stalled
         HOST driver (dispatches stop, in-flight falls to zero)."""
+        # also the live host-blocked source for step attribution: the
+        # drain-window delta of host_blocked_s is the measured per-step
+        # host tax (obs/attribution.py books it as the host fraction)
+        self._disp = disp
         if self.heartbeat is not None:
             self.heartbeat.set_extra(
                 lambda: {"dispatch_in_flight": int(disp.in_flight),
@@ -501,17 +541,57 @@ class Observability:
             self.snapshot(step=step)
 
     def note_step_seconds(self, per_step_seconds: Optional[float]) -> None:
-        """Refresh the achieved-GB/s gauge from an amortized per-step
-        time (utils/dispatch.py's spaced syncs). Under deferred dispatch
+        """Refresh the achieved-GB/s gauge — and, when the engine
+        declared a cost model, the live MFU / HBM / step-fraction
+        attribution gauges — from an amortized per-step time
+        (utils/dispatch.py's spaced syncs). Under deferred dispatch
         :meth:`on_step` no longer knows the step time at push time —
         the dispatcher calls this at each sync point instead, so the
-        gauge carries the same analytic-bytes / measured-time reading
-        sync mode produced, just on the sync cadence."""
-        if not self.enabled or self.traffic is None or not per_step_seconds:
+        gauges carry the same analytic-models / measured-time reading
+        sync mode produced, just on the sync cadence (no new host
+        syncs: every input is already host-side)."""
+        if not self.enabled or not per_step_seconds:
             return
-        gbps = self.traffic.achieved_gbps(per_step_seconds)
-        if gbps is not None:
-            self._set_gbps_gauges(gbps)
+        if self.traffic is not None:
+            gbps = self.traffic.achieved_gbps(per_step_seconds)
+            if gbps is not None:
+                self._set_gbps_gauges(gbps)
+        if self.cost is not None:
+            self._note_attribution(per_step_seconds)
+
+    def _live_host_frac(self) -> Optional[float]:
+        """Host-blocked fraction of the wall since the previous drain
+        sync (dispatcher cumulative counter deltas — measured, free)."""
+        import time as _time
+
+        if self._disp is None:
+            return None
+        now = _time.perf_counter()
+        blocked = float(self._disp.host_blocked_s)
+        mark, self._host_mark = self._host_mark, (blocked, now)
+        if mark is None or now <= mark[1]:
+            return None
+        return max(0.0, min(1.0, (blocked - mark[0]) / (now - mark[1])))
+
+    def _note_attribution(self, per_step_seconds: float) -> None:
+        """Refresh the live attribution gauges (obs/attribution.py) and
+        keep the newest decomposition for the snapshot-time
+        ``kind=profile`` record. Pure host-side float math per drain."""
+        from theanompi_tpu.obs.attribution import attribute_step
+
+        try:
+            attr = attribute_step(
+                per_step_seconds, cost=self.cost, traffic=self.traffic,
+                host_frac=self._live_host_frac(),
+            )
+        except Exception:  # noqa: BLE001 — gauges must never kill a drain
+            return
+        self._last_attr = attr
+        for key, value in attr.as_metrics().items():
+            self.registry.gauge(
+                f"tmpi_{key}",
+                help="step-time attribution (obs/attribution.py)",
+            ).set(value)
 
     def _set_gbps_gauges(self, gbps: float) -> None:
         """Effective GB/s gauge, plus the raw (uncompressed-equivalent)
@@ -539,6 +619,19 @@ class Observability:
             return None
         if step is not None:
             self._last_snapshot_step = step
+        if self._last_attr is not None:
+            # one kind=profile record per snapshot: the newest step-time
+            # attribution (schema: tools/check_obs_schema.py) — the
+            # machine-readable trail tools/perf_gate.py diffs. Written
+            # BEFORE the snapshot line: downstream readers (and tests)
+            # may treat the file's last record as the metrics snapshot.
+            import json as _json
+
+            self._metrics_f.write(_json.dumps(self._last_attr.as_record(
+                step=step if step is not None else self._last_snapshot_step,
+                rank=self.rank,
+                rule=self.traffic.rule if self.traffic is not None else None,
+            )) + "\n")
         rec = self.registry.emit_snapshot(self._metrics_f, step=step)
         try:
             self.registry.write_prometheus(self._prom_path)

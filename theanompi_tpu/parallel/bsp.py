@@ -379,6 +379,20 @@ class BSPEngine:
             strategy=self._build["strategy"], codec=self.codec,
         )
 
+    def cost_model(self, state, global_batch: int):
+        """XLA cost analysis of this engine's compiled numerics-off
+        train step over an abstract global batch (utils/flops.py
+        ``CostModel``) — the per-executable FLOPs + HBM bytes behind
+        the live ``tmpi_mfu``/attribution gauges (obs/attribution.py).
+        Lowering over ShapeDtypeStructs compiles but never executes."""
+        import jax as _jax
+
+        from theanompi_tpu.utils.flops import abstract_batch, compiled_cost
+
+        x, y = abstract_batch(self.model, int(global_batch))
+        return compiled_cost(self._steps[False], state, x, y,
+                             _jax.random.PRNGKey(0))
+
     def numerics_model(self, state):
         """Numerics declaration (obs/numerics.py): the standard sentinel
         set; no divergence gauge — BSP params are replicated by

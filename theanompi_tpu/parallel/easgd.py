@@ -352,6 +352,21 @@ class EASGDEngine:
             codec=self.codec,
         )
 
+    def cost_model(self, state, global_batch: int):
+        """XLA cost analysis of the compiled numerics-off LOCAL step
+        over an abstract global batch (utils/flops.py ``CostModel``;
+        see BSPEngine.cost_model). The periodic elastic exchange is a
+        separate executable and is NOT included — its wire time is the
+        traffic model's amortized share (obs/attribution.py books it
+        under comm, not compute)."""
+        import jax as _jax
+
+        from theanompi_tpu.utils.flops import abstract_batch, compiled_cost
+
+        x, y = abstract_batch(self.model, int(global_batch))
+        return compiled_cost(self._steps[False], state, x, y,
+                             _jax.random.PRNGKey(0))
+
     def numerics_model(self, state):
         """Numerics declaration (obs/numerics.py): standard sentinels
         plus the EASGD divergence gauge — RMS-over-workers L2 distance

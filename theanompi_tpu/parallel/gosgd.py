@@ -456,6 +456,21 @@ class GOSGDEngine:
             group_size=self.group_size, codec=self.codec,
         )
 
+    def cost_model(self, state, global_batch: int):
+        """XLA cost analysis of the compiled numerics-off WITH-GOSSIP
+        step variant over an abstract global batch (utils/flops.py
+        ``CostModel``; see BSPEngine.cost_model) — the gossip ppermute
+        rides inside the step, so the representative executable is the
+        gossip-round one (exact on ``gossip_every == 1``, a slight
+        over-count of pack/unpack flops otherwise)."""
+        import jax as _jax
+
+        from theanompi_tpu.utils.flops import abstract_batch, compiled_cost
+
+        x, y = abstract_batch(self.model, int(global_batch))
+        return compiled_cost(self._steps[(True, False)], state, x, y,
+                             _jax.random.PRNGKey(0))
+
     def numerics_model(self, state):
         """Numerics declaration (obs/numerics.py): standard sentinels
         plus the inter-replica disagreement gauge (RMS distance to the

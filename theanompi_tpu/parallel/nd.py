@@ -570,6 +570,20 @@ class NDEngine:
             codec=self.codec,
         )
 
+    def cost_model(self, state, global_batch: int):
+        """XLA cost analysis of the compiled numerics-off ND step over
+        an abstract global token batch (utils/flops.py ``CostModel``;
+        see BSPEngine.cost_model) — tp/sp/pp/expert collectives are
+        inside the executable, so its FLOPs/bytes include them even
+        though ``traffic_model()`` models the dp grad sync only."""
+        import jax as _jax
+
+        from theanompi_tpu.utils.flops import abstract_batch, compiled_cost
+
+        tok, _ = abstract_batch(self.model, int(global_batch))
+        return compiled_cost(self._steps[False], state, tok,
+                             _jax.random.PRNGKey(0))
+
     def numerics_model(self, state):
         """Numerics declaration (obs/numerics.py): sentinels computed
         spec-aware over the sharded param/grad trees (per-leaf scalar

@@ -396,6 +396,19 @@ class ZeroEngine:
             codec=self.codec,
         )
 
+    def cost_model(self, state, global_batch: int):
+        """XLA cost analysis of the compiled ZeRO-1 step over an
+        abstract global batch (utils/flops.py ``CostModel``; see
+        BSPEngine.cost_model) — scatter/update/gather included, since
+        they are inside the same executable."""
+        import jax as _jax
+
+        from theanompi_tpu.utils.flops import abstract_batch, compiled_cost
+
+        x, y = abstract_batch(self.model, int(global_batch))
+        return compiled_cost(self._steps[False], state, x, y,
+                             _jax.random.PRNGKey(0))
+
     def numerics_model(self, state):
         """Numerics declaration (obs/numerics.py): standard sentinels
         computed over the sharded flat segments (scalar psums); no

@@ -193,6 +193,27 @@ SCHEMAS: dict[str, dict[str, tuple[tuple, bool]]] = {
         "budget_left": ((int,), True),
         "skipped": ((int,), False),
     },
+    # step-time attribution (obs/attribution.py, written by
+    # Observability.snapshot into metrics.jsonl when the engine
+    # declared a cost model): one record per snapshot — the measured
+    # step wall, the compute/comm/host/residual fractions (validated
+    # below: they must sum to 1.0 +/- 0.02, the decomposition's own
+    # invariant), the roofline classification, and the utilization
+    # readings (mfu vs spec peak, or mfu_calibrated on devices without
+    # one; achieved hbm_gbps). tools/perf_gate.py diffs these.
+    "profile": {
+        "rank": ((int,), True),
+        "t": (_NUM, True),
+        "step": ((int,), True),
+        "step_seconds": (_NUM, True),
+        "fractions": ((dict,), True),
+        "classification": ((str,), True),
+        "peak_source": ((str,), False),
+        "rule": ((str,), False),
+        "mfu": (_NUM, False),
+        "mfu_calibrated": (_NUM, False),
+        "hbm_gbps": (_NUM, False),
+    },
     # serving engine (serve/engine.py): periodic + drain-time stats
     # records in <obs_dir>/serve.jsonl. `params_step` is the checkpoint
     # step being served (-1 before the first load); `metrics` is a flat
@@ -227,6 +248,22 @@ SCHEMAS: dict[str, dict[str, tuple[tuple, bool]]] = {
 #   tmpi_serve_batches_total     counter    by bucket=N
 #   tmpi_serve_reloads_total     counter    hot-reloads applied
 SERVE_METRIC_PREFIX = "tmpi_serve_"
+
+# the step-attribution gauge family (obs/attribution.py; set live at
+# every dispatcher drain sync, documented here next to its record kind —
+# snapshot metric maps are an open union by design, so unlike
+# SERVE_METRIC_PREFIX these names are documentation, not enforcement):
+#   tmpi_mfu                  gauge  achieved/peak FLOP/s (spec peak)
+#   tmpi_mfu_calibrated       gauge  compute fraction vs calibrated peak
+#   tmpi_hbm_gbps             gauge  achieved HBM GB/s (any backend)
+#   tmpi_step_compute_frac    gauge  model compute share of the step
+#   tmpi_step_comm_frac       gauge  model collective share
+#   tmpi_step_host_frac       gauge  measured host-blocked share
+#   tmpi_step_residual_frac   gauge  unattributed remainder
+#   tmpi_cost_flops_per_step  gauge  XLA cost-analysis FLOPs/step
+#   tmpi_cost_hbm_bytes_per_step  gauge  XLA bytes-accessed/step
+# kind=profile fractions must sum to 1 within this absolute tolerance
+PROFILE_FRACTION_SUM_TOL = 0.02
 
 
 def _check_numeric_map(d: dict, what: str) -> list[str]:
@@ -282,6 +319,16 @@ def validate_record(obj: Any) -> list[str]:
                     errs.append(
                         f"serve.metrics key {k!r} lacks the "
                         f"{SERVE_METRIC_PREFIX!r} prefix"
+                    )
+        elif kind == "profile":
+            errs += _check_numeric_map(obj["fractions"], "fractions")
+            if not errs:
+                total = sum(obj["fractions"].values())
+                if abs(total - 1.0) > PROFILE_FRACTION_SUM_TOL:
+                    errs.append(
+                        f"profile fractions sum to {total:.6f}, not "
+                        f"1.0 +/- {PROFILE_FRACTION_SUM_TOL} — the "
+                        "attribution lost a component"
                     )
         elif kind == "span_summary":
             errs += _check_numeric_map(obj["fractions"], "fractions")

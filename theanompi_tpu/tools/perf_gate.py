@@ -1,0 +1,237 @@
+"""Perf-regression gate: diff two bench/profile snapshots on ratio
+invariants (attribution-profiler PR).
+
+The BENCH_r* trajectory was archival — numbers landed in the repo and
+nothing failed when they regressed. This gate makes it enforceable:
+give it a committed baseline and a fresh reading (a ``tmpi profile``
+``report.json``, a raw ``bench.py`` result object, or a bench
+``kind=metrics`` snapshot line) and it fails when a RATIO invariant
+moved beyond its tolerance band:
+
+- ``mfu`` — model FLOPs utilization (symmetric band: an unexplained
+  2x jump is drift just like a drop — ratio invariants are supposed to
+  be stable, not merely high);
+- ``host_blocked_frac`` — the dispatch pipeline's host tax;
+- ``compression_ratio`` — the codec layer's claimed wire win;
+- ``hbm_gbps`` — achieved HBM bandwidth;
+- per-file: a profile report's attribution fractions must sum to
+  1.0 +/- the fraction tolerance (the decomposition's own invariant).
+
+Only metrics present in BOTH files are diffed (a bench result and a
+profile report share mfu/host_blocked_frac; schema drift that removes
+a previously-compared metric fails loudly rather than silently
+shrinking coverage).
+
+Usage::
+
+    python -m theanompi_tpu.tools.perf_gate baseline.json current.json
+    python -m theanompi_tpu.tools.perf_gate a.json b.json --rel-tol 0.15
+    tools/perf_gate.py old_report.json new_report.json   # repo-root shim
+
+Exit codes: 0 = within bands, 1 = regression/drift, 2 = unusable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from typing import Optional
+
+# symmetric relative band per ratio metric (overridable via --rel-tol):
+# wide enough for CPU test-mesh noise, tight enough that a 2x drift
+# (the mutation the acceptance path injects) can never pass
+DEFAULT_REL_TOL = 0.25
+# |sum(fractions) - 1| bound per profile report (absolute)
+FRACTION_SUM_TOL = 0.02
+
+# the ratio invariants the gate understands, in report order
+GATE_METRICS = ("mfu", "host_blocked_frac", "compression_ratio",
+                "hbm_gbps")
+
+
+def _num(v) -> Optional[float]:
+    if isinstance(v, bool) or v is None:
+        return None
+    if isinstance(v, (int, float)) and math.isfinite(float(v)):
+        return float(v)
+    return None
+
+
+def extract_invariants(obj: dict) -> dict:
+    """``{metric: value}`` for every gate metric the snapshot carries.
+    Accepts the three snapshot shapes the repo emits:
+
+    - ``tmpi profile`` report.json (``kind=profile_report``);
+    - a raw bench.py result object (flat keys);
+    - a bench/obs ``kind=metrics`` snapshot (``metrics`` map with
+      ``<source>_``-prefixed gauge names)."""
+    out: dict = {}
+    if not isinstance(obj, dict):
+        return out
+    if obj.get("kind") == "metrics":
+        flat = obj.get("metrics", {})
+        for key in GATE_METRICS:
+            best = None
+            for name, v in flat.items():
+                if name != key and not name.endswith(f"_{key}"):
+                    continue
+                n = _num(v)
+                if n is None:
+                    continue
+                # rank candidates: a static cost/peak constant (e.g.
+                # tmpi_cost_peak_hbm_gbps next to the measured
+                # tmpi_hbm_gbps) must never shadow the achieved gauge,
+                # and the shortest (most direct) name wins ties
+                rank = (("cost" in name) or ("peak" in name), len(name))
+                if best is None or rank < best[0]:
+                    best = (rank, n)
+            if best is not None:
+                out[key] = best[1]
+        return out
+    # profile report / raw bench result: flat keys first, then the
+    # report's nested homes
+    for key in GATE_METRICS:
+        n = _num(obj.get(key))
+        if n is None and key == "compression_ratio":
+            n = _num(obj.get("traffic", {}).get("compression_ratio")
+                     if isinstance(obj.get("traffic"), dict) else None)
+        if n is None and key == "hbm_gbps":
+            n = _num(obj.get("throughput", {}).get("hbm_gbps")
+                     if isinstance(obj.get("throughput"), dict) else None)
+        if n is not None:
+            out[key] = n
+    return out
+
+
+def fraction_sum(obj: dict) -> Optional[float]:
+    """Sum of a profile report's attribution fractions (None when the
+    snapshot carries none — bench results don't)."""
+    attr = obj.get("attribution")
+    if isinstance(attr, dict) and isinstance(attr.get("fractions"), dict):
+        vals = [_num(v) for v in attr["fractions"].values()]
+        if all(v is not None for v in vals):
+            return float(sum(vals))
+    if isinstance(obj.get("fractions"), dict):  # kind=profile record
+        vals = [_num(v) for v in obj["fractions"].values()]
+        if all(v is not None for v in vals):
+            return float(sum(vals))
+    return None
+
+
+def gate(baseline: dict, current: dict,
+         rel_tol: float = DEFAULT_REL_TOL,
+         frac_tol: float = FRACTION_SUM_TOL) -> dict:
+    """Compare two parsed snapshots; returns ``{ok, checks, errors}``
+    (``checks``: one row per diffed invariant)."""
+    checks = []
+    errors = []
+    base_inv = extract_invariants(baseline)
+    cur_inv = extract_invariants(current)
+    common = [k for k in GATE_METRICS if k in base_inv and k in cur_inv]
+    if not common:
+        errors.append(
+            "no common ratio invariants between the two snapshots "
+            f"(baseline has {sorted(base_inv)}, current has "
+            f"{sorted(cur_inv)}) — nothing to gate on"
+        )
+    for key in common:
+        b, c = base_inv[key], cur_inv[key]
+        if b == 0:
+            delta = abs(c)
+            ok = c == 0
+        else:
+            delta = abs(c - b) / abs(b)
+            ok = delta <= rel_tol
+        checks.append({
+            "metric": key, "baseline": b, "current": c,
+            "rel_delta": round(delta, 6), "tolerance": rel_tol, "ok": ok,
+        })
+    # schema-drift guard: a metric the baseline carried must not vanish
+    for key in base_inv:
+        if key not in cur_inv:
+            errors.append(
+                f"baseline carries {key!r} but the current snapshot "
+                "does not — coverage silently shrank"
+            )
+    for label, obj in (("baseline", baseline), ("current", current)):
+        s = fraction_sum(obj)
+        if s is not None:
+            ok = abs(s - 1.0) <= frac_tol
+            checks.append({
+                "metric": f"{label}_fractions_sum", "baseline": 1.0,
+                "current": round(s, 6), "rel_delta": round(abs(s - 1.0), 6),
+                "tolerance": frac_tol, "ok": ok,
+            })
+    ok = not errors and all(c["ok"] for c in checks) and bool(checks)
+    return {"ok": ok, "checks": checks, "errors": errors}
+
+
+def _load(path: str) -> dict:
+    """Parse one snapshot file; JSONL inputs use their LAST parseable
+    object line (a metrics.jsonl tail is a valid baseline)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        obj = json.loads(text)
+        if isinstance(obj, dict):
+            return obj
+    except json.JSONDecodeError:
+        pass
+    last = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            o = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(o, dict):
+            last = o
+    if last is None:
+        raise ValueError(f"{path!r}: no JSON object found")
+    return last
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("baseline", help="committed snapshot "
+                    "(profile report.json / bench result / metrics "
+                    "snapshot JSONL)")
+    ap.add_argument("current", help="fresh snapshot to gate")
+    ap.add_argument("--rel-tol", type=float, default=DEFAULT_REL_TOL,
+                    help="symmetric relative band per ratio metric "
+                         f"(default {DEFAULT_REL_TOL})")
+    ap.add_argument("--frac-tol", type=float, default=FRACTION_SUM_TOL,
+                    help="absolute |fraction sum - 1| bound "
+                         f"(default {FRACTION_SUM_TOL})")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable result on stdout")
+    args = ap.parse_args(argv)
+    try:
+        baseline = _load(args.baseline)
+        current = _load(args.current)
+    except (OSError, ValueError) as e:
+        print(f"perf_gate: {e}", file=sys.stderr)
+        return 2
+    result = gate(baseline, current, rel_tol=args.rel_tol,
+                  frac_tol=args.frac_tol)
+    if args.json:
+        print(json.dumps(result, indent=1))
+    else:
+        for c in result["checks"]:
+            print(
+                f"{'OK  ' if c['ok'] else 'FAIL'} {c['metric']:>24}: "
+                f"{c['baseline']:.6g} -> {c['current']:.6g} "
+                f"(delta {c['rel_delta']:.3f}, tol {c['tolerance']})"
+            )
+        for e in result["errors"]:
+            print(f"ERROR {e}")
+        print("perf gate: " + ("PASS" if result["ok"] else "FAIL"))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,9 +24,13 @@ snapshots) and per-kind span time fractions (the ``span_summary`` line
 of obs/spans_rank*.jsonl). Runs recorded with ``--numerics-freq`` add
 a FOURTH row from ``obs/numerics_rank0.jsonl``: grad/update norms
 (left, log scale) and the per-rule divergence gauge (right), with
-detected anomaly steps marked as vertical lines on both. Runs without
-obs/numerics data plot exactly as before — extra rows only render when
-at least one run has them.
+detected anomaly steps marked as vertical lines on both. Runs whose
+engine declared a cost model add an ATTRIBUTION row from the
+``kind=profile`` records (obs/attribution.py): stacked step-time
+fractions (compute/comm/host/residual — where the step goes) on the
+left, the MFU trend (spec MFU, or the calibrated stand-in dashed) on
+the right. Runs without obs/numerics/profile data plot exactly as
+before — extra rows only render when at least one run has them.
 """
 
 from __future__ import annotations
@@ -74,7 +78,11 @@ def load_obs(jsonl_path: str) -> dict:
     when the run has no (or unreadable) obs data, so callers degrade
     gracefully."""
     out: dict = {"comm_step": [], "comm_gbps": [], "comm_gbps_raw": [],
-                 "codec": None, "fractions": {}}
+                 "codec": None, "fractions": {},
+                 # step-time attribution (kind=profile records,
+                 # obs/attribution.py): stacked fractions + MFU trend
+                 "prof_step": [], "prof_fracs": [], "prof_mfu": [],
+                 "prof_mfu_calibrated": []}
     obs_dir = os.path.join(os.path.dirname(os.path.abspath(jsonl_path)), "obs")
     metrics = os.path.join(obs_dir, "metrics.jsonl")
     if os.path.exists(metrics):
@@ -90,6 +98,29 @@ def load_obs(jsonl_path: str) -> dict:
                         # the span summary): names the codec for the
                         # legend of the raw-vs-effective pair
                         out["codec"] = row.get("codec")
+                        continue
+                    if row.get("kind") == "profile" and "step" in row:
+                        if out["prof_step"] and (
+                            row["step"] < out["prof_step"][-1]
+                        ):
+                            # append-mode rerun: newest run's series
+                            # wins (mirrors the comm-series rule)
+                            for k in ("prof_step", "prof_fracs",
+                                      "prof_mfu", "prof_mfu_calibrated"):
+                                out[k] = []
+                        if out["prof_step"] and (
+                            row["step"] == out["prof_step"][-1]
+                        ):
+                            out["prof_step"].pop()
+                            out["prof_fracs"].pop()
+                            out["prof_mfu"].pop()
+                            out["prof_mfu_calibrated"].pop()
+                        out["prof_step"].append(row["step"])
+                        out["prof_fracs"].append(row.get("fractions", {}))
+                        out["prof_mfu"].append(row.get("mfu"))
+                        out["prof_mfu_calibrated"].append(
+                            row.get("mfu_calibrated")
+                        )
                         continue
                     if row.get("kind") != "metrics" or "step" not in row:
                         continue
@@ -249,16 +280,20 @@ def plot(runs: dict[str, str], out: str, show: bool = False,
         o["nm_step"] or o["div_step"] or o["anomaly_steps"]
         for o in obs.values()
     )
-    n_rows = 2 + int(has_obs) + int(has_nm)
+    has_prof = any(o["prof_step"] for o in obs.values())
+    n_rows = 2 + int(has_obs) + int(has_nm) + int(has_prof)
     fig, axes = plt.subplots(n_rows, 2, figsize=(11, 3.5 * n_rows))
     (ax_loss, ax_val), (ax_ips, ax_lr) = axes[0], axes[1]
-    ax_comm = ax_frac = ax_nm = ax_div = None
+    ax_comm = ax_frac = ax_nm = ax_div = ax_attr = ax_mfu = None
     row = 2
     if has_obs:
         ax_comm, ax_frac = axes[row]
         row += 1
     if has_nm:
         ax_nm, ax_div = axes[row]
+        row += 1
+    if has_prof:
+        ax_attr, ax_mfu = axes[row]
     frac_kinds: list[str] = []
     for o in obs.values():
         frac_kinds += [k for k in o["fractions"] if k not in frac_kinds]
@@ -301,6 +336,34 @@ def plot(runs: dict[str, str], out: str, show: bool = False,
         if ax_div is not None and o["div_step"]:
             ax_div.plot(*smoothed(o["div_step"], o["divergence"], smooth),
                         label=label)
+        if ax_attr is not None and o["prof_step"]:
+            # stacked step-time fractions (kind=profile records): the
+            # stack IS the step — where each step's wall went; residual
+            # clamps at 0 for display (a negative residual means the
+            # models over-explain, already flagged in the record)
+            kinds = ("compute", "comm", "host", "residual")
+            series = [
+                [max(0.0, f.get(k, 0.0)) for f in o["prof_fracs"]]
+                for k in kinds
+            ]
+            ax_attr.stackplot(
+                o["prof_step"], series, alpha=0.7,
+                labels=[f"{label} {k}" for k in kinds]
+                if len(runs) > 1 else list(kinds),
+            )
+        if ax_mfu is not None and o["prof_step"]:
+            spec = [(s, v) for s, v in zip(o["prof_step"], o["prof_mfu"])
+                    if v is not None]
+            cal = [(s, v) for s, v in
+                   zip(o["prof_step"], o["prof_mfu_calibrated"])
+                   if v is not None]
+            if spec:
+                ax_mfu.plot(*zip(*spec), label=f"{label} mfu")
+            if cal:
+                # the calibrated stand-in (no spec peak): dashed so it
+                # cannot be misread as a real utilization number
+                ax_mfu.plot(*zip(*cal), linestyle="--",
+                            label=f"{label} mfu (calibrated)")
         if o["anomaly_steps"]:
             # anomaly markers on both numerics panels: first marker per
             # run carries the legend entry, the rest stay unlabeled
@@ -345,9 +408,16 @@ def plot(runs: dict[str, str], out: str, show: bool = False,
         ax_div.set(title="divergence gauge (anomaly steps dotted red)",
                    xlabel="step")
         all_axes += [ax_nm, ax_div]
+    if ax_attr is not None:
+        ax_attr.set(title="step-time attribution "
+                          "(compute/comm/host/residual fractions)",
+                    xlabel="step", ylim=(0, 1.05))
+        ax_mfu.set(title="MFU trend (dashed = calibrated peak)",
+                   xlabel="step")
+        all_axes += [ax_attr, ax_mfu]
     for ax in all_axes:
         ax.grid(True, alpha=0.3)
-        if ax.lines or ax.patches:
+        if ax.lines or ax.patches or ax.collections:
             ax.legend(fontsize=8)
     fig.tight_layout()
     fig.savefig(out, dpi=120)

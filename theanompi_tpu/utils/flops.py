@@ -1,4 +1,5 @@
-"""FLOP accounting and MFU (model FLOPs utilization).
+"""FLOP + HBM cost accounting and MFU (model FLOPs utilization) — the
+single per-compiled-executable cost authority.
 
 The reference had no FLOPs accounting at all — its recorder reported
 images/sec only (reference: ``lib/recorder.py``, SURVEY.md §5.1). On TPU
@@ -6,15 +7,23 @@ the honest scaling story needs achieved TFLOP/s vs the chip's peak, so
 the bench and recorder report MFU alongside img/s (BASELINE metric
 "scaling eff" is defined in those terms).
 
-FLOPs come from XLA's own cost model on the COMPILED program
-(``Compiled.cost_analysis()``) — the same HLO the chip executes, so
-fusion/rematerialization are accounted for. Peak numbers are a small
-device-kind table (public spec-sheet bf16 peaks); unknown devices (CPU
-test meshes) report ``mfu=None`` rather than a made-up number.
+FLOPs and HBM bytes come from XLA's own cost model on the COMPILED
+program (``Compiled.cost_analysis()``: ``flops`` + ``bytes accessed``) —
+the same HLO the chip executes, so fusion/rematerialization are
+accounted for. Peak numbers are small device-kind tables (public
+spec-sheet bf16 FLOP/s and HBM GB/s); unknown devices (CPU test meshes)
+report ``mfu=None`` rather than a made-up number.
+
+Every consumer shares this module (attribution-profiler PR): bench.py's
+compute mode, the ``tmpi profile`` subcommand (tools/profile.py), the
+live ``tmpi_mfu``/``tmpi_hbm_gbps`` gauges (obs/attribution.py via each
+engine's ``cost_model()`` hook), and the run summary's ``mfu`` field —
+one :class:`CostModel` per compiled step, no hand-rolled duplicates.
 """
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Optional
 
 # public spec-sheet dense bf16 peak FLOP/s per chip; substring-matched
@@ -32,38 +41,166 @@ _PEAK_BF16 = (
     ("v2", 45e12),
 )
 
+# public spec-sheet HBM bandwidth (bytes/s) per chip — the roofline's
+# other ceiling; same substring-match convention as _PEAK_BF16
+_PEAK_HBM = (
+    ("v5 lite", 819e9),  # v5e: 819 GB/s
+    ("v5litepod", 819e9),
+    ("v5e", 819e9),
+    ("v6 lite", 1640e9),  # v6e / Trillium: 1640 GB/s
+    ("v6e", 1640e9),
+    ("v5p", 2765e9),
+    ("v5", 2765e9),
+    ("v4", 1228e9),
+    ("v3", 900e9),
+    ("v2", 700e9),
+)
 
-def peak_flops(device=None) -> Optional[float]:
-    """Per-chip peak bf16 FLOP/s for ``device`` (default: first visible
-    device); None when unknown (e.g. CPU)."""
+
+def _match_table(table, device) -> Optional[float]:
     import jax
 
     if device is None:
         device = jax.devices()[0]
     kind = getattr(device, "device_kind", "").lower()
-    for key, peak in _PEAK_BF16:
+    for key, peak in table:
         if key in kind:
             return peak
     return None
 
 
-def compiled_flops(jitted, *args, **kwargs) -> Optional[float]:
-    """Total FLOPs of one invocation of an already-jitted function, from
-    XLA's cost analysis of the lowered+compiled program. None when the
-    backend provides no cost model."""
+def peak_flops(device=None) -> Optional[float]:
+    """Per-chip peak bf16 FLOP/s for ``device`` (default: first visible
+    device); None when unknown (e.g. CPU)."""
+    return _match_table(_PEAK_BF16, device)
+
+
+def peak_hbm_bytes_per_sec(device=None) -> Optional[float]:
+    """Per-chip peak HBM bytes/s (spec sheet); None when unknown."""
+    return _match_table(_PEAK_HBM, device)
+
+
+@dataclass
+class CostModel:
+    """XLA's cost analysis of ONE compiled executable invocation (one
+    training step, usually), paired with the device's spec-sheet peaks.
+
+    ``flops``/``hbm_bytes`` are per-invocation totals from the compiled
+    HLO (``cost_analysis()``: ``flops`` + ``bytes accessed``). Peaks are
+    None on devices without a spec entry (CPU test meshes) — consumers
+    must then either skip utilization ratios (:meth:`mfu` returns None)
+    or calibrate against measured time (obs/attribution.py documents
+    that convention)."""
+
+    flops: float
+    hbm_bytes: float
+    device_kind: str = ""
+    peak_flops_per_sec: Optional[float] = None
+    peak_hbm_bytes_per_sec: Optional[float] = None
+
+    def mfu(self, step_seconds: Optional[float]) -> Optional[float]:
+        """Achieved / peak FLOP/s for a measured per-step time; None
+        when the peak is unknown or the time unmeasurable."""
+        if not step_seconds or step_seconds <= 0 or not self.peak_flops_per_sec:
+            return None
+        return mfu(self.flops / step_seconds,
+                   peak=self.peak_flops_per_sec)
+
+    def hbm_gbps(self, step_seconds: Optional[float]) -> Optional[float]:
+        """Achieved HBM GB/s implied by a measured per-step time (bytes
+        accessed / time) — computable on every backend."""
+        if not step_seconds or step_seconds <= 0:
+            return None
+        return self.hbm_bytes / step_seconds / 1e9
+
+    def compute_seconds(self) -> Optional[float]:
+        """Roofline lower bound on the step's device time: the larger of
+        the FLOP time at peak compute and the HBM time at peak
+        bandwidth. None when the peaks are unknown."""
+        if not self.peak_flops_per_sec or not self.peak_hbm_bytes_per_sec:
+            return None
+        return max(self.flops / self.peak_flops_per_sec,
+                   self.hbm_bytes / self.peak_hbm_bytes_per_sec)
+
+    def hbm_bound(self) -> Optional[bool]:
+        """True when the roofline's binding ceiling is HBM bandwidth,
+        False when compute; None when the peaks are unknown."""
+        if not self.peak_flops_per_sec or not self.peak_hbm_bytes_per_sec:
+            return None
+        return (self.hbm_bytes / self.peak_hbm_bytes_per_sec
+                > self.flops / self.peak_flops_per_sec)
+
+    def as_metrics(self) -> dict:
+        """Numeric gauge map (obs facade prefixes ``tmpi_``)."""
+        out = {
+            "cost_flops_per_step": self.flops,
+            "cost_hbm_bytes_per_step": self.hbm_bytes,
+        }
+        if self.peak_flops_per_sec:
+            out["cost_peak_tflops"] = self.peak_flops_per_sec / 1e12
+        if self.peak_hbm_bytes_per_sec:
+            out["cost_peak_hbm_gbps"] = self.peak_hbm_bytes_per_sec / 1e9
+        return out
+
+
+def compiled_cost(jitted, *args, device=None, **kwargs) -> Optional[CostModel]:
+    """:class:`CostModel` of one invocation of an already-jitted
+    function, from XLA's cost analysis of the lowered+compiled program
+    (abstract ``ShapeDtypeStruct`` args work — nothing executes). None
+    when the backend provides no cost model or the lowering fails."""
+    import jax
+
     try:
         compiled = jitted.lower(*args, **kwargs).compile()
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else {}
         flops = float(ca.get("flops", 0.0))
-        return flops if flops > 0 else None
+        if flops <= 0:
+            return None
+        if device is None:
+            device = jax.devices()[0]
+        return CostModel(
+            flops=flops,
+            hbm_bytes=float(ca.get("bytes accessed", 0.0)),
+            device_kind=getattr(device, "device_kind", ""),
+            peak_flops_per_sec=peak_flops(device),
+            peak_hbm_bytes_per_sec=peak_hbm_bytes_per_sec(device),
+        )
     except Exception:
         return None
 
 
-def mfu(flops_per_sec: Optional[float], device=None) -> Optional[float]:
-    peak = peak_flops(device)
+def compiled_flops(jitted, *args, **kwargs) -> Optional[float]:
+    """Total FLOPs of one invocation of an already-jitted function
+    (thin view over :func:`compiled_cost`). None when the backend
+    provides no cost model."""
+    cost = compiled_cost(jitted, *args, **kwargs)
+    return cost.flops if cost is not None else None
+
+
+def abstract_batch(model, global_batch: int):
+    """``(x, y)`` ShapeDtypeStructs for one global training batch of
+    ``model`` — the abstract operands every engine's ``cost_model()``
+    lowers its compiled step over (LM models: x IS the label stream)."""
+    import jax
+    import jax.numpy as jnp
+
+    sds = jax.ShapeDtypeStruct
+    ishape = tuple(model.recipe.input_shape)
+    if getattr(model, "is_lm", False):
+        x = sds((global_batch, *ishape), jnp.int32)
+        return x, x
+    return (sds((global_batch, *ishape), jnp.float32),
+            sds((global_batch,), jnp.int32))
+
+
+def mfu(flops_per_sec: Optional[float], device=None,
+        peak: Optional[float] = None) -> Optional[float]:
+    """Achieved / peak FLOP/s. ``peak`` overrides the device-table
+    lookup (CostModel carries its own)."""
+    if peak is None:
+        peak = peak_flops(device)
     if not peak or not flops_per_sec:
         return None
     return flops_per_sec / peak

@@ -1,0 +1,14 @@
+#!/usr/bin/env python
+"""Repo-root shim for the perf-regression gate — the CI-invocable path
+(``tools/perf_gate.py baseline.json current.json``). The implementation
+(and its tests) live in :mod:`theanompi_tpu.tools.perf_gate`."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from theanompi_tpu.tools.perf_gate import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
