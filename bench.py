@@ -25,6 +25,10 @@ line is ONE JSON object {"metric", "value", "unit", "vs_baseline", ...}):
                                    #   dynamic micro-batching inference
                                    #   engine (serve/) — sustained req/s,
                                    #   p50/p99 latency, batch-fill
+  python bench.py --bucket-sweep   # bucketed-allreduce sweep (bucket
+                                   #   size x engine variant); compute
+                                   #   mode also takes --fused-update /
+                                   #   --allreduce-buckets directly
 
 Beyond img/s, compute mode reports achieved TFLOP/s and MFU from XLA's
 cost analysis of the compiled program (utils/flops.py) — the reference
@@ -164,15 +168,22 @@ def _zoo_entry(name: str):
     return zoo_entry(name)
 
 
-def bench_compute(steps: int = 20, trials: int = 5, model_name: str = "alexnet") -> dict:
+def bench_compute(steps: int = 20, trials: int = 5, model_name: str = "alexnet",
+                  fused_update: bool = False,
+                  allreduce_buckets: float = 0.0) -> dict:
     """Fused-step device throughput: fwd+bwd+sync+update, input pipeline
-    excluded (see e2e mode for the honest framework number)."""
+    excluded (see e2e mode for the honest framework number).
+
+    ``fused_update`` / ``allreduce_buckets``: the MFU-push knobs
+    (ROADMAP item 2a/2b) — the one-pass optimizer epilogue
+    (ops/pallas_update.py) and the bucketed overlap-with-backward
+    allreduce (parallel/strategies.py; a no-op on one chip)."""
     import jax
     import jax.numpy as jnp
 
     from theanompi_tpu.parallel import make_mesh
     from theanompi_tpu.parallel.mesh import put_global_batch
-    from theanompi_tpu.parallel.strategies import get_strategy
+    from theanompi_tpu.parallel.strategies import bucketed, get_strategy
     from theanompi_tpu.train import init_train_state, make_multi_step, make_train_step
     from theanompi_tpu.utils.flops import compiled_cost, peak_flops
 
@@ -191,15 +202,22 @@ def bench_compute(steps: int = 20, trials: int = 5, model_name: str = "alexnet")
     thread_state = model_name.endswith("_350m") and n_dev == 1
 
     if n_dev == 1:
-        single = jax.jit(make_train_step(model))
+        step1 = make_train_step(model, fused_update=fused_update)
+        single = jax.jit(step1)
         runner = jax.jit(
-            make_multi_step(make_train_step(model), steps),
+            make_multi_step(step1, steps),
             donate_argnums=(0,) if thread_state else (),
         )
     else:
         from jax.sharding import PartitionSpec as P
 
-        base = make_train_step(model, grad_sync=get_strategy("psum", "data", n_dev))
+        sync = (
+            bucketed("psum", "data", n_dev, allreduce_buckets)
+            if allreduce_buckets
+            else get_strategy("psum", "data", n_dev)
+        )
+        base = make_train_step(model, grad_sync=sync,
+                               fused_update=fused_update)
         specs = dict(
             mesh=mesh,
             in_specs=(P(), P("data"), P("data"), P()),
@@ -317,6 +335,10 @@ def bench_compute(steps: int = 20, trials: int = 5, model_name: str = "alexnet")
         "hbm_gbps": round(hbm_gbps, 2) if hbm_gbps is not None else None,
         "batch": batch,
         "timing": timing,  # {k, median_s, spread_frac}: value quotes the median
+        # MFU-push knobs this reading was taken under (perf_gate pairs
+        # compare like with like)
+        "fused_update": bool(fused_update),
+        "allreduce_buckets": float(allreduce_buckets or 0.0),
     }
     if is_lm:
         import jax.numpy as jnp
@@ -697,6 +719,113 @@ def bench_codec_sweep(engines=("bsp", "zero1", "easgd", "gosgd", "nd"),
     }
 
 
+def bench_bucket_sweep(engines=("bsp", "bsp_fused"),
+                       bucket_mbs=(0.0, 4.0, 8.0, 32.0),
+                       max_steps: int = 6) -> dict:
+    """Bucketed-allreduce sweep (bucket size x engine variant): run the
+    BSP rule with ``--allreduce-buckets`` at each size — per-step and
+    fused-dispatch (``bsp_fused`` = ``--steps-per-dispatch 4``) engine
+    variants — and report throughput next to the analytic bucket count
+    and overlap fraction per row. Headline value: best bucketed img/s
+    over the unbucketed (size-0) baseline of the same engine variant —
+    > 1.0 means the overlap schedule pays for its bucket overheads on
+    this backend. Emitted through the standard snapshot schema like
+    every bench mode."""
+    import tempfile
+
+    import jax
+
+    from theanompi_tpu.launch.worker import run_training
+    from theanompi_tpu.models.cifar10 import Cifar10_model
+    from theanompi_tpu.parallel.strategies import (
+        BucketedOverlapSync,
+        bucket_overlap_frac,
+    )
+
+    n_dev = len(jax.devices())
+    n = min(4, n_dev)
+    if n < 2:
+        # a 1-device mesh has no allreduce: every row would read the
+        # single-device fast path and the table would "prove" buckets
+        # free — refuse instead (same policy as --codec-sweep)
+        raise RuntimeError(
+            "--bucket-sweep needs >= 2 devices; on CPU set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=4 "
+            "(before jax import)")
+    recipe_overrides = {"batch_size": 16, "input_shape": (16, 16, 3),
+                        "sched_kwargs": {"lr": 0.05,
+                                         "boundaries": [10 ** 9]}}
+    # analytic geometry per size (model-dependent, run-invariant)
+    model = Cifar10_model(
+        Cifar10_model.default_recipe().replace(**recipe_overrides)
+    )
+    params = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0))[0])
+    variants = {"bsp": 1, "bsp_fused": 4}  # steps_per_dispatch
+    # validate the whole engine list BEFORE any training runs — a typo
+    # in the second name must not discard minutes of completed sweep
+    for engine in engines:
+        if engine not in variants:
+            raise ValueError(
+                f"unknown bucket-sweep engine {engine!r}; known: "
+                f"{sorted(variants)}"
+            )
+    rows = []
+    with tempfile.TemporaryDirectory(prefix="tmpi_bucket_sweep_") as d:
+        for engine in engines:
+            for mb in bucket_mbs:
+                summary = run_training(
+                    rule="bsp", model_cls=Cifar10_model, devices=n,
+                    allreduce_buckets=mb,
+                    steps_per_dispatch=variants[engine],
+                    max_steps=max_steps, n_epochs=100,
+                    dataset="synthetic",
+                    dataset_kwargs={"n_train": 128, "n_val": 64,
+                                    "image_shape": (16, 16, 3)},
+                    recipe_overrides=recipe_overrides,
+                    obs_dir=os.path.join(
+                        d, f"{engine}_{str(mb).replace('.', 'p')}"),
+                    print_freq=0, seed=7,
+                )
+                nb = (
+                    BucketedOverlapSync("data", bucket_mb=mb).n_buckets(params)
+                    if mb else 1
+                )
+                rows.append({
+                    "engine": engine,
+                    "bucket_mb": float(mb),
+                    "n_buckets": nb,
+                    "overlap_frac": round(
+                        bucket_overlap_frac(nb) if mb else 0.0, 4),
+                    "images_per_sec": round(summary["images_per_sec"], 1),
+                    "val_loss": round(summary["val"]["loss"], 4)
+                    if "val" in summary else None,
+                    "steps": summary["steps"],
+                })
+    def _best_ratio(engine):
+        base = [r for r in rows
+                if r["engine"] == engine and not r["bucket_mb"]]
+        bucketed_rows = [r for r in rows
+                         if r["engine"] == engine and r["bucket_mb"]]
+        if not base or not bucketed_rows or not base[0]["images_per_sec"]:
+            return None
+        return max(r["images_per_sec"] for r in bucketed_rows) / \
+            base[0]["images_per_sec"]
+
+    ratios = [r for r in (_best_ratio(e) for e in engines) if r]
+    return {
+        "metric": "bucket_sweep_best_speedup_vs_unbucketed",
+        "value": round(max(ratios), 4) if ratios else None,
+        "unit": "x img/s of the size-0 baseline (best bucketed row)",
+        "vs_baseline": round(max(ratios), 4) if ratios else None,
+        "baseline_estimated": False,
+        "n_devices": n,
+        "engines": ",".join(engines),
+        "bucket_mbs": ",".join(str(b) for b in bucket_mbs),
+        "max_steps": max_steps,
+        "table": rows,
+    }
+
+
 _SCALING_PROBE = """
 # per-step timing, no scan fusion: XLA:CPU compiles a k-step scan of a
 # conv model pathologically slowly (~5 min measured), and CPU dispatch
@@ -849,6 +978,27 @@ def main() -> int:
                     help="codec sweep: comma-separated engine subset")
     ap.add_argument("--codecs", default="none,bf16,int8,int8:ef",
                     help="codec sweep: comma-separated codec subset")
+    ap.add_argument("--fused-update", action="store_true",
+                    help="compute mode: one-pass fused optimizer "
+                         "epilogue (ops/pallas_update.py; ROADMAP 2a)")
+    ap.add_argument("--allreduce-buckets", type=float, default=0.0,
+                    metavar="MB",
+                    help="compute mode: bucketed overlap-with-backward "
+                         "allreduce (parallel/strategies.py; no-op on "
+                         "one chip; ROADMAP 2b)")
+    ap.add_argument("--bucket-sweep", action="store_true",
+                    help="bucketed-allreduce sweep (bucket size x "
+                         "engine variant over the BSP rule): per-row "
+                         "img/s + analytic bucket count/overlap; "
+                         "headline = best speedup vs the unbucketed "
+                         "baseline (overrides --mode)")
+    ap.add_argument("--bucket-engines", default="bsp,bsp_fused",
+                    help="bucket sweep: engine variants (bsp = "
+                         "per-step dispatch, bsp_fused = "
+                         "--steps-per-dispatch 4)")
+    ap.add_argument("--bucket-sizes", default="0,4,8,32",
+                    help="bucket sweep: comma-separated bucket sizes "
+                         "in MB (0 = the unbucketed baseline row)")
     ap.add_argument("--serve-bench", action="store_true",
                     help="closed-loop serving benchmark over the "
                          "dynamic micro-batching engine (serve/): "
@@ -878,13 +1028,21 @@ def main() -> int:
             codecs=tuple(c for c in args.codecs.split(",") if c),
             max_steps=args.steps or 6,
         )
+    elif args.bucket_sweep:
+        result = bench_bucket_sweep(
+            engines=tuple(e for e in args.bucket_engines.split(",") if e),
+            bucket_mbs=tuple(float(b) for b in args.bucket_sizes.split(",")),
+            max_steps=args.steps or 6,
+        )
     elif args.serve_bench:
         result = bench_serve(
             duration_s=args.serve_duration, clients=args.serve_clients,
             buckets=tuple(int(b) for b in args.serve_buckets.split(",")),
         )
     elif args.mode == "compute":
-        result = bench_compute(steps=args.steps or 20, model_name=args.model)
+        result = bench_compute(steps=args.steps or 20, model_name=args.model,
+                               fused_update=args.fused_update,
+                               allreduce_buckets=args.allreduce_buckets)
     elif args.mode == "e2e":
         depths = (
             tuple(int(k) for k in args.dispatch_depths.split(","))

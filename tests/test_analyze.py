@@ -346,6 +346,105 @@ def test_golden_drift_is_caught():
     assert compare_golden(trace, tampered) != []
 
 
+def test_bucketed_golden_pins_per_bucket_psums():
+    """The bsp_bucketed config (--allreduce-buckets) traces one psum
+    PER BUCKET instead of the single gradient pmean — its own golden
+    pins that schedule (ISSUE 11)."""
+    trace = harness.trace_engine("bsp_bucketed", "none")
+    assert trace.error is None, trace.error
+    gold = load_golden("bsp_bucketed", "none")
+    assert compare_golden(trace, gold) == []
+    step = signature_payload(trace)["parts"]["step"]
+    grad_psums = [c for c in step if c["prim"] == "psum" and c["shape"]]
+    plain = signature_payload(harness.trace_engine("bsp", "none"))
+    plain_grad = [c for c in plain["parts"]["step"]
+                  if c["prim"] == "psum" and c["shape"]]
+    # same per-leaf collectives, DIFFERENT order: the bucketed trace
+    # posts them in gradient-PRODUCTION order (output-layer leaves
+    # first — the overlap schedule), where plain bsp's single
+    # post-backward pmean posts them in tree order. That ordering is
+    # exactly what the golden pins.
+    key = lambda c: (c["prim"], tuple(c["shape"]))  # noqa: E731
+    assert sorted(map(key, grad_psums)) == sorted(map(key, plain_grad))
+    assert [key(c) for c in grad_psums] != [key(c) for c in plain_grad]
+    # the output layer's 10-class leaves lead the bucketed schedule
+    assert key(grad_psums[0]) == ("psum", (10,))
+    # every bucket reduces over the SAME axis — the invariant the
+    # mutation below violates
+    assert {tuple(c["axes"]) for c in grad_psums} == {("data",)}
+
+
+def test_bucket_psum_axis_drift_caught(mesh22):
+    """Mutation self-test (ISSUE 11 satellite): a bucketed sync where
+    ONE bucket's psum axis drifts from its siblings. The traced
+    schedule shows the drift, and the golden comparison (rule SPMD003)
+    reports it — a reviewer cannot merge a bucket that reduces over the
+    wrong mesh axis without regenerating (and re-reviewing) the
+    snapshot."""
+    from theanompi_tpu.parallel.strategies import assign_buckets
+
+    params = {
+        "a": jax.ShapeDtypeStruct((64,), jnp.float32),
+        "b": jax.ShapeDtypeStruct((8,), jnp.float32),
+        "c": jax.ShapeDtypeStruct((4,), jnp.float32),
+    }
+
+    def make_mapped(drift: bool):
+        def wrap(p):
+            leaves, treedef = jax.tree_util.tree_flatten(p)
+            out = list(leaves)
+            # ~64 B buckets: every leaf its own bucket
+            for k, idx in enumerate(assign_buckets(leaves, 64)):
+                axis = "aux" if (drift and k == 0) else "data"
+
+                @jax.custom_vjp
+                def tag(*ls):
+                    return ls
+
+                def fwd(*ls):
+                    return ls, None
+
+                def bwd(_, cts, axis=axis):
+                    return tuple(lax.pmean(c, axis) for c in cts)
+
+                tag.defvjp(fwd, bwd)
+                tagged = tag(*[leaves[i] for i in idx])
+                for j, i in enumerate(idx):
+                    out[i] = tagged[j]
+            return jax.tree_util.tree_unflatten(treedef, out)
+
+        def step(p, x):
+            def loss(p):
+                wp = wrap(p)
+                return sum(jnp.sum(l) for l in
+                           jax.tree_util.tree_leaves(wp)) * jnp.sum(x)
+
+            return jax.grad(loss)(p)
+
+        return jax.shard_map(
+            step, mesh=mesh22, in_specs=(P(), P("data")), out_specs=P(),
+            check_vma=False,
+        )
+
+    x = jax.ShapeDtypeStruct((8,), jnp.float32)
+    sig_ok, _ = extract_signature(jax.make_jaxpr(make_mapped(False))(params, x))
+    sig_bad, _ = extract_signature(jax.make_jaxpr(make_mapped(True))(params, x))
+    assert {tuple(c.axes) for c in sig_ok.collectives} == {("data",)}
+    assert ("aux",) in {tuple(c.axes) for c in sig_bad.collectives}
+
+    # the drifted schedule against the reviewed snapshot -> SPMD003
+    ok_trace = harness.EngineTrace(engine="bsp_bucketed", codec="none")
+    ok_trace.parts.append(harness.TracePart(
+        name="step", signature=sig_ok, axis_sizes={"data": 2, "aux": 2}))
+    bad_trace = harness.EngineTrace(engine="bsp_bucketed", codec="none")
+    bad_trace.parts.append(harness.TracePart(
+        name="step", signature=sig_bad, axis_sizes={"data": 2, "aux": 2}))
+    golden = signature_payload(ok_trace)
+    assert compare_golden(ok_trace, golden) == []
+    errs = compare_golden(bad_trace, golden)
+    assert errs and any("aux" in e for e in errs)
+
+
 def test_spmd_exempt_needs_a_reason(tmp_path):
     from theanompi_tpu.tools.lint import _exemption_reason
 

@@ -114,6 +114,42 @@ def test_model_overrun_flagged():
     assert "model_overrun" in a.detail
 
 
+def test_overlap_frac_discounts_comm():
+    """The bucketed-allreduce fix: only the EXPOSED (1 - overlap) share
+    of the collective books as comm; the hidden seconds are named in
+    detail rather than double-counted against compute."""
+    cost = _spec_cost()  # 10us compute
+    tm = TrafficModel(rule="bsp", n_workers=4, bytes_per_step=1e6)
+    serial = attribute_step(100e-6, cost=cost, traffic=tm, host_frac=0.1,
+                            link_bps=100e9)  # comm model = 10us
+    overlapped = attribute_step(100e-6, cost=cost, traffic=tm,
+                                host_frac=0.1, link_bps=100e9,
+                                overlap_frac=0.75)
+    assert serial.fractions["comm"] == pytest.approx(0.1)
+    assert overlapped.fractions["comm"] == pytest.approx(0.025)
+    # the hidden share moves to the residual, not into thin air
+    assert overlapped.fractions["residual"] == pytest.approx(
+        serial.fractions["residual"] + 0.075)
+    assert overlapped.fractions_sum == pytest.approx(1.0)
+    assert overlapped.detail["overlap_frac"] == pytest.approx(0.75)
+    assert overlapped.detail["comm_hidden_s"] == pytest.approx(7.5e-6)
+
+
+def test_overlap_frac_defaults_from_traffic_detail():
+    """The bucketed engine's traffic_model carries the schedule's
+    overlap estimate in detail — attribute_step must pick it up without
+    an explicit argument (the obs facade path passes none)."""
+    cost = _spec_cost()
+    tm = TrafficModel(rule="bsp", n_workers=4, bytes_per_step=1e6,
+                      detail={"n_buckets": 4, "overlap_frac": 0.75})
+    a = attribute_step(100e-6, cost=cost, traffic=tm, link_bps=100e9)
+    assert a.fractions["comm"] == pytest.approx(0.025)
+    # explicit argument overrides the detail block
+    b = attribute_step(100e-6, cost=cost, traffic=tm, link_bps=100e9,
+                       overlap_frac=0.0)
+    assert b.fractions["comm"] == pytest.approx(0.1)
+
+
 def test_attribute_step_rejects_bad_wall():
     with pytest.raises(ValueError, match="step_seconds"):
         attribute_step(0.0)

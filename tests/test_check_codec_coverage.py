@@ -56,6 +56,47 @@ def test_exempt_marker_covers(tmp_path):
     assert check_file(str(p2)) is not None
 
 
+_BUCKET_BODY = """
+    from jax import lax
+
+    def bucketed_exchange(grads, axis):
+        return [lax.pmean(g, axis) for g in grads]
+"""
+
+
+def test_bucketed_exchange_without_codec_fails(tmp_path):
+    p = tmp_path / "bucketing.py"
+    p.write_text(textwrap.dedent(_BUCKET_BODY))
+    err = check_file(str(p))
+    assert err is not None and "bucketed_exchange" in err
+    assert main([str(tmp_path)]) == 1
+
+
+def test_bucketed_exchange_with_codec_or_exempt_passes(tmp_path):
+    p = tmp_path / "good_buckets.py"
+    p.write_text(
+        "from theanompi_tpu.parallel.codec import get_codec\n"
+        + textwrap.dedent(_BUCKET_BODY)
+    )
+    assert check_file(str(p)) is None
+    p2 = tmp_path / "exempt_buckets.py"
+    p2.write_text(
+        "# codec_exempt: research prototype, wire stays fp32 by design\n"
+        + textwrap.dedent(_BUCKET_BODY)
+    )
+    assert check_file(str(p2)) is None
+
+
+def test_bucket_named_helper_without_collective_out_of_scope(tmp_path):
+    # a bucket-ish name alone is not a wire schedule — only posting a
+    # collective pulls a def into scope
+    p = tmp_path / "geometry.py"
+    p.write_text(
+        "def assign_buckets(leaves, bucket_bytes):\n    return []\n"
+    )
+    assert check_file(str(p)) is None
+
+
 def test_library_modules_out_of_scope(tmp_path):
     p = tmp_path / "lib.py"
     p.write_text("def helper():\n    return 1\n")

@@ -1,0 +1,293 @@
+"""Fused optimizer-update kernel (ops/pallas_update.py, ROADMAP 2a):
+bit-or-tolerance parity against the tree_map reference rules on the
+CPU Pallas interpreter — fp32 AND bf16 params with fp32 velocity,
+weight-decay-folded grads, and both global-norm clip edges (zero norm,
+norm beyond the max)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from theanompi_tpu.ops import optimizers as opt
+from theanompi_tpu.ops.pallas_update import (
+    clip_coefficient,
+    fuse_optimizer,
+    fused_momentum_sgd,
+    fused_nesterov_sgd,
+    fused_sgd,
+)
+
+LR = jnp.float32(0.05)
+
+
+def _tree(seed=0, dtype=jnp.float32):
+    r = np.random.RandomState(seed)
+    # deliberately lane-hostile shapes: 1-element, sub-lane, multi-row
+    return {
+        "w": jnp.asarray(r.randn(37, 129), dtype),
+        "b": jnp.asarray(r.randn(13), dtype),
+        "s": jnp.asarray(r.randn(1), dtype),
+    }
+
+
+def _apply_ref(o, grads, state, params, lr=LR):
+    """The unfused two-phase path (o.update + apply_updates) — the
+    oracle every fused `apply` must match."""
+    updates, state = o.update(grads, state, params, lr)
+    return opt.apply_updates(params, updates), state
+
+
+def _leaves_equal(a, b):
+    return all(
+        bool(jnp.all(x == y))
+        for x, y in zip(jax.tree_util.tree_leaves(a),
+                        jax.tree_util.tree_leaves(b))
+    )
+
+
+def _assert_leaves_close(a, b, rtol=1e-6, atol=1e-7):
+    """fp32 parity bar: the fused kernel computes the same expression
+    chain, but it is a DIFFERENT XLA program than the tree_map oracle —
+    fma contraction may differ per op, so the contract is 1-ulp-class
+    tolerance, not bitwise equality across programs."""
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_allclose(
+            np.asarray(x, np.float32), np.asarray(y, np.float32),
+            rtol=rtol, atol=atol,
+        )
+
+
+# --------------------------------------------------------------------------
+# fp32 parity: same expression chain, 1-ulp fma-contraction tolerance
+# (see _assert_leaves_close)
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("nesterov", [False, True])
+def test_momentum_fused_parity_fp32(nesterov):
+    params, grads = _tree(0), _tree(1)
+    o = fused_momentum_sgd(momentum=0.9, weight_decay=0.01,
+                           nesterov=nesterov)
+    state = o.init(params)
+    p_ref, s_ref = params, state
+    p_f, s_f = params, state
+    for _ in range(3):
+        p_ref, s_ref = _apply_ref(o, grads, s_ref, p_ref)
+        p_f, s_f = jax.jit(o.apply)(grads, s_f, p_f, LR)
+    _assert_leaves_close(p_ref, p_f)
+    _assert_leaves_close(s_ref["vel"], s_f["vel"])
+
+
+def test_fused_matches_registry_momentum_exactly():
+    """The fused rule without clip IS the registry's momentum_sgd —
+    same trajectory, same state layout (resume crosses the boundary)."""
+    params, grads = _tree(0), _tree(1)
+    classic = opt.momentum_sgd(momentum=0.9, weight_decay=0.005)
+    fused = fuse_optimizer("momentum", momentum=0.9, weight_decay=0.005)
+    p_c, s_c = params, classic.init(params)
+    p_f, s_f = params, fused.init(params)
+    assert jax.tree_util.tree_structure(s_c) == \
+        jax.tree_util.tree_structure(s_f)
+    for _ in range(2):
+        p_c, s_c = _apply_ref(classic, grads, s_c, p_c)
+        p_f, s_f = fused.apply(grads, s_f, p_f, LR)
+    _assert_leaves_close(p_c, p_f)
+    _assert_leaves_close(s_c, s_f)
+
+
+def test_fused_sgd_stateless_parity():
+    params, grads = _tree(0), _tree(1)
+    classic = opt.sgd(weight_decay=0.02)
+    fused = fused_sgd(weight_decay=0.02)
+    assert fused.init(params) == ()
+    p_c, _ = _apply_ref(classic, grads, (), params)
+    p_f, st = jax.jit(fused.apply)(grads, (), params, LR)
+    assert st == ()
+    _assert_leaves_close(p_c, p_f)
+
+
+def test_nesterov_fused_matches_registry():
+    params, grads = _tree(2), _tree(3)
+    classic = opt.nesterov_sgd(momentum=0.95)
+    fused = fused_nesterov_sgd(momentum=0.95)
+    p_c, s_c = _apply_ref(classic, grads, classic.init(params), params)
+    p_f, s_f = fused.apply(grads, fused.init(params), params, LR)
+    _assert_leaves_close(p_c, p_f)
+    _assert_leaves_close(s_c["vel"], s_f["vel"])
+
+
+# --------------------------------------------------------------------------
+# bf16 params, fp32 velocity: fused rounds (p + step) ONCE to bf16
+# where apply_updates rounds the step then adds in bf16 — 1-ulp-class
+# tolerance on params, velocity stays bit-exact fp32
+# --------------------------------------------------------------------------
+
+
+def test_bf16_params_fp32_velocity():
+    params = _tree(0, jnp.bfloat16)
+    grads = _tree(1, jnp.bfloat16)
+    o = fused_momentum_sgd(momentum=0.9, weight_decay=0.01)
+    s_ref = o.init(params)
+    assert all(l.dtype == jnp.float32
+               for l in jax.tree_util.tree_leaves(s_ref))
+    p_ref, s_ref2 = _apply_ref(o, grads, s_ref, params)
+    p_f, s_f = o.apply(grads, o.init(params), params, LR)
+    # velocity math never touches bf16 (fp32 end to end)
+    _assert_leaves_close(s_ref2["vel"], s_f["vel"])
+    for a, b in zip(jax.tree_util.tree_leaves(p_ref),
+                    jax.tree_util.tree_leaves(p_f)):
+        assert a.dtype == jnp.bfloat16 and b.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=1e-2, atol=1e-2,
+        )
+
+
+# --------------------------------------------------------------------------
+# global-norm clip edges
+# --------------------------------------------------------------------------
+
+
+def test_clip_zero_norm_is_identity_and_finite():
+    grads = jax.tree_util.tree_map(jnp.zeros_like, _tree(0))
+    coef = clip_coefficient(grads, clip_norm=1.0)
+    assert np.isfinite(float(coef)) and float(coef) == 1.0
+    params = _tree(1)
+    o = fused_momentum_sgd(momentum=0.9, clip_norm=1.0)
+    p_f, _ = o.apply(grads, o.init(params), params, LR)
+    # zero grads + no decay: params untouched, nothing NaN'd
+    assert _leaves_equal(p_f, params)
+
+
+def test_clip_norm_above_max_scales_globally():
+    params, grads = _tree(0), _tree(1)
+    gnorm = float(jnp.sqrt(sum(
+        jnp.sum(jnp.square(g)) for g in jax.tree_util.tree_leaves(grads)
+    )))
+    clip = gnorm / 3.0  # norm > max: coefficient must be clip/norm
+    coef = float(clip_coefficient(grads, clip))
+    np.testing.assert_allclose(coef, 1.0 / 3.0, rtol=1e-5)
+    o = fused_momentum_sgd(momentum=0.0, weight_decay=0.0, clip_norm=clip)
+    p_f, _ = o.apply(grads, o.init(params), params, LR)
+    # mu=0, wd=0: p' = p - lr * coef * g exactly
+    expect = jax.tree_util.tree_map(
+        lambda p, g: p - LR * coef * g, params, grads
+    )
+    for a, b in zip(jax.tree_util.tree_leaves(expect),
+                    jax.tree_util.tree_leaves(p_f)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_clip_norm_below_max_no_op():
+    params, grads = _tree(0), _tree(1)
+    assert float(clip_coefficient(grads, 1e9)) == 1.0
+    with_clip = fused_momentum_sgd(momentum=0.9, clip_norm=1e9)
+    without = fused_momentum_sgd(momentum=0.9)
+    p_a, _ = with_clip.apply(grads, with_clip.init(params), params, LR)
+    p_b, _ = without.apply(grads, without.init(params), params, LR)
+    assert _leaves_equal(p_a, p_b)
+
+
+def test_clipped_fused_matches_clipped_reference():
+    """wd + clip together: fused kernel vs the update() oracle with the
+    same coefficient — the full epilogue parity."""
+    params, grads = _tree(4), _tree(5)
+    o = fused_momentum_sgd(momentum=0.9, weight_decay=0.01, clip_norm=2.0,
+                           nesterov=True)
+    p_ref, s_ref = _apply_ref(o, grads, o.init(params), params)
+    p_f, s_f = jax.jit(o.apply)(grads, o.init(params), params, LR)
+    _assert_leaves_close(p_ref, p_f)
+    _assert_leaves_close(s_ref["vel"], s_f["vel"])
+
+
+# --------------------------------------------------------------------------
+# registry + train-step integration
+# --------------------------------------------------------------------------
+
+
+def test_fuse_optimizer_refuses_unfused_rules():
+    with pytest.raises(ValueError, match="no fused kernel"):
+        fuse_optimizer("adam")
+    with pytest.raises(ValueError, match="no fused kernel"):
+        fuse_optimizer("rmsprop")
+
+
+def test_clip_norm_on_classic_path_refuses_loudly():
+    """A recipe carrying the fused-only clip_norm opt_kwarg must refuse
+    with an actionable ValueError on the CLASSIC path (e.g. resuming a
+    --fused-update run with the flag dropped), not a raw TypeError."""
+    with pytest.raises(ValueError, match="clip_norm"):
+        opt.get_optimizer("momentum", clip_norm=1.0)
+
+
+def test_clip_norm_refused_on_sharded_fused_engines():
+    """ZeRO-1 and ND see only LOCAL shards inside their steps — a fused
+    global-norm clip there would use per-rank partial norms; both must
+    refuse rather than silently mis-clip."""
+    from theanompi_tpu.parallel import make_mesh
+    from theanompi_tpu.parallel.zero import ZeroEngine
+    from tests.tinymodel import TinyCNN
+
+    recipe = TinyCNN.default_recipe().replace(
+        batch_size=8, opt_kwargs={"clip_norm": 1.0})
+    model = TinyCNN(recipe)
+    mesh = make_mesh(2)
+    with pytest.raises(ValueError, match="clip_norm"):
+        ZeroEngine(model, mesh, fused_update=True)
+
+    from theanompi_tpu.models.lm import TransformerLMModel
+    from theanompi_tpu.parallel.nd import NDEngine
+
+    lm_recipe = TransformerLMModel.default_recipe().replace(
+        batch_size=8, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        input_shape=(16,), num_classes=32, optimizer="momentum",
+        opt_kwargs={"clip_norm": 1.0})
+    with pytest.raises(ValueError, match="clip_norm"):
+        NDEngine(TransformerLMModel(lm_recipe), mesh, dp_axis="data",
+                 fused_update=True)
+
+
+def test_make_train_step_fused_matches_unfused():
+    """The --fused-update step is the SAME trajectory as the classic
+    step (single device, TinyCNN recipe = momentum)."""
+    from tests.tinymodel import TinyCNN
+    from theanompi_tpu.train import init_train_state, make_train_step
+
+    model = TinyCNN(TinyCNN.default_recipe().replace(batch_size=8))
+    state = init_train_state(model, jax.random.PRNGKey(0))
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.randn(8, *model.recipe.input_shape), jnp.float32)
+    y = jnp.asarray(r.randint(0, model.recipe.num_classes, 8), jnp.int32)
+    rng = jax.random.PRNGKey(1)
+    ref = jax.jit(make_train_step(model))
+    fus = jax.jit(make_train_step(model, fused_update=True))
+    s1, m1 = ref(state, x, y, rng)
+    s2, m2 = fus(state, x, y, rng)
+    assert float(m1["loss"]) == float(m2["loss"])
+    for a, b in zip(jax.tree_util.tree_leaves(s1.params),
+                    jax.tree_util.tree_leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_fused_step_numerics_sentinels_present():
+    """The fused path reconstructs the update tree for the gauges: the
+    nm_* sentinel family survives --fused-update."""
+    from tests.tinymodel import TinyCNN
+    from theanompi_tpu.train import init_train_state, make_train_step
+
+    model = TinyCNN(TinyCNN.default_recipe().replace(batch_size=8))
+    state = init_train_state(model, jax.random.PRNGKey(0))
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.randn(8, *model.recipe.input_shape), jnp.float32)
+    y = jnp.asarray(r.randint(0, model.recipe.num_classes, 8), jnp.int32)
+    step = jax.jit(make_train_step(model, fused_update=True, numerics=True))
+    _, m = step(state, x, y, jax.random.PRNGKey(1))
+    for k in ("nm_grad_norm", "nm_update_norm", "nm_param_norm",
+              "nm_nonfinite"):
+        assert k in m and np.isfinite(float(m[k]))
+    assert float(m["nm_nonfinite"]) == 0.0
+    assert float(m["nm_update_norm"]) > 0.0

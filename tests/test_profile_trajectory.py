@@ -1,0 +1,53 @@
+"""CI enforcement of the committed `tmpi profile` trajectory
+(ISSUE 11 satellite): the checked-in before/after report pair under
+experiments/profile/ must keep passing `tools/perf_gate.py`, so a
+change that silently breaks a ratio invariant (or the reports' own
+fraction-sum identity) fails tier-1 instead of rotting in-tree."""
+
+import json
+import os
+
+from theanompi_tpu.tools.perf_gate import gate, main
+
+_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "experiments", "profile")
+BASELINE = os.path.join(_DIR, "r11_baseline", "report.json")
+CURRENT = os.path.join(_DIR, "r11_fused_bucketed", "report.json")
+
+
+def _load(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def test_committed_pair_exists_with_knob_provenance():
+    base = _load(BASELINE)
+    cur = _load(CURRENT)
+    for rep in (base, cur):
+        assert rep["kind"] == "profile_report"
+        assert rep["model"] == "alexnet" and rep["steps"] == 20
+    # the pair is meaningless unless the knobs actually differ
+    assert base["knobs"] == {"fused_update": False,
+                             "allreduce_buckets": 0.0}
+    assert cur["knobs"]["fused_update"] is True
+    assert cur["knobs"]["allreduce_buckets"] > 0
+
+
+def test_perf_gate_passes_on_committed_pair():
+    result = gate(_load(BASELINE), _load(CURRENT))
+    assert result["errors"] == []
+    assert result["ok"], result["checks"]
+    # mfu must be among the diffed invariants (not vacuously passing)
+    assert any(c["metric"] == "mfu" for c in result["checks"])
+    # and the CLI path agrees (what CI actually invokes)
+    assert main([BASELINE, CURRENT]) == 0
+
+
+def test_gate_still_catches_a_seeded_regression(tmp_path):
+    """The pair passing must not be vacuous: a 2x MFU drift on the same
+    files fails (the acceptance-path mutation)."""
+    cur = _load(CURRENT)
+    cur["mfu"] = cur["mfu"] * 2
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(cur))
+    assert main([BASELINE, str(bad)]) == 1

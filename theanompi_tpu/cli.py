@@ -68,6 +68,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "GoSGD's gossip message, and the ND engine's "
                         "sharded-axis grad psums; traffic gauges report "
                         "effective vs raw bytes")
+    p.add_argument("--fused-update", action="store_true",
+                   help="fuse the optimizer epilogue (weight decay + "
+                        "global-norm clip + momentum/Nesterov + param "
+                        "write) into ONE Pallas pass over donated "
+                        "buffers (ops/pallas_update.py) — one HBM "
+                        "round-trip per leaf instead of ~4; every "
+                        "engine opts in; SGD-family recipes only "
+                        "(momentum/nesterov/sgd)")
+    p.add_argument("--allreduce-buckets", type=float, default=0.0,
+                   metavar="MB",
+                   help="BSP rule: chunk the gradient allreduce into "
+                        "~MB-sized buckets whose psums launch inside "
+                        "backward, overlapping comm with the tail of "
+                        "the backward pass (GC3-style scheduling; "
+                        "parallel/strategies.py). Same numerics as the "
+                        "single psum; composes with --wire-codec "
+                        "(':ef' syncs post-backward, bucketed). 0 = "
+                        "off; 4-32 MB is the useful range — biggest "
+                        "win multi-chip/DCN, a no-op on one chip")
     p.add_argument("--steps-per-dispatch", type=int, default=1,
                    help="fuse this many steps into one compiled dispatch "
                         "(one H2D transfer + one host dispatch per group) — "
@@ -464,6 +483,8 @@ def main(argv=None) -> int:
             devices=args.n_devices or None,
             strategy=args.strategy,
             wire_codec=args.wire_codec,
+            fused_update=args.fused_update,
+            allreduce_buckets=args.allreduce_buckets,
             n_slices=args.slices,
             steps_per_dispatch=args.steps_per_dispatch,
             dispatch_depth=args.dispatch_depth,

@@ -144,6 +144,13 @@ def run_training(
     # engine's exchange path consumes it (BSP psum/ring, ZeRO
     # scatter+gather, EASGD elastic psum, GoSGD gossip, ND grad psums)
     wire_codec: str = "none",
+    # MFU-push knobs (ROADMAP item 2a/2b): fused_update swaps the
+    # optimizer epilogue for the one-pass Pallas kernel
+    # (ops/pallas_update.py) on EVERY engine; allreduce_buckets (MB,
+    # 0 = off) chunks the BSP gradient allreduce into buckets whose
+    # psums launch inside backward (parallel/strategies.py)
+    fused_update: bool = False,
+    allreduce_buckets: float = 0.0,
     n_slices: Optional[int] = None,
     steps_per_dispatch: int = 1,
     # async dispatch pipeline (utils/dispatch.py): keep up to this many
@@ -366,6 +373,14 @@ def run_training(
     if nd_active and zero:
         raise ValueError("--zero composes with plain BSP only (ND shards "
                          "optimizer state per its own param specs already)")
+    allreduce_buckets = float(allreduce_buckets or 0.0)
+    if allreduce_buckets and (rule != "bsp" or zero or nd_active):
+        raise ValueError(
+            "--allreduce-buckets buckets the BSP in-step gradient "
+            "allreduce only (ZeRO's scatter/gather and the ND sharded-"
+            "axis psums own their own schedules; EASGD/GoSGD exchange "
+            "periodically — there is no every-step allreduce to bucket)"
+        )
     if microbatches is not None and pp <= 1:
         raise ValueError("--microbatches requires --pp (GPipe microbatching)")
     if pp_interleave > 1 and pp <= 1:
@@ -579,7 +594,7 @@ def run_training(
 
         engine = NDEngine(
             model, mesh, steps_per_epoch=steps_per_epoch,
-            wire_codec=codec, **nd_axes,
+            wire_codec=codec, fused_update=fused_update, **nd_axes,
         )
     elif zero:
         from theanompi_tpu.parallel.zero import ZeroEngine
@@ -587,7 +602,7 @@ def run_training(
         engine = ZeroEngine(
             model, mesh, steps_per_epoch=steps_per_epoch,
             input_transform=input_transform, eval_views=eval_views,
-            wire_codec=codec,
+            wire_codec=codec, fused_update=fused_update,
         )
     elif rule == "bsp":
         from theanompi_tpu.parallel.bsp import BSPEngine
@@ -596,6 +611,7 @@ def run_training(
             model, mesh, steps_per_epoch=steps_per_epoch, strategy=strategy,
             input_transform=input_transform, eval_views=eval_views,
             accum_steps=accum_steps, wire_codec=codec,
+            fused_update=fused_update, allreduce_buckets=allreduce_buckets,
         )
     elif rule == "easgd":
         from theanompi_tpu.parallel.easgd import EASGDEngine
@@ -603,7 +619,8 @@ def run_training(
         engine = EASGDEngine(
             model, mesh, steps_per_epoch=steps_per_epoch,
             input_transform=input_transform, eval_views=eval_views,
-            accum_steps=accum_steps, wire_codec=codec, **rule_kwargs,
+            accum_steps=accum_steps, wire_codec=codec,
+            fused_update=fused_update, **rule_kwargs,
         )
     else:
         from theanompi_tpu.parallel.gosgd import GOSGDEngine
@@ -611,7 +628,8 @@ def run_training(
         engine = GOSGDEngine(
             model, mesh, steps_per_epoch=steps_per_epoch,
             input_transform=input_transform, eval_views=eval_views,
-            accum_steps=accum_steps, wire_codec=codec, **rule_kwargs,
+            accum_steps=accum_steps, wire_codec=codec,
+            fused_update=fused_update, **rule_kwargs,
         )
 
     # Topology stamp for every checkpoint this run writes (elastic PR):

@@ -156,17 +156,30 @@ def attribute_step(
     traffic=None,  # obs.comm.TrafficModel (or None)
     host_frac: Optional[float] = None,
     link_bps: Optional[float] = None,
+    overlap_frac: Optional[float] = None,
 ) -> Attribution:
     """Reconcile one measured per-step wall time against the analytic
     models (see module docstring for the calibrated-fallback rules).
 
     ``host_frac``: the measured fraction of the step the host spent
     blocked (dispatcher drain tax) or dispatching. ``link_bps``
-    overrides the device-table ICI bandwidth (tests; multislice DCN)."""
+    overrides the device-table ICI bandwidth (tests; multislice DCN).
+
+    ``overlap_frac``: fraction of the collective that HIDES under
+    backward compute (the bucketed allreduce's schedule estimate —
+    parallel/strategies.py::bucket_overlap_frac; defaults to the
+    traffic model's ``detail["overlap_frac"]``). Before this knob the
+    comm model priced the whole exchange as serial post-backward
+    traffic, so an overlapped wire double-counted against compute; now
+    only the EXPOSED ``(1 - overlap)`` share books as the comm
+    fraction, the hidden seconds land in ``detail["comm_hidden_s"]``."""
     if not step_seconds or step_seconds <= 0:
         raise ValueError(f"step_seconds must be > 0, got {step_seconds}")
     detail: dict = {}
     host = min(1.0, max(0.0, float(host_frac or 0.0)))
+    if overlap_frac is None and traffic is not None:
+        overlap_frac = traffic.detail.get("overlap_frac")
+    overlap = min(1.0, max(0.0, float(overlap_frac or 0.0)))
 
     comm_s = 0.0
     wire = float(traffic.bytes_per_step_amortized) if traffic is not None else 0.0
@@ -175,6 +188,10 @@ def attribute_step(
             link_bps = link_bytes_per_sec()
         if link_bps:
             comm_s = wire / link_bps
+            if overlap > 0:
+                detail["overlap_frac"] = overlap
+                detail["comm_hidden_s"] = comm_s * overlap
+                comm_s = comm_s * (1.0 - overlap)
         else:
             detail["comm_note"] = (
                 "link bandwidth unknown on this device kind: collective "

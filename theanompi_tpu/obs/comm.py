@@ -192,13 +192,21 @@ all_gather_bytes = reduce_scatter_bytes  # same wire volume, other half
 
 
 def bsp_traffic(n_elements: int, n: int, strategy: str = "psum",
-                codec=None) -> TrafficModel:
+                codec=None, n_buckets: Optional[int] = None,
+                overlap_frac: Optional[float] = None) -> TrafficModel:
     """BSP in-step gradient allreduce. Ring variants pad the flat buffer
     to ``n`` equal segments (128-multiples for int8) — accounted, since
     the padding rides the wire. ``codec``: the wire codec the exchange
     runs through (parallel/codec.py) — its bytes-per-element replaces
     the strategy's own when active (psum + codec, or ring whose wire
-    the codec selects)."""
+    the codec selects).
+
+    ``n_buckets``/``overlap_frac`` (``--allreduce-buckets``,
+    parallel/strategies.py): the bucketed schedule moves the SAME bytes
+    (chunked), so the volume figures are untouched; the geometry lands
+    in ``detail`` and ``overlap_frac`` tells the attribution model
+    (obs/attribution.py) what fraction of the collective hides under
+    backward — so the comm fraction stays honest once comm overlaps."""
     codec = get_codec(codec)
     b = wire_bytes_per_element(strategy)
     canonical = {"ar": "psum", "cudaaware": "psum", "copper": "psum",
@@ -219,13 +227,17 @@ def bsp_traffic(n_elements: int, n: int, strategy: str = "psum",
         if canonical == "ring_int8" or codec.name == "int8":
             seg = -(-seg // 128) * 128
         elems = n * seg
+    detail = {"strategy": strategy, "elements": elems,
+              "wire_bytes_per_element": b}
+    if n_buckets is not None:
+        detail["n_buckets"] = int(n_buckets)
+        detail["overlap_frac"] = float(overlap_frac or 0.0)
     return TrafficModel(
         rule="bsp", n_workers=n,
         bytes_per_step=allreduce_bytes(elems, n, b),
         codec=codec.spec,
         raw_bytes_per_step=allreduce_bytes(elems, n),
-        detail={"strategy": strategy, "elements": elems,
-                "wire_bytes_per_element": b},
+        detail=detail,
     )
 
 

@@ -45,17 +45,38 @@ PyTree = Any
 
 
 class Optimizer(NamedTuple):
-    """A pure optimizer: ``init(params) -> state``, ``update(grads, state, params, lr) -> (updates, state)``."""
+    """A pure optimizer: ``init(params) -> state``, ``update(grads, state, params, lr) -> (updates, state)``.
+
+    ``apply`` (optional): the FUSED one-pass form ``(grads, state,
+    params, lr) -> (new_params, new_state)`` — params are rewritten
+    inside the rule instead of materializing a separate update tree
+    (ops/pallas_update.py: one HBM round-trip per leaf instead of the
+    ~4 the ``update`` → ``apply_updates`` tree_maps cost). ``None`` for
+    the classic two-phase optimizers; ``train.make_train_step`` prefers
+    ``apply`` when present."""
 
     name: str
     init: Callable[[PyTree], PyTree]
     update: Callable[[PyTree, PyTree, PyTree, jax.Array], tuple[PyTree, PyTree]]
+    apply: Any = None  # fused one-pass form, or None
 
 
 def apply_updates(params: PyTree, updates: PyTree) -> PyTree:
     """``p += u`` leafwise, preserving the parameter dtype."""
     return jax.tree_util.tree_map(
         lambda p, u: (p + u.astype(p.dtype)) if u is not None else p, params, updates
+    )
+
+
+def update_delta(new_params: PyTree, params: PyTree) -> PyTree:
+    """``new - old`` leafwise in fp32 — the reconstructed update tree
+    the numerics gauges read on the FUSED path, where the one-pass
+    kernel (``Optimizer.apply``) never materializes updates. Gauge-only:
+    callers gate it behind the numerics flag so sentinel-off steps pay
+    nothing."""
+    return jax.tree_util.tree_map(
+        lambda n, p: n.astype(jnp.float32) - p.astype(jnp.float32),
+        new_params, params,
     )
 
 
@@ -195,8 +216,23 @@ def get_optimizer(name: str, **kwargs) -> Optimizer:
     """Look up an optimizer builder by name (model recipes name their rule
     as a string, mirroring the reference's model-owned hyperparams)."""
     try:
-        return _REGISTRY[name](**kwargs)
+        builder = _REGISTRY[name]
     except KeyError:
         raise ValueError(
             f"unknown optimizer {name!r}; available: {sorted(_REGISTRY)}"
+        ) from None
+    try:
+        return builder(**kwargs)
+    except TypeError as e:
+        # a recipe carrying a bad kwarg must refuse loudly on the
+        # classic path, not crash with a raw TypeError — e.g. a
+        # fused-only clip_norm left in opt_kwargs when --fused-update
+        # is dropped
+        hint = (
+            " (clip_norm is a --fused-update-only knob — "
+            "ops/pallas_update.py)" if "clip_norm" in kwargs else ""
+        )
+        raise ValueError(
+            f"optimizer {name!r} does not accept {sorted(kwargs)}: "
+            f"{e}{hint}"
         ) from None

@@ -26,7 +26,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from theanompi_tpu.models.contract import Model
 from theanompi_tpu.parallel.mesh import DATA_AXIS
-from theanompi_tpu.parallel.strategies import checked_mode_strategy, get_strategy
+from theanompi_tpu.parallel.strategies import (
+    bucketed,
+    checked_mode_strategy,
+    get_strategy,
+)
 from theanompi_tpu.train import TrainState, init_train_state, make_eval_step, make_train_step
 
 
@@ -66,6 +70,27 @@ def _bsp_state_spec(codec, axes):
     return P()
 
 
+def _bsp_grad_sync(strategy, axis_name, n, codec, checked,
+                   allreduce_buckets):
+    """The one place the BSP step builders resolve their exchanger:
+    ``--allreduce-buckets`` swaps the single psum for the bucketed
+    overlap scheduler (parallel/strategies.py::BucketedOverlapSync);
+    checked-mode AD has no exchanger collective to bucket and refuses."""
+    if allreduce_buckets:
+        if checked:
+            raise ValueError(
+                "--allreduce-buckets has nothing to bucket under "
+                "TMPI_CHECKED_VMA=1: checked-mode AD already summed the "
+                "cotangents, there is no exchanger collective"
+            )
+        return bucketed(strategy, axis_name, n, allreduce_buckets,
+                        codec=codec)
+    return (
+        checked_mode_strategy(strategy, axis_name, n, codec=codec) if checked
+        else get_strategy(strategy, axis_name, n, codec=codec)
+    )
+
+
 def make_bsp_train_step(
     model: Model,
     mesh: Mesh,
@@ -77,6 +102,8 @@ def make_bsp_train_step(
     accum_steps: int = 1,
     numerics: bool = False,
     wire_codec=None,
+    fused_update: bool = False,
+    allreduce_buckets: float = 0.0,
 ):
     """Build the jitted BSP step: ``(state, images, labels, rng) ->
     (state, metrics)`` over global arrays. ``accum_steps``: gradient
@@ -92,16 +119,28 @@ def make_bsp_train_step(
     (``('dcn', 'data')``): the gradient mean then reduces over ICI
     within each slice and DCN across slices — XLA lowers the hierarchy
     from the mesh layout (SURVEY.md §5.8 "topology split").
+
+    ``fused_update``: one-pass optimizer epilogue (train.make_train_step
+    / ops/pallas_update.py). ``allreduce_buckets`` (MB, 0 = off): chunk
+    the gradient allreduce into ~MB buckets whose psums launch inside
+    backward (parallel/strategies.py::BucketedOverlapSync) — same
+    numerics as the single psum, strategy 'psum' only.
     """
     from theanompi_tpu.parallel.codec import get_codec
 
     codec = get_codec(wire_codec)
+    allreduce_buckets = float(allreduce_buckets or 0.0)
     axes = _axes_tuple(axis_name)
     n = 1
     for a in axes:
         n *= mesh.shape[a]
     if n == 1:
-        get_strategy(strategy, axis_name, n, codec=codec)  # validate early
+        # validate early (bucketed also checks the strategy/codec pair);
+        # a 1-device mesh has no collectives, so buckets are a no-op
+        if allreduce_buckets:
+            bucketed(strategy, axis_name, n, allreduce_buckets, codec=codec)
+        else:
+            get_strategy(strategy, axis_name, n, codec=codec)
         # Single-device fast path: no collectives exist, so skip the
         # shard_map machinery entirely (it pays real dispatch overhead on
         # some backends) — the plain jitted step is semantically identical.
@@ -111,7 +150,8 @@ def make_bsp_train_step(
         # save is not binding on one chip.
         base = make_train_step(model, steps_per_epoch,
                                input_transform=input_transform,
-                               accum_steps=accum_steps, numerics=numerics)
+                               accum_steps=accum_steps, numerics=numerics,
+                               fused_update=fused_update)
 
         def single_step(state, images, labels, rng):
             return base(state, images, labels, jax.random.fold_in(rng, 0))
@@ -119,14 +159,12 @@ def make_bsp_train_step(
         return jax.jit(single_step)
 
     checked = _checked_vma()
-    grad_sync = (
-        checked_mode_strategy(strategy, axis_name, n, codec=codec) if checked
-        else get_strategy(strategy, axis_name, n, codec=codec)
-    )
+    grad_sync = _bsp_grad_sync(strategy, axis_name, n, codec, checked,
+                               allreduce_buckets)
     base_step = make_train_step(
         model, steps_per_epoch, grad_sync=grad_sync,
         input_transform=input_transform, accum_steps=accum_steps,
-        numerics=numerics,
+        numerics=numerics, fused_update=fused_update,
     )
 
     def sharded_step(state: TrainState, images, labels, rng):
@@ -168,6 +206,8 @@ def make_bsp_fused_step(
     accum_steps: int = 1,
     numerics: bool = False,
     wire_codec=None,
+    fused_update: bool = False,
+    allreduce_buckets: float = 0.0,
 ):
     """``k`` BSP steps fused into ONE compiled program via ``lax.scan``
     over stacked batches ``[k, batch, ...]`` — one host dispatch (and one
@@ -186,20 +226,26 @@ def make_bsp_fused_step(
     from theanompi_tpu.parallel.codec import get_codec
 
     codec = get_codec(wire_codec)
+    allreduce_buckets = float(allreduce_buckets or 0.0)
     axes = _axes_tuple(axis_name)
     n = 1
     for a in axes:
         n *= mesh.shape[a]
     checked = _checked_vma()
-    grad_sync = (  # also validates the name
-        checked_mode_strategy(strategy, axis_name, n, codec=codec) if checked
-        else get_strategy(strategy, axis_name, n, codec=codec)
-    )
 
     if n == 1:
+        # same validation contract as make_bsp_train_step's n==1 path:
+        # names/codec pairs are checked, but the checked-mode bucket
+        # refusal does not apply — one device has no collective either
+        # way, so the knob is the documented no-op
+        if allreduce_buckets:
+            bucketed(strategy, axis_name, n, allreduce_buckets, codec=codec)
+        else:
+            get_strategy(strategy, axis_name, n, codec=codec)
         base = make_train_step(
             model, steps_per_epoch, input_transform=input_transform,
             accum_steps=accum_steps, numerics=numerics,
+            fused_update=fused_update,
         )
 
         def single(state, images, labels, rngs):
@@ -210,10 +256,13 @@ def make_bsp_fused_step(
             return lax.scan(body, state, (images, labels, rngs))
 
         return jax.jit(single)
+    grad_sync = _bsp_grad_sync(  # also validates the name
+        strategy, axis_name, n, codec, checked, allreduce_buckets
+    )
     base_step = make_train_step(
         model, steps_per_epoch, grad_sync=grad_sync,
         input_transform=input_transform, accum_steps=accum_steps,
-        numerics=numerics,
+        numerics=numerics, fused_update=fused_update,
     )
 
     def sharded_step(state: TrainState, images, labels, rngs):
@@ -276,6 +325,8 @@ class BSPEngine:
         eval_views: int = 1,
         accum_steps: int = 1,
         wire_codec=None,
+        fused_update: bool = False,
+        allreduce_buckets: float = 0.0,
     ):
         from theanompi_tpu.parallel.codec import get_codec
 
@@ -290,6 +341,8 @@ class BSPEngine:
             steps_per_epoch=steps_per_epoch, strategy=strategy,
             axis_name=axis_name, input_transform=input_transform,
             accum_steps=accum_steps, wire_codec=self.codec,
+            fused_update=bool(fused_update),
+            allreduce_buckets=float(allreduce_buckets or 0.0),
         )
         # per-flag variants, built lazily: {numerics_flag: jitted step}.
         # The numerics step is a SECOND compiled program (sentinels are
@@ -367,16 +420,40 @@ class BSPEngine:
         """Analytic per-step wire volume of this engine's gradient
         allreduce (obs/comm.py): the in-step psum/ring over the data
         axes, sized by the grad pytree (= params) and the strategy's /
-        codec's wire compression — raw AND effective bytes."""
+        codec's wire compression — raw AND effective bytes. With
+        ``--allreduce-buckets`` the TOTAL volume is unchanged (the same
+        bytes, chunked) but the schedule geometry — bucket count and the
+        overlap fraction the attribution model prices comm at — rides
+        the detail block, keeping the gauges and the SPMD101/102
+        cross-checks truthful about the bucketed wire."""
         from theanompi_tpu.obs.comm import bsp_traffic, pytree_num_elements
 
         axes = _axes_tuple(self._build["axis_name"])
         n = 1
         for a in axes:
             n *= self.mesh.shape[a]
+        n_buckets = None
+        overlap = None
+        if self._build["allreduce_buckets"] and n > 1:
+            from theanompi_tpu.parallel.strategies import (
+                bucket_overlap_frac,
+            )
+
+            sync = bucketed(
+                self._build["strategy"], self._build["axis_name"], n,
+                self._build["allreduce_buckets"], codec=self.codec,
+            )
+            # one bucket walk serves both figures (this runs on the
+            # metrics-snapshot path)
+            n_buckets = sync.n_buckets(state.params)
+            overlap = (
+                bucket_overlap_frac(n_buckets) if sync.in_backward
+                else 0.0
+            )
         return bsp_traffic(
             pytree_num_elements(state.params), n,
             strategy=self._build["strategy"], codec=self.codec,
+            n_buckets=n_buckets, overlap_frac=overlap,
         )
 
     def cost_model(self, state, global_batch: int):

@@ -99,6 +99,7 @@ class EASGDEngine:
         accum_steps: int = 1,
         n_slices: Optional[int] = None,
         wire_codec=None,
+        fused_update: bool = False,
     ):
         from theanompi_tpu.parallel.codec import get_codec
         from theanompi_tpu.parallel.mesh import make_worker_group_mesh
@@ -144,7 +145,7 @@ class EASGDEngine:
             bstep = make_train_step(
                 model, steps_per_epoch, grad_sync=grad_sync,
                 input_transform=input_transform, accum_steps=accum_steps,
-                numerics=numerics,
+                numerics=numerics, fused_update=fused_update,
             )
 
             def sharded_step(state: EASGDState, images, labels, rng):

@@ -108,6 +108,7 @@ class GOSGDEngine:
         accum_steps: int = 1,
         n_slices: "int | None" = None,
         wire_codec=None,
+        fused_update: bool = False,
     ):
         from theanompi_tpu.parallel.codec import get_codec
         from theanompi_tpu.parallel.mesh import make_worker_group_mesh
@@ -136,7 +137,7 @@ class GOSGDEngine:
             return make_train_step(
                 model, steps_per_epoch, grad_sync=grad_sync,
                 input_transform=input_transform, accum_steps=accum_steps,
-                numerics=numerics,
+                numerics=numerics, fused_update=fused_update,
             )
 
         base_step = make_base_step(False)

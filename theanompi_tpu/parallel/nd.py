@@ -110,6 +110,7 @@ class NDEngine:
         pp_interleave: int = 1,
         donate: bool = True,
         wire_codec=None,
+        fused_update: bool = False,
     ):
         if not hasattr(model, "arch"):
             raise ValueError(
@@ -122,7 +123,27 @@ class NDEngine:
         self.microbatches = None
         self.schedule = None  # pipeline branch: schedule_report dict
         self._dp_axis = dp_axis  # kept for the analytic traffic model
-        opt = model.optimizer()
+        if fused_update:
+            # fused epilogue over the spec-sharded leaves: inside
+            # shard_map each leaf is its LOCAL shard, so the one-pass
+            # kernel runs unchanged (ops/pallas_update.py). Refuses the
+            # LM recipes' adam loudly — no fused kernel for it.
+            from theanompi_tpu.ops.pallas_update import fuse_optimizer
+
+            if model.recipe.opt_kwargs.get("clip_norm") is not None:
+                # the fused clip is a GLOBAL grad norm; this step's
+                # leaves are spec-sharded local shards, so each device
+                # would clip by its own partial-norm coefficient
+                raise ValueError(
+                    "--fused-update clip_norm is not supported on the "
+                    "ND engine: the fused global-norm clip would be "
+                    "computed over each device's local param shards, "
+                    "not the global gradient (drop clip_norm)"
+                )
+            opt = fuse_optimizer(model.recipe.optimizer,
+                                 **model.recipe.opt_kwargs)
+        else:
+            opt = model.optimizer()
         schedule_lr = make_schedule_fn(model, steps_per_epoch)
         sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
 
@@ -299,10 +320,27 @@ class NDEngine:
                 for a in batch_axes:
                     loss = lax.pmean(loss, a)  # report the global batch mean
                 lr = schedule_lr(state.step)
-                updates, new_opt = opt.update(grads, state.opt_state, state.params, lr)
-                new_params = apply_updates(state.params, updates)
+                if opt.apply is not None:
+                    # fused one-pass update (ops/pallas_update.py); the
+                    # gauges' update tree is reconstructed below, only
+                    # in the numerics variant
+                    new_params, new_opt = opt.apply(
+                        grads, state.opt_state, state.params, lr
+                    )
+                    updates = None
+                else:
+                    updates, new_opt = opt.update(
+                        grads, state.opt_state, state.params, lr
+                    )
+                    new_params = apply_updates(state.params, updates)
                 metrics = {"loss": loss, "lr": lr}
                 if numerics:
+                    if updates is None:
+                        from theanompi_tpu.ops.optimizers import (
+                            update_delta,
+                        )
+
+                        updates = update_delta(new_params, state.params)
                     # sentinels over SPEC-SHARDED trees: per-leaf local
                     # squared sums psummed over exactly the axes that
                     # leaf shards over (obs/numerics.py) — scalar

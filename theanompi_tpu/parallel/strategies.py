@@ -46,6 +46,7 @@ at once — grep ``check_vma=False``; a mixed tree double-counts.
 
 from __future__ import annotations
 
+import math
 from typing import Any, Callable, Optional
 
 import jax
@@ -248,6 +249,199 @@ def codec_psum_mean(axis_name, codec) -> Strategy:
 
     strategy.stateful = True
     return strategy
+
+
+# --------------------------------------------------------------------------
+# bucketed overlap-with-backward allreduce — GC3-style collective
+# scheduling (PAPERS.md, arXiv:2201.11840): chunk the gradient pytree
+# into ~MB-sized buckets and launch each bucket's psum AS SOON AS its
+# grads are produced, so the collective overlaps the tail of backward
+# instead of serializing after it. The ``--allreduce-buckets`` knob.
+# --------------------------------------------------------------------------
+
+
+def _leaf_wire_bytes(leaf) -> int:
+    """fp32 wire bytes of one gradient leaf (grads cross the exchanger
+    in fp32 regardless of param dtype — see _packed)."""
+    return int(math.prod(getattr(leaf, "shape", ()) or ()) or 1) * 4
+
+
+def assign_buckets(leaves, bucket_bytes: int) -> list:
+    """Group leaf INDICES into contiguous buckets of ~``bucket_bytes``,
+    walking leaves in REVERSE flatten order: backward produces grads
+    for late-forward params first, so reverse-order buckets fill (and
+    their collectives launch) in gradient-production order. Leaf
+    granularity — a single leaf over the budget gets its own bucket
+    (no intra-leaf chunking); deterministic in the leaf sizes."""
+    buckets, cur, cur_b = [], [], 0
+    for i in reversed(range(len(leaves))):
+        b = _leaf_wire_bytes(leaves[i])
+        if cur and cur_b + b > bucket_bytes:
+            buckets.append(cur)
+            cur, cur_b = [], 0
+        cur.append(i)
+        cur_b += b
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def bucket_overlap_frac(n_buckets: int) -> float:
+    """Schedule-level overlap estimate for the attribution model
+    (obs/attribution.py): with B buckets launched as their grads are
+    produced, all but the LAST bucket's collective can hide under the
+    remaining backward compute — the tail bucket is always exposed.
+    ``(B-1)/B``; 0 for the single post-backward collective."""
+    n = int(n_buckets or 0)
+    return (n - 1) / n if n > 1 else 0.0
+
+
+class BucketedOverlapSync:
+    """Bucketed gradient allreduce with overlap-with-backward.
+
+    Mechanism: each bucket's param leaves pass through a
+    ``custom_vjp`` identity tag on the FORWARD side; the tag's backward
+    applies the bucket's pmean to the cotangents at the exact point the
+    backward pass produces them. Reverse-mode order then interleaves
+    the B collectives with the remaining backward computation and XLA's
+    async collective scheduling can hide all but the tail bucket
+    (``train.make_train_step`` detects ``in_backward`` and wraps the
+    params inside the differentiated loss instead of transforming grads
+    after it). Numerics are IDENTICAL to the single ``psum_mean``:
+    pmean is leafwise, so B per-bucket pmeans compute exactly the same
+    per-leaf means (bit-identical — tests/test_bucketed.py).
+
+    Codec composition (parallel/codec.py): stateless codecs (``bf16``,
+    plain ``int8``) quantize each bucket's LOCAL cotangents value-space
+    before the pmean — ``codec_psum_mean`` per bucket. Error feedback
+    (``:ef``) is engine STATE the vjp boundary cannot thread (a
+    backward rule yields cotangents, not residuals), so the ``:ef``
+    path runs post-backward instead: per-bucket ``compress_stacked`` +
+    pmean, stateful — bucketed wire scheduling without the structural
+    overlap, EF residuals keyed per bucket's leaves. ``in_backward`` /
+    ``stateful`` tell the step builder which contract applies.
+    """
+
+    def __init__(self, axis_name, bucket_mb: float = 8.0, codec=None):
+        from theanompi_tpu.parallel.codec import get_codec
+
+        if not bucket_mb or bucket_mb <= 0:
+            raise ValueError(
+                f"--allreduce-buckets needs a positive bucket size in "
+                f"MB, got {bucket_mb!r}"
+            )
+        self.axis_name = axis_name
+        self.bucket_mb = float(bucket_mb)
+        self.bucket_bytes = max(1, int(bucket_mb * 2 ** 20))
+        self.codec = get_codec(codec)
+        self.stateful = self.codec.active and self.codec.error_feedback
+        self.in_backward = not self.stateful
+
+    # -- schedule geometry ---------------------------------------------------
+    def buckets_for(self, tree) -> list:
+        return assign_buckets(jax.tree_util.tree_leaves(tree),
+                              self.bucket_bytes)
+
+    def n_buckets(self, tree) -> int:
+        return len(self.buckets_for(tree))
+
+    def overlap_frac(self, tree) -> float:
+        if not self.in_backward:
+            return 0.0  # post-backward :ef path: nothing hides
+        return bucket_overlap_frac(self.n_buckets(tree))
+
+    # -- in-backward path (stateless codecs) ---------------------------------
+    def _qdq(self, c):
+        if not self.codec.active:
+            return c
+        # value-space wire compression of the LOCAL contribution, fp32
+        # accumulation inside the collective — codec_psum_mean's
+        # compress path, minus the residual state
+        return self.codec.qdq(c.astype(jnp.float32)).astype(c.dtype)
+
+    def _make_tag(self):
+        axis = self.axis_name
+        qdq = self._qdq
+
+        @jax.custom_vjp
+        def tag(*leaves):
+            return leaves
+
+        def fwd(*leaves):
+            return leaves, None
+
+        def bwd(_, cts):
+            return tuple(lax.pmean(qdq(c), axis) for c in cts)
+
+        tag.defvjp(fwd, bwd)
+        return tag
+
+    def wrap_params(self, params):
+        """Tag the param pytree per bucket INSIDE the differentiated
+        loss; the cotangents then arrive at each tag's backward already
+        grouped, and the bucket's collective posts right there."""
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        out = list(leaves)
+        tag = self._make_tag()
+        for idx in assign_buckets(leaves, self.bucket_bytes):
+            tagged = tag(*[leaves[i] for i in idx])
+            for j, i in enumerate(idx):
+                out[i] = tagged[j]
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    # -- post-backward path (:ef — and the no-tag fallback) ------------------
+    def __call__(self, grads, ef=None):
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        buckets = assign_buckets(leaves, self.bucket_bytes)
+        if not self.stateful:
+            out = list(leaves)
+            for idx in buckets:
+                for i in idx:
+                    out[i] = lax.pmean(self._qdq(leaves[i]), self.axis_name)
+            return jax.tree_util.tree_unflatten(treedef, out)
+        ef_leaves = jax.tree_util.tree_leaves(ef)
+        if len(ef_leaves) != len(leaves):
+            raise ValueError(
+                f"error-feedback state has {len(ef_leaves)} leaves for a "
+                f"{len(leaves)}-leaf grad tree — engine state was not "
+                "initialized with init_ef"
+            )
+        out = [None] * len(leaves)
+        new_ef = [None] * len(leaves)
+        for idx in buckets:
+            # one codec application + one collective per bucket: the EF
+            # residuals stay keyed to exactly this bucket's leaves
+            sub = [leaves[i] for i in idx]
+            esub = [ef_leaves[i] for i in idx]
+            wire, e2 = self.codec.compress_stacked(sub, esub)
+            red = lax.pmean(wire, self.axis_name)
+            for j, i in enumerate(idx):
+                out[i] = red[j]
+                new_ef[i] = e2[j]
+        return (
+            jax.tree_util.tree_unflatten(treedef, out),
+            jax.tree_util.tree_unflatten(treedef, new_ef),
+        )
+
+
+def bucketed(name: str, axis_name, axis_size: int, bucket_mb: float,
+             codec=None) -> BucketedOverlapSync:
+    """``--allreduce-buckets`` entry: validate the (strategy, codec)
+    pair and return the bucketed scheduler. psum family only — the
+    explicit ring variants already own a segmented hop schedule that a
+    leaf-bucket layer would fight, and checked-mode AD has no exchanger
+    collective to bucket (callers gate on that)."""
+    del axis_size  # collectives are axis-name driven; kept for symmetry
+    codec = _resolve_codec(name, codec)
+    key = _ALIASES.get(name, name)
+    if key != "psum":
+        raise ValueError(
+            f"--allreduce-buckets needs strategy 'psum' (got {name!r}): "
+            "the explicit ring variants already schedule their own "
+            "segments, and compressed wires ride the codec knob "
+            "(--wire-codec) on the psum path"
+        )
+    return BucketedOverlapSync(axis_name, bucket_mb=bucket_mb, codec=codec)
 
 
 # --------------------------------------------------------------------------

@@ -77,6 +77,7 @@ def make_zero1_train_step(
     fused: bool = False,
     numerics: bool = False,
     wire_codec=None,
+    fused_update: bool = False,
 ):
     """Build ``(init_state, train_step)`` for ZeRO-1 BSP training over
     ``mesh``'s ``axis_name``.
@@ -108,11 +109,48 @@ def make_zero1_train_step(
     if n == 1:
         codec = get_codec(None)  # no peers, no wire to compress
     use_ef = codec.active and codec.error_feedback
-    opt = (
-        get_optimizer(optimizer)
-        if isinstance(optimizer, str)
-        else (optimizer or model.optimizer())
-    )
+    if fused_update:
+        # fused one-pass epilogue over the flat 1/n segment: ZeRO-1
+        # reuses the SAME kernel the replicated engines run, applied to
+        # its flat-padded slice (ops/pallas_update.py; state layout
+        # matches the unfused rule, so resume crosses the boundary)
+        from theanompi_tpu.ops.pallas_update import fuse_optimizer
+
+        if optimizer is not None and not isinstance(optimizer, str):
+            raise ValueError(
+                "fused_update composes with a named optimizer (the "
+                "fused kernel is built from the recipe), not an "
+                "Optimizer instance"
+            )
+        # mirror the classic path's kwarg scoping exactly: an explicit
+        # name gets builder DEFAULTS (get_optimizer(optimizer) passes no
+        # kwargs), only the recipe's own rule carries its opt_kwargs —
+        # a momentum recipe's kwargs must not leak into an explicit
+        # "sgd" override
+        name = optimizer if isinstance(optimizer, str) else (
+            model.recipe.optimizer
+        )
+        opt_kwargs = (
+            {} if isinstance(optimizer, str) else model.recipe.opt_kwargs
+        )
+        if opt_kwargs.get("clip_norm") is not None:
+            # the fused clip is a GLOBAL grad norm; inside this step the
+            # optimizer only sees the rank's 1/n flat segment, so each
+            # rank would clip by a different partial-norm coefficient —
+            # silently wrong numerics, refused instead
+            raise ValueError(
+                "--fused-update clip_norm is not supported under ZeRO-1:"
+                " the fused global-norm clip would be computed over each"
+                " rank's local segment, not the global gradient (drop "
+                "clip_norm or run the replicated engines)"
+            )
+        opt = fuse_optimizer(name, **opt_kwargs)
+    else:
+        opt = (
+            get_optimizer(optimizer)
+            if isinstance(optimizer, str)
+            else (optimizer or model.optimizer())
+        )
     schedule_lr = make_schedule_fn(model, steps_per_epoch)
 
     # flat-buffer geometry, from an abstract init (nothing materialized)
@@ -202,8 +240,15 @@ def make_zero1_train_step(
             p_seg = p_seg + state.ef["p"][0]
 
         lr = schedule_lr(state.step)
-        updates, new_opt = opt.update(g_seg, state.opt_state, p_seg, lr)
-        new_p_seg = apply_updates(p_seg, updates)
+        if opt.apply is not None:
+            # fused one-pass segment update (ops/pallas_update.py); the
+            # gauges' update segment is reconstructed in the numerics
+            # block below
+            new_p_seg, new_opt = opt.apply(g_seg, state.opt_state, p_seg, lr)
+            updates = None
+        else:
+            updates, new_opt = opt.update(g_seg, state.opt_state, p_seg, lr)
+            new_p_seg = apply_updates(p_seg, updates)
 
         gather_seg = new_p_seg
         if codec.active:
@@ -230,6 +275,10 @@ def make_zero1_train_step(
             # the freshly all-gathered full buffer (replicated), and
             # the non-finite count covers the synced grads exactly like
             # the replicated engines'.
+            if updates is None:
+                from theanompi_tpu.ops.optimizers import update_delta
+
+                updates = update_delta(new_p_seg, p_seg)
             gsq = lax.psum(jnp.sum(jnp.square(g_seg)), axis_name)
             usq = lax.psum(
                 jnp.sum(jnp.square(updates.astype(jnp.float32))), axis_name
@@ -306,6 +355,7 @@ class ZeroEngine:
         input_transform=None,
         eval_views: int = 1,
         wire_codec=None,
+        fused_update: bool = False,
     ):
         from theanompi_tpu.parallel.bsp import make_bsp_eval_step
         from theanompi_tpu.parallel.codec import get_codec
@@ -315,7 +365,8 @@ class ZeroEngine:
         self.codec = get_codec(wire_codec)
         self._build = dict(steps_per_epoch=steps_per_epoch,
                            input_transform=input_transform,
-                           wire_codec=self.codec)
+                           wire_codec=self.codec,
+                           fused_update=bool(fused_update))
         self._init, step = make_zero1_train_step(model, mesh, **self._build)
         self._steps = {False: step}
         self._fused: dict = {}

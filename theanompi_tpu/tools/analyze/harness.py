@@ -31,10 +31,17 @@ from theanompi_tpu.tools.analyze.signature import (
 
 # the analyzed engine configurations: every driver rule, codec off and
 # the error-feedback int8 codec (the convergence-safe compressed
-# default) — golden signatures exist for each pair
-ENGINE_NAMES = ("bsp", "zero1", "easgd", "gosgd", "nd")
+# default) — golden signatures exist for each pair. ``bsp_bucketed``
+# is the BSP rule under ``--allreduce-buckets``: its per-bucket psums
+# replace the single gradient pmean, so the bucketed collective
+# schedule gets its own golden (a bucket whose axis drifts from its
+# siblings fails SPMD003; tests/test_analyze.py mutation self-test).
+ENGINE_NAMES = ("bsp", "bsp_bucketed", "zero1", "easgd", "gosgd", "nd")
 CODEC_SPECS = ("none", "int8:ef")
 EASGD_AVG_FREQ = 4  # harness exchange cadence (amortization weight)
+# bucket size for the bucketed-BSP trace: small enough that the tiny
+# model's 4 leaves split into 4 buckets (reverse-order greedy fill)
+BUCKET_MB = 0.001
 
 
 @dataclass
@@ -142,11 +149,15 @@ def _build_one(name: str, codec: str) -> EngineTrace:
         # per-engine finding (SPMD001), not crash the whole lint
         rng = jax.random.PRNGKey(0)
         mesh = _mesh2()
-        if name == "bsp":
+        if name in ("bsp", "bsp_bucketed"):
             from theanompi_tpu.parallel.bsp import BSPEngine
 
             model = _tiny_model()
-            eng = BSPEngine(model, mesh, wire_codec=wire_codec)
+            eng = BSPEngine(
+                model, mesh, wire_codec=wire_codec,
+                allreduce_buckets=BUCKET_MB if name == "bsp_bucketed"
+                else 0.0,
+            )
             state = _abstract_state(eng, rng)
             x = sds((16, 8, 8, 3), jnp.float32)
             y = sds((16,), jnp.int32)

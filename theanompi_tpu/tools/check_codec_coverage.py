@@ -14,8 +14,14 @@ references ``parallel.codec`` nor declares an explicit exemption::
 Scope: an "engine module" is any ``parallel/*.py`` defining a class
 with BOTH ``train_step`` and ``traffic_model`` methods (the driver
 protocol every sync rule implements — bsp/zero/easgd/gosgd/nd today).
-Library modules (mesh, fused, pipeline, strategies, codec itself) are
-out of scope by construction.
+Library modules (mesh, fused, pipeline, codec itself) are out of scope
+by construction — EXCEPT for bucketed-exchange code: any ``def`` or
+``class`` in ``parallel/*.py`` whose name mentions a bucket AND whose
+body posts a collective (psum/pmean/ppermute/all_gather/psum_scatter/
+all_to_all) is a wire schedule of its own and must route through the
+codec layer too (the bucketed overlap allreduce composes with
+``--wire-codec`` today; a future bucketed path that skips the codec
+would silently shrink the fleet exactly like a codec-less engine).
 
 Usage::
 
@@ -68,25 +74,72 @@ def _engine_classes(source: str) -> list:
     return out
 
 
+# collective-posting calls that make a bucketed def a wire schedule
+_COLLECTIVES = {"psum", "pmean", "ppermute", "all_gather", "psum_scatter",
+                "all_to_all", "psum_invariant"}
+
+
+def _posts_collective(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        f = sub.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None
+        )
+        if name in _COLLECTIVES:
+            return True
+    return False
+
+
+def _bucketed_exchange_defs(source: str) -> list:
+    """Names of ``def``/``class`` nodes that (a) name a bucket and (b)
+    post a collective — the bucketed-exchange code paths this lint
+    holds to the same codec-or-exempt bar as full engines."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+            continue
+        if "bucket" not in node.name.lower():
+            continue
+        if _posts_collective(node):
+            out.append(node.name)
+    return out
+
+
 def check_file(path: str) -> Optional[str]:
     """A violation string for ``path``, or None (clean / not an engine
     module / explicitly exempt)."""
     with open(path) as f:
         source = f.read()
     engines = _engine_classes(source)
-    if not engines:
+    buckets = _bucketed_exchange_defs(source)
+    if not engines and not buckets:
         return None
     if _CODEC_REF.search(source):
         return None
     m = _EXEMPT.search(source)
     if m:
         return None  # declared exemption, reason on record
+    what = []
+    if engines:
+        what.append(f"engine class(es) {', '.join(sorted(engines))}")
+    if buckets:
+        what.append(
+            f"bucketed-exchange path(s) {', '.join(sorted(buckets))}"
+        )
     return (
-        f"{path}: engine class(es) {', '.join(sorted(engines))} neither "
+        f"{path}: {' and '.join(what)} neither "
         "import theanompi_tpu.parallel.codec nor declare a "
-        "'codec_exempt: <reason>' marker — every engine's exchange must "
-        "route through the codec layer (parallel/codec.py) so "
-        "--wire-codec keeps covering the whole fleet"
+        "'codec_exempt: <reason>' marker — every engine's exchange (and "
+        "every bucketed wire schedule) must route through the codec "
+        "layer (parallel/codec.py) so --wire-codec keeps covering the "
+        "whole fleet"
     )
 
 

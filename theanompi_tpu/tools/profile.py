@@ -43,25 +43,34 @@ WARMUP_STEPS = 2
 
 
 def _build_engine(engine_name: str, model, mesh, codec: Optional[str],
-                  avg_freq: int):
+                  avg_freq: int, fused_update: bool = False,
+                  allreduce_buckets: float = 0.0):
     """The worker driver's engine selection, minimal (no datasets)."""
+    if allreduce_buckets and engine_name != "bsp":
+        raise ValueError(
+            "--allreduce-buckets buckets the BSP in-step allreduce only"
+        )
     if engine_name == "bsp":
         from theanompi_tpu.parallel.bsp import BSPEngine
 
-        return BSPEngine(model, mesh, wire_codec=codec)
+        return BSPEngine(model, mesh, wire_codec=codec,
+                         fused_update=fused_update,
+                         allreduce_buckets=allreduce_buckets)
     if engine_name == "zero1":
         from theanompi_tpu.parallel.zero import ZeroEngine
 
-        return ZeroEngine(model, mesh, wire_codec=codec)
+        return ZeroEngine(model, mesh, wire_codec=codec,
+                          fused_update=fused_update)
     if engine_name == "easgd":
         from theanompi_tpu.parallel.easgd import EASGDEngine
 
         return EASGDEngine(model, mesh, avg_freq=avg_freq,
-                           wire_codec=codec)
+                           wire_codec=codec, fused_update=fused_update)
     if engine_name == "gosgd":
         from theanompi_tpu.parallel.gosgd import GOSGDEngine
 
-        return GOSGDEngine(model, mesh, wire_codec=codec)
+        return GOSGDEngine(model, mesh, wire_codec=codec,
+                           fused_update=fused_update)
     if engine_name == "nd":
         from theanompi_tpu.parallel.nd import NDEngine
 
@@ -72,7 +81,8 @@ def _build_engine(engine_name: str, model, mesh, codec: Optional[str],
             )
         from theanompi_tpu.parallel.mesh import DATA_AXIS
 
-        return NDEngine(model, mesh, dp_axis=DATA_AXIS, wire_codec=codec)
+        return NDEngine(model, mesh, dp_axis=DATA_AXIS, wire_codec=codec,
+                        fused_update=fused_update)
     raise ValueError(f"unknown engine {engine_name!r}; known: {ENGINES}")
 
 
@@ -117,6 +127,8 @@ def run_profile(
     out_dir: str = "tmpi_profile",
     trace: bool = False,
     seed: int = 0,
+    fused_update: bool = False,
+    allreduce_buckets: float = 0.0,
 ) -> dict:
     """Run the warm-step measurement + attribution; returns (and
     writes) the report dict. See the module docstring."""
@@ -155,7 +167,9 @@ def run_profile(
         global_batch = base
     model = model_cls(recipe.replace(batch_size=base))
     engine = _build_engine(engine_name, model, mesh,
-                           codec if codec_obj.active else None, avg_freq)
+                           codec if codec_obj.active else None, avg_freq,
+                           fused_update=fused_update,
+                           allreduce_buckets=allreduce_buckets)
 
     state = engine.init_state(jax.random.PRNGKey(seed))
     r = np.random.RandomState(seed)
@@ -277,6 +291,11 @@ def run_profile(
         "device_kind": jax.devices()[0].device_kind,
         "steps": steps,
         "global_batch": global_batch,
+        # the MFU-push knobs this reading was taken under — the
+        # committed before/after pair (experiments/profile/) is
+        # meaningless without them
+        "knobs": {"fused_update": bool(fused_update),
+                  "allreduce_buckets": float(allreduce_buckets or 0.0)},
         "step_seconds": {
             "median_s": round(med, 6),
             "exchange_s_amortized": round(exch_s / steps, 6),
@@ -404,12 +423,21 @@ def profile_main(argv=None) -> int:
                          "(tools/op_profile.py; needs a device op "
                          "track — TPU)")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--fused-update", action="store_true",
+                    help="profile with the one-pass fused optimizer "
+                         "epilogue (ops/pallas_update.py)")
+    ap.add_argument("--allreduce-buckets", type=float, default=0.0,
+                    metavar="MB",
+                    help="BSP engine: profile with the bucketed "
+                         "overlap-with-backward allreduce "
+                         "(parallel/strategies.py; 0 = off)")
     args = ap.parse_args(argv)
     report = run_profile(
         model_name=args.model, engine_name=args.engine, steps=args.steps,
         batch=args.batch, devices=args.devices, codec=args.codec,
         avg_freq=args.avg_freq, out_dir=args.out, trace=args.trace,
-        seed=args.seed,
+        seed=args.seed, fused_update=args.fused_update,
+        allreduce_buckets=args.allreduce_buckets,
     )
     print(format_report(report))
     print(f"wrote {os.path.join(args.out, 'report.json')}")

@@ -68,13 +68,23 @@ def make_schedule_fn(model: Model, steps_per_epoch: int = 1):
 
 
 def loss_and_grads(
-    model: Model, params, model_state, images, labels, rng, loss_scale: float = 1.0
+    model: Model, params, model_state, images, labels, rng,
+    loss_scale: float = 1.0, param_sync: Optional[Callable] = None,
 ):
     """The shared forward+backward core: ``-> (loss, logits,
     new_model_state, raw_grads)``. Used by make_train_step and the
-    ZeRO-1 step (parallel/zero.py) so step semantics cannot drift."""
+    ZeRO-1 step (parallel/zero.py) so step semantics cannot drift.
+
+    ``param_sync``: applied to the params INSIDE the differentiated
+    function — the hook the bucketed overlap exchanger uses to plant
+    per-bucket ``custom_vjp`` tags whose backward posts each bucket's
+    collective at the point its grads are produced
+    (parallel/strategies.py::BucketedOverlapSync.wrap_params). The
+    returned grads are then already synced."""
 
     def loss_fn(params):
+        if param_sync is not None:
+            params = param_sync(params)
         logits, new_model_state = model.apply(
             params, model_state, images, train=True, rng=rng
         )
@@ -97,6 +107,7 @@ def make_train_step(
     input_transform: Optional[Callable] = None,
     accum_steps: int = 1,
     numerics: bool = False,
+    fused_update: bool = False,
 ):
     """Build the pure train step: ``(state, images, labels, rng) ->
     (state, metrics)``.
@@ -120,6 +131,20 @@ def make_train_step(
     ``grad_sync`` is the exchanger hook — under ``shard_map`` it holds the
     collective (psum mean / ring / compressed ring); None means single
     replica.
+
+    ``fused_update``: replace the recipe's optimizer with its fused
+    one-pass equivalent (ops/pallas_update.py — weight decay + clip +
+    momentum + param write in one Pallas kernel per leaf, one HBM
+    round-trip instead of ~4). SGD-family rules only; others refuse
+    loudly. State layout matches the unfused rule, so checkpoints
+    resume across the boundary.
+
+    ``grad_sync`` objects exposing ``in_backward=True`` (the bucketed
+    overlap exchanger, parallel/strategies.py) are applied to the
+    PARAMS inside the differentiated loss instead of to the grads after
+    it — their per-bucket collectives then overlap the tail of
+    backward. Incompatible with ``accum_steps > 1`` (the sync must run
+    once on the accumulated grads, not per microbatch).
 
     ``numerics``: compile the numerics sentinels into the step
     (obs/numerics.py) — global grad-norm (post-sync: the gradient the
@@ -153,14 +178,32 @@ def make_train_step(
     ``check_vma=False``. (models/transformer.py::make_nd_train_step
     generalizes this rule to multi-axis tp/sp meshes.)
     """
-    optimizer = model.optimizer()
+    if fused_update:
+        from theanompi_tpu.ops.pallas_update import fuse_optimizer
+
+        optimizer = fuse_optimizer(model.recipe.optimizer,
+                                   **model.recipe.opt_kwargs)
+    else:
+        optimizer = model.optimizer()
     schedule_lr = make_schedule_fn(model, steps_per_epoch)
     accum_steps = max(1, int(accum_steps))
+    in_backward = bool(getattr(grad_sync, "in_backward", False))
+    if in_backward and accum_steps > 1:
+        # in-backward buckets only: the :ef bucketed variant is
+        # stateful/post-backward (in_backward=False) and composes with
+        # accumulation — one bucketed sync on the accumulated grads
+        raise ValueError(
+            "--allreduce-buckets syncs inside backward, but "
+            f"accum_steps={accum_steps} needs ONE sync on the "
+            "accumulated grads — per-microbatch bucket collectives "
+            "would multiply the wire volume; drop one of the two"
+        )
+    param_sync = grad_sync.wrap_params if in_backward else None
 
     def fwd_bwd(params, model_state, images, labels, rng):
         loss, logits, new_model_state, grads = loss_and_grads(
             model, params, model_state, images, labels, rng,
-            loss_scale=loss_scale,
+            loss_scale=loss_scale, param_sync=param_sync,
         )
         metrics = {"loss": loss, **model.metrics(logits, labels)}
         return new_model_state, grads, metrics
@@ -211,23 +254,44 @@ def make_train_step(
             metrics = jax.tree_util.tree_map(lambda m: jnp.mean(m, axis=0), ms)
 
         new_ef = state.ef
-        if grad_sync is not None:
+        if grad_sync is not None and not in_backward:
             if getattr(grad_sync, "stateful", False):
                 # compressed exchange with error feedback: the strategy
                 # threads the codec residuals through engine state
-                # (parallel/strategies.py::codec_psum_mean)
+                # (parallel/strategies.py::codec_psum_mean, and the
+                # bucketed :ef path)
                 grads, new_ef = grad_sync(grads, state.ef)
             else:
                 grads = grad_sync(grads)
+        # (in_backward syncs already ran inside the bucket tags' vjps —
+        # `grads` here is post-collective either way, so the numerics
+        # sentinels below keep their post-sync meaning)
 
         lr = schedule_lr(state.step)
-        updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params, lr)
-        new_params = apply_updates(state.params, updates)
+        if optimizer.apply is not None:
+            # fused one-pass epilogue (ops/pallas_update.py): params and
+            # velocity are rewritten in place, no update tree exists
+            new_params, new_opt_state = optimizer.apply(
+                grads, state.opt_state, state.params, lr
+            )
+            updates = None
+        else:
+            updates, new_opt_state = optimizer.update(
+                grads, state.opt_state, state.params, lr
+            )
+            new_params = apply_updates(state.params, updates)
 
         metrics = {**metrics, "lr": lr}
         if numerics:
             from theanompi_tpu.obs.numerics import sentinel_metrics
 
+            if updates is None:
+                # fused path: reconstruct the update tree for the gauges
+                # only — the numerics variant is a SEPARATE compiled
+                # program, so sentinel-off hot steps pay nothing
+                from theanompi_tpu.ops.optimizers import update_delta
+
+                updates = update_delta(new_params, state.params)
             metrics = {**metrics,
                        **sentinel_metrics(grads, updates, new_params)}
         new_state = TrainState(new_params, new_model_state, new_opt_state,
