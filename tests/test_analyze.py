@@ -374,6 +374,31 @@ def test_bucketed_golden_pins_per_bucket_psums():
     assert {tuple(c["axes"]) for c in grad_psums} == {("data",)}
 
 
+def test_bucketed_fused_combined_config_has_its_own_goldens():
+    """ISSUE 12 satellite: the two PR-11 knobs COMBINED
+    (--allreduce-buckets + --fused-update) are pinned together as the
+    `bsp_bucketed_fused` config — per-bucket psum schedule preserved
+    under the fused epilogue, with its own committed goldens, not only
+    the knobs-in-isolation ones."""
+    import os
+
+    for codec in harness.CODEC_SPECS:
+        assert os.path.exists(golden_path("bsp_bucketed_fused", codec))
+    trace = harness.trace_engine("bsp_bucketed_fused", "none")
+    assert trace.error is None, trace.error
+    gold = load_golden("bsp_bucketed_fused", "none")
+    assert compare_golden(trace, gold) == []
+    # the fused epilogue must NOT change the bucketed wire schedule:
+    # same ordered collective keys as bsp_bucketed
+    fused_step = signature_payload(trace)["parts"]["step"]
+    plain_step = signature_payload(
+        harness.trace_engine("bsp_bucketed", "none"))["parts"]["step"]
+    key = lambda c: (c["prim"], tuple(c["shape"]), tuple(c["axes"]))  # noqa: E731
+    assert [key(c) for c in fused_step] == [key(c) for c in plain_step]
+    # and the combined config rides the default lint matrix
+    assert "bsp_bucketed_fused" in harness.ENGINE_NAMES
+
+
 def test_bucket_psum_axis_drift_caught(mesh22):
     """Mutation self-test (ISSUE 11 satellite): a bucketed sync where
     ONE bucket's psum axis drifts from its siblings. The traced

@@ -480,6 +480,47 @@ def test_perf_gate_accepts_bench_and_snapshot_shapes():
     assert not gate({"no": 1}, {"metrics": 2})["ok"]
 
 
+def test_perf_gate_zero_valued_baseline_is_carried_not_vanished():
+    """ISSUE 12 satellite regression: a baseline metric valued EXACTLY
+    0.0 (a fast host rounds host_blocked_frac to zero) is a CARRIED
+    metric — presence is key membership, never value truthiness. It
+    must be diffed (absolutely, within ZERO_BASELINE_ABS_TOL — no
+    ratio exists at 0), not reported as vanished, and a genuine drift
+    off the zero baseline still fails."""
+    from theanompi_tpu.tools.perf_gate import (
+        ZERO_BASELINE_ABS_TOL,
+        extract_invariants,
+        gate,
+    )
+
+    base = {"mfu": 0.4, "host_blocked_frac": 0.0}
+    # extraction keeps the 0.0 (truthiness would drop it)
+    assert extract_invariants(base)["host_blocked_frac"] == 0.0
+    # same-zero current: compared OK, no vanished-metric error
+    res = gate(base, {"mfu": 0.4, "host_blocked_frac": 0.0})
+    assert res["ok"] and res["errors"] == []
+    assert any(c["metric"] == "host_blocked_frac" and c["ok"]
+               for c in res["checks"])
+    # sub-tolerance noise off the zero baseline passes...
+    noisy = {"mfu": 0.4,
+             "host_blocked_frac": ZERO_BASELINE_ABS_TOL / 2}
+    assert gate(base, noisy)["ok"]
+    # ...a real drift fails as a CHECK (not an error)
+    drifted = gate(base, {"mfu": 0.4, "host_blocked_frac": 0.3})
+    assert not drifted["ok"] and drifted["errors"] == []
+    assert any(c["metric"] == "host_blocked_frac" and not c["ok"]
+               for c in drifted["checks"])
+    # and ACTUALLY removing the metric is still the vanished error
+    gone = gate(base, {"mfu": 0.4})
+    assert not gone["ok"]
+    assert any("host_blocked_frac" in e for e in gone["errors"])
+    # the 0.0 also survives the kind=metrics snapshot path
+    snap = {"kind": "metrics", "t": 1.0,
+            "metrics": {"bench_mfu": 0.4,
+                        "bench_host_blocked_frac": 0.0}}
+    assert extract_invariants(snap)["host_blocked_frac"] == 0.0
+
+
 def test_perf_gate_snapshot_prefers_measured_over_peak_constant():
     """In an obs snapshot the static spec-peak gauge
     (tmpi_cost_peak_hbm_gbps) sorts BEFORE the achieved tmpi_hbm_gbps —

@@ -6,8 +6,10 @@ dispatch pipeline removed."""
 import pytest
 
 from theanompi_tpu.tools.check_hot_loop import (
+    PROFILE_PATH,
     SERVE_PATH,
     WORKER_PATH,
+    check_profile_source,
     check_serve_source,
     check_source,
     main as lint_main,
@@ -135,3 +137,85 @@ def test_default_cli_covers_worker_and_serve(capsys):
     assert lint_main([]) == 0
     out = capsys.readouterr().out
     assert "worker.py" in out and "engine.py" in out
+    assert "profile.py" in out  # ISSUE 12 satellite: HOT003 coverage
+
+
+# --------------------------------------------------------------------------
+# `tmpi profile` warm-step path (HOT003, ISSUE 12 satellite) — the
+# blocked one_step reads are the ONE allowed sync family; anything new
+# in the step or the measure loops fails, mutation-tested like
+# check_serve_source
+# --------------------------------------------------------------------------
+
+_PROFILE_CLEAN = '''
+def run_profile(steps):
+    def one_step(state, rng, i):
+        state, m = engine.train_step(state, x, y, rng)
+        jax.block_until_ready(m["loss"])  # the sanctioned sync
+        return state, rng, 0.1
+    for i in range(2):
+        state, rng, t = one_step(state, rng, i)
+    times = []
+    for i in range(steps):
+        state, rng, t = one_step(state, rng, i)
+        times.append(t)
+    med = float(np.median(times))  # outside the loops: allowed
+    return med
+'''
+
+_PROFILE_BAD_LOOP = '''
+def run_profile(steps):
+    def one_step(state, rng, i):
+        state, m = engine.train_step(state, x, y, rng)
+        jax.block_until_ready(m["loss"])
+        return state, rng, 0.1
+    for i in range(steps):
+        state, rng, t = one_step(state, rng, i)
+        loss = float(m["loss"])  # a NEW sync in the measure loop
+        jax.block_until_ready(state)  # and a second block point
+    return 0
+'''
+
+_PROFILE_BAD_STEP = '''
+def run_profile(steps):
+    def one_step(state, rng, i):
+        state, m = engine.train_step(state, x, y, rng)
+        jax.block_until_ready(m["loss"])
+        v = m["lr"].item()  # a metric fetch inside the step closure
+        return state, rng, 0.1
+    for i in range(steps):
+        state, rng, t = one_step(state, rng, i)
+    return 0
+'''
+
+
+def test_live_profile_source_is_clean():
+    with open(PROFILE_PATH) as f:
+        assert check_profile_source(f.read()) == []
+
+
+def test_profile_blocked_warmup_is_the_one_allowed_sync():
+    assert check_profile_source(_PROFILE_CLEAN) == []
+
+
+def test_profile_new_sync_in_measure_loop_fails():
+    errs = check_profile_source(_PROFILE_BAD_LOOP)
+    assert len(errs) == 2
+    assert any("float(" in e for e in errs)
+    assert any("block_until_ready" in e for e in errs)
+    assert all("measurement loop" in e for e in errs)
+
+
+def test_profile_new_sync_inside_one_step_fails():
+    errs = check_profile_source(_PROFILE_BAD_STEP)
+    assert len(errs) == 1 and ".item(" in errs[0]
+
+
+def test_profile_anchor_guard():
+    with pytest.raises(ValueError, match="run_profile"):
+        check_profile_source("def other():\n    pass\n")
+    with pytest.raises(ValueError, match="one_step"):
+        check_profile_source("def run_profile():\n    pass\n")
+    with pytest.raises(ValueError, match="warm-step loops"):
+        check_profile_source(
+            "def run_profile():\n    def one_step():\n        pass\n")

@@ -1,0 +1,89 @@
+"""Kill-and-resume across the ``--fused-update`` boundary (ISSUE 12
+satellite): the PR-11 claim that the fused optimizers keep a state
+layout IDENTICAL to the unfused rules — so a checkpoint written on one
+side of the boundary resumes on the other — proven end-to-end UNDER
+THE SUPERVISOR, not only by the kernel parity test.
+
+Shape of each direction: phase 1 trains and checkpoints with one
+setting of the knob; phase 2 resumes with the knob FLIPPED, once
+uninterrupted and once with an injected crash auto-resumed by the
+supervisor. Both phase-2 runs must finish with BIT-IDENTICAL params:
+the boundary crossing loses nothing, and a mid-phase-2 kill replays to
+the same bits."""
+
+import numpy as np
+
+import jax
+
+from tinymodel import TinyCNN
+from theanompi_tpu.launch.supervisor import supervise_training
+from theanompi_tpu.launch.worker import run_training
+from theanompi_tpu.utils.checkpoint import (
+    checkpoint_step,
+    latest_checkpoint,
+    load_checkpoint,
+)
+
+_TINY = dict(
+    rule="bsp",
+    model_cls=TinyCNN,
+    devices=8,
+    recipe_overrides={"batch_size": 32, "input_shape": (16, 16, 3),
+                      "sched_kwargs": {"lr": 0.05, "boundaries": [10**9]}},
+    dataset="synthetic",
+    dataset_kwargs={"n_train": 64, "n_val": 32, "image_shape": (16, 16, 3)},
+    print_freq=0,
+)
+
+
+def _final_leaves(ckpt_dir):
+    path = latest_checkpoint(ckpt_dir, verify=True)
+    assert path is not None, f"no verified checkpoint in {ckpt_dir}"
+    model = TinyCNN(TinyCNN.default_recipe().replace(
+        batch_size=32, input_shape=(16, 16, 3)))
+    from theanompi_tpu.train import init_train_state
+
+    template = init_train_state(model, jax.random.PRNGKey(0))
+    restored, _ = load_checkpoint(path, template)
+    return path, jax.tree_util.tree_leaves(restored)
+
+
+def _boundary_run(d: str, first_fused: bool, crash: bool) -> None:
+    """Phase 1: 1 epoch (2 steps) with ``first_fused``; phase 2: resume
+    to epoch 2 (4 steps) with the knob FLIPPED — supervised with an
+    injected crash when ``crash``."""
+    run_training(ckpt_dir=d, n_epochs=1, fused_update=first_fused,
+                 **_TINY)
+    kw = dict(ckpt_dir=d, resume=True, n_epochs=2,
+              fused_update=not first_fused, **_TINY)
+    if crash:
+        sup = supervise_training(max_retries=2, backoff_base=0.0,
+                                 inject_faults=["crash@3"], **kw)
+        assert sup["retries"] == 1 and sup["steps"] == 4
+    else:
+        run_training(**kw)
+
+
+def _assert_boundary_direction(tmp_path, first_fused: bool) -> None:
+    a = str(tmp_path / "uninterrupted")
+    b = str(tmp_path / "killed")
+    _boundary_run(a, first_fused, crash=False)
+    _boundary_run(b, first_fused, crash=True)
+    pa, la = _final_leaves(a)
+    pb, lb = _final_leaves(b)
+    assert checkpoint_step(pa) == checkpoint_step(pb) == 4
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_unfused_checkpoint_resumes_fused_bit_identical(tmp_path):
+    """Checkpoint written UNFUSED, killed-and-resumed FUSED: the
+    supervisor replay lands on the same bits as the uninterrupted
+    boundary crossing."""
+    _assert_boundary_direction(tmp_path, first_fused=False)
+
+
+def test_fused_checkpoint_resumes_unfused_bit_identical(tmp_path):
+    """And the reverse direction: FUSED phase 1, unfused supervised
+    resume."""
+    _assert_boundary_direction(tmp_path, first_fused=True)

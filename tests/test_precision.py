@@ -1,0 +1,209 @@
+"""Dtype-flow lint (tools/analyze/precision.py, ISSUE 12): mutation
+self-tests per rule — an fp32 island seeded into a bf16 forward
+(PREC001), a long bf16 reduce_sum (PREC002), a fused update computing
+in bf16 (PREC003), a widened accumulator drifting the golden
+signature (PREC101) — plus the sanctioned-pattern gates: the real
+bf16 transformer recipe and the shipped fused kernels must stay
+finding-free."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+
+from theanompi_tpu.tools.analyze import harness
+from theanompi_tpu.tools.analyze.golden import (
+    diff_payload,
+    load_preflight_golden,
+)
+from theanompi_tpu.tools.analyze.precision import (
+    accumulation_findings,
+    analyze_precision,
+    dtype_histogram,
+    fp32_island_findings,
+    fused_update_invariant_findings,
+    precision_payload,
+    reduction_table,
+    update_math_findings,
+)
+
+
+def _rules(findings):
+    return sorted(f.rule for f in findings)
+
+
+# --------------------------------------------------------------------------
+# PREC001: fp32 islands
+# --------------------------------------------------------------------------
+
+
+def test_fp32_island_in_bf16_forward_caught():
+    """bf16 -> convert fp32 -> matmul(fp32) is the island."""
+    def island(x, w):
+        return (x.astype(jnp.float32) @ w.astype(jnp.float32)).astype(
+            jnp.bfloat16)
+
+    sds = jax.ShapeDtypeStruct
+    jaxpr = jax.make_jaxpr(island)(sds((8, 32), jnp.bfloat16),
+                                   sds((32, 16), jnp.bfloat16))
+    found = fp32_island_findings(jaxpr, engine="t", tag="[t]")
+    assert _rules(found) == ["PREC001"]
+    assert "upcast" in found[0].message
+
+
+def test_bf16_matmul_and_fp32_accumulation_not_flagged():
+    """The two sanctioned patterns: matmul IN bf16, and
+    bf16-operands-fp32-accumulate via preferred_element_type."""
+    sds = jax.ShapeDtypeStruct
+    x = sds((8, 32), jnp.bfloat16)
+    w = sds((32, 16), jnp.bfloat16)
+    j1 = jax.make_jaxpr(lambda a, b: a @ b)(x, w)
+    assert fp32_island_findings(j1) == []
+    j2 = jax.make_jaxpr(
+        lambda a, b: jax.lax.dot(a, b,
+                                 preferred_element_type=jnp.float32)
+    )(x, w)
+    assert fp32_island_findings(j2) == []
+
+
+def test_pure_fp32_model_has_no_islands():
+    sds = jax.ShapeDtypeStruct
+    j = jax.make_jaxpr(lambda a, b: a @ b)(
+        sds((8, 32), jnp.float32), sds((32, 16), jnp.float32))
+    assert fp32_island_findings(j) == []
+
+
+def test_pallas_kernel_bodies_are_exempt():
+    """Hand-written kernels manage precision deliberately (the flash
+    softmax statistics and o-accumulator are fp32 ON PURPOSE) — the
+    island walk must not descend into pallas_call. Proven on the real
+    fused attention kernel over bf16 q/k/v, whose body upcasts to fp32
+    by design."""
+    from theanompi_tpu.ops.pallas_attention import flash_attention
+
+    sds = jax.ShapeDtypeStruct
+    q = sds((2, 256, 4, 64), jnp.bfloat16)
+    jaxpr = jax.make_jaxpr(
+        lambda a, b, c: flash_attention(a, b, c, causal=True)
+    )(q, q, q)
+    from theanompi_tpu.tools.analyze.precision import iter_eqns
+
+    assert any(e.primitive.name == "pallas_call"
+               for e in iter_eqns(jaxpr)), "kernel path not taken"
+    assert fp32_island_findings(jaxpr) == []
+    assert accumulation_findings(jaxpr) == []
+
+
+# --------------------------------------------------------------------------
+# PREC002: bf16 accumulation hazards
+# --------------------------------------------------------------------------
+
+
+def test_long_bf16_reduction_caught():
+    """A genuine bf16 additive accumulation (lax.reduce with an add
+    monoid — the form bf16 grad transposes and hand-rolled folds take)
+    over >= threshold elements is the hazard."""
+    from jax import lax
+
+    sds = jax.ShapeDtypeStruct
+    j = jax.make_jaxpr(
+        lambda x: lax.reduce(x, jnp.bfloat16(0), lax.add, (1,))
+    )(sds((2, 8192), jnp.bfloat16))
+    found = accumulation_findings(j, tag="[t]")
+    assert _rules(found) == ["PREC002"]
+    assert "8192" in found[0].message
+
+
+def test_short_max_or_fp32_reductions_pass():
+    from jax import lax
+
+    sds = jax.ShapeDtypeStruct
+    short = jax.make_jaxpr(
+        lambda x: lax.reduce(x, jnp.bfloat16(0), lax.add, (1,))
+    )(sds((2, 64), jnp.bfloat16))
+    assert accumulation_findings(short) == []
+    # a max monoid loses no mantissa regardless of length
+    longmax = jax.make_jaxpr(lambda x: jnp.max(x, axis=-1))(
+        sds((2, 8192), jnp.bfloat16))
+    assert accumulation_findings(longmax) == []
+    # jnp.sum auto-widens the bf16 accumulator to fp32 — the safe
+    # pattern the rule must not flag
+    wide = jax.make_jaxpr(lambda x: jnp.sum(x, axis=-1))(
+        sds((2, 8192), jnp.bfloat16))
+    assert accumulation_findings(wide) == []
+
+
+# --------------------------------------------------------------------------
+# PREC003: fused-update fp32-math invariant
+# --------------------------------------------------------------------------
+
+
+def test_shipped_fused_optimizers_compute_fp32():
+    """The static pin of the PR-11 claim: every registered fused
+    optimizer's epilogue does fp32 math over bf16 params — kernel
+    body included."""
+    assert fused_update_invariant_findings() == []
+
+
+def test_bf16_update_math_caught():
+    """The mutation: an update rule doing its momentum math IN bf16."""
+    def bad_apply(g, v, p, lr):
+        v2 = jnp.bfloat16(0.9) * v.astype(jnp.bfloat16) - lr * g
+        return (p + v2).astype(p.dtype), v2
+
+    sds = jax.ShapeDtypeStruct
+    p = sds((256,), jnp.bfloat16)
+    jaxpr = jax.make_jaxpr(bad_apply)(
+        p, sds((256,), jnp.float32), p, jnp.bfloat16(0.1))
+    found = update_math_findings(jaxpr, tag="[bad]")
+    assert "PREC003" in _rules(found)
+    assert "fp32" in found[0].message
+
+
+# --------------------------------------------------------------------------
+# PREC101: golden dtype-flow signature
+# --------------------------------------------------------------------------
+
+
+def test_clean_matrix_has_zero_precision_findings(devices):
+    findings = analyze_precision()
+    assert findings == [], [f.as_json() for f in findings]
+
+
+def test_widened_accumulator_drifts_the_golden(devices):
+    """THE golden mutation: widen one reduction's accumulator dtype
+    and the committed signature reports the drift at its row."""
+    pre = harness.preflight_trace("bsp", "none", False)
+    payload = precision_payload(pre.jaxpr)
+    gold = load_preflight_golden("bsp", "none", False)["precision"]
+    assert diff_payload(gold, payload) == []
+    widened = json.loads(json.dumps(payload))
+    row = next(r for r in widened["reductions"]
+               if r["accum_dtype"] == "float32")
+    row["accum_dtype"] = "float64"
+    errs = diff_payload(gold, widened)
+    assert errs and any("accum_dtype" in e for e in errs)
+
+
+def test_reduction_table_carries_dots_and_sums(devices):
+    """dot_general rows ride the golden table (so a silently narrowed
+    preferred_element_type is PREC101 drift) even though they are not
+    PREC002 hazards."""
+    pre = harness.preflight_trace("bsp", "none", False)
+    rows = reduction_table(pre.jaxpr)
+    prims = {r["prim"] for r in rows}
+    assert "dot_general" in prims
+    assert all(r["accum_dtype"] is not None for r in rows)
+    hist = dtype_histogram(pre.jaxpr)
+    assert hist.get("float32", 0) > 0
+
+
+def test_fused_configs_pin_the_fused_epilogue(devices):
+    """The fused-flag goldens are not copies of the unfused ones: the
+    traced program differs across the --fused-update boundary."""
+    gold_u = load_preflight_golden("bsp", "none", False)
+    gold_f = load_preflight_golden("bsp", "none", True)
+    assert gold_u["precision"] != gold_f["precision"]
+    # while the MEMORY layout is identical — the state-layout claim
+    # that makes checkpoint resume across the boundary possible
+    assert gold_u["memory"]["leaves"] == gold_f["memory"]["leaves"]

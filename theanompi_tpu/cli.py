@@ -31,6 +31,15 @@ into a compute/comm/host/residual split with a roofline verdict::
 
     tmpi profile --model mlp --steps 8            # CPU-runnable
     tmpi profile --model alexnet --steps 20 --trace
+
+``tmpi preflight`` is the memory & precision pre-flight
+(tools/preflight.py): static peak-HBM budgeting (lowered, never
+executed) with a per-leaf byte table, donation-realization audit and
+dtype-flow lint, gated on the device's HBM capacity or an explicit
+budget::
+
+    tmpi preflight --model mlp --engine bsp --budget-gb 16
+    tmpi preflight --model transformer_lm --engine nd --mesh 2x4
 """
 
 from __future__ import annotations
@@ -338,6 +347,14 @@ def main(argv=None) -> int:
         from theanompi_tpu.tools.profile import profile_main
 
         return profile_main(argv[1:])
+    if argv[:1] == ["preflight"]:
+        # memory & precision pre-flight (tools/preflight.py): static
+        # peak-HBM budgeting + dtype-flow lint of one engine x model x
+        # mesh configuration — lowers, never executes; sets up its own
+        # multi-device platform like `tmpi lint`
+        from theanompi_tpu.tools.preflight import preflight_main
+
+        return preflight_main(argv[1:])
     if argv[:1] == ["serve"]:
         # inference subcommand: its own parser + driver (serve/cli.py);
         # dispatched before the training parser, whose first positional

@@ -59,7 +59,17 @@ On-disk layout under ``obs_dir`` (schemas:
                             achieved hbm_gbps — next to the live
                             tmpi_mfu / tmpi_hbm_gbps /
                             tmpi_step_*_frac gauges the dispatcher's
-                            drain cadence refreshes
+                            drain cadence refreshes; a `tmpi preflight`
+                            run with --obs-dir appends one
+                            kind=preflight record (model/engine/codec/
+                            fused config, PREDICTED per-device
+                            peak_bytes from the lowered-not-executed
+                            step, budget + fit verdict when a budget
+                            exists) next to a snapshot carrying the
+                            tmpi_preflight_peak_bytes /
+                            tmpi_preflight_fit gauges — the memory
+                            trajectory tools/perf_gate.py gates via
+                            its preflight_peak_bytes invariant
     metrics.prom            rank-0 Prometheus text exposition (atomic)
     spans_rank{r}.jsonl     per-rank span + span_summary lines
     heartbeat_rank{r}.json  per-rank liveness (atomic rewrite; carries

@@ -456,6 +456,28 @@ class BSPEngine:
             n_buckets=n_buckets, overlap_frac=overlap,
         )
 
+    def memory_model(self, state):
+        """Analytic per-leaf HBM residency of this engine's state
+        (utils/flops.py ``MemoryModel``; the memory-side peer of
+        ``traffic_model()``, consumed by ``tmpi preflight`` /
+        tools/analyze/memory.py). BSP state is replicated on every
+        device — shard factor 1 everywhere — except the codec's
+        error-feedback residuals, stacked ``[n, ...]`` and sharded over
+        the data axes. ``state`` may be abstract (eval_shape structs)."""
+        from theanompi_tpu.utils.flops import state_memory_model
+
+        n = 1
+        for a in _axes_tuple(self._build["axis_name"]):
+            n *= self.mesh.shape[a]
+
+        def factor(path, leaf):
+            return n if path.startswith(".ef") and n > 1 else 1
+
+        return state_memory_model(
+            state, "bsp", n, factor,
+            detail={"note": "replicated state; ef stacked per-device"},
+        )
+
     def cost_model(self, state, global_batch: int):
         """XLA cost analysis of this engine's compiled numerics-off
         train step over an abstract global batch (utils/flops.py

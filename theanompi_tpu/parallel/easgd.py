@@ -353,6 +353,29 @@ class EASGDEngine:
             codec=self.codec,
         )
 
+    def memory_model(self, state):
+        """Analytic per-leaf HBM residency (utils/flops.py
+        ``MemoryModel``; see BSPEngine.memory_model). The per-worker
+        replicas are stacked ``(n_workers, ...)`` and sharded over the
+        worker axis — each device holds ONE worker's params+opt — while
+        the elastic center (params + refreshed BN state) is replicated
+        on every device; error-feedback residuals are per-worker."""
+        from theanompi_tpu.utils.flops import state_memory_model
+
+        n = self.n
+
+        def factor(path, leaf):
+            if n > 1 and (path.startswith(".workers")
+                          or path.startswith(".ef")):
+                return n
+            return 1
+
+        return state_memory_model(
+            state, "easgd", n, factor,
+            detail={"note": "worker stack sharded 1/n; center "
+                            "replicated on every device"},
+        )
+
     def cost_model(self, state, global_batch: int):
         """XLA cost analysis of the compiled numerics-off LOCAL step
         over an abstract global batch (utils/flops.py ``CostModel``;

@@ -457,6 +457,25 @@ class GOSGDEngine:
             group_size=self.group_size, codec=self.codec,
         )
 
+    def memory_model(self, state):
+        """Analytic per-leaf HBM residency (utils/flops.py
+        ``MemoryModel``; see BSPEngine.memory_model). Everything in
+        GoSGD state is per-worker — the stacked replicas, the share
+        weights, and the codec residuals all shard ``1/n`` over the
+        worker axis; there is no replicated center."""
+        from theanompi_tpu.utils.flops import state_memory_model
+
+        n = self.n
+
+        def factor(path, leaf):
+            return n if n > 1 else 1
+
+        return state_memory_model(
+            state, "gosgd", n, factor,
+            detail={"note": "all state per-worker (stack + alpha + ef "
+                            "sharded 1/n); no replicated center"},
+        )
+
     def cost_model(self, state, global_batch: int):
         """XLA cost analysis of the compiled numerics-off WITH-GOSSIP
         step variant over an abstract global batch (utils/flops.py

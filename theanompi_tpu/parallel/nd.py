@@ -608,6 +608,45 @@ class NDEngine:
             codec=self.codec,
         )
 
+    def memory_model(self, state):
+        """Analytic per-leaf HBM residency (utils/flops.py
+        ``MemoryModel``; see BSPEngine.memory_model). The ND engine is
+        the spec-driven case: each leaf's shard factor is the mesh
+        extent over the axes its own PartitionSpec names
+        (``self._state_specs`` — the same per-leaf specs the
+        checkpoint topology manifest stamps), so tp/pipe/expert-sharded
+        params and their like-sharded accumulators divide by their
+        sharding ways while replicated leaves count in full."""
+        import jax as _jax
+
+        from theanompi_tpu.utils.flops import state_memory_model
+
+        sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+
+        def spec_extent(spec) -> int:
+            ways = 1
+            for dim in tuple(spec):
+                for ax in (dim if isinstance(dim, tuple) else (dim,)):
+                    if ax is not None:
+                        ways *= int(sizes.get(ax, 1))
+            return ways
+
+        factors = {
+            _jax.tree_util.keystr(path): spec_extent(spec)
+            for path, spec in _jax.tree_util.tree_flatten_with_path(
+                self._state_specs,
+                is_leaf=lambda x: isinstance(x, P))[0]
+        }
+
+        def factor(path, leaf):
+            return factors.get(path, 1)
+
+        return state_memory_model(
+            state, "nd", self.mesh.devices.size, factor,
+            detail={"note": "per-leaf PartitionSpec extents "
+                            "(tp/pipe/expert sharding)"},
+        )
+
     def cost_model(self, state, global_batch: int):
         """XLA cost analysis of the compiled numerics-off ND step over
         an abstract global token batch (utils/flops.py ``CostModel``;

@@ -447,6 +447,28 @@ class ZeroEngine:
             codec=self.codec,
         )
 
+    def memory_model(self, state):
+        """Analytic per-leaf HBM residency (utils/flops.py
+        ``MemoryModel``; see BSPEngine.memory_model). ZeRO-1's point IS
+        this table: params/BN state replicated (factor 1), the flat
+        optimizer accumulators sharded ``1/n`` over the data axis, the
+        codec's error-feedback residuals likewise per-device."""
+        from theanompi_tpu.utils.flops import state_memory_model
+
+        n = self.mesh.devices.size
+
+        def factor(path, leaf):
+            if n > 1 and (path.startswith(".opt_state")
+                          or path.startswith(".ef")):
+                return n
+            return 1
+
+        return state_memory_model(
+            state, "zero1", n, factor,
+            detail={"note": "optimizer state flat-sharded 1/n "
+                            "(the ZeRO-1 memory claim)"},
+        )
+
     def cost_model(self, state, global_batch: int):
         """XLA cost analysis of the compiled ZeRO-1 step over an
         abstract global batch (utils/flops.py ``CostModel``; see

@@ -58,6 +58,84 @@ def write_golden(trace) -> str:
     return path
 
 
+# --------------------------------------------------------------------------
+# preflight goldens (memory & precision pre-flight, ISSUE 12): one JSON
+# per (engine, codec, fused) triple holding the per-leaf HBM residency
+# table (tools/analyze/memory.py) and the dtype-flow signature
+# (tools/analyze/precision.py). Same contract as the collective
+# snapshots: any drift fails `tmpi lint` (MEM101 / PREC101) until
+# `tmpi lint --update-golden` regenerates it and the diff is reviewed.
+# --------------------------------------------------------------------------
+
+
+def preflight_golden_path(engine: str, codec: str, fused: bool) -> str:
+    tag = codec.replace(":", "_")
+    knob = "fused" if fused else "unfused"
+    return os.path.join(GOLDEN_DIR, f"preflight_{engine}_{tag}_{knob}.json")
+
+
+def load_preflight_golden(engine: str, codec: str,
+                          fused: bool) -> Optional[dict]:
+    path = preflight_golden_path(engine, codec, fused)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def update_preflight_golden(engine: str, codec: str, fused: bool,
+                            memory: Optional[dict] = None,
+                            precision: Optional[dict] = None) -> str:
+    """Merge one family's payload into the config's golden file (the
+    memory and precision passes regenerate independently under
+    ``--update-golden``; each owns its block)."""
+    os.makedirs(GOLDEN_DIR, exist_ok=True)
+    path = preflight_golden_path(engine, codec, fused)
+    payload = load_preflight_golden(engine, codec, fused) or {
+        "engine": engine, "codec": codec, "fused": bool(fused),
+    }
+    if memory is not None:
+        payload["memory"] = memory
+    if precision is not None:
+        payload["precision"] = precision
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def diff_payload(gold, current, prefix: str = "") -> list:
+    """Human-readable recursive diff strings between two JSON-shaped
+    payloads ([] = identical) — shared by the preflight golden
+    comparisons (a drifted accumulator dtype or residency row names
+    its path)."""
+    if type(gold) is not type(current):
+        return [f"{prefix or 'payload'}: golden {gold!r} != "
+                f"current {current!r}"]
+    if isinstance(gold, dict):
+        errs = []
+        for k in sorted(set(gold) | set(current)):
+            if k not in gold:
+                errs.append(f"{prefix}.{k} appeared")
+            elif k not in current:
+                errs.append(f"{prefix}.{k} disappeared")
+            else:
+                errs.extend(diff_payload(gold[k], current[k],
+                                         f"{prefix}.{k}"))
+        return errs
+    if isinstance(gold, list):
+        errs = []
+        if len(gold) != len(current):
+            errs.append(f"{prefix}: {len(gold)} entries in golden, "
+                        f"{len(current)} current")
+        for i, (g, c) in enumerate(zip(gold, current)):
+            errs.extend(diff_payload(g, c, f"{prefix}[{i}]"))
+        return errs
+    if gold != current:
+        return [f"{prefix}: golden {gold!r} != current {current!r}"]
+    return []
+
+
 def compare_golden(trace, golden: dict) -> list:
     """Human-readable mismatch strings ([] = signatures identical)."""
     current = signature_payload(trace)

@@ -36,8 +36,22 @@ from theanompi_tpu.tools.analyze.signature import (
 # replace the single gradient pmean, so the bucketed collective
 # schedule gets its own golden (a bucket whose axis drifts from its
 # siblings fails SPMD003; tests/test_analyze.py mutation self-test).
-ENGINE_NAMES = ("bsp", "bsp_bucketed", "zero1", "easgd", "gosgd", "nd")
+# ``bsp_bucketed_fused`` pins the two PR-11 knobs COMBINED
+# (``--allreduce-buckets`` + ``--fused-update``): the per-bucket psum
+# schedule must survive the fused epilogue, so the pair gets its own
+# golden instead of only the knobs-in-isolation ones.
+ENGINE_NAMES = ("bsp", "bsp_bucketed", "bsp_bucketed_fused", "zero1",
+                "easgd", "gosgd", "nd")
 CODEC_SPECS = ("none", "int8:ef")
+
+# the memory & precision pre-flight matrix (tools/analyze/memory.py /
+# precision.py, `tmpi preflight`): the five driver rules, each codec,
+# each side of the --fused-update boundary — goldens per triple
+# (golden/preflight_*.json). ND runs the momentum recipe here (both
+# flags, so the fused/unfused pair differs ONLY by the knob — the LM
+# default adam has no fused kernel and is refused loudly).
+PREFLIGHT_ENGINES = ("bsp", "zero1", "easgd", "gosgd", "nd")
+FUSED_FLAGS = (False, True)
 EASGD_AVG_FREQ = 4  # harness exchange cadence (amortization weight)
 # bucket size for the bucketed-BSP trace: small enough that the tiny
 # model's 4 leaves split into 4 buckets (reverse-order greedy fill)
@@ -149,14 +163,15 @@ def _build_one(name: str, codec: str) -> EngineTrace:
         # per-engine finding (SPMD001), not crash the whole lint
         rng = jax.random.PRNGKey(0)
         mesh = _mesh2()
-        if name in ("bsp", "bsp_bucketed"):
+        if name in ("bsp", "bsp_bucketed", "bsp_bucketed_fused"):
             from theanompi_tpu.parallel.bsp import BSPEngine
 
             model = _tiny_model()
             eng = BSPEngine(
                 model, mesh, wire_codec=wire_codec,
-                allreduce_buckets=BUCKET_MB if name == "bsp_bucketed"
+                allreduce_buckets=BUCKET_MB if "bucketed" in name
                 else 0.0,
+                fused_update=name.endswith("_fused"),
             )
             state = _abstract_state(eng, rng)
             x = sds((16, 8, 8, 3), jnp.float32)
@@ -239,3 +254,114 @@ def trace_all() -> dict:
     """{(engine, codec): EngineTrace} for the full analyzed matrix."""
     return {(n, c): trace_engine(n, c)
             for n in ENGINE_NAMES for c in CODEC_SPECS}
+
+
+# --------------------------------------------------------------------------
+# preflight harness: engine x codec x fused configs with abstract
+# operands + the raw traced jaxpr, for the memory & precision families
+# (tools/analyze/memory.py / precision.py, `tmpi preflight`)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class PreflightTrace:
+    """One preflight configuration: the built engine, its ABSTRACT
+    state/operands (nothing materialized), the jitted numerics-off step
+    ready to lower, the raw traced jaxpr for the dtype-flow pass, and
+    the engine's declared memory model."""
+
+    engine: str
+    codec: str
+    fused: bool
+    eng: Any = None
+    state: Any = None
+    step_fn: Any = None
+    step_args: tuple = ()
+    jaxpr: Any = None
+    memory: Any = None  # utils/flops.MemoryModel
+    declared_donates: bool = False
+    module_file: str = ""
+    error: Optional[str] = None
+
+
+def _build_preflight(name: str, codec: str, fused: bool) -> PreflightTrace:
+    import inspect
+
+    import jax
+    import jax.numpy as jnp
+
+    sds = jax.ShapeDtypeStruct
+    wire_codec = None if codec == "none" else codec
+    out = PreflightTrace(engine=name, codec=codec, fused=bool(fused))
+    try:
+        rng = jax.random.PRNGKey(0)
+        mesh = _mesh2()
+        if name == "bsp":
+            from theanompi_tpu.parallel.bsp import BSPEngine
+
+            eng = BSPEngine(_tiny_model(), mesh, wire_codec=wire_codec,
+                            fused_update=fused)
+        elif name == "zero1":
+            from theanompi_tpu.parallel.zero import ZeroEngine
+
+            eng = ZeroEngine(_tiny_model(), mesh, wire_codec=wire_codec,
+                             fused_update=fused)
+        elif name == "easgd":
+            from theanompi_tpu.parallel.easgd import EASGDEngine
+
+            eng = EASGDEngine(_tiny_model(), mesh,
+                              avg_freq=EASGD_AVG_FREQ,
+                              wire_codec=wire_codec, fused_update=fused)
+        elif name == "gosgd":
+            from theanompi_tpu.parallel.gosgd import GOSGDEngine
+
+            eng = GOSGDEngine(_tiny_model(), mesh, wire_codec=wire_codec,
+                              fused_update=fused)
+        elif name == "nd":
+            from theanompi_tpu.models.lm import TransformerLMModel
+            from theanompi_tpu.parallel.nd import NDEngine
+
+            recipe = TransformerLMModel.default_recipe().replace(
+                batch_size=8, d_model=32, n_heads=4, n_layers=2,
+                d_ff=64, input_shape=(16,), num_classes=32,
+                optimizer="momentum",  # fused-capable on BOTH flags
+            )
+            eng = NDEngine(TransformerLMModel(recipe), mesh,
+                           dp_axis="data", wire_codec=wire_codec,
+                           fused_update=fused)
+        else:
+            raise ValueError(f"unknown preflight engine {name!r}")
+
+        state = _abstract_state(eng, rng)
+        if name == "nd":
+            step_fn = eng._steps[False]
+            args = (state, sds((16, 16), jnp.int32), rng)
+        elif name == "gosgd":
+            step_fn = eng._steps[(True, False)]
+            args = (state, sds((16, 8, 8, 3), jnp.float32),
+                    sds((16,), jnp.int32), rng)
+        else:
+            step_fn = eng._steps[False]
+            args = (state, sds((16, 8, 8, 3), jnp.float32),
+                    sds((16,), jnp.int32), rng)
+        out.eng = eng
+        out.state = state
+        out.step_fn = step_fn
+        out.step_args = args
+        out.jaxpr = jax.make_jaxpr(step_fn)(*args)
+        out.memory = eng.memory_model(state)
+        out.declared_donates = bool(getattr(eng, "donates_state", False))
+        out.module_file = inspect.getsourcefile(type(eng)) or ""
+    except Exception as e:  # noqa: BLE001 — surfaced as a finding
+        out.error = f"{type(e).__name__}: {e}"
+    return out
+
+
+_PREFLIGHT_CACHE: dict = {}
+
+
+def preflight_trace(name: str, codec: str, fused: bool) -> PreflightTrace:
+    key = (name, codec, bool(fused))
+    if key not in _PREFLIGHT_CACHE:
+        _PREFLIGHT_CACHE[key] = _build_preflight(name, codec, fused)
+    return _PREFLIGHT_CACHE[key]

@@ -1,0 +1,430 @@
+"""Static peak-HBM budgeting — the memory side of ``tmpi preflight``.
+
+The flagship question this module answers WITHOUT executing anything:
+*will this engine × model × mesh × codec fit in HBM, and where does
+every byte live?* Each engine's numerics-off train step is LOWERED over
+abstract ``ShapeDtypeStruct`` operands via the same path the PR-9 cost
+authority uses (``jitted.lower(...).compile()`` — compiles, never
+executes) and XLA's own ``memory_analysis()`` is read off the compiled
+executable: argument / output / temp / generated-code bytes plus the
+``alias`` bytes donation actually realized. Per-leaf attribution comes
+from the engine's declared :func:`~theanompi_tpu.utils.flops.MemoryModel`
+(the ``memory_model()`` hook every engine carries, mirroring
+``traffic_model()``): sharded leaves divide by their mesh extent, so
+the table is per-DEVICE residency.
+
+Peak model::
+
+    peak = argument + (output - alias) + temp + generated_code
+           + donation_shortfall
+
+``donation_shortfall`` is the double-buffer penalty of a DECLARED
+donation the lowered program did not realize: under the async dispatch
+pipeline every in-flight step then holds a second full state copy, so
+an unrealized donation costs (at least) one extra state copy of HBM.
+This term is what makes the predicted peak GROW by >= the state bytes
+when a ``donate`` flag is dropped — backend-independent, where the raw
+XLA numbers are not (this container's CPU backend books aliased
+buffers into ``temp`` as well, so donated/undonated XLA peaks nearly
+cancel; TPU does not).
+
+Rules (IDs in tools/lint.py RULES):
+
+- **MEM001 over-budget** — predicted peak exceeds the HBM budget
+  (``--budget-gb``, or the device table's capacity column,
+  utils/flops.py ``hbm_capacity_bytes``). The finding names the top-10
+  largest live buffers so the refusal is actionable.
+- **MEM002 donation-declared-but-double-buffered** — extends SPMD201
+  from "``donated_invars`` set" to "bytes saved REALIZED": the engine
+  declares ``donates_state`` but XLA's alias bytes fall short of the
+  state's per-device bytes.
+- **MEM003 rematerialization smell** — XLA temp bytes exceed
+  ``TEMP_STATE_RATIO`` x the engine state's per-device bytes: the
+  compiled step is holding far more scratch than the model it trains,
+  the classic signature of a missed remat/fusion opportunity.
+- **MEM101 golden drift** — the per-leaf residency table drifted from
+  the reviewed snapshot (golden.py ``preflight``; regenerate with
+  ``tmpi lint --update-golden``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from theanompi_tpu.tools.analyze.rules import Finding
+
+# MEM003: temp bytes beyond this multiple of the state's per-device
+# bytes smell like rematerialization. The harness tiny models sit
+# around 1-4x (activations for a 16-row batch vs a few-KB net); real
+# training steps keep temps within a small multiple of state unless
+# XLA lost a fusion — 16x leaves comfortable clean-tree margin while
+# still catching order-of-magnitude scratch blowups.
+TEMP_STATE_RATIO = 16.0
+# MEM002: alias shortfall below this floor is accounting noise (tiny
+# unaliased leaves like empty () fields), not a lost donation
+DONATION_SHORTFALL_FLOOR = 4096
+
+
+@dataclass(frozen=True)
+class XlaMemory:
+    """One compiled executable's ``memory_analysis()`` numbers (bytes,
+    per device — the executable IS the per-device program)."""
+
+    argument_bytes: int
+    output_bytes: int
+    temp_bytes: int
+    alias_bytes: int
+    generated_code_bytes: int
+
+    def as_json(self) -> dict:
+        return {"argument_bytes": int(self.argument_bytes),
+                "output_bytes": int(self.output_bytes),
+                "temp_bytes": int(self.temp_bytes),
+                "alias_bytes": int(self.alias_bytes),
+                "generated_code_bytes": int(self.generated_code_bytes)}
+
+
+def lowered_memory(jitted, *args, **kwargs) -> XlaMemory:
+    """XLA ``memory_analysis()`` of one jitted callable lowered over
+    abstract operands — compiles, never executes. Raises when the
+    backend provides no memory analysis (the caller converts that into
+    a per-config finding rather than crashing the lint).
+
+    The persistent compilation cache is bypassed for this compile: a
+    cache-DESERIALIZED executable reports ``alias_size_in_bytes == 0``
+    (the stats don't survive serialization), which would read as every
+    donation silently failing — the exact false positive MEM002 must
+    never produce. Measured on this container's jax: a warm-cache
+    reload of a donated program loses its alias bytes while
+    argument/temp survive. On this container's jax the cache decision
+    is LATCHED process-wide at the first compile (``is_cache_used``
+    memoizes), so clearing the dir alone is not enough once anything
+    compiled cache-enabled — the cache state is reset around the
+    bypass and again after, so surrounding code re-initializes with
+    its configured dir."""
+    import jax
+
+    try:
+        from jax._src import compilation_cache as _cc
+    except Exception:  # noqa: BLE001 — private module; degrade to dir-only
+        _cc = None
+
+    def _reset():
+        if _cc is not None:
+            try:
+                _cc.reset_cache()
+            except Exception:  # noqa: BLE001
+                pass
+
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+        _reset()
+        compiled = jitted.lower(*args, **kwargs).compile()
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+        _reset()
+    ma = compiled.memory_analysis()
+    if ma is None:
+        raise RuntimeError("backend returned no memory_analysis()")
+    return XlaMemory(
+        argument_bytes=int(getattr(ma, "argument_size_in_bytes", 0)),
+        output_bytes=int(getattr(ma, "output_size_in_bytes", 0)),
+        temp_bytes=int(getattr(ma, "temp_size_in_bytes", 0)),
+        alias_bytes=int(getattr(ma, "alias_size_in_bytes", 0)),
+        generated_code_bytes=int(
+            getattr(ma, "generated_code_size_in_bytes", 0)),
+    )
+
+
+@dataclass
+class MemoryReport:
+    """The reconciled memory picture of ONE engine configuration:
+    XLA's compiled-program accounting + the engine's declared per-leaf
+    residency + the donation audit, against an optional budget."""
+
+    engine: str
+    codec: str
+    fused: bool
+    xla: XlaMemory
+    model: "object"  # utils/flops.MemoryModel
+    declared_donates: bool
+    budget_bytes: Optional[float] = None
+    budget_source: str = ""  # "--budget-gb" | "device-table" | ""
+
+    @property
+    def donated_expected_bytes(self) -> int:
+        """Per-device bytes a full state donation should alias."""
+        return int(self.model.state_bytes_per_device)
+
+    @property
+    def donation_shortfall(self) -> int:
+        """Declared-but-unrealized donation bytes (0 when the engine
+        does not declare donation at all — an honest no-donate engine
+        pays its double buffer in the XLA output term instead)."""
+        if not self.declared_donates:
+            return 0
+        return max(0, self.donated_expected_bytes
+                   - int(self.xla.alias_bytes))
+
+    @property
+    def peak_bytes(self) -> int:
+        x = self.xla
+        return int(x.argument_bytes + max(0, x.output_bytes - x.alias_bytes)
+                   + x.temp_bytes + x.generated_code_bytes
+                   + self.donation_shortfall)
+
+    @property
+    def fit(self) -> Optional[bool]:
+        # None-vs-0.0 is presence-vs-value (the same distinction the
+        # perf-gate zero-baseline fix draws): an explicit 0 budget is a
+        # budget, and nothing fits in it
+        if self.budget_bytes is None:
+            return None
+        return self.peak_bytes <= float(self.budget_bytes)
+
+    def buffer_table(self) -> list:
+        """Named live buffers, largest first: every state leaf (per
+        device) plus synthetic rows for the batch operands and XLA's
+        temp pool — the table MEM001 prints on refusal."""
+        rows = [
+            {"name": l.path, "bytes": int(l.per_device_bytes),
+             "dtype": l.dtype, "shape": list(l.shape),
+             "kind": "state"}
+            for l in self.model.leaves
+        ]
+        batch = max(0, int(self.xla.argument_bytes)
+                    - self.donated_expected_bytes)
+        rows.append({"name": "<batch operands>", "bytes": batch,
+                     "dtype": "", "shape": [], "kind": "argument"})
+        rows.append({"name": "<xla temp pool>",
+                     "bytes": int(self.xla.temp_bytes),
+                     "dtype": "", "shape": [], "kind": "temp"})
+        if self.donation_shortfall:
+            rows.append({"name": "<double-buffered state "
+                                 "(unrealized donation)>",
+                         "bytes": int(self.donation_shortfall),
+                         "dtype": "", "shape": [], "kind": "penalty"})
+        return sorted(rows, key=lambda r: -r["bytes"])
+
+    def top_buffers(self, k: int = 10) -> list:
+        return self.buffer_table()[:k]
+
+    def as_json(self) -> dict:
+        return {
+            "engine": self.engine, "codec": self.codec,
+            "fused": bool(self.fused),
+            "n_devices": int(self.model.n_devices),
+            "xla": self.xla.as_json(),
+            "state_bytes_per_device": self.donated_expected_bytes,
+            "declared_donates": bool(self.declared_donates),
+            "donation_shortfall": int(self.donation_shortfall),
+            "peak_bytes": int(self.peak_bytes),
+            "budget_bytes": float(self.budget_bytes)
+            if self.budget_bytes is not None else None,
+            "budget_source": self.budget_source,
+            "fit": self.fit,
+            "buffers": self.buffer_table(),
+        }
+
+
+def analyze_step_memory(jitted, args, model, declared_donates: bool,
+                        engine: str = "", codec: str = "none",
+                        fused: bool = False,
+                        budget_bytes: Optional[float] = None,
+                        budget_source: str = "") -> MemoryReport:
+    """Lower+compile ``jitted`` over abstract ``args`` and reconcile
+    the XLA memory analysis with the declared per-leaf ``model``
+    (utils/flops.MemoryModel). The building block both ``tmpi lint``'s
+    matrix sweep and ``tmpi preflight``'s single-config run share —
+    also the mutation-test entry point (hand it a scratch no-donate
+    step and watch MEM002 + the predicted peak grow)."""
+    return MemoryReport(
+        engine=engine, codec=codec, fused=bool(fused),
+        xla=lowered_memory(jitted, *args),
+        model=model,
+        declared_donates=bool(declared_donates),
+        budget_bytes=budget_bytes,
+        budget_source=budget_source,
+    )
+
+
+def _fmt_bytes(n: float) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0 or unit == "GiB":
+            return f"{n:.2f} {unit}" if unit != "B" else f"{n:.0f} B"
+        n /= 1024.0
+    return f"{n:.2f} GiB"
+
+
+def memory_findings(report: MemoryReport,
+                    temp_state_ratio: float = TEMP_STATE_RATIO) -> list:
+    """MEM001/MEM002/MEM003 over one reconciled report."""
+    out = []
+    tag = (f"[{report.engine}/{report.codec}"
+           f"{'/fused' if report.fused else ''}]")
+    if report.fit is False:
+        top = ", ".join(
+            f"{r['name']}={_fmt_bytes(r['bytes'])}"
+            for r in report.top_buffers(10)
+        )
+        out.append(Finding(
+            rule="MEM001", path="", line=0, engine=report.engine,
+            message=(
+                f"{tag} predicted peak {_fmt_bytes(report.peak_bytes)} "
+                f"exceeds the {_fmt_bytes(report.budget_bytes)} budget "
+                f"({report.budget_source or 'device table'}); largest "
+                f"live buffers: {top}"
+            ),
+        ))
+    if report.donation_shortfall > DONATION_SHORTFALL_FLOOR:
+        out.append(Finding(
+            rule="MEM002", path="", line=0, engine=report.engine,
+            message=(
+                f"{tag} engine declares donates_state but the lowered "
+                f"step aliases only "
+                f"{_fmt_bytes(report.xla.alias_bytes)} of the "
+                f"{_fmt_bytes(report.donated_expected_bytes)} state — "
+                "the unrealized donation double-buffers "
+                f"{_fmt_bytes(report.donation_shortfall)} per in-flight "
+                "dispatch (declared-vs-lowered bytes, the MEM "
+                "extension of SPMD201)"
+            ),
+        ))
+    state_b = max(1, report.donated_expected_bytes)
+    if report.xla.temp_bytes > temp_state_ratio * state_b:
+        out.append(Finding(
+            rule="MEM003", path="", line=0, engine=report.engine,
+            message=(
+                f"{tag} XLA temp pool "
+                f"{_fmt_bytes(report.xla.temp_bytes)} is "
+                f"{report.xla.temp_bytes / state_b:.1f}x the engine "
+                f"state ({_fmt_bytes(state_b)}) — rematerialization "
+                f"smell (threshold {temp_state_ratio:.0f}x); check "
+                "remat/fusion on the backward pass"
+            ),
+        ))
+    return out
+
+
+# --------------------------------------------------------------------------
+# the lint-side matrix sweep (engine x codec x fused via the preflight
+# harness) + golden comparison
+# --------------------------------------------------------------------------
+
+_REPORT_CACHE: dict = {}
+
+
+def config_report(name: str, codec: str, fused: bool,
+                  budget_bytes: Optional[float] = None,
+                  budget_source: str = ""):
+    """``(MemoryReport | None, error | None)`` for one harness config,
+    memoized per process (the lint and its tests re-enter)."""
+    from theanompi_tpu.tools.analyze import harness
+
+    key = (name, codec, fused)
+    if key not in _REPORT_CACHE:
+        pre = harness.preflight_trace(name, codec, fused)
+        if pre.error is not None:
+            _REPORT_CACHE[key] = (None, pre.error)
+        else:
+            try:
+                report = analyze_step_memory(
+                    pre.step_fn, pre.step_args, pre.memory,
+                    pre.declared_donates, engine=name, codec=codec,
+                    fused=fused,
+                )
+                _REPORT_CACHE[key] = (report, None)
+            except Exception as e:  # noqa: BLE001 — becomes a finding
+                _REPORT_CACHE[key] = (None, f"{type(e).__name__}: {e}")
+    report, err = _REPORT_CACHE[key]
+    if report is not None and budget_bytes is not None:
+        # budget applies per call (the CLI passes one; the lint none)
+        report = MemoryReport(
+            engine=report.engine, codec=report.codec, fused=report.fused,
+            xla=report.xla, model=report.model,
+            declared_donates=report.declared_donates,
+            budget_bytes=budget_bytes, budget_source=budget_source,
+        )
+    return report, err
+
+
+def analyze_memory(update_golden: bool = False) -> list:
+    """MEM001/002/003 + MEM101 (golden) over the full preflight matrix
+    (5 engines x {none, int8:ef} x {unfused, fused}). No budget is
+    applied here — the lint machine is a CPU without an HBM spec entry;
+    will-it-fit runs through ``tmpi preflight`` where a budget exists."""
+    from theanompi_tpu.tools.analyze import harness
+
+    findings: list = []
+    for name in harness.PREFLIGHT_ENGINES:
+        for codec in harness.CODEC_SPECS:
+            for fused in harness.FUSED_FLAGS:
+                report, err = config_report(name, codec, fused)
+                if err is not None:
+                    # a config that cannot even be built/lowered is an
+                    # analysis failure, NOT a budget refusal — routed to
+                    # the family's golden/infrastructure rule (MEM101)
+                    # so rule-keyed CI consumers never misread it as
+                    # over-budget (mirrors PREC101's failure routing
+                    # and SPMD001's trace-failure convention)
+                    findings.append(Finding(
+                        rule="MEM101", path="", line=0, engine=name,
+                        message=(
+                            f"[{name}/{codec}{'/fused' if fused else ''}] "
+                            f"memory pre-flight could not lower the "
+                            f"step: {err}"
+                        ),
+                    ))
+                    continue
+                findings.extend(memory_findings(report))
+                findings.extend(golden_memory_findings(
+                    report, update=update_golden))
+    return findings
+
+
+def memory_payload(report: MemoryReport) -> dict:
+    """The golden-stable slice of a report: the per-leaf residency
+    table and the donation declaration — pure functions of the engine's
+    state structure and mesh, deliberately excluding the raw XLA
+    temp/code numbers (XLA-version-fragile)."""
+    return {
+        "declared_donates": bool(report.declared_donates),
+        "n_devices": int(report.model.n_devices),
+        "state_bytes_per_device": int(report.model.state_bytes_per_device),
+        "leaves": [l.as_json() for l in report.model.leaves],
+    }
+
+
+def golden_memory_findings(report: MemoryReport,
+                           update: bool = False) -> list:
+    """MEM101: the per-leaf residency table vs the reviewed snapshot
+    (golden.py ``preflight`` block)."""
+    from theanompi_tpu.tools.analyze import golden as G
+
+    if update:
+        G.update_preflight_golden(report.engine, report.codec,
+                                  report.fused,
+                                  memory=memory_payload(report))
+        return []
+    gold = G.load_preflight_golden(report.engine, report.codec,
+                                   report.fused)
+    path = G.preflight_golden_path(report.engine, report.codec,
+                                   report.fused)
+    tag = (f"[{report.engine}/{report.codec}"
+           f"{'/fused' if report.fused else ''}]")
+    if gold is None or "memory" not in gold:
+        return [Finding(
+            rule="MEM101", path=path, line=0, engine=report.engine,
+            message=f"{tag} no memory golden — run `tmpi lint "
+                    "--update-golden` and review the residency table",
+        )]
+    errs = G.diff_payload(gold["memory"], memory_payload(report))
+    return [Finding(
+        rule="MEM101", path=path, line=0, engine=report.engine,
+        message=f"{tag} per-leaf residency drifted from golden: {e} — "
+                "if deliberate, regenerate with `tmpi lint "
+                "--update-golden` and review the diff",
+    ) for e in errs]

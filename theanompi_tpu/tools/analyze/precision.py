@@ -1,0 +1,395 @@
+"""Dtype-flow lint — the precision side of ``tmpi preflight``.
+
+A jaxpr dtype-dataflow pass over the same abstract traces the SPMD
+analyzer walks (tools/analyze/signature.py — this module reuses its
+quantization-evidence convention: track where low-precision values
+originate and where they silently widen). Three rule families:
+
+- **PREC001 fp32 island** — inside a bf16 model, a compute-heavy op
+  (``dot_general`` / conv) executing with fp32 operands that ORIGINATE
+  from bf16 values: an unintended upcast on the hot path. A
+  ``dot_general(bf16, bf16) -> f32`` via ``preferred_element_type`` is
+  the GOOD pattern (fp32 accumulation on bf16 inputs) and is not
+  flagged — the island is ``bf16 -> convert f32 -> matmul(f32)``.
+  Pallas kernel BODIES are exempt: a hand-written kernel manages its
+  own precision deliberately (the flash-attention softmax statistics
+  and the fused-update epilogue are fp32 ON PURPOSE — the latter is
+  even enforced the other way by PREC003).
+- **PREC002 bf16 accumulation hazard** — an EXPLICIT reduction
+  (``reduce_sum``) of >= :data:`ACCUM_MIN_ELEMS` elements accumulating
+  IN bf16 (8 mantissa bits swamp). ``dot_general`` is deliberately NOT
+  a hazard site regardless of its output dtype: the MXU/XLA accumulate
+  a single dot in fp32 internally and round once on output — flagging
+  every bf16 transformer matmul would be crying wolf on the sanctioned
+  mixed-precision recipe (models/transformer.py). Dots still appear in
+  the golden reduction TABLE, so silently narrowing a
+  ``preferred_element_type`` fp32 accumulator is caught as PREC101
+  drift even though it is not a PREC002 hazard.
+- **PREC003 fused-update fp32-math invariant** — the ``--fused-update``
+  epilogue (ops/pallas_update.py) must compute in fp32 even for bf16
+  params. Pinned STATICALLY here (trace the registry's fused
+  optimizers over bf16 params and reject any arithmetic eqn producing
+  a sub-fp32 value — the Pallas kernel body included), not just by the
+  parity test.
+- **PREC101 golden drift** — the per-config dtype-flow signature
+  (dtype histogram + reduction table) drifted from the reviewed
+  snapshot; widening or narrowing ANY accumulator shows up here even
+  when no hazard rule fires.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from theanompi_tpu.tools.analyze.rules import Finding
+from theanompi_tpu.tools.analyze.signature import _source_of, _subjaxprs
+
+# reductions at least this long accumulating in bf16 lose mantissa
+# bits to swamping; the threshold is deliberately generous (a 4096-term
+# bf16 sum is already ~2 decimal digits of error in the worst case)
+ACCUM_MIN_ELEMS = 4096
+
+_LOW_PRECISION = ("bfloat16", "float16")
+# arithmetic primitives whose sub-fp32 output inside an update
+# epilogue violates the fused-update fp32-math invariant
+_ARITH_PRIMS = {
+    "add", "sub", "mul", "div", "neg", "max", "min", "pow",
+    "integer_pow", "sqrt", "rsqrt", "exp", "log", "dot_general",
+    "add_any",
+}
+_MATMUL_PRIMS = {"dot_general", "conv_general_dilated"}
+
+
+def _dtype_of(var) -> Optional[str]:
+    dt = getattr(getattr(var, "aval", None), "dtype", None)
+    return None if dt is None else str(dt)
+
+
+def _is_low(dtype: Optional[str]) -> bool:
+    return dtype is not None and dtype.startswith(_LOW_PRECISION)
+
+
+def iter_eqns(jaxpr):
+    """Every eqn reachable from ``jaxpr``, descending into all
+    subjaxpr-carrying params (pjit, scan, cond branches, custom_*,
+    pallas_call kernels — the precision rules must see kernel bodies,
+    unlike the collective walk which treats them as opaque wire)."""
+    stack = [jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr]
+    seen = set()
+    while stack:
+        j = stack.pop()
+        if id(j) in seen:
+            continue
+        seen.add(id(j))
+        for eqn in j.eqns:
+            yield eqn
+            for pv in eqn.params.values():
+                stack.extend(_subjaxprs(pv))
+
+
+# --------------------------------------------------------------------------
+# dtype-flow signature (the PREC101 golden payload)
+# --------------------------------------------------------------------------
+
+
+def dtype_histogram(jaxpr) -> dict:
+    """``{dtype: eqn_output_count}`` over the whole traced program —
+    the coarse fingerprint a precision change cannot dodge."""
+    hist: dict = {}
+    for eqn in iter_eqns(jaxpr):
+        for v in eqn.outvars:
+            dt = _dtype_of(v)
+            if dt is not None:
+                hist[dt] = hist.get(dt, 0) + 1
+    return hist
+
+
+def _reduced_elems(eqn) -> int:
+    """Elements folded into each output element of a reduction eqn."""
+    try:
+        in_elems = int(np.prod(eqn.invars[0].aval.shape or (1,)))
+        out_elems = int(np.prod(eqn.outvars[0].aval.shape or (1,)))
+        return max(1, in_elems // max(1, out_elems))
+    except Exception:  # noqa: BLE001 — advisory sizing only
+        return 1
+
+
+def _contraction_elems(eqn) -> int:
+    """Contraction length of a dot_general (elements accumulated per
+    output element)."""
+    try:
+        (lhs_c, _), _ = eqn.params["dimension_numbers"]
+        shape = eqn.invars[0].aval.shape
+        n = 1
+        for d in lhs_c:
+            n *= int(shape[d])
+        return max(1, n)
+    except Exception:  # noqa: BLE001
+        return 1
+
+
+def reduction_table(jaxpr) -> list:
+    """Ordered accumulation signature: every ``reduce_sum`` and
+    ``dot_general`` with its operand dtype, ACCUMULATION dtype (the
+    output / preferred_element_type — widening an accumulator changes
+    this column, which is exactly the PREC101 golden-drift mutation),
+    and folded length."""
+    rows = []
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name == "reduce_sum":
+            rows.append({
+                "prim": name,
+                "operand_dtype": _dtype_of(eqn.invars[0]),
+                "accum_dtype": _dtype_of(eqn.outvars[0]),
+                "elems": _reduced_elems(eqn),
+            })
+        elif name == "dot_general":
+            rows.append({
+                "prim": name,
+                "operand_dtype": _dtype_of(eqn.invars[0]),
+                "accum_dtype": _dtype_of(eqn.outvars[0]),
+                "elems": _contraction_elems(eqn),
+            })
+    return rows
+
+
+def precision_payload(jaxpr) -> dict:
+    return {"dtype_ops": dtype_histogram(jaxpr),
+            "reductions": reduction_table(jaxpr)}
+
+
+# --------------------------------------------------------------------------
+# PREC001: fp32 islands in a low-precision model
+# --------------------------------------------------------------------------
+
+
+def fp32_island_findings(jaxpr, engine: str = "",
+                         tag: str = "") -> list:
+    """Flag compute-heavy ops running in fp32 on values that ORIGINATE
+    from bf16/f16 — the silent-upcast hot-path island. Dataflow: a var
+    is 'low-origin' when its dtype is low precision, or it was produced
+    (transitively) from a low-origin var by a convert/elementwise
+    chain. Only matmul-class eqns whose OPERANDS are already fp32 fire
+    (bf16-in/fp32-out accumulation is the sanctioned pattern)."""
+    out = []
+    origin: dict = {}
+
+    def get(v) -> bool:
+        if not hasattr(v, "aval") or hasattr(v, "val"):
+            return False  # literals
+        return origin.get(id(v), False)
+
+    def walk(j):
+        for eqn in j.eqns:
+            in_low = any(get(v) or _is_low(_dtype_of(v))
+                         for v in eqn.invars)
+            name = eqn.primitive.name
+            if (name in _MATMUL_PRIMS and in_low
+                    and all(_dtype_of(v) == "float32"
+                            for v in eqn.invars
+                            if _dtype_of(v) is not None)):
+                f, ln = _source_of(eqn)
+                out.append(Finding(
+                    rule="PREC001", path=f, line=ln, engine=engine,
+                    message=(
+                        f"{tag} {name} executes in fp32 on values that "
+                        "originated as bf16 — an unintended upcast "
+                        "island on the hot path (cast back to bf16 "
+                        "before the matmul, or use "
+                        "preferred_element_type for fp32 accumulation "
+                        "on bf16 operands)"
+                    ),
+                ))
+            if name != "pallas_call":  # kernels manage precision
+                for pv in eqn.params.values():
+                    for sub in _subjaxprs(pv):
+                        if len(sub.invars) == len(eqn.invars):
+                            for si, oi in zip(sub.invars, eqn.invars):
+                                origin[id(si)] = get(oi) or _is_low(
+                                    _dtype_of(oi))
+                        else:
+                            for si in sub.invars:
+                                origin[id(si)] = in_low
+                        walk(sub)
+            for v in eqn.outvars:
+                origin[id(v)] = in_low or _is_low(_dtype_of(v))
+    j = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+    walk(j)
+    return out
+
+
+# --------------------------------------------------------------------------
+# PREC002: long reductions accumulating in bf16
+# --------------------------------------------------------------------------
+
+
+def accumulation_findings(jaxpr, engine: str = "", tag: str = "",
+                          min_elems: int = ACCUM_MIN_ELEMS) -> list:
+    """Explicit reductions (``reduce_sum``) folding >= ``min_elems``
+    elements IN a sub-fp32 dtype. ``dot_general`` is not a hazard site
+    (see the module docstring) — a bf16 matmul accumulates fp32 inside
+    the MXU and rounds once."""
+    out = []
+    for row_eqn in iter_eqns(jaxpr):
+        name = row_eqn.primitive.name
+        if name == "reduce":
+            # generic monoid reduce: only the additive monoid
+            # accumulates (min/max reductions lose no mantissa)
+            if not _reduce_monoid_is_add(row_eqn):
+                continue
+        elif name != "reduce_sum":
+            continue
+        acc = _dtype_of(row_eqn.outvars[0])
+        elems = _reduced_elems(row_eqn)
+        if _is_low(acc) and elems >= min_elems:
+            f, ln = _source_of(row_eqn)
+            out.append(Finding(
+                rule="PREC002", path=f, line=ln, engine=engine,
+                message=(
+                    f"{tag} {name} folds {elems} elements "
+                    f"accumulating in {acc} — widen the "
+                    "accumulator to fp32 (8 mantissa bits swamp "
+                    f"past ~{min_elems} terms)"
+                ),
+            ))
+    return out
+
+
+def _reduce_monoid_is_add(eqn) -> bool:
+    j = eqn.params.get("jaxpr")
+    if j is None:
+        return False
+    j = j.jaxpr if hasattr(j, "jaxpr") else j
+    return any(e.primitive.name in ("add", "add_any") for e in j.eqns)
+
+
+# --------------------------------------------------------------------------
+# PREC003: fused-update epilogue must do fp32 math
+# --------------------------------------------------------------------------
+
+
+def update_math_findings(jaxpr, engine: str = "", tag: str = "",
+                         where: str = "fused update") -> list:
+    """Reject any arithmetic eqn producing a sub-fp32 value inside an
+    optimizer-update program. Converts (the final cast back to the
+    param dtype) are exempt — math is not."""
+    out = []
+    for eqn in iter_eqns(jaxpr):
+        if eqn.primitive.name not in _ARITH_PRIMS:
+            continue
+        for v in eqn.outvars:
+            if _is_low(_dtype_of(v)):
+                f, ln = _source_of(eqn)
+                out.append(Finding(
+                    rule="PREC003", path=f, line=ln, engine=engine,
+                    message=(
+                        f"{tag} {eqn.primitive.name} inside the "
+                        f"{where} produces {_dtype_of(v)} — the "
+                        "epilogue must compute in fp32 even for bf16 "
+                        "params (cast in, math fp32, cast out; "
+                        "ops/pallas_update.py pins this invariant)"
+                    ),
+                ))
+                break
+    return out
+
+
+def fused_update_invariant_findings() -> list:
+    """PREC003 self-check: trace every registered fused optimizer's
+    one-pass ``apply`` over bf16 params (fp32 velocity) and verify no
+    sub-fp32 arithmetic anywhere — Pallas kernel body included
+    (``iter_eqns`` descends into ``pallas_call``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from theanompi_tpu.ops.pallas_update import _FUSED_BUILDERS
+
+    findings: list = []
+    sds = jax.ShapeDtypeStruct
+    params = {"w": sds((256,), jnp.bfloat16),
+              "b": sds((16,), jnp.bfloat16)}
+    grads = params
+    for name, builder in sorted(_FUSED_BUILDERS.items()):
+        opt = builder()
+        state = jax.eval_shape(opt.init, params)
+        try:
+            jaxpr = jax.make_jaxpr(
+                lambda g, s, p: opt.apply(g, s, p, 0.1)
+            )(grads, state, params)
+        except Exception as e:  # noqa: BLE001 — becomes a finding
+            findings.append(Finding(
+                rule="PREC003", path="", line=0, engine="",
+                message=f"[fused:{name}] fused apply could not be "
+                        f"traced over bf16 params: "
+                        f"{type(e).__name__}: {e}",
+            ))
+            continue
+        findings.extend(update_math_findings(
+            jaxpr, engine="", tag=f"[fused:{name}]",
+            where=f"fused '{name}' update"))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# the lint-side matrix sweep + golden comparison
+# --------------------------------------------------------------------------
+
+
+def config_findings(name: str, codec: str, fused: bool,
+                    update_golden: bool = False) -> list:
+    """PREC001/002 + PREC101 for one harness config."""
+    from theanompi_tpu.tools.analyze import golden as G, harness
+
+    pre = harness.preflight_trace(name, codec, fused)
+    tag = f"[{name}/{codec}{'/fused' if fused else ''}]"
+    if pre.error is not None:
+        return [Finding(
+            rule="PREC101", path="", line=0, engine=name,
+            message=f"{tag} precision pre-flight could not trace the "
+                    f"step: {pre.error}",
+        )]
+    findings = []
+    findings.extend(fp32_island_findings(pre.jaxpr, engine=name, tag=tag))
+    findings.extend(accumulation_findings(pre.jaxpr, engine=name, tag=tag))
+    payload = precision_payload(pre.jaxpr)
+    if update_golden:
+        G.update_preflight_golden(name, codec, fused, precision=payload)
+        return findings
+    gold = G.load_preflight_golden(name, codec, fused)
+    path = G.preflight_golden_path(name, codec, fused)
+    if gold is None or "precision" not in gold:
+        findings.append(Finding(
+            rule="PREC101", path=path, line=0, engine=name,
+            message=f"{tag} no precision golden — run `tmpi lint "
+                    "--update-golden` and review the dtype-flow "
+                    "signature",
+        ))
+        return findings
+    for e in G.diff_payload(gold["precision"], payload):
+        findings.append(Finding(
+            rule="PREC101", path=path, line=0, engine=name,
+            message=f"{tag} dtype-flow signature drifted from golden: "
+                    f"{e} — if deliberate, regenerate with `tmpi lint "
+                    "--update-golden` and review the diff "
+                    "(accumulator widened/narrowed?)",
+        ))
+    return findings
+
+
+def analyze_precision(update_golden: bool = False) -> list:
+    """The full precision family over the preflight matrix, plus the
+    engine-independent fused-update fp32 invariant."""
+    from theanompi_tpu.tools.analyze import harness
+
+    findings: list = []
+    for name in harness.PREFLIGHT_ENGINES:
+        for codec in harness.CODEC_SPECS:
+            for fused in harness.FUSED_FLAGS:
+                findings.extend(config_findings(
+                    name, codec, fused, update_golden=update_golden))
+    findings.extend(fused_update_invariant_findings())
+    return findings

@@ -26,9 +26,18 @@ dequeue loop (``_loop``) and inside any per-request ``for`` loop of
 ``_serve_batch`` — the "fetch each request's logits separately" patch
 that would turn one device round trip per batch into one per request.
 
+**Profiler warm-step path** (ISSUE 12 satellite): ``tmpi profile``
+(tools/profile.py) measures by blocking, but only at its sanctioned
+points — the ``one_step`` closure's ``block_until_ready`` reads. Rule
+HOT003 (``check_profile_source``) fails on any other host-
+materializing call inside ``one_step`` or inside the warm/measure
+loops that drive it: an extra sync would silently change what the
+profiler times.
+
 Usage::
 
     python -m theanompi_tpu.tools.check_hot_loop            # worker + serve
+                                                            # + profile
     python -m theanompi_tpu.tools.check_hot_loop path.py    # train-loop lint
                                                             # on that file
 
@@ -63,8 +72,16 @@ SERVE_PATH = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "serve", "engine.py",
 )
+PROFILE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "profile.py",
+)
 # the serve micro-batch hot path: the dequeue loop and the batch server
 _SERVE_FUNCS = ("_loop", "_serve_batch")
+# `tmpi profile` hot path anchors (tools/profile.py): the per-step
+# closure holding the SANCTIONED blocked reads, and the warm/measure
+# loops that drive it
+_PROFILE_FUNC = "run_profile"
+_PROFILE_STEP = "one_step"
 
 
 def _forbidden_call(node: ast.Call) -> Optional[str]:
@@ -184,6 +201,86 @@ def check_serve_source(source: str) -> list:
     return errs
 
 
+def check_profile_source(source: str) -> list:
+    """Violation strings for ``tmpi profile``'s warm-step path
+    (tools/profile.py; empty = clean). The profiler measures by
+    BLOCKING — but only where the measurement contract says so: the
+    ``one_step`` closure's ``block_until_ready`` reads are the
+    sanctioned syncs (the blocked warmup/measure bracket). Anything
+    else is drift that silently changes what ``tmpi profile`` times:
+
+    - inside ``one_step``: any OTHER host-materializing call
+      (``float``/``.item``/``asarray``/``device_get``) — a per-step
+      metric fetch would fold host-transfer time into the step reading;
+    - inside the warm/measure loops that drive ``one_step`` (every
+      ``for`` loop in ``run_profile`` whose body calls it): ANY
+      host-materializing call, ``block_until_ready`` included — a
+      second sync point would double-count device time.
+
+    Anchor-guarded like the other hot paths: a refactor that renames
+    ``run_profile``/``one_step`` fails loudly instead of silently
+    passing."""
+    tree = ast.parse(source)
+    fn: Optional[ast.FunctionDef] = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == _PROFILE_FUNC:
+            fn = node
+            break
+    if fn is None:
+        raise ValueError(
+            f"profile hot-path anchor {_PROFILE_FUNC!r} not found — the "
+            "warm-step loop moved; update tools/check_hot_loop.py"
+        )
+    step_fn: Optional[ast.FunctionDef] = None
+    for node in ast.walk(fn):
+        if isinstance(node, ast.FunctionDef) and node.name == _PROFILE_STEP:
+            step_fn = node
+            break
+    if step_fn is None:
+        raise ValueError(
+            f"profile step anchor {_PROFILE_STEP!r} not found inside "
+            f"{_PROFILE_FUNC!r}; update tools/check_hot_loop.py"
+        )
+    errs = []
+    for node in ast.walk(step_fn):
+        if not isinstance(node, ast.Call):
+            continue
+        tok = _forbidden_call(node)
+        if tok is not None and "block_until_ready" not in tok:
+            errs.append(
+                f"line {node.lineno}: forbidden host sync {tok!r} "
+                f"inside {_PROFILE_STEP}: {ast.unparse(node)} "
+                "(only the sanctioned block_until_ready measurement "
+                "reads belong in the profiled step)"
+            )
+    step_ids = {id(n) for n in ast.walk(step_fn)}
+    loops = [
+        node for node in ast.walk(fn)
+        if isinstance(node, ast.For) and id(node) not in step_ids
+        and any(isinstance(sub, ast.Name) and sub.id == _PROFILE_STEP
+                for sub in ast.walk(node))
+    ]
+    if not loops:
+        raise ValueError(
+            f"no warm-step loops driving {_PROFILE_STEP!r} found in "
+            f"{_PROFILE_FUNC!r}; update tools/check_hot_loop.py"
+        )
+    for loop in loops:
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            tok = _forbidden_call(node)
+            if tok is not None:
+                errs.append(
+                    f"line {node.lineno}: forbidden host sync {tok!r} "
+                    f"inside a warm-step measurement loop: "
+                    f"{ast.unparse(node)} (all syncs live inside "
+                    f"{_PROFILE_STEP}'s blocked reads — a second sync "
+                    "point double-counts device time)"
+                )
+    return errs
+
+
 def main(argv: Optional[list] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if argv:
@@ -199,7 +296,8 @@ def main(argv: Optional[list] = None) -> int:
         return 1 if errs else 0
     rc = 0
     for path, checker in ((WORKER_PATH, check_source),
-                          (SERVE_PATH, check_serve_source)):
+                          (SERVE_PATH, check_serve_source),
+                          (PROFILE_PATH, check_profile_source)):
         with open(path) as f:
             errs = checker(f.read())
         for e in errs:

@@ -214,6 +214,31 @@ SCHEMAS: dict[str, dict[str, tuple[tuple, bool]]] = {
         "mfu_calibrated": (_NUM, False),
         "hbm_gbps": (_NUM, False),
     },
+    # memory & precision pre-flight (`tmpi preflight`,
+    # tools/preflight.py): one record per pre-flight run appended to
+    # metrics.jsonl next to a metrics snapshot carrying the
+    # tmpi_preflight_peak_bytes / tmpi_preflight_fit /
+    # tmpi_preflight_state_bytes gauges — the memory trajectory line
+    # tools/perf_gate.py diffs (gate metric `preflight_peak_bytes`).
+    # `peak_bytes` is the PREDICTED per-device peak (XLA memory
+    # analysis of the lowered step + the declared donation audit);
+    # `fit`/`budget_bytes` appear when a budget exists (--budget-gb or
+    # the device table's HBM capacity).
+    "preflight": {
+        "t": (_NUM, True),
+        "model": ((str,), True),
+        "engine": ((str,), True),
+        "codec": ((str,), True),
+        "n_devices": ((int,), True),
+        "peak_bytes": (_NUM, True),
+        "fused": ((bool,), False),
+        "state_bytes": (_NUM, False),
+        "budget_bytes": (_NUM, False),
+        "budget_source": ((str,), False),
+        "fit": ((bool,), False),
+        "device_kind": ((str,), False),
+        "findings": ((int,), False),
+    },
     # serving engine (serve/engine.py): periodic + drain-time stats
     # records in <obs_dir>/serve.jsonl. `params_step` is the checkpoint
     # step being served (-1 before the first load); `metrics` is a flat

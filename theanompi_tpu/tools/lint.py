@@ -29,11 +29,35 @@ SPMD201 donates_state declared but the lowered step does not donate
 SPMD202 host np.asarray aliases state donated to an engine step
 SPMD301 rank-divergent value gates cross-rank work (host taint)
 SPMD302 unsorted directory listing (shared-storage order divergence)
+HOT003  host sync in `tmpi profile`'s warm-step measurement loops
+        beyond the sanctioned blocked reads
+MEM001  predicted peak HBM exceeds the budget (tmpi preflight)
+MEM002  donation declared but bytes not realized (double buffer)
+MEM003  XLA temp pool >> engine state (rematerialization smell)
+MEM101  per-leaf HBM residency drifted from golden
+PREC001 fp32 island inside a low-precision model's hot path
+PREC002 long reduction accumulating in bf16
+PREC003 fused-update epilogue math below fp32
+PREC101 dtype-flow signature drifted from golden
 ======== ================================================================
 
-**Suppressions**: any SPMD finding can be waived per line with an
+The MEM/PREC families are the memory & precision pre-flight (ISSUE
+12): every engine x codec x --fused-update configuration is LOWERED
+over abstract operands (compiled, never executed) and its XLA memory
+analysis / dtype dataflow checked against the engine's declared
+``memory_model()`` and the committed ``golden/preflight_*.json``
+snapshots. The same analysis runs one-config-at-a-time with a real
+HBM budget behind ``tmpi preflight`` (tools/preflight.py). The
+``--json`` report carries per-rule-family wall seconds (``timings_s``)
+so budget regressions are attributable.
+
+**Suppressions**: any SPMD/MEM/PREC finding that carries a source
+location (SPMD*, PREC001/002/003) can be waived per line with an
 end-of-line (or immediately preceding) comment carrying a written
-reason::
+reason. Config-level findings have no source line to suppress at:
+MEM001 is answered with a budget, MEM002/MEM003 by fixing the engine
+(or, for MEM003, the documented ``TEMP_STATE_RATIO``), and
+MEM101/PREC101 by ``--update-golden`` after review::
 
     files = os.listdir(d)  # spmd_exempt: order-insensitive dict fill
 
@@ -59,6 +83,9 @@ RULES = {
               "(tools/check_hot_loop.py)",
     "HOT002": "host sync inside the serve micro-batch loop's per-request "
               "paths (tools/check_hot_loop.py)",
+    "HOT003": "host sync inside `tmpi profile`'s warm-step measurement "
+              "loops beyond the sanctioned blocked reads "
+              "(tools/check_hot_loop.py)",
     "CODEC001": "engine exchange bypasses the wire-codec layer "
                 "(tools/check_codec_coverage.py)",
     "SCHEMA001": "telemetry record violates its schema "
@@ -73,6 +100,20 @@ RULES = {
     "SPMD202": "host asarray aliases donated engine state",
     "SPMD301": "rank-divergent value gates cross-rank work",
     "SPMD302": "unsorted directory listing on possibly-shared storage",
+    "MEM001": "predicted peak HBM exceeds the budget "
+              "(tools/analyze/memory.py; tmpi preflight)",
+    "MEM002": "donates_state declared but the donation bytes are not "
+              "realized — state double-buffers per in-flight dispatch",
+    "MEM003": "XLA temp pool >> engine state (rematerialization smell)",
+    "MEM101": "per-leaf HBM residency drifted from golden, or the "
+              "config could not be lowered "
+              "(tmpi lint --update-golden to accept a reviewed drift)",
+    "PREC001": "fp32 island inside a low-precision model's hot path",
+    "PREC002": "long reduction accumulating in bf16",
+    "PREC003": "fused-update epilogue math below fp32",
+    "PREC101": "dtype-flow signature drifted from golden, or the "
+               "config could not be traced "
+               "(tmpi lint --update-golden to accept a reviewed drift)",
 }
 
 _EXEMPT_RE = re.compile(r"spmd_exempt:[ \t]*(\S[^\n]*)")
@@ -101,6 +142,10 @@ class LintReport:
     findings: list = field(default_factory=list)
     suppressed: list = field(default_factory=list)
     notes: list = field(default_factory=list)
+    # per-rule-family wall seconds (hot_loop, codec, schema, spmd,
+    # memory, precision) — budget regressions are attributable to the
+    # family that grew (tests/test_lint_all.py enforces the total)
+    timings_s: dict = field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -116,6 +161,8 @@ class LintReport:
             "findings": [f.as_json() for f in self.findings],
             "suppressed": [f.as_json() for f in self.suppressed],
             "notes": list(self.notes),
+            "timings_s": {k: round(v, 3)
+                          for k, v in self.timings_s.items()},
             "rules": RULES,
         }
 
@@ -147,8 +194,11 @@ def _exemption_reason(path: str, line: int) -> Optional[str]:
 def _add(report: LintReport, rule: str, path: str, line: int,
          message: str, suppressible: bool = True) -> None:
     f = LintFinding(rule=rule, path=path, line=line, message=message)
+    # the analyzer families (SPMD + the MEM/PREC pre-flight) share the
+    # per-line written-reason suppression; HOT/CODEC/SCHEMA keep their
+    # own exemption mechanics
     reason = _exemption_reason(path, line) if (
-        suppressible and rule.startswith("SPMD")) else None
+        suppressible and rule.startswith(("SPMD", "MEM", "PREC"))) else None
     if reason:
         f.suppressed = True
         f.exempt_reason = reason
@@ -172,6 +222,11 @@ def _run_hot_loop(report: LintReport) -> None:
         for err in H.check_serve_source(f.read()):
             m = _LINE_RE.search(err)
             _add(report, "HOT002", H.SERVE_PATH,
+                 int(m.group(1)) if m else 0, err)
+    with open(H.PROFILE_PATH) as f:
+        for err in H.check_profile_source(f.read()):
+            m = _LINE_RE.search(err)
+            _add(report, "HOT003", H.PROFILE_PATH,
                  int(m.group(1)) if m else 0, err)
 
 
@@ -228,14 +283,44 @@ def _run_analyzer(report: LintReport, update_golden: bool) -> None:
         _add(report, f.rule, f.path, f.line, f.message)
 
 
+def _run_memory(report: LintReport, update_golden: bool) -> None:
+    _ensure_virtual_devices()
+    from theanompi_tpu.tools.analyze.memory import analyze_memory
+
+    for f in analyze_memory(update_golden=update_golden):
+        _add(report, f.rule, f.path, f.line, f.message)
+
+
+def _run_precision(report: LintReport, update_golden: bool) -> None:
+    _ensure_virtual_devices()
+    from theanompi_tpu.tools.analyze.precision import analyze_precision
+
+    for f in analyze_precision(update_golden=update_golden):
+        _add(report, f.rule, f.path, f.line, f.message)
+
+
+def _timed(report: LintReport, family: str, fn, *args) -> None:
+    import time
+
+    t0 = time.monotonic()
+    fn(report, *args)
+    report.timings_s[family] = (report.timings_s.get(family, 0.0)
+                                + time.monotonic() - t0)
+
+
 def run_lint(paths: Optional[list] = None, update_golden: bool = False,
              analyze: bool = True) -> LintReport:
     report = LintReport()
-    _run_hot_loop(report)
-    _run_codec_coverage(report)
-    _run_schema(report, paths)
+    _timed(report, "hot_loop", _run_hot_loop)
+    _timed(report, "codec_coverage", _run_codec_coverage)
+    _timed(report, "schema", _run_schema, paths)
     if analyze:
-        _run_analyzer(report, update_golden)
+        _timed(report, "spmd", _run_analyzer, update_golden)
+        # the preflight families lower+compile the engine matrix (the
+        # only lint step that compiles); their share of the <90 s CPU
+        # budget is attributable via timings_s
+        _timed(report, "memory", _run_memory, update_golden)
+        _timed(report, "precision", _run_precision, update_golden)
     return report
 
 

@@ -2,7 +2,12 @@
 
 ISSUE 7 folded the three classic lints (hot-loop, codec coverage,
 telemetry schemas) together with the SPMD safety analyzer behind the
-``tmpi lint`` subcommand; this module stays as a thin alias so
+``tmpi lint`` subcommand; ISSUE 12 added the memory & precision
+pre-flight families (MEM*/PREC*, tools/analyze/memory.py /
+precision.py — the one step that lowers+compiles), so the full alias
+pass now runs those too, under the <90 s CPU budget
+tests/test_lint_all.py enforces (per-family wall time rides the
+``--json`` report's ``timings_s``). This module stays a thin alias so
 existing CI invocations keep working::
 
     python -m theanompi_tpu.tools.lint_all              # repo tree

@@ -14,8 +14,16 @@ moved beyond its tolerance band:
 - ``host_blocked_frac`` — the dispatch pipeline's host tax;
 - ``compression_ratio`` — the codec layer's claimed wire win;
 - ``hbm_gbps`` — achieved HBM bandwidth;
+- ``preflight_peak_bytes`` — the memory pre-flight's predicted peak
+  HBM (a ``tmpi preflight`` ``kind=preflight`` record, the
+  ``tmpi_preflight_peak_bytes`` gauge, or a profile report's
+  ``memory`` block) — the memory trajectory gated like MFU;
 - per-file: a profile report's attribution fractions must sum to
   1.0 +/- the fraction tolerance (the decomposition's own invariant).
+
+A baseline metric valued EXACTLY 0.0 is still a carried metric —
+presence is decided by key, never truthiness — and is compared
+absolutely within :data:`ZERO_BASELINE_ABS_TOL` (no ratio exists).
 
 Only metrics present in BOTH files are diffed (a bench result and a
 profile report share mfu/host_blocked_frac; schema drift that removes
@@ -45,10 +53,16 @@ from typing import Optional
 DEFAULT_REL_TOL = 0.25
 # |sum(fractions) - 1| bound per profile report (absolute)
 FRACTION_SUM_TOL = 0.02
+# a baseline metric whose value is EXACTLY 0.0 (a fast host rounds
+# host_blocked_frac to zero) has no ratio to diff — the current value
+# is compared absolutely against this band instead. Presence in the
+# baseline is decided by KEY, never by truthiness: a 0.0 baseline is a
+# carried metric, not a vanished one.
+ZERO_BASELINE_ABS_TOL = 0.02
 
 # the ratio invariants the gate understands, in report order
 GATE_METRICS = ("mfu", "host_blocked_frac", "compression_ratio",
-                "hbm_gbps")
+                "hbm_gbps", "preflight_peak_bytes")
 
 
 def _num(v) -> Optional[float]:
@@ -90,6 +104,11 @@ def extract_invariants(obj: dict) -> dict:
             if best is not None:
                 out[key] = best[1]
         return out
+    if obj.get("kind") == "preflight":
+        n = _num(obj.get("peak_bytes"))
+        if n is not None:
+            out["preflight_peak_bytes"] = n
+        return out
     # profile report / raw bench result: flat keys first, then the
     # report's nested homes
     for key in GATE_METRICS:
@@ -100,6 +119,9 @@ def extract_invariants(obj: dict) -> dict:
         if n is None and key == "hbm_gbps":
             n = _num(obj.get("throughput", {}).get("hbm_gbps")
                      if isinstance(obj.get("throughput"), dict) else None)
+        if n is None and key == "preflight_peak_bytes":
+            n = _num(obj.get("memory", {}).get("peak_bytes")
+                     if isinstance(obj.get("memory"), dict) else None)
         if n is not None:
             out[key] = n
     return out
@@ -139,16 +161,25 @@ def gate(baseline: dict, current: dict,
     for key in common:
         b, c = base_inv[key], cur_inv[key]
         if b == 0:
+            # exactly-0.0 baseline: a CARRIED metric (key presence
+            # decided above, never value truthiness) with no ratio to
+            # form — compare absolutely within ZERO_BASELINE_ABS_TOL
+            # instead of demanding exact equality
             delta = abs(c)
-            ok = c == 0
+            tol = ZERO_BASELINE_ABS_TOL
+            ok = delta <= tol
         else:
             delta = abs(c - b) / abs(b)
-            ok = delta <= rel_tol
+            tol = rel_tol
+            ok = delta <= tol
         checks.append({
             "metric": key, "baseline": b, "current": c,
-            "rel_delta": round(delta, 6), "tolerance": rel_tol, "ok": ok,
+            "rel_delta": round(delta, 6), "tolerance": tol, "ok": ok,
         })
-    # schema-drift guard: a metric the baseline carried must not vanish
+    # schema-drift guard: a metric the baseline carried must not vanish.
+    # Membership is KEY presence in the extracted map — a 0.0-valued
+    # baseline metric is carried, not vanished (regression-tested with
+    # a 0.0 host_blocked_frac baseline)
     for key in base_inv:
         if key not in cur_inv:
             errors.append(

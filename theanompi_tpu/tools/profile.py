@@ -86,6 +86,24 @@ def _build_engine(engine_name: str, model, mesh, codec: Optional[str],
     raise ValueError(f"unknown engine {engine_name!r}; known: {ENGINES}")
 
 
+def resolve_model_and_batch(model_cls, engine_name: str, n_dev: int,
+                            batch: Optional[int]):
+    """``(model, global_batch)`` under the worker driver's batch
+    semantics: per-worker rules (easgd/gosgd) train ``batch`` PER
+    device (global = n x batch), everything else shards one global
+    batch rounded up to the mesh. Shared with ``tmpi preflight`` so
+    the two tools always configure the SAME program for the same
+    flags (the perf gate compares their outputs)."""
+    recipe = model_cls.default_recipe()
+    base = int(batch or recipe.batch_size)
+    if engine_name in ("easgd", "gosgd"):
+        global_batch = base * n_dev
+    else:
+        base = -(-base // n_dev) * n_dev  # shard evenly on any mesh
+        global_batch = base
+    return model_cls(recipe.replace(batch_size=base)), global_batch
+
+
 def _trace_parts(engine, engine_name: str, state, model,
                  global_batch: int) -> list:
     """``(fn, abstract_args, weight)`` per traced program — the inputs
@@ -155,17 +173,8 @@ def run_profile(
     mesh = make_mesh(devices or None)
     n_dev = mesh.devices.size
     model_cls, _ = zoo_entry(model_name)
-    recipe = model_cls.default_recipe()
-    per_worker = engine_name in ("easgd", "gosgd")
-    base = int(batch or recipe.batch_size)
-    if per_worker:
-        # per-worker batch semantics (worker driver parity): every
-        # device trains its own full batch; the global batch is n x base
-        global_batch = base * n_dev
-    else:
-        base = -(-base // n_dev) * n_dev  # shard evenly on any mesh
-        global_batch = base
-    model = model_cls(recipe.replace(batch_size=base))
+    model, global_batch = resolve_model_and_batch(
+        model_cls, engine_name, n_dev, batch)
     engine = _build_engine(engine_name, model, mesh,
                            codec if codec_obj.active else None, avg_freq,
                            fused_update=fused_update,
@@ -247,11 +256,53 @@ def run_profile(
         print(f"[profile] cost model unavailable: {e!r}", file=sys.stderr)
     traffic = engine.traffic_model(state)
 
-    # traffic cross-check: traced jaxpr collective bytes vs the
-    # declared model, under the SPMD101 tolerance (live configuration)
+    # one abstract trace of the engine's programs serves BOTH the
+    # memory block and the traffic cross-check below — the two
+    # analyses must see the same programs
     try:
         parts = _trace_parts(engine, engine_name, state, model,
                              global_batch)
+    except Exception as e:  # noqa: BLE001
+        parts = None
+        parts_error = f"{type(e).__name__}: {e}"
+
+    # static memory block (memory pre-flight, ISSUE 12): XLA
+    # memory_analysis of the SAME step lowered over abstract operands +
+    # the engine's declared per-leaf residency — `tmpi profile` reports
+    # where the bytes live next to where the time goes
+    mem_block = None
+    try:
+        if parts is None:
+            raise RuntimeError(parts_error)
+        from theanompi_tpu.tools.analyze.memory import analyze_step_memory
+        from theanompi_tpu.utils.flops import hbm_capacity_bytes
+
+        mfn, margs, _ = parts[0]
+        cap = hbm_capacity_bytes()
+        mrep = analyze_step_memory(
+            mfn, margs, engine.memory_model(margs[0]),
+            bool(getattr(engine, "donates_state", False)),
+            engine=engine_name, codec=traffic.codec,
+            fused=fused_update, budget_bytes=cap,
+            budget_source="device-table" if cap else "",
+        )
+        mem_block = {
+            "peak_bytes": mrep.peak_bytes,
+            "state_bytes_per_device": mrep.donated_expected_bytes,
+            "donation_shortfall": mrep.donation_shortfall,
+            "xla": mrep.xla.as_json(),
+            "budget_bytes": mrep.budget_bytes,
+            "fit": mrep.fit,
+        }
+    except Exception as e:  # noqa: BLE001 — report degrades, not dies
+        print(f"[profile] memory analysis unavailable: {e!r}",
+              file=sys.stderr)
+
+    # traffic cross-check: traced jaxpr collective bytes vs the
+    # declared model, under the SPMD101 tolerance (live configuration)
+    try:
+        if parts is None:
+            raise RuntimeError(parts_error)
         if codec_obj.active:
             traced = traced_wire_bytes(
                 parts, codec_bytes=codec_obj.wire_bytes_per_element
@@ -344,6 +395,8 @@ def run_profile(
             "detail": attr.detail,
         },
     }
+    if mem_block is not None:
+        report["memory"] = mem_block
     if ops is not None:
         report["ops"] = ops
     os.makedirs(out_dir, exist_ok=True)
@@ -376,6 +429,15 @@ def format_report(report: dict) -> str:
         lines.append(
             f"    {k:>8}: {a['fractions'][k] * 100:6.2f}%  "
             f"({a['seconds'][k] * 1e3:8.3f} ms)"
+        )
+    if report.get("memory"):
+        m = report["memory"]
+        fit = ("" if m["fit"] is None else
+               ("  ->  FITS" if m["fit"] else "  ->  OVER BUDGET"))
+        lines.append(
+            f"  memory: predicted peak {m['peak_bytes'] / 1e6:.1f} MB"
+            f"/device (state {m['state_bytes_per_device'] / 1e6:.1f} MB, "
+            f"temp {m['xla']['temp_bytes'] / 1e6:.1f} MB)" + fit
         )
     cc = t["crosscheck"]
     if "error" in cc:

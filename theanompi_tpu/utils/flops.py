@@ -56,6 +56,24 @@ _PEAK_HBM = (
     ("v2", 700e9),
 )
 
+# public spec-sheet HBM CAPACITY (bytes) per chip — the memory
+# pre-flight's budget ceiling (tools/analyze/memory.py, `tmpi
+# preflight`); same substring-match convention as the peak tables.
+# Unknown devices (CPU test meshes) report None — the pre-flight then
+# needs an explicit ``--budget-gb``.
+_HBM_CAPACITY = (
+    ("v5 lite", 16e9),  # v5e: 16 GB
+    ("v5litepod", 16e9),
+    ("v5e", 16e9),
+    ("v6 lite", 32e9),  # v6e / Trillium: 32 GB
+    ("v6e", 32e9),
+    ("v5p", 95e9),
+    ("v5", 95e9),
+    ("v4", 32e9),
+    ("v3", 32e9),
+    ("v2", 16e9),
+)
+
 
 def _match_table(table, device) -> Optional[float]:
     import jax
@@ -78,6 +96,13 @@ def peak_flops(device=None) -> Optional[float]:
 def peak_hbm_bytes_per_sec(device=None) -> Optional[float]:
     """Per-chip peak HBM bytes/s (spec sheet); None when unknown."""
     return _match_table(_PEAK_HBM, device)
+
+
+def hbm_capacity_bytes(device=None) -> Optional[float]:
+    """Per-chip HBM capacity in bytes (spec sheet); None when unknown
+    (e.g. CPU test meshes — the memory pre-flight then requires an
+    explicit ``--budget-gb``)."""
+    return _match_table(_HBM_CAPACITY, device)
 
 
 @dataclass
@@ -204,3 +229,123 @@ def mfu(flops_per_sec: Optional[float], device=None,
     if not peak or not flops_per_sec:
         return None
     return flops_per_sec / peak
+
+
+# --------------------------------------------------------------------------
+# per-leaf state HBM residency — the `memory_model()` engine hook
+# (mirrors obs/comm.py's `traffic_model()`: an ANALYTIC declaration the
+# static analyzer cross-checks against the lowered program;
+# tools/analyze/memory.py, `tmpi preflight`)
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class MemoryLeaf:
+    """One engine-state leaf's HBM residency: the global logical array
+    and the slice of it each device actually holds (``global_bytes /
+    shard_factor``, the mesh extent over the leaf's sharded axes)."""
+
+    path: str  # jax.tree_util.keystr of the leaf (".params['h']['w']")
+    dtype: str
+    shape: tuple  # global logical shape
+    global_bytes: int
+    shard_factor: int  # mesh extent the leaf is divided over (>= 1)
+
+    @property
+    def per_device_bytes(self) -> int:
+        return -(-self.global_bytes // max(1, self.shard_factor))
+
+    @property
+    def category(self) -> str:
+        """Top-level state field the leaf lives under (params,
+        opt_state, workers, ef, ...)."""
+        return self.path.lstrip(".").split("[")[0].split(".")[0]
+
+    def as_json(self) -> dict:
+        return {"path": self.path, "dtype": self.dtype,
+                "shape": list(self.shape),
+                "global_bytes": int(self.global_bytes),
+                "per_device_bytes": int(self.per_device_bytes),
+                "shard_factor": int(self.shard_factor)}
+
+
+@dataclass
+class MemoryModel:
+    """An engine's declared per-leaf state residency on ONE device —
+    what the persistent training state costs in HBM before any
+    activations/temps (XLA's `memory_analysis()` adds those;
+    tools/analyze/memory.py reconciles the two)."""
+
+    rule: str
+    n_devices: int
+    leaves: list  # list[MemoryLeaf]
+    detail: dict = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.detail is None:
+            self.detail = {}
+
+    @property
+    def state_bytes_per_device(self) -> int:
+        return sum(l.per_device_bytes for l in self.leaves)
+
+    @property
+    def state_bytes_global(self) -> int:
+        return sum(l.global_bytes for l in self.leaves)
+
+    def category_bytes_per_device(self) -> dict:
+        out: dict = {}
+        for l in self.leaves:
+            out[l.category] = out.get(l.category, 0) + l.per_device_bytes
+        return out
+
+    def params_bytes_per_device(self) -> int:
+        """Bytes of the parameter leaves proper on one device (the
+        MEM003 rematerialization-smell denominator). Worker-stacked
+        engines keep their replicas under ``.workers`` — those count
+        too (each device's slice of the stack IS its params)."""
+        total = 0
+        for l in self.leaves:
+            if l.category in ("params", "workers", "center_params"):
+                total += l.per_device_bytes
+        return total
+
+    def top_leaves(self, k: int = 10) -> list:
+        return sorted(self.leaves, key=lambda l: -l.per_device_bytes)[:k]
+
+    def as_json(self) -> dict:
+        return {"rule": self.rule, "n_devices": int(self.n_devices),
+                "state_bytes_per_device": int(self.state_bytes_per_device),
+                "leaves": [l.as_json() for l in self.leaves],
+                "detail": dict(self.detail)}
+
+
+def state_memory_model(state, rule: str, n_devices: int, shard_factor,
+                       detail: Optional[dict] = None) -> MemoryModel:
+    """Build a :class:`MemoryModel` from a (possibly abstract) engine
+    state pytree. ``shard_factor(path_str, leaf) -> int`` is the
+    engine's own per-leaf sharding knowledge — the mesh extent the
+    leaf's global shape is divided over (1 = replicated). Works on
+    ``jax.eval_shape`` structs: only ``.shape``/``.dtype`` are read."""
+    import jax
+
+    leaves = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = getattr(leaf, "dtype", None)
+        if dtype is None:
+            continue
+        import numpy as _np
+
+        n_elems = 1
+        for d in shape:
+            n_elems *= int(d)
+        nbytes = int(n_elems * _np.dtype(dtype).itemsize)
+        pstr = jax.tree_util.keystr(path)
+        leaves.append(MemoryLeaf(
+            path=pstr, dtype=str(dtype), shape=shape,
+            global_bytes=nbytes,
+            shard_factor=max(1, int(shard_factor(pstr, leaf))),
+        ))
+    return MemoryModel(rule=rule, n_devices=int(n_devices), leaves=leaves,
+                       detail=dict(detail or {}))
