@@ -66,6 +66,25 @@ def test_valid_records_pass():
          "per_replica_batch": 16},
         {"kind": "reshard", "rank": 0, "t": 1.0, "step": 2,
          "from_world": 2, "to_world": 4, "seconds": 0.2},
+        # chaos PR: retry cause labels, failed reloads, the scrubber,
+        # and the campaign runner's own record kind
+        {"kind": "retry", "rank": 0, "t": 1.0, "attempt": 1, "step": 4,
+         "error": "OSError(28, 'enospc')", "backoff_s": 0.31,
+         "cause": "storage"},
+        {"kind": "reload", "t": 1.0, "from_step": 4, "to_step": -1,
+         "ok": False, "error": "FileNotFoundError('pruned')"},
+        {"kind": "scrub", "rank": 0, "t": 1.0, "checked": 3,
+         "corrupt": 1, "quarantined": "ckpt_6.npz", "seconds": 0.02},
+        {"kind": "scrub", "rank": 0, "t": 1.0, "checked": 0,
+         "corrupt": 0, "quarantined": "", "seconds": 0.0},
+        {"kind": "chaos", "t": 1.0, "seed": 7, "config": "zero1_int8ef",
+         "schedule": "bitrot@3+sigkill@5", "ok": True, "violations": "",
+         "runs": 2, "seconds": 4.2},
+        {"kind": "chaos", "t": 1.0, "seed": 9, "config": "bsp_none",
+         "schedule": "crash@5+enospc@4", "ok": False,
+         "violations": "parity,no_refeed", "runs": 5,
+         "shrunk_schedule": "crash@5",
+         "repro": "--inject-fault crash@5"},
     ]
     for rec in good:
         assert validate_record(rec) == [], rec
@@ -118,6 +137,18 @@ def test_valid_records_pass():
     ({"kind": "retry", "rank": 0, "t": 1.0, "attempt": 1, "step": 4,
       "error": "x", "backoff_s": 0.5, "world": "four"},
      "is str, want int"),
+    ({"kind": "retry", "rank": 0, "t": 1.0, "attempt": 1, "step": 4,
+      "error": "x", "backoff_s": 0.5, "cause": 3}, "is int, want str"),
+    ({"kind": "scrub", "rank": 0, "t": 1.0, "checked": 3, "corrupt": 0,
+      "seconds": 0.1}, "missing required field 'quarantined'"),
+    ({"kind": "scrub", "rank": 0, "t": 1.0, "checked": 3, "corrupt": 0,
+      "quarantined": ["a.npz"], "seconds": 0.1}, "is list, want str"),
+    ({"kind": "chaos", "t": 1.0, "seed": 1, "config": "bsp_none",
+      "schedule": "crash@2"}, "missing required field 'ok'"),
+    ({"kind": "chaos", "t": 1.0, "seed": 1, "config": "bsp_none",
+      "schedule": "crash@2", "ok": 1}, "is int, want bool"),
+    ({"kind": "reload", "t": 1.0, "from_step": 1, "to_step": -1,
+      "ok": "no"}, "is str, want bool"),
 ])
 def test_invalid_records_flagged(rec, frag):
     errs = validate_record(rec)

@@ -174,3 +174,81 @@ def test_topology_fault_fires_inside_fused_group_range():
     inj.check_step(1, 4)
     with pytest.raises(TopologyChanged):
         inj.check_step(5, 8)
+
+
+# -- storage-level kinds + the fired-fault ledger (chaos PR) ---------------
+
+
+def test_parse_storage_kinds():
+    assert parse_fault_spec("enospc@3").kind == "enospc"
+    assert parse_fault_spec("slow_write@2:0.5").arg == 0.5
+    assert parse_fault_spec("bitrot@4").kind == "bitrot"
+    assert parse_fault_spec("partial_set@2").kind == "partial_set"
+
+
+def test_write_fault_fires_once_at_or_after_step():
+    inj = FaultInjector(["enospc@3"])
+    assert inj.write_fault(2) is None           # save before the step
+    assert inj.write_fault(4) == ("enospc", None)  # first save at/after
+    assert inj.write_fault(5) is None           # fired: once only
+
+
+def test_storage_mutations_due_fires_each_once():
+    inj = FaultInjector(["bitrot@2", "ckpt_truncate@2", "partial_set@5"])
+    due = inj.storage_mutations_due(3)
+    assert sorted(s.kind for s in due) == ["bitrot", "ckpt_truncate"]
+    assert inj.storage_mutations_due(3) == []   # both fired
+    assert [s.kind for s in inj.storage_mutations_due(6)] == ["partial_set"]
+
+
+def test_bitrot_and_partial_set_mutators(tmp_path):
+    """bitrot flips bytes the CRC chain must catch; partial_set makes
+    the sharded set read as absent (completeness-by-counting)."""
+    import jax
+    from theanompi_tpu.utils.checkpoint import (
+        latest_checkpoint,
+        save_checkpoint,
+        save_checkpoint_sharded,
+        verify_checkpoint,
+    )
+
+    state = {"w": jnp.arange(64, dtype=jnp.float32)}
+    single = tmp_path / "single"
+    save_checkpoint(str(single), state, 3, rng=jax.random.PRNGKey(0))
+    assert verify_checkpoint(latest_checkpoint(str(single)))
+    mangled = FaultInjector.bitrot_newest(str(single))
+    assert mangled.endswith("ckpt_3.npz")
+    assert not verify_checkpoint(mangled)       # size intact, CRC not
+    import os as _os
+
+    assert _os.path.getsize(mangled) > 0
+
+    sharded = tmp_path / "sharded"
+    save_checkpoint_sharded(str(sharded), state, 3,
+                            rng=jax.random.PRNGKey(0))
+    assert latest_checkpoint(str(sharded)) is not None
+    removed = FaultInjector.drop_sharded_member(str(sharded))
+    assert removed is not None
+    assert latest_checkpoint(str(sharded)) is None  # incomplete = absent
+
+
+def test_fault_ledger_survives_process_boundary(tmp_path):
+    """The cross-process once-only contract: fired specs land in the
+    ledger BEFORE their side effect, and a fresh injector armed with
+    the same specs + ledger treats them as already fired — duplicates
+    consume ledger entries positionally."""
+    ledger = str(tmp_path / "ledger.txt")
+    inj = FaultInjector(["crash@3", "crash@5", "enospc@2"], ledger=ledger)
+    with pytest.raises(InjectedCrash):
+        inj.check_step(3)
+    assert inj.write_fault(2) == ("enospc", None)
+    assert open(ledger).read().splitlines() == ["crash@3", "enospc@2"]
+
+    # the "relaunched process": same specs, same ledger
+    inj2 = FaultInjector(["crash@3", "crash@5", "enospc@2"], ledger=ledger)
+    inj2.check_step(3)                      # already fired: no raise
+    assert inj2.write_fault(4) is None      # enospc consumed too
+    with pytest.raises(InjectedCrash):
+        inj2.check_step(5)                  # the unfired spec still fires
+    assert open(ledger).read().splitlines() == [
+        "crash@3", "enospc@2", "crash@5"]

@@ -171,3 +171,54 @@ def test_load_for_serving_roundtrip(tmp_path):
     for a, b in zip(jax.tree_util.tree_leaves(params),
                     jax.tree_util.tree_leaves(state.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_toctou_pruned_checkpoint_keeps_serving_and_records(serving,
+                                                            monkeypatch):
+    """REGRESSION (chaos PR satellite): a checkpoint pruned between
+    newer_verified_checkpoint() and the load — the discovery/load
+    TOCTOU — must not surface as a serving failure: the engine keeps
+    its current params, a failed-reload record (ok=false) lands in
+    serve.jsonl with the failure counted, and the NEXT poll recovers
+    with a good checkpoint."""
+    import theanompi_tpu.serve.reload as reload_mod
+
+    model, state, engine, ckpt_dir = serving
+    reloader = CheckpointReloader(engine, str(ckpt_dir))
+    save_step(ckpt_dir, state, 3)
+
+    real = reload_mod.load_for_serving
+    raced = {"n": 0}
+
+    def prune_race(path, mdl):
+        # the training run's keep-chain deletes the file right after
+        # discovery verified it
+        raced["n"] += 1
+        raise FileNotFoundError(f"{path} pruned underneath the reloader")
+
+    monkeypatch.setattr(reload_mod, "load_for_serving", prune_race)
+    assert reloader.poll_once() is None
+    assert raced["n"] == 1
+    assert engine.params_step == 1          # still serving the old step
+    x = np.random.RandomState(1).randn(8, 8, 3)
+    assert engine.infer(x, timeout=30.0).step == 1
+
+    monkeypatch.setattr(reload_mod, "load_for_serving", real)
+    assert reloader.poll_once() == 3        # next poll simply retries
+    assert engine.infer(x, timeout=30.0).step == 3
+    assert engine.stats()["tmpi_serve_reload_failures_total"] == 1.0
+    assert engine.stats()["tmpi_serve_reloads_total"] == 1.0
+
+    engine.drain(timeout=10.0)
+    from theanompi_tpu.tools.check_obs_schema import check_file
+
+    path = ckpt_dir / "obs" / "serve.jsonl"
+    assert check_file(str(path)) == []
+    recs = [json.loads(l) for l in path.read_text().splitlines()]
+    reloads = [r for r in recs if r["kind"] == "reload"]
+    failed = [r for r in reloads if r.get("ok") is False]
+    assert len(failed) == 1
+    assert failed[0]["from_step"] == 1 and failed[0]["to_step"] == -1
+    assert "pruned underneath" in failed[0]["error"]
+    applied = [r for r in reloads if "ok" not in r]
+    assert applied and applied[-1]["to_step"] == 3

@@ -328,3 +328,127 @@ def test_loader_stall_fault_trips_watchdog(tmp_path):
     assert report.exists()
     rec = json.loads(report.read_text())
     assert rec["kind"] == "stall" and rec["stall_s"] >= 0.4
+
+
+# -- chaos-PR satellites: jitter, cause labels, ENOSPC sharded walk-back ---
+
+
+def test_retry_jitter_deterministic_and_recorded(tmp_path):
+    """--retry-jitter: the decorrelated-jitter backoff actually slept
+    is recorded in the retry record, stays within [base, cap], and is
+    DETERMINISTIC under the run's seed — two identical supervised runs
+    draw the identical schedule (reproducibility), while a different
+    seed de-phases (the anti-stampede property)."""
+
+    def jittered_backoffs(root, seed):
+        supervise_training(
+            ckpt_dir=str(root / "ck"), obs_dir=str(root / "obs"),
+            max_retries=2, backoff_base=0.01, retry_jitter=True,
+            inject_faults=["crash@2", "crash@3"], seed=seed, **{
+                k: v for k, v in _TINY.items() if k != "seed"},
+        )
+        recs = [json.loads(l) for l in
+                (root / "obs" / "supervisor.jsonl").read_text().splitlines()]
+        return [r["backoff_s"] for r in recs if r["kind"] == "retry"]
+
+    a = jittered_backoffs(tmp_path / "a", seed=0)
+    b = jittered_backoffs(tmp_path / "b", seed=0)
+    c = jittered_backoffs(tmp_path / "c", seed=1)
+    assert len(a) == 2
+    assert a == b                     # seeded: reproducible schedule
+    assert a != c                     # distinct seeds de-phase
+    assert all(0.01 <= x <= 60.0 for x in a)
+
+
+def test_retry_cause_classification_and_labels(tmp_path):
+    """Retry records carry a cause label derived from the exception,
+    and the final snapshot exports per-cause tmpi_retries_total series
+    — crash for worker exceptions, storage for OSErrors (an injected
+    ENOSPC on a SYNC save kills the attempt with the real OSError)."""
+    sup = supervise_training(
+        ckpt_dir=str(tmp_path / "ck"), obs_dir=str(tmp_path / "obs"),
+        max_retries=3, backoff_base=0.0, async_checkpoint=False,
+        inject_faults=["enospc@2", "crash@3"], **_TINY,
+    )
+    assert sup["steps"] == 4
+    assert sup["retry_causes"] == {"storage": 1, "crash": 1}
+    recs = [json.loads(l) for l in
+            (tmp_path / "obs" / "supervisor.jsonl").read_text().splitlines()]
+    causes = [r["cause"] for r in recs if r["kind"] == "retry"]
+    assert causes == ["storage", "crash"]
+    from theanompi_tpu.tools.check_obs_schema import check_file
+
+    assert check_file(str(tmp_path / "obs" / "supervisor.jsonl")) == []
+    snaps = [json.loads(l) for l in
+             (tmp_path / "obs" / "metrics.jsonl").read_text().splitlines()
+             if json.loads(l).get("source") == "supervisor"]
+    m = snaps[-1]["metrics"]
+    assert m["tmpi_retries_total"] == 2.0
+    assert m['tmpi_retries_total{cause="storage"}'] == 1.0
+    assert m['tmpi_retries_total{cause="crash"}'] == 1.0
+
+
+def test_classify_retry_cause_mapping():
+    from theanompi_tpu.launch.supervisor import classify_retry_cause
+    from theanompi_tpu.obs.numerics import NumericsAnomaly
+    from theanompi_tpu.utils.faults import InjectedCrash, TopologyChanged
+
+    assert classify_retry_cause(Preempted(3)) == "preempt"
+    assert classify_retry_cause(TopologyChanged("shrink", 2, 2)) == "topology"
+    assert classify_retry_cause(OSError(28, "enospc")) == "storage"
+    assert classify_retry_cause(NumericsAnomaly("x")) == "anomaly"
+    assert classify_retry_cause(InjectedCrash("x")) == "crash"
+    assert classify_retry_cause(RuntimeError("x")) == "crash"
+
+
+def test_enospc_async_sharded_save_supervisor_resumes_prior_step(tmp_path):
+    """Satellite acceptance: ENOSPC tears an async SHARDED save — the
+    torn set reads as absent, latest_checkpoint(verify=True) walks back
+    cleanly, and the supervised resume lands on the prior step,
+    finishing bit-identical to an uninterrupted run. 3 epochs: saves at
+    2/4/6; enospc@3 tears the step-4 set mid-write (the swallow keeps
+    the attempt alive), crash@5 kills the attempt — the retry must
+    resume from step 2."""
+    tiny3 = {**{k: v for k, v in _TINY.items() if k != "n_epochs"},
+             "n_epochs": 3}
+    clean = run_training(ckpt_dir=str(tmp_path / "clean"),
+                         sharded_ckpt=True, **tiny3)
+    sup = supervise_training(
+        ckpt_dir=str(tmp_path / "sup"), obs_dir=str(tmp_path / "obs"),
+        max_retries=2, backoff_base=0.0, sharded_ckpt=True,
+        inject_faults=["enospc@3", "crash@5"], **tiny3,
+    )
+    assert sup["retries"] == 1
+    assert sup["steps"] == clean["steps"] == 6
+    # the torn step-4 set never landed: nothing between 2 and 6
+    recs = [json.loads(l) for l in
+            (tmp_path / "obs" / "supervisor.jsonl").read_text().splitlines()]
+    retry = [r for r in recs if r["kind"] == "retry"]
+    assert retry[0]["step"] == 2 and retry[0]["cause"] == "crash"
+    _assert_bit_identical(str(tmp_path / "clean"), str(tmp_path / "sup"))
+    # no torn spill files either
+    assert not [f for f in os.listdir(tmp_path / "sup")
+                if f.endswith(".tmp")]
+
+
+def test_worker_scrub_interval_quarantines_in_background(tmp_path):
+    """--scrub-interval: the background scrubber quarantines a corrupt
+    member DURING training and its kind=scrub record lands in
+    metrics.jsonl."""
+    from theanompi_tpu.utils.checkpoint import save_checkpoint
+
+    ck = tmp_path / "ck"
+    # pre-seed the dir with a corrupt old checkpoint the run inherits
+    p = save_checkpoint(str(ck), {"w": np.zeros(4, np.float32)}, 1)
+    open(p, "r+b").truncate(os.path.getsize(p) // 2)
+    out = run_training(ckpt_dir=str(ck), obs_dir=str(tmp_path / "obs"),
+                       scrub_interval=0.1, **_TINY)
+    assert out["steps"] == 4
+    assert (ck / "quarantine" / "ckpt_1.npz").exists()
+    mrecs = [json.loads(l) for l in
+             (tmp_path / "obs" / "metrics.jsonl").read_text().splitlines()]
+    scrubs = [r for r in mrecs if r.get("kind") == "scrub"]
+    assert scrubs and any("ckpt_1.npz" in r["quarantined"] for r in scrubs)
+    from theanompi_tpu.tools.check_obs_schema import check_file
+
+    assert check_file(str(tmp_path / "obs" / "metrics.jsonl")) == []

@@ -40,6 +40,16 @@ budget::
 
     tmpi preflight --model mlp --engine bsp --budget-gb 16
     tmpi preflight --model transformer_lm --engine nd --mesh 2x4
+
+``tmpi chaos`` is the chaos campaign runner (tools/chaos.py): fuzzed
+fault schedules over the full matrix (process, data AND storage
+faults), each run under the supervisor and checked against a recovery
+invariant oracle; failing schedules are shrunk to a minimal
+``--inject-fault`` repro::
+
+    tmpi chaos --seeds 25               # full matrix, all configs
+    tmpi chaos --smoke --seeds 5        # tier-1 CPU smoke
+    tmpi chaos --schedule 'crash@5+bitrot@3'
 """
 
 from __future__ import annotations
@@ -246,6 +256,32 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--retry-backoff", type=float, default=1.0,
                    help="supervisor backoff base in seconds: retry k "
                         "sleeps base * 2**(k-1), capped at 60s")
+    p.add_argument("--retry-jitter", action="store_true",
+                   help="decorrelated-jitter retry backoff instead of "
+                        "the plain exponential ladder (sleep_k = "
+                        "uniform(base, 3*sleep_{k-1}), capped): the "
+                        "ladder is identical across controllers, so a "
+                        "pod-wide fault retries as a synchronized "
+                        "stampede — jitter de-phases the fleet; "
+                        "deterministic under --seed, and the value "
+                        "actually slept is recorded in the retry "
+                        "JSONL record")
+    p.add_argument("--scrub-interval", type=float, default=0.0,
+                   help="background checkpoint scrubber: re-verify the "
+                        "keep-chain every N seconds and quarantine "
+                        "corrupt members (bit-rot, torn writes) into "
+                        "<ckpt-dir>/quarantine/ so resume discovery "
+                        "never re-pays a walk past a known-bad file "
+                        "(kind=scrub records + tmpi_scrub_* gauges; "
+                        "0 = off — the supervisor still scrubs once "
+                        "before each retry)")
+    p.add_argument("--fault-ledger", default=None, metavar="PATH",
+                   help="fired-fault ledger file for --inject-fault: "
+                        "fired specs are appended (fsynced BEFORE the "
+                        "fault's side effect) and specs already in the "
+                        "ledger arm as fired — once-only fault "
+                        "semantics ACROSS process relaunches (the "
+                        "chaos runner's sandbox relies on it)")
     p.add_argument("--elastic", action="store_true",
                    help="elastic world size (launch/supervisor.py + "
                         "utils/checkpoint.load_resharded): with "
@@ -355,6 +391,13 @@ def main(argv=None) -> int:
         from theanompi_tpu.tools.preflight import preflight_main
 
         return preflight_main(argv[1:])
+    if argv[:1] == ["chaos"]:
+        # chaos campaign runner (tools/chaos.py): fuzzed fault
+        # schedules + invariant oracle + shrinker; sets up its own
+        # multi-device virtual CPU platform like `tmpi lint`
+        from theanompi_tpu.tools.chaos import chaos_main
+
+        return chaos_main(argv[1:])
     if argv[:1] == ["serve"]:
         # inference subcommand: its own parser + driver (serve/cli.py);
         # dispatched before the training parser, whose first positional
@@ -457,6 +500,9 @@ def main(argv=None) -> int:
               "--obs-dir; observability is off", flush=True)
     # (--numerics-freq without --obs-dir warns inside run_training,
     # which covers API callers too)
+    if args.scrub_interval and not args.ckpt_dir:
+        print("WARNING: --scrub-interval needs --ckpt-dir; the "
+              "checkpoint scrubber is off", flush=True)
     if args.on_anomaly == "rollback" and not args.ckpt_dir:
         raise SystemExit("--on-anomaly rollback requires --ckpt-dir "
                          "(the rollback restores a checkpoint)")
@@ -484,6 +530,7 @@ def main(argv=None) -> int:
             return supervise_training(
                 max_retries=args.max_retries,
                 backoff_base=args.retry_backoff,
+                retry_jitter=args.retry_jitter,
                 **kw,
             )
         # elastic binds to the SUPERVISOR's kwarg (it re-probes the
@@ -492,6 +539,16 @@ def main(argv=None) -> int:
         # run_training for the one-shot reshard-resume case
     else:
         _run = run_training
+
+    inject_faults = args.inject_fault or None
+    if inject_faults is not None and args.fault_ledger:
+        # ledger-armed injector: once-only semantics survive process
+        # relaunches (utils/faults.py module docstring) — the chaos
+        # sandbox's resume launches pass the same ledger
+        from theanompi_tpu.utils.faults import FaultInjector
+
+        inject_faults = FaultInjector(inject_faults,
+                                      ledger=args.fault_ledger)
 
     try:
         summary = _run(
@@ -538,7 +595,8 @@ def main(argv=None) -> int:
             rollback_budget=args.rollback_budget,
             rollback_skip=args.rollback_skip,
             sigterm_grace=args.sigterm_grace,
-            inject_faults=args.inject_fault or None,
+            inject_faults=inject_faults,
+            scrub_interval=args.scrub_interval,
             elastic=args.elastic,
             elastic_lr_scale=args.elastic_lr_scale,
             **rule_kwargs,

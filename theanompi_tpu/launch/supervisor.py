@@ -11,7 +11,29 @@ contract a production run needs:
   an ordinary exception is retried up to ``max_retries`` times, sleeping
   ``backoff_base * 2**(failures-1)`` (capped at ``backoff_max``) between
   attempts — a crash-looping run must not hammer shared storage or the
-  scheduler.
+  scheduler. ``retry_jitter=True`` (``--retry-jitter``) replaces the
+  deterministic ladder with seeded DECORRELATED jitter
+  (``sleep_k = uniform(base, 3 * sleep_{k-1})``, capped): the plain
+  ladder is identical across controllers, so a pod-wide fault retries
+  as a synchronized stampede against the same storage/scheduler that
+  just failed — jitter de-phases the fleet while the seed (the run's
+  ``seed``) keeps any ONE supervisor's schedule reproducible. The
+  value actually slept is recorded in the retry record's
+  ``backoff_s``.
+- **Retry cause classification**: every retry record (and the final
+  ``tmpi_retries_total`` snapshot) carries a ``cause`` label —
+  ``crash`` / ``preempt`` / ``topology`` / ``storage`` / ``anomaly``,
+  derived from the exception type (:func:`classify_retry_cause`) — so
+  campaign reports and dashboards can attribute instability to the
+  layer that caused it instead of lumping everything under "retried".
+- **Storage scrub before resume**: a retry's resume discovery is
+  preceded by one synchronous scrub pass
+  (``utils/checkpoint.scrub_checkpoint_dir``): corrupt keep-chain
+  members (bit-rot, torn writes) are quarantined into
+  ``<ckpt_dir>/quarantine/`` so the verified walk-back is O(1) and a
+  corrupt newest file can never be re-examined by every later
+  discovery; a ``kind=scrub`` record lands in metrics.jsonl whenever
+  the pass moved anything.
 - **Verified auto-resume**: every retry resumes from the newest
   checkpoint that passes the integrity chain
   (``latest_checkpoint(verify=True)``: per-array CRC32 manifests,
@@ -59,17 +81,47 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import time
 from typing import Any, Optional
 
-from theanompi_tpu.obs.numerics import NumericsAnomaly
+from theanompi_tpu.obs.numerics import NumericsAnomaly, RollbackRequested
 from theanompi_tpu.utils.checkpoint import (
     checkpoint_step,
     clear_resumable_marker,
     latest_checkpoint,
     read_resumable_marker,
+    scrub_checkpoint_dir,
 )
-from theanompi_tpu.utils.faults import Preempted
+from theanompi_tpu.utils.faults import Preempted, TopologyChanged
+
+# retry cause labels (classify_retry_cause): the closed vocabulary the
+# retry records, the tmpi_retries_total{cause=...} series, and the
+# chaos campaign reports share
+RETRY_CAUSES = ("crash", "preempt", "topology", "storage", "anomaly")
+
+
+def classify_retry_cause(e: BaseException) -> str:
+    """Map an attempt-killing exception to its instability layer:
+
+    - ``preempt``:  SIGTERM-grace exits (:class:`Preempted`)
+    - ``topology``: the device world changed (:class:`TopologyChanged`)
+    - ``storage``:  filesystem/OS errors (ENOSPC, vanished mounts,
+      unreadable checkpoints — any :class:`OSError`)
+    - ``anomaly``:  numerics-policy stops (:class:`NumericsAnomaly` /
+      an escaped :class:`RollbackRequested`) — recorded for the
+      exhausted-retries record even though these are never retried
+    - ``crash``:    everything else (the worker-loop default)
+    """
+    if isinstance(e, Preempted):
+        return "preempt"
+    if isinstance(e, TopologyChanged):
+        return "topology"
+    if isinstance(e, OSError):
+        return "storage"
+    if isinstance(e, (NumericsAnomaly, RollbackRequested)):
+        return "anomaly"
+    return "crash"
 
 
 class _SupervisorLog:
@@ -96,12 +148,28 @@ class _SupervisorLog:
             "attempt": int(attempt), "step": int(step),
             "error": repr(error), "backoff_s": float(backoff_s),
             "resumable": bool(resumable),
+            # instability attribution (chaos PR): which layer killed
+            # the attempt — crash/preempt/topology/storage/anomaly
+            "cause": classify_retry_cause(error),
         }
         if world is not None:
             # the attempt's world size: supervisor.jsonl alone shows
             # the topology trajectory across retries
             rec["world"] = int(world)
         self._append("supervisor.jsonl", rec)
+
+    def scrub(self, result: dict) -> None:
+        """One ``kind=scrub`` record per retry-time scrub pass that
+        quarantined anything (utils/checkpoint.scrub_checkpoint_dir),
+        appended to metrics.jsonl next to the reshard/profile records
+        — same shape the worker's background scrubber emits."""
+        self._append("metrics.jsonl", {
+            "kind": "scrub", "rank": self.rank, "t": time.time(),
+            "checked": int(result["checked"]),
+            "corrupt": int(result["corrupt"]),
+            "quarantined": ",".join(result["quarantined"]),
+            "seconds": float(result["seconds"]),
+        })
 
     def topology(self, attempt: int, world: int,
                  prev_world: Optional[int] = None) -> None:
@@ -115,10 +183,16 @@ class _SupervisorLog:
         self._append("supervisor.jsonl", rec)
 
     def snapshot(self, retries: int, preempts: int,
-                 step: Optional[int] = None) -> None:
+                 step: Optional[int] = None,
+                 causes: Optional[dict] = None) -> None:
+        metrics = {"tmpi_retries_total": float(retries),
+                   "tmpi_preempt_resumes_total": float(preempts)}
+        for cause, n in sorted((causes or {}).items()):
+            # per-cause series, Prometheus label syntax (the same key
+            # shape MetricsRegistry emits for labeled counters)
+            metrics[f'tmpi_retries_total{{cause="{cause}"}}'] = float(n)
         rec = {"kind": "metrics", "t": time.time(), "source": "supervisor",
-               "metrics": {"tmpi_retries_total": float(retries),
-                           "tmpi_preempt_resumes_total": float(preempts)}}
+               "metrics": metrics}
         if step is not None:
             rec["step"] = int(step)
         self._append("metrics.jsonl", rec)
@@ -151,6 +225,7 @@ def supervise_training(
     max_retries: int = 2,
     backoff_base: float = 1.0,
     backoff_max: float = 60.0,
+    retry_jitter: bool = False,
     ckpt_dir: Optional[str] = None,
     obs_dir: Optional[str] = None,
     resume: bool = False,
@@ -163,6 +238,11 @@ def supervise_training(
     a checkpoint to resume from silently restarts training from scratch,
     which is never what a recovery path should do quietly. All other
     kwargs forward to ``run_training`` unchanged.
+
+    ``retry_jitter=True``: decorrelated-jitter backoff instead of the
+    plain exponential ladder — seeded from the run's ``seed`` kwarg,
+    so one supervisor's sleep schedule is reproducible while a fleet
+    of supervisors with distinct seeds de-phases (module docstring).
 
     ``elastic=True``: re-probe the device world before every attempt
     (``requested`` = the caller's ``devices`` count, honored as a cap)
@@ -210,6 +290,22 @@ def supervise_training(
     preempts = 0
     attempt = 0
     world: Optional[int] = None
+    retry_causes: dict[str, int] = {}
+    # decorrelated jitter state: seeded from the run's seed MIXED with
+    # a per-host/per-controller salt (hostname + TMPI_PROCESS_ID). A
+    # fleet necessarily shares the training seed (step determinism
+    # requires it), so seeding from it alone would make every
+    # controller draw the identical backoff — the synchronized
+    # stampede the jitter exists to break. Same host + same seed is
+    # still reproducible.
+    import socket
+    import zlib as _zlib
+
+    _salt = (_zlib.crc32(socket.gethostname().encode())
+             ^ int(os.environ.get("TMPI_PROCESS_ID", 0) or 0))
+    _jitter_rng = random.Random(
+        (int(run_kwargs.get("seed", 0) or 0) << 20) ^ _salt)
+    _prev_sleep = float(backoff_base)
     if ckpt_dir and read_resumable_marker(ckpt_dir) is not None:
         # a previous invocation was preempted mid-run and checkpointed
         # inside its grace window: auto-resume, no flag needed
@@ -245,7 +341,8 @@ def supervise_training(
             # is imminent; record the attempt and let the exit happen.
             # The next supervise_training() sees the marker and resumes.
             log.retry(attempt, e.step, e, 0.0, resumable=True, world=world)
-            log.snapshot(retries, preempts, step=e.step)
+            log.snapshot(retries, preempts, step=e.step,
+                         causes=retry_causes)
             raise
         except NumericsAnomaly:
             # --on-anomaly halt (or an exhausted rollback budget) is a
@@ -253,6 +350,23 @@ def supervise_training(
             raise
         except Exception as e:  # noqa: BLE001 — the retry boundary
             retries += 1
+            cause = classify_retry_cause(e)
+            retry_causes[cause] = retry_causes.get(cause, 0) + 1
+            if ckpt_dir:
+                # quarantine corrupt keep-chain members BEFORE the
+                # discovery walk (bit-rot, torn writes): the verified
+                # walk-back then never re-pays the decompress+CRC of a
+                # known-bad file, and the record below names the step
+                # the next attempt ACTUALLY resumes from
+                scrub = scrub_checkpoint_dir(ckpt_dir)
+                if scrub["corrupt"]:
+                    log.scrub(scrub)
+                    print(
+                        f"[supervisor] scrub quarantined "
+                        f"{scrub['corrupt']} corrupt checkpoint "
+                        f"member(s): {scrub['quarantined']}",
+                        flush=True,
+                    )
             # verify=True deliberately duplicates the walk resume will
             # redo: the retry record's `step` field is the contract
             # "what the next attempt ACTUALLY resumes from" — after a
@@ -264,10 +378,19 @@ def supervise_training(
             step = checkpoint_step(path)
             if retries > max_retries:
                 log.retry(attempt, step, e, 0.0, world=world)
-                log.snapshot(retries, preempts)
+                log.snapshot(retries, preempts, causes=retry_causes)
                 raise
-            backoff = min(float(backoff_max),
-                          float(backoff_base) * (2 ** (retries - 1)))
+            if retry_jitter:
+                # decorrelated jitter (module docstring): the slept
+                # value is what the retry record carries — the log is
+                # the proof the fleet de-phased
+                backoff = min(float(backoff_max), _jitter_rng.uniform(
+                    float(backoff_base), max(float(backoff_base),
+                                             3.0 * _prev_sleep)))
+                _prev_sleep = backoff
+            else:
+                backoff = min(float(backoff_max),
+                              float(backoff_base) * (2 ** (retries - 1)))
             log.retry(attempt, step, e, backoff, world=world)
             print(
                 f"[supervisor] attempt {attempt} failed ({e!r}); retry "
@@ -284,5 +407,7 @@ def supervise_training(
     summary["retries"] = retries
     summary["preempt_resumes"] = preempts
     summary["attempts"] = attempt
-    log.snapshot(retries, preempts, step=summary.get("steps"))
+    summary["retry_causes"] = dict(retry_causes)
+    log.snapshot(retries, preempts, step=summary.get("steps"),
+                 causes=retry_causes)
     return summary

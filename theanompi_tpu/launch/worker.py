@@ -178,6 +178,13 @@ def run_training(
     ckpt_every_epochs: int = 1,
     async_checkpoint: bool = True,
     sharded_ckpt: bool = False,
+    # background checkpoint scrubber (chaos PR,
+    # utils/checkpoint.CheckpointScrubber): re-verify the keep-chain
+    # every N seconds and quarantine corrupt members (at-rest bit-rot,
+    # torn writes) into <ckpt_dir>/quarantine/ — kind=scrub records +
+    # tmpi_scrub_* gauges ride the obs stream; 0 = off (the supervisor
+    # still runs one synchronous pass before each retry's resume)
+    scrub_interval: float = 0.0,
     resume: bool = False,
     print_freq: int = 40,
     run_name: Optional[str] = None,
@@ -871,6 +878,13 @@ def run_training(
     # consumed — trained steps PLUS rollback-skipped batches — so data
     # order and epoch accounting stay exact.
     skip_batches = (step_count + skipped_prior) % steps_per_epoch
+    if skip_batches and os.environ.get("TMPI_CHAOS_MUTATE") == "refeed":
+        # chaos oracle self-test mutation (tools/chaos.py --mutate
+        # refeed): deliberately re-feed the last already-consumed batch
+        # on resume — a seeded recovery-accounting bug the campaign's
+        # invariant oracle MUST catch (and shrink); never set outside
+        # the chaos runner's mutation mode
+        skip_batches -= 1
     from theanompi_tpu.obs import Observability
 
     # obs facade: span log + heartbeat per rank, metrics snapshots on
@@ -989,6 +1003,26 @@ def run_training(
         inject_faults if isinstance(inject_faults, FaultInjector)
         else (FaultInjector(inject_faults) if inject_faults else None)
     )
+    if faults is not None:
+        # storage faults (enospc/slow_write) fire INSIDE the checkpoint
+        # write — install the injector as the writer shim for this run
+        # (cleared in the finally; the hook is process-global because
+        # the async writer thread has no per-save plumbing)
+        from theanompi_tpu.utils.checkpoint import set_write_fault_hook
+
+        set_write_fault_hook(faults.write_fault)
+    # background keep-chain scrubber (chaos PR): periodic re-verify +
+    # quarantine of corrupt checkpoint members, reported through the
+    # obs facade (kind=scrub + tmpi_scrub_* gauges)
+    scrubber = None
+    if ckpt_dir and scrub_interval and scrub_interval > 0:
+        from theanompi_tpu.utils.checkpoint import CheckpointScrubber
+
+        scrubber = CheckpointScrubber(
+            ckpt_dir, interval=float(scrub_interval),
+            on_result=obs.note_scrub,
+        )
+        scrubber.start()
     rollbacks = 0
     rollback_budget_left = (
         max(0, int(rollback_budget)) if on_anomaly == "rollback" else 0
@@ -1272,15 +1306,20 @@ def run_training(
                               extra_meta=_save_meta(), topology=topo_meta)
                 rec.end("checkpoint")
                 last_ckpt_step = step_count
-                if faults is not None and faults.truncate_due(step_count):
-                    # ckpt_truncate: tear the newest checkpoint the way
-                    # a host dying mid-write would (the async save must
-                    # be durable first, or the PREVIOUS file would be
-                    # the one torn) — latest_checkpoint(verify=True)
-                    # must walk back past it
-                    if ckpt_writer is not None:
-                        ckpt_writer.wait()
-                    faults.truncate_newest(ckpt_dir)
+                if faults is not None:
+                    # post-save storage mutations (ckpt_truncate /
+                    # bitrot / partial_set): mangle the newest COMMITTED
+                    # checkpoint the way torn writes / at-rest bit-rot /
+                    # a lost shard file would (the async save must be
+                    # durable first, or the PREVIOUS file would be the
+                    # one mutated) — latest_checkpoint(verify=True) and
+                    # the scrubber must absorb them
+                    due = faults.storage_mutations_due(step_count)
+                    if due:
+                        if ckpt_writer is not None:
+                            ckpt_writer.wait()
+                        for spec in due:
+                            faults.apply_storage_mutation(spec, ckpt_dir)
             rec.save()
             obs.snapshot(step=step_count)  # epoch-boundary metrics snapshot
             summary["epochs"].append(epoch)
@@ -1516,10 +1555,25 @@ def run_training(
                     try:
                         obs.close()
                     finally:
-                        if _prev_sigterm is not None:
-                            # restore the caller's SIGTERM disposition
-                            # (tests and stacked runs share the process)
-                            signal.signal(signal.SIGTERM, _prev_sigterm)
+                        try:
+                            if faults is not None:
+                                # uninstall the process-global writer
+                                # shim (installed where faults armed) —
+                                # AFTER the crash/preempt saves above,
+                                # so a due write fault can still hit
+                                # them like any other save
+                                from theanompi_tpu.utils.checkpoint import (
+                                    set_write_fault_hook as _clear_wfh,
+                                )
+
+                                _clear_wfh(None)
+                            if scrubber is not None:
+                                scrubber.stop()
+                        finally:
+                            if _prev_sigterm is not None:
+                                # restore the caller's SIGTERM disposition
+                                # (tests and stacked runs share the process)
+                                signal.signal(signal.SIGTERM, _prev_sigterm)
     # reached only on success: a completed run consumed any resumable
     # marker a preempted predecessor left — otherwise a later SUPERVISED
     # run reusing this ckpt_dir would silently flip into resume mode
@@ -1546,6 +1600,12 @@ def run_training(
     # skipped at the anomalous steps
     summary["rollbacks"] = rollbacks
     summary["skipped_steps"] = skipped_steps_total
+    if ckpt_writer is not None:
+        # boundary saves the ENOSPC-safe async writer absorbed (torn
+        # attempt, chain intact — utils/checkpoint.AsyncCheckpointer):
+        # nonzero means the checkpoint cadence silently degraded, which
+        # a success summary must not hide
+        summary["ckpt_storage_failures"] = ckpt_writer.storage_failures
     summary["host_blocked_s"] = round(disp.host_blocked_s, 6)
     summary["train_loop_s"] = round(train_loop_s, 6)
     summary["host_blocked_frac"] = (

@@ -69,7 +69,24 @@ On-disk layout under ``obs_dir`` (schemas:
                             tmpi_preflight_peak_bytes /
                             tmpi_preflight_fit gauges — the memory
                             trajectory tools/perf_gate.py gates via
-                            its preflight_peak_bytes invariant
+                            its preflight_peak_bytes invariant; runs
+                            with a checkpoint scrubber active
+                            (--scrub-interval, or the supervisor's
+                            retry-time pass) add one kind=scrub record
+                            per pass that ran — members checked,
+                            corrupt count, the quarantined filenames
+                            (comma-joined), pass seconds — next to the
+                            tmpi_scrub_checked / tmpi_scrub_runs_total
+                            / tmpi_scrub_quarantined_total gauges
+    chaos.jsonl             chaos campaign log (tools/chaos.py, written
+                            under the campaign's --out dir): one
+                            kind=chaos record per fuzzed fault
+                            schedule — seed, config, the schedule
+                            itself, ok/violations verdict from the
+                            invariant oracle, run count, and (for a
+                            failing schedule) the shrunken minimal
+                            repro as a ready-to-paste --inject-fault
+                            line
     metrics.prom            rank-0 Prometheus text exposition (atomic)
     spans_rank{r}.jsonl     per-rank span + span_summary lines
     heartbeat_rank{r}.json  per-rank liveness (atomic rewrite; carries
@@ -130,6 +147,7 @@ from __future__ import annotations
 
 import os
 import sys
+import threading
 from typing import Optional
 
 from theanompi_tpu.obs import spans as _spans_mod
@@ -224,6 +242,10 @@ class Observability:
         # cap the per-rank anomaly log rather than writing one line per
         # step for the rest of the run
         self._metrics_f = None
+        # serializes metrics.jsonl writes: the checkpoint scrubber's
+        # kind=scrub records arrive from its background thread while
+        # the driver thread snapshots
+        self._metrics_lock = threading.Lock()
         self._numerics_f = None
         self._prom_path = None
         self._last_snapshot_step = 0
@@ -494,6 +516,49 @@ class Observability:
             print(f"[rank {self.rank}] elastic reshard: {line}",
                   file=sys.stderr, flush=True)
 
+    def note_scrub(self, result: dict) -> None:
+        """Scrubber hook (utils/checkpoint.CheckpointScrubber
+        ``on_result``): one keep-chain scrub pass finished. Refreshes
+        the ``tmpi_scrub_*`` gauges/counters and writes a ``kind=scrub``
+        JSONL record into metrics.jsonl (rank 0) — called from the
+        scrubber's background thread, so the metrics sink write is
+        lock-serialized against driver-thread snapshots."""
+        if self.enabled:
+            self.registry.gauge(
+                "tmpi_scrub_checked",
+                help="keep-chain members verified by the last scrub "
+                     "pass (utils/checkpoint.scrub_checkpoint_dir)",
+            ).set(int(result["checked"]))
+            self.registry.gauge(
+                "tmpi_scrub_last_seconds",
+                help="wall seconds of the last scrub pass",
+            ).set(float(result["seconds"]))
+            self.registry.counter(
+                "tmpi_scrub_runs_total", help="scrub passes completed",
+            ).inc()
+            if result["corrupt"]:
+                self.registry.counter(
+                    "tmpi_scrub_quarantined_total",
+                    help="corrupt checkpoint members moved to "
+                         "quarantine/ by the scrubber",
+                ).inc(int(result["corrupt"]))
+        import json as _json
+        import time as _time
+
+        line = {"kind": "scrub", "rank": self.rank, "t": _time.time(),
+                "checked": int(result["checked"]),
+                "corrupt": int(result["corrupt"]),
+                "quarantined": ",".join(result["quarantined"]),
+                "seconds": float(result["seconds"])}
+        if self._metrics_f is not None and not self._closed:
+            with self._metrics_lock:
+                if not self._closed:
+                    self._metrics_f.write(_json.dumps(line) + "\n")
+                    self._metrics_f.flush()
+        elif result["corrupt"]:
+            print(f"[rank {self.rank}] checkpoint scrub: {line}",
+                  file=sys.stderr, flush=True)
+
     def note_rollback(self, anomaly_step: int, restore_step: int,
                       budget_left: int, skipped: int = 0) -> None:
         """Driver hook (``--on-anomaly rollback``, launch/worker.py):
@@ -629,20 +694,22 @@ class Observability:
             return None
         if step is not None:
             self._last_snapshot_step = step
-        if self._last_attr is not None:
-            # one kind=profile record per snapshot: the newest step-time
-            # attribution (schema: tools/check_obs_schema.py) — the
-            # machine-readable trail tools/perf_gate.py diffs. Written
-            # BEFORE the snapshot line: downstream readers (and tests)
-            # may treat the file's last record as the metrics snapshot.
-            import json as _json
+        with self._metrics_lock:
+            if self._last_attr is not None:
+                # one kind=profile record per snapshot: the newest
+                # step-time attribution (schema:
+                # tools/check_obs_schema.py) — the machine-readable
+                # trail tools/perf_gate.py diffs. Written BEFORE the
+                # snapshot line: downstream readers (and tests) may
+                # treat the file's last record as the metrics snapshot.
+                import json as _json
 
-            self._metrics_f.write(_json.dumps(self._last_attr.as_record(
-                step=step if step is not None else self._last_snapshot_step,
-                rank=self.rank,
-                rule=self.traffic.rule if self.traffic is not None else None,
-            )) + "\n")
-        rec = self.registry.emit_snapshot(self._metrics_f, step=step)
+                self._metrics_f.write(_json.dumps(self._last_attr.as_record(
+                    step=step if step is not None else self._last_snapshot_step,
+                    rank=self.rank,
+                    rule=self.traffic.rule if self.traffic is not None else None,
+                )) + "\n")
+            rec = self.registry.emit_snapshot(self._metrics_f, step=step)
         try:
             self.registry.write_prometheus(self._prom_path)
         except OSError as e:
@@ -667,8 +734,10 @@ class Observability:
         if self.heartbeat is not None:
             self.heartbeat.stop()
         if self._metrics_f is not None:
-            self._metrics_f.close()
-            self._metrics_f = None
+            # under the lock: the scrubber thread may be mid-write
+            with self._metrics_lock:
+                self._metrics_f.close()
+                self._metrics_f = None
         if self._numerics_f is not None:
             self._numerics_f.close()
             self._numerics_f = None

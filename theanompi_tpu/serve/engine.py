@@ -322,6 +322,20 @@ class ServeEngine:
             "ms": round(float(ms), 3),
         })
 
+    def note_reload_failed(self, from_step: int, error: str) -> None:
+        """Reloader hook for a reload that verified but failed to LOAD
+        (the keep-chain pruned the file between discovery and open —
+        the TOCTOU race — or a structure mismatch): count it and write
+        a failed ``reload`` record (``ok: false``, ``to_step: -1``) so
+        the telemetry shows the race happened even though serving never
+        blinked and the next poll simply retries."""
+        self._c_reloads.inc(status="failed")
+        self._write_record({
+            "kind": "reload", "t": time.time(),
+            "from_step": int(from_step), "to_step": -1,
+            "ok": False, "error": str(error)[:500],
+        })
+
     # -- lifecycle ----------------------------------------------------------
     def warmup(self) -> int:
         """AOT-warm every bucket shape through the jitted apply, so no
@@ -517,6 +531,8 @@ class ServeEngine:
             "tmpi_serve_expired_total": self._c_requests.value(status="expired"),
             "tmpi_serve_rejected_total": self._c_requests.value(status="rejected"),
             "tmpi_serve_reloads_total": self._c_reloads.value(),
+            "tmpi_serve_reload_failures_total":
+                self._c_reloads.value(status="failed"),
             "tmpi_serve_batches_total": float(self._batches),
         }
         if self._batches:

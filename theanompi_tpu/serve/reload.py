@@ -58,10 +58,15 @@ class CheckpointReloader:
     ``poll_once()`` is the unit of work (tests drive it directly for
     determinism); ``start()`` runs it on a background thread every
     ``interval`` seconds until ``stop()``. Failures to LOAD a
-    checkpoint that verified a moment earlier (pruned underneath us, or
-    a structure mismatch from pointing at the wrong run) are logged and
-    skipped — the engine keeps serving; a reloader crash must never
-    take serving down.
+    checkpoint that verified a moment earlier — the discovery/load
+    TOCTOU: the training run's keep-chain pruned the file between
+    ``newer_verified_checkpoint()`` and the open, or the dir points at
+    a structurally different run — are absorbed, not surfaced as a
+    reload failure of the SERVING side: the engine keeps serving its
+    current params, a failed ``reload`` record (``ok: false``) lands
+    in serve.jsonl (``tmpi_serve_reload_failures_total`` counts it),
+    and the next poll simply retries against whatever the keep-chain
+    holds then. A reloader crash must never take serving down.
     """
 
     def __init__(self, engine, ckpt_dir: str, *, interval: float = 2.0):
@@ -85,9 +90,14 @@ class CheckpointReloader:
         try:
             params, model_state, step = load_for_serving(path, self.engine.model)
         except Exception as e:  # noqa: BLE001 — keep serving on any load
-            # failure (the keep-chain pruned the file mid-load, etc.)
+            # failure (the keep-chain pruned the file mid-load, etc.);
+            # the failed-reload record makes the TOCTOU race observable
+            # without ever surfacing it to a request
             print(f"[serve.reload] load of {path!r} failed ({e!r}); "
-                  "keeping current params", flush=True)
+                  "keeping current params, retrying next poll", flush=True)
+            note = getattr(self.engine, "note_reload_failed", None)
+            if note is not None:
+                note(current, repr(e))
             return None
         if not self.engine.set_params(params, model_state, step):
             return None  # raced a newer swap; served step never regresses

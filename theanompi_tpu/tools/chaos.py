@@ -1,0 +1,834 @@
+"""``tmpi chaos`` — seeded chaos campaigns over the full fault matrix.
+
+Every recovery path in this framework (supervisor retry/backoff,
+verified resume, anomaly rollback, SIGTERM grace, elastic reshard,
+storage-fault walk-back, the scrubber) was proven by HAND-PICKED single
+faults — ``--inject-fault sigkill@3`` — which is exactly how recovery
+code rots: the combinations nobody wrote a test for are the ones
+production hits. This module fuzzes the combinations. A campaign:
+
+1. **generates randomized fault schedules** from a seeded RNG — kind x
+   step x composition over the full matrix (process faults, data
+   faults, and the storage kinds this PR adds: ``enospc`` /
+   ``slow_write`` / ``bitrot`` / ``partial_set``), including
+   back-to-back same-step pairs and fault-during-recovery timings (a
+   second fault whose step lands inside the first fault's replay
+   window);
+2. **runs each schedule under** ``supervise_training`` — in-process
+   when the schedule stays inside the process, in a subprocess sandbox
+   (with relaunch-on-kill and a fired-fault ledger,
+   ``utils/faults.FaultInjector(ledger=...)``) when it contains
+   ``sigkill``;
+3. **checks the invariant oracle** after every run (:data:`INVARIANTS`):
+   the run completed to its target step with host/device step
+   agreement, the newest VERIFIED checkpoint is restorable and finite
+   (never poisoned), the final state is at parity with an uninterrupted
+   baseline — bit-identical where the matrix says exact — the saved
+   RNG stream position matches the baseline (an independent no-re-fed/
+   no-skipped-batch detector: every consumed batch advances the key
+   split stream), rc/resumable-marker semantics are honored, and every
+   obs JSONL line is schema-clean;
+4. **shrinks** a failing schedule to a minimal reproducer (greedy
+   delta-debugging over the fault list) and emits it as a
+   ready-to-paste ``--inject-fault`` command-line fragment plus a
+   ``kind=chaos`` record in ``<out>/chaos.jsonl``.
+
+The payoff is leverage: the same oracle runs over every engine x codec
+x checkpoint-format combination (BSP and ZeRO-1, ``none`` and
+``int8:ef``, single-file and sharded sets), so crash-safety of a new
+knob is inherited by re-running the campaign, not re-deriving a test
+matrix by hand.
+
+Usage::
+
+    tmpi chaos --seeds 25                  # full matrix, 4 configs
+    tmpi chaos --smoke --seeds 5           # tier-1 CPU smoke (<120 s)
+    tmpi chaos --schedule 'crash@5+bitrot@3'   # one directed schedule
+    tmpi chaos --schedule crash@5 --mutate refeed   # oracle self-test
+
+``--mutate refeed`` arms a deliberately seeded recovery bug (the worker
+re-feeds one already-consumed batch on mid-epoch resume,
+``TMPI_CHAOS_MUTATE``) — the campaign MUST catch and shrink it; that is
+the proof the oracle is alive, the same way ``--inject-fault`` is the
+proof the recovery paths are.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# fault matrix
+# ---------------------------------------------------------------------------
+
+# kind -> properties the scheduler/oracle need:
+#   exact:      an injected fault of this kind must leave the final state
+#               BIT-IDENTICAL to the uninterrupted baseline (the resume/
+#               walk-back contract); inexact kinds (nan_batch's rollback
+#               skips data batches by design) get the weaker oracle
+#   arg:        spec arg appended as KIND@STEP:ARG (stall/slow seconds)
+#   subprocess: the fault kills the process — needs the sandbox
+#   sharded:    only meaningful for sharded checkpoint sets
+#   rollback:   needs numerics sentinels + --on-anomaly rollback armed
+MATRIX: dict[str, dict] = {
+    "crash": {},
+    "sigterm": {},
+    "sigkill": {"subprocess": True},
+    "ckpt_truncate": {},
+    "loader_stall": {"arg": 0.2},
+    "nan_batch": {"exact": False, "rollback": True},
+    "enospc": {},
+    "slow_write": {"arg": 0.2},
+    "bitrot": {},
+    "partial_set": {"sharded": True},
+}
+
+# the tier-1 smoke matrix: in-process, sleep-free, storage kinds included
+SMOKE_KINDS = ("crash", "ckpt_truncate", "enospc", "bitrot")
+
+INVARIANTS = (
+    "completed",        # final summary reached the target step count
+    "device_truth",     # host step ledger == device step counter
+    "verified_chain",   # a VERIFIED checkpoint is restorable at the end
+    "finite_state",     # ... and every array in it is finite
+    "parity",           # exact schedules: bit-identical to the baseline
+    "no_refeed",        # exact schedules: saved RNG stream position
+                        # matches the baseline (re-fed/skipped batch
+                        # detector independent of params)
+    "rc_semantics",     # every launch exited 0 / rc-75 / injected kill;
+                        # the final launch exited 0; marker consumed
+    "schema",           # every obs JSONL line validates
+)
+
+
+@dataclass
+class ChaosConfig:
+    """One engine x codec x checkpoint-format cell of the campaign."""
+
+    name: str
+    zero: int = 0
+    wire_codec: str = "none"
+    sharded_ckpt: bool = False
+    devices: int = 4
+    batch: int = 32
+    n_train: int = 96       # -> 3 steps/epoch: mid-epoch resumes happen
+    n_epochs: int = 2
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return self.n_train // self.batch
+
+    @property
+    def total_steps(self) -> int:
+        return self.steps_per_epoch * self.n_epochs
+
+
+def default_configs(smoke: bool) -> list[ChaosConfig]:
+    if smoke:
+        return [ChaosConfig("bsp_none")]
+    return [
+        ChaosConfig("bsp_none"),
+        ChaosConfig("bsp_int8ef", wire_codec="int8:ef"),
+        ChaosConfig("zero1_none", zero=1, sharded_ckpt=True),
+        ChaosConfig("zero1_int8ef", zero=1, wire_codec="int8:ef",
+                    sharded_ckpt=True),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# schedule generation
+# ---------------------------------------------------------------------------
+
+
+def spec_kind(spec: str) -> str:
+    return spec.partition("@")[0]
+
+
+def usable_kinds(cfg: ChaosConfig, kinds: list[str]) -> list[str]:
+    """The subset of ``kinds`` this config can actually draw:
+    sharded-only kinds need a sharded config, and rollback kinds need a
+    run long enough to hold a checkpoint to roll back TO (before the
+    first epoch-boundary save the policy correctly degrades to halt —
+    working-as-designed, not a schedule worth fuzzing)."""
+    out = [k for k in kinds
+           if not MATRIX[k].get("sharded") or cfg.sharded_ckpt]
+    return [k for k in out
+            if not MATRIX[k].get("rollback")
+            or cfg.steps_per_epoch + 1 <= cfg.total_steps]
+
+
+def generate_schedule(rng: random.Random, cfg: ChaosConfig,
+                      kinds: list[str], max_faults: int) -> list[str]:
+    """One fuzzed schedule: 1..max_faults specs over the run's step
+    range. Composition pressure is deliberate: with probability ~0.4 a
+    fault reuses (or lands adjacent to) the previous fault's step —
+    back-to-back faults and fault-during-recovery timings (the second
+    fault fires inside the first one's replay) are where hand-written
+    tests are thinnest."""
+    usable = usable_kinds(cfg, kinds)
+    if not usable:
+        raise ValueError(
+            f"no usable fault kinds for config {cfg.name!r}: {kinds} "
+            "all filtered out (sharded-only kinds on a non-sharded "
+            "config?) — pick --configs/--kinds that compose"
+        )
+    n = rng.randint(1, max_faults)
+    schedule: list[str] = []
+    prev_step: Optional[int] = None
+    for _ in range(n):
+        kind = rng.choice(usable)
+        lo = (cfg.steps_per_epoch + 1 if MATRIX[kind].get("rollback")
+              else 1)
+        if prev_step is not None and rng.random() < 0.4:
+            step = min(cfg.total_steps,
+                       max(lo, prev_step + rng.choice((0, 1))))
+        else:
+            step = rng.randint(lo, cfg.total_steps)
+        prev_step = step
+        arg = MATRIX[kind].get("arg")
+        schedule.append(f"{kind}@{step}" + (f":{arg}" if arg else ""))
+    # at most one process-killer per schedule keeps the relaunch budget
+    # small without losing composition coverage (two sigkills mostly
+    # test the same path twice)
+    killers = [s for s in schedule if spec_kind(s) == "sigkill"]
+    for extra in killers[1:]:
+        schedule.remove(extra)
+    return schedule
+
+
+# ---------------------------------------------------------------------------
+# running one schedule
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RunResult:
+    """Everything the oracle needs from one schedule's execution."""
+
+    launches: list[str] = field(default_factory=list)  # per-launch outcome
+    final_summary: Optional[dict] = None
+    error: Optional[str] = None
+    ckpt_dir: str = ""
+    obs_dir: str = ""
+
+
+def _base_run_kwargs(cfg: ChaosConfig, ckpt_dir: str, obs_dir: Optional[str],
+                     schedule: list[str]) -> dict:
+    from theanompi_tpu.models.mlp import MLP
+
+    kw = dict(
+        rule="bsp",
+        model_cls=MLP,
+        devices=cfg.devices,
+        zero=cfg.zero,
+        wire_codec=cfg.wire_codec,
+        sharded_ckpt=cfg.sharded_ckpt,
+        ckpt_dir=ckpt_dir,
+        obs_dir=obs_dir,
+        dataset="synthetic",
+        dataset_kwargs={"n_train": cfg.n_train, "n_val": cfg.batch},
+        recipe_overrides={"batch_size": cfg.batch},
+        n_epochs=cfg.n_epochs,
+        print_freq=0,
+        seed=0,
+    )
+    if any(MATRIX[spec_kind(s)].get("rollback") for s in schedule):
+        kw.update(numerics_freq=1, on_anomaly="rollback",
+                  rollback_budget=len(schedule) + 1)
+    if any(spec_kind(s) == "sigterm" for s in schedule):
+        kw["sigterm_grace"] = 10.0
+    return kw
+
+
+class BaselineCache:
+    """Uninterrupted reference runs for parity checks, built lazily and
+    cached per (config, step).
+
+    The full-run baseline's keep-chain covers the epoch-boundary steps;
+    a chaos run's newest verified checkpoint can also land MID-epoch
+    (the crash-path and SIGTERM-grace saves checkpoint at the step they
+    interrupt) — those anchors are produced on demand by a clean
+    ``max_steps=step`` run, whose truncation save writes ``ckpt_step``
+    with the exact state/rng an uninterrupted run holds after ``step``
+    batches."""
+
+    def __init__(self, out_dir: str):
+        self.out_dir = out_dir
+        self.seconds = 0.0
+        self._full: dict[str, str] = {}
+        self._at_step: dict[tuple, Optional[str]] = {}
+
+    def full_dir(self, cfg: ChaosConfig) -> str:
+        if cfg.name not in self._full:
+            from theanompi_tpu.launch.worker import run_training
+
+            t0 = time.perf_counter()
+            ckpt_dir = os.path.join(self.out_dir,
+                                    f"baseline_{cfg.name}", "ckpt")
+            summary = run_training(**_base_run_kwargs(cfg, ckpt_dir,
+                                                      None, []))
+            self.seconds += time.perf_counter() - t0
+            if summary["steps"] != cfg.total_steps:
+                raise RuntimeError(
+                    f"baseline for {cfg.name} stopped at step "
+                    f"{summary['steps']}, expected {cfg.total_steps}"
+                )
+            self._full[cfg.name] = ckpt_dir
+        return self._full[cfg.name]
+
+    def at_step(self, cfg: ChaosConfig, step: int) -> Optional[str]:
+        """A verified clean checkpoint of ``cfg`` at exactly ``step``
+        (None only for step 0, which has no save to anchor on)."""
+        key = (cfg.name, int(step))
+        if key in self._at_step:
+            return self._at_step[key]
+        path = _chain_at_step(self.full_dir(cfg), step)
+        if path is None and 0 < step <= cfg.total_steps:
+            from theanompi_tpu.launch.worker import run_training
+
+            t0 = time.perf_counter()
+            ckpt_dir = os.path.join(self.out_dir, f"baseline_{cfg.name}",
+                                    f"step{step}", "ckpt")
+            run_training(max_steps=step,
+                         **_base_run_kwargs(cfg, ckpt_dir, None, []))
+            self.seconds += time.perf_counter() - t0
+            path = _chain_at_step(ckpt_dir, step)
+        self._at_step[key] = path
+        return path
+
+
+def _run_inprocess(cfg: ChaosConfig, schedule: list[str],
+                   workdir: str) -> RunResult:
+    """Run one schedule under supervise_training in THIS process: one
+    FaultInjector threads through every supervisor attempt AND every
+    rc-75-equivalent relaunch (Preempted re-raise -> marker resume), so
+    each fault fires exactly once per schedule."""
+    from theanompi_tpu.launch.supervisor import supervise_training
+    from theanompi_tpu.utils.faults import FaultInjector, Preempted
+
+    res = RunResult(ckpt_dir=os.path.join(workdir, "ckpt"),
+                    obs_dir=os.path.join(workdir, "obs"))
+    injector = FaultInjector(schedule)
+    kw = _base_run_kwargs(cfg, res.ckpt_dir, res.obs_dir, schedule)
+    resume = False
+    budget = len(schedule) + 3
+    for _ in range(budget):
+        try:
+            summary = supervise_training(
+                max_retries=len(schedule) + 2, backoff_base=0.0,
+                inject_faults=injector, resume=resume, **kw,
+            )
+            res.launches.append("ok")
+            res.final_summary = summary
+            return res
+        except Preempted:
+            # the marker the grace path dropped drives the next
+            # launch's auto-resume — exactly the scheduler-requeue
+            # contract rc 75 promises
+            res.launches.append("preempted")
+            continue
+        except Exception as e:  # noqa: BLE001 — the oracle's evidence
+            res.launches.append(f"error:{type(e).__name__}")
+            res.error = repr(e)
+            return res
+    res.error = f"relaunch budget ({budget}) exhausted"
+    return res
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def _subprocess_env(mutate: Optional[str]) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TMPI_FORCE_PLATFORM"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    if mutate:
+        env["TMPI_CHAOS_MUTATE"] = mutate
+    else:
+        env.pop("TMPI_CHAOS_MUTATE", None)
+    return env
+
+
+def _run_subprocess(cfg: ChaosConfig, schedule: list[str], workdir: str,
+                    mutate: Optional[str], timeout: float) -> RunResult:
+    """Run one schedule in a subprocess sandbox — required whenever the
+    schedule kills the process (sigkill has no in-process recovery).
+    The chaos runner is the outer scheduler: it relaunches a killed/
+    preempted run with ``--resume``, and the fired-fault LEDGER
+    (``--fault-ledger``) carries once-only semantics across the process
+    boundary — without it every relaunch would replay the kill forever."""
+    import signal as _signal
+
+    res = RunResult(ckpt_dir=os.path.join(workdir, "ckpt"),
+                    obs_dir=os.path.join(workdir, "obs"))
+    ledger = os.path.join(workdir, "fault_ledger.txt")
+    args = [
+        "BSP", str(cfg.devices), "theanompi_tpu.models.mlp", "MLP",
+        "--synthetic", "--epochs", str(cfg.n_epochs),
+        "--batch-size", str(cfg.batch), "--print-freq", "0",
+        "--dataset-arg", f"n_train={cfg.n_train}",
+        "--dataset-arg", f"n_val={cfg.batch}",
+        "--ckpt-dir", res.ckpt_dir, "--obs-dir", res.obs_dir,
+        "--max-retries", str(len(schedule) + 2), "--retry-backoff", "0",
+        "--fault-ledger", ledger,
+        "--wire-codec", cfg.wire_codec,
+    ]
+    if cfg.zero:
+        args += ["--zero", str(cfg.zero)]
+    if cfg.sharded_ckpt:
+        args += ["--ckpt-sharded"]
+    if any(MATRIX[spec_kind(s)].get("rollback") for s in schedule):
+        args += ["--numerics-freq", "1", "--on-anomaly", "rollback",
+                 "--rollback-budget", str(len(schedule) + 1)]
+    if any(spec_kind(s) == "sigterm" for s in schedule):
+        args += ["--sigterm-grace", "10"]
+    for s in schedule:
+        args += ["--inject-fault", s]
+    env = _subprocess_env(mutate)
+    budget = len(schedule) + 3
+    resume: list[str] = []
+    for _ in range(budget):
+        try:
+            p = subprocess.run(
+                [sys.executable, "-m", "theanompi_tpu.cli", *args, *resume],
+                env=env, capture_output=True, text=True, timeout=timeout,
+                cwd=_repo_root(),
+            )
+        except subprocess.TimeoutExpired as e:
+            # a hung launch is a FINDING for this schedule (exactly the
+            # class of bug a chaos tool exists to surface), not a
+            # campaign-aborting runner error — record it and let the
+            # oracle fail/shrink the schedule like any other violation
+            res.launches.append("timeout")
+            res.error = (f"launch exceeded {timeout:.0f}s "
+                         f"({e.cmd[-3:]}...)")
+            return res
+        if p.returncode == 0:
+            res.launches.append("ok")
+            for line in reversed(p.stdout.strip().splitlines()):
+                try:
+                    res.final_summary = json.loads(line)
+                    break
+                except json.JSONDecodeError:
+                    continue
+            return res
+        if p.returncode == 75:
+            res.launches.append("preempted")
+            resume = ["--resume"]
+            continue
+        if p.returncode in (-_signal.SIGKILL, -_signal.SIGTERM):
+            res.launches.append(f"killed:{p.returncode}")
+            resume = ["--resume"]
+            continue
+        res.launches.append(f"rc:{p.returncode}")
+        res.error = (f"rc {p.returncode}\n{p.stdout[-1500:]}\n"
+                     f"{p.stderr[-1500:]}")
+        return res
+    res.error = f"relaunch budget ({budget}) exhausted"
+    return res
+
+
+def run_schedule(cfg: ChaosConfig, schedule: list[str], workdir: str, *,
+                 mutate: Optional[str] = None,
+                 timeout: float = 300.0) -> RunResult:
+    os.makedirs(workdir, exist_ok=True)
+    if any(MATRIX[spec_kind(s)].get("subprocess") for s in schedule):
+        return _run_subprocess(cfg, schedule, workdir, mutate, timeout)
+    if mutate:
+        os.environ["TMPI_CHAOS_MUTATE"] = mutate
+    try:
+        return _run_inprocess(cfg, schedule, workdir)
+    finally:
+        if mutate:
+            os.environ.pop("TMPI_CHAOS_MUTATE", None)
+
+
+# ---------------------------------------------------------------------------
+# the invariant oracle
+# ---------------------------------------------------------------------------
+
+
+def _ckpt_arrays(path: str) -> dict[str, np.ndarray]:
+    """The comparable content of one checkpoint: every saved array,
+    minus the JSON sidecars whose text may legitimately differ across
+    recovery histories (__usermeta__ records rollback skips;
+    __integrity__ re-derives from the arrays; __meta__/__topology__
+    describe layout, which shape checks already pin)."""
+    data = np.load(path)
+    skip = ("__integrity__", "__usermeta__", "__meta__", "__topology__",
+            "__rng_impl__")
+    return {k: data[k] for k in data.files if k not in skip}
+
+
+def _sharded_member_paths(path: str) -> list[str]:
+    from theanompi_tpu.utils.checkpoint import _SHARD_RE, _sharded_sets
+
+    m = _SHARD_RE.search(os.path.basename(path))
+    if not m:
+        return [path]
+    return _sharded_sets(os.path.dirname(path) or ".")[int(m.group(1))]
+
+
+def _final_verified(ckpt_dir: str):
+    from theanompi_tpu.utils.checkpoint import (
+        checkpoint_step, latest_checkpoint,
+    )
+
+    path = latest_checkpoint(ckpt_dir, verify=True)
+    return path, (checkpoint_step(path) if path else -1)
+
+
+# fault kinds that can destroy a COMMITTED or in-flight save: a
+# schedule made of these may legitimately leave ZERO verified
+# checkpoints (every save torn/rotted/dropped) — an empty chain is only
+# a violation when nothing in the schedule could have caused it
+_SAVE_DESTROYING = ("ckpt_truncate", "bitrot", "partial_set", "enospc")
+
+
+def check_invariants(cfg: ChaosConfig, schedule: list[str], res: RunResult,
+                     baseline: BaselineCache) -> list[str]:
+    """The oracle: the names of every violated invariant (empty = the
+    schedule was absorbed correctly). See :data:`INVARIANTS`."""
+    from theanompi_tpu.utils.checkpoint import read_resumable_marker
+
+    viol: list[str] = []
+    exact = all(MATRIX[spec_kind(s)].get("exact", True) for s in schedule)
+
+    # a schedule can compose a rollback-policy fault with enough
+    # save-destroyers that NOTHING verified remains when the rollback
+    # needs it — the policy then degrades to halt (a DELIBERATE stop,
+    # the documented PR-4 semantics, and the supervisor rightly never
+    # retries it). That terminal state is legitimate: the oracle keeps
+    # enforcing the quarantine invariant (no poisoned verified
+    # checkpoint) and schema/marker hygiene, but not completion.
+    _halt_names = ("RollbackRequested", "NumericsAnomaly")
+    anomaly_halt = (
+        any(MATRIX[spec_kind(f)].get("rollback") for f in schedule)
+        and any(spec_kind(f) in _SAVE_DESTROYING for f in schedule)
+        and res.error is not None
+        and any(n in res.error for n in _halt_names)
+    )
+
+    s = res.final_summary
+    # batches-consumed accounting: an anomaly rollback SKIPS data
+    # batches by design (each skip consumes a batch without a training
+    # step), so completion is judged on steps + skipped_steps — the
+    # same ledger the resume-positioning contract uses
+    consumed = (int(s.get("steps", -1)) + int(s.get("skipped_steps", 0))
+                if s else -1)
+    if not anomaly_halt and (
+            res.error is not None or s is None
+            or consumed != cfg.total_steps):
+        viol.append("completed")
+    if s is not None and s.get("device_steps") is not None and (
+            s.get("device_steps") != s.get("steps")):
+        viol.append("device_truth")
+
+    path, step = _final_verified(res.ckpt_dir)
+    if path is None:
+        if not any(spec_kind(f) in _SAVE_DESTROYING for f in schedule):
+            viol.append("verified_chain")
+    else:
+        arrays = _ckpt_arrays(path)
+        member_arrays = [
+            _ckpt_arrays(p) for p in _sharded_member_paths(path)
+        ]
+        if not all(
+            np.isfinite(a).all()
+            for ma in member_arrays
+            for a in ma.values()
+            if np.issubdtype(a.dtype, np.floating)
+        ):
+            viol.append("finite_state")
+        if exact and step > 0:
+            # parity against a CLEAN run's checkpoint at the SAME step
+            # (a tail-of-run storage fault legitimately walks the chain
+            # back, so the anchor is whatever IS restorable; step 0 has
+            # no save to anchor on and is skipped)
+            bpath = baseline.at_step(cfg, step)
+            if bpath is None:
+                viol.append("parity")
+            else:
+                barrays = _ckpt_arrays(bpath)
+                if set(arrays) != set(barrays) or any(
+                    not np.array_equal(arrays[k], barrays[k])
+                    for k in arrays if k != "__rng__"
+                ):
+                    viol.append("parity")
+                if "__rng__" in arrays and not np.array_equal(
+                        arrays.get("__rng__"), barrays.get("__rng__")):
+                    viol.append("no_refeed")
+
+    if anomaly_halt:
+        # the halt must still be CLEAN: no stale resumable marker
+        # promising a scheduler an auto-resume into a halted policy
+        if read_resumable_marker(res.ckpt_dir) is not None:
+            viol.append("rc_semantics")
+    else:
+        bad_launch = [
+            l for l in res.launches
+            if l not in ("ok", "preempted") and not l.startswith("killed:")
+        ]
+        if (not res.launches or res.launches[-1] != "ok" or bad_launch
+                or read_resumable_marker(res.ckpt_dir) is not None):
+            viol.append("rc_semantics")
+
+    viol.extend(_schema_violations(res.obs_dir))
+    return viol
+
+
+def _chain_at_step(ckpt_dir: str, step: int) -> Optional[str]:
+    from theanompi_tpu.utils.checkpoint import _keep_chain, verify_checkpoint
+
+    for s, _, path in _keep_chain(ckpt_dir):
+        if s == step and verify_checkpoint(path):
+            return path
+    return None
+
+
+def _schema_violations(obs_dir: str) -> list[str]:
+    from theanompi_tpu.tools.check_obs_schema import check_file, discover
+
+    if not obs_dir or not os.path.isdir(obs_dir):
+        return []
+    try:
+        files = discover([obs_dir])
+    except FileNotFoundError:
+        return []
+    errs: list[str] = []
+    for f in files:
+        errs += check_file(f)
+    return ["schema"] if errs else []
+
+
+# ---------------------------------------------------------------------------
+# shrinking
+# ---------------------------------------------------------------------------
+
+
+def shrink_schedule(cfg: ChaosConfig, schedule: list[str],
+                    baseline: BaselineCache, workdir: str, *,
+                    mutate: Optional[str] = None, timeout: float = 300.0,
+                    max_runs: int = 24) -> tuple[list[str], int]:
+    """Greedy delta-debugging: drop one fault at a time while the
+    reduced schedule still violates ANY invariant; fixed point = the
+    minimal reproducer. Returns (minimal schedule, shrink runs spent)."""
+    current = list(schedule)
+    runs = 0
+    changed = True
+    while changed and len(current) > 1 and runs < max_runs:
+        changed = False
+        for i in range(len(current)):
+            cand = current[:i] + current[i + 1:]
+            wd = os.path.join(workdir, f"shrink{runs}")
+            runs += 1
+            res = run_schedule(cfg, cand, wd, mutate=mutate, timeout=timeout)
+            if check_invariants(cfg, cand, res, baseline):
+                current = cand
+                changed = True
+                break
+            if runs >= max_runs:
+                break
+    return current, runs
+
+
+def repro_line(schedule: list[str]) -> str:
+    return " ".join(f"--inject-fault {s}" for s in schedule)
+
+
+# ---------------------------------------------------------------------------
+# campaign driver
+# ---------------------------------------------------------------------------
+
+
+def run_campaign(args: argparse.Namespace) -> dict:
+    out_dir = os.path.abspath(args.out)
+    os.makedirs(out_dir, exist_ok=True)
+    chaos_log = os.path.join(out_dir, "chaos.jsonl")
+    kinds = list(SMOKE_KINDS if args.smoke else
+                 (args.kinds.split(",") if args.kinds else MATRIX))
+    for k in kinds:
+        if k not in MATRIX:
+            raise SystemExit(f"unknown fault kind {k!r}; matrix: "
+                             f"{sorted(MATRIX)}")
+    configs = default_configs(args.smoke)
+    if args.configs:
+        want = args.configs.split(",")
+        configs = [c for c in default_configs(False) if c.name in want]
+        if not configs:
+            raise SystemExit(f"no config matches {args.configs!r}")
+
+    t_start = time.perf_counter()
+    timings = {"baseline": 0.0, "runs": 0.0, "shrink": 0.0}
+    baseline = BaselineCache(out_dir)
+
+    # directed mode: one explicit schedule instead of fuzzing
+    if args.schedule:
+        plans = [(0, configs[0], args.schedule.split("+"))]
+    else:
+        for cfg in configs:
+            # refuse up front with an actionable message rather than an
+            # IndexError mid-campaign
+            if not usable_kinds(cfg, kinds):
+                raise SystemExit(
+                    f"config {cfg.name!r} has no usable fault kinds in "
+                    f"{kinds} (sharded-only kinds on a non-sharded "
+                    "config?) — adjust --kinds/--configs"
+                )
+        plans = []
+        for i in range(args.seeds):
+            seed = args.seed + i
+            cfg = configs[i % len(configs)]
+            rng = random.Random(seed * 100003 + 17)
+            plans.append((seed, cfg,
+                          generate_schedule(rng, cfg, kinds,
+                                            args.max_faults)))
+
+    results = []
+    n_bad = 0
+    with open(chaos_log, "a") as log_f:
+        for seed, cfg, schedule in plans:
+            baseline.full_dir(cfg)  # build the reference run up front
+            wd = os.path.join(out_dir, f"seed{seed}_{cfg.name}")
+            t0 = time.perf_counter()
+            res = run_schedule(cfg, schedule, wd, mutate=args.mutate,
+                               timeout=args.run_timeout)
+            viol = check_invariants(cfg, schedule, res, baseline)
+            timings["runs"] += time.perf_counter() - t0
+            rec = {
+                "kind": "chaos", "t": time.time(), "seed": int(seed),
+                "config": cfg.name, "schedule": "+".join(schedule),
+                "ok": not viol, "violations": ",".join(viol),
+                "runs": len(res.launches),
+                "seconds": round(time.perf_counter() - t0, 3),
+            }
+            if viol:
+                n_bad += 1
+                t0 = time.perf_counter()
+                minimal, shrink_runs = shrink_schedule(
+                    cfg, schedule, baseline, wd, mutate=args.mutate,
+                    timeout=args.run_timeout)
+                timings["shrink"] += time.perf_counter() - t0
+                rec["shrunk_schedule"] = "+".join(minimal)
+                rec["repro"] = repro_line(minimal)
+                rec["runs"] = rec["runs"] + shrink_runs
+                print(f"[chaos] seed {seed} ({cfg.name}) VIOLATED "
+                      f"{viol} by {'+'.join(schedule)}; minimal repro: "
+                      f"{rec['repro']}", flush=True)
+                if res.error:
+                    print(f"[chaos]   run error: {res.error[:400]}",
+                          flush=True)
+            else:
+                print(f"[chaos] seed {seed} ({cfg.name}) ok: "
+                      f"{'+'.join(schedule)} absorbed "
+                      f"({len(res.launches)} launch(es))", flush=True)
+            log_f.write(json.dumps(rec) + "\n")
+            log_f.flush()
+            results.append(rec)
+
+    # baseline wall time is attributed wherever it was lazily paid
+    # (up-front full runs + on-demand mid-epoch anchors inside the
+    # oracle); the dedicated bucket reports the true total
+    timings["baseline"] = baseline.seconds
+    timings["total"] = time.perf_counter() - t_start
+    report = {
+        "schedules": len(results),
+        "ok": len(results) - n_bad,
+        "violated": n_bad,
+        "kinds": kinds,
+        "configs": [c.name for c in configs],
+        "mutate": args.mutate,
+        "results": results,
+        "timings_s": {k: round(v, 3) for k, v in timings.items()},
+        "out": out_dir,
+    }
+    with open(os.path.join(out_dir, "report.json"), "w") as f:
+        json.dump(report, f, indent=2)
+    return report
+
+
+def chaos_main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tmpi chaos", description=__doc__.splitlines()[0])
+    ap.add_argument("--seeds", type=int, default=25,
+                    help="fuzzed schedules to run (one seed each)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="base seed: schedule i uses seed+i")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tier-1 CPU smoke: bsp/none config, in-process "
+                         "sleep-free kinds only (crash/ckpt_truncate/"
+                         "enospc/bitrot) — the <120 s CI mode")
+    ap.add_argument("--schedule", default=None, metavar="K@S[+K@S...]",
+                    help="run ONE directed schedule instead of fuzzing "
+                         "(e.g. 'crash@5+bitrot@3')")
+    ap.add_argument("--kinds", default=None,
+                    help="comma-joined fault-kind subset of the matrix")
+    ap.add_argument("--configs", default=None,
+                    help="comma-joined config subset "
+                         "(bsp_none,bsp_int8ef,zero1_none,zero1_int8ef)")
+    ap.add_argument("--max-faults", type=int, default=3,
+                    help="max faults per fuzzed schedule")
+    ap.add_argument("--mutate", choices=["refeed"], default=None,
+                    help="arm a deliberately seeded recovery bug "
+                         "(oracle self-test): 'refeed' re-feeds one "
+                         "consumed batch on mid-epoch resume — the "
+                         "campaign must catch and shrink it")
+    ap.add_argument("--out", default="chaos_out",
+                    help="campaign output dir (chaos.jsonl, report.json, "
+                         "per-seed work dirs)")
+    ap.add_argument("--run-timeout", type=float, default=300.0,
+                    help="per-subprocess-launch timeout seconds")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full JSON report to stdout")
+    args = ap.parse_args(argv)
+
+    from theanompi_tpu.tools.lint import _ensure_virtual_devices
+
+    _ensure_virtual_devices()
+
+    try:
+        report = run_campaign(args)
+    except SystemExit:
+        raise
+    except Exception as e:  # noqa: BLE001 — rc 2 = runner bug, not a finding
+        print(f"tmpi chaos: internal error: {e!r}", file=sys.stderr)
+        import traceback
+
+        traceback.print_exc()
+        return 2
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        t = report["timings_s"]
+        print(
+            f"chaos: {report['ok']}/{report['schedules']} schedules "
+            f"absorbed ({report['violated']} violated) over configs "
+            f"{report['configs']} | timings_s baseline={t['baseline']} "
+            f"runs={t['runs']} shrink={t['shrink']} total={t['total']}"
+        )
+        for r in report["results"]:
+            if not r["ok"]:
+                print(f"  seed {r['seed']} {r['config']}: "
+                      f"{r['violations']} <- {r['schedule']} | repro: "
+                      f"{r.get('repro', '')}")
+    return 1 if report["violated"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(chaos_main())

@@ -146,12 +146,52 @@ SCHEMAS: dict[str, dict[str, tuple[tuple, bool]]] = {
         "attempt": ((int,), True),
         "step": ((int,), True),
         "error": ((str,), True),
+        # the backoff ACTUALLY slept — under --retry-jitter this is the
+        # seeded decorrelated-jitter draw, the de-phasing proof line
         "backoff_s": (_NUM, True),
         "resumable": ((bool,), False),
+        # instability attribution (chaos PR): which layer killed the
+        # attempt — crash/preempt/topology/storage/anomaly
+        # (launch/supervisor.classify_retry_cause)
+        "cause": ((str,), False),
         # the attempt's device world size (elastic PR): present on
         # every elastic-supervised record so supervisor.jsonl alone
         # shows the topology trajectory across retries
         "world": ((int,), False),
+    },
+    # checkpoint scrubber (utils/checkpoint.scrub_checkpoint_dir /
+    # CheckpointScrubber): one record per scrub pass — keep-chain
+    # members re-verified, how many failed, the quarantined filenames
+    # (comma-joined; empty string = clean pass), and the pass's wall
+    # seconds. Written by the worker's background scrubber
+    # (--scrub-interval) and by the supervisor's retry-time pass.
+    "scrub": {
+        "rank": ((int,), True),
+        "t": (_NUM, True),
+        "checked": ((int,), True),
+        "corrupt": ((int,), True),
+        "quarantined": ((str,), True),
+        "seconds": (_NUM, True),
+    },
+    # chaos campaign runner (tools/chaos.py, `tmpi chaos`): one record
+    # per fuzzed fault schedule — the seed that generated it, the
+    # engine/codec config label, the schedule itself ('+'-joined
+    # KIND@STEP specs), the invariant oracle's verdict (`ok` with
+    # `violations` naming the failed invariants, comma-joined), how
+    # many training runs the schedule cost (incl. process relaunches),
+    # and — for a failing schedule — the shrunken minimal repro as a
+    # ready-to-paste --inject-fault command-line fragment.
+    "chaos": {
+        "t": (_NUM, True),
+        "seed": ((int,), True),
+        "config": ((str,), True),
+        "schedule": ((str,), True),
+        "ok": ((bool,), True),
+        "violations": ((str,), False),
+        "runs": ((int,), False),
+        "seconds": (_NUM, False),
+        "repro": ((str,), False),
+        "shrunk_schedule": ((str,), False),
     },
     # elastic supervision (launch/supervisor.py): one record per
     # attempt — the device world size the attempt was launched in,
@@ -253,12 +293,18 @@ SCHEMAS: dict[str, dict[str, tuple[tuple, bool]]] = {
     },
     # one record per checkpoint hot-reload applied by the serving
     # engine (serve/reload.py): the step served before, the verified
-    # step swapped in, and the off-hot-path load+swap latency
+    # step swapped in, and the off-hot-path load+swap latency. A
+    # reload that verified but failed to LOAD (keep-chain pruned the
+    # file between discovery and open — the TOCTOU race) writes
+    # ok=false with to_step=-1 and the error; serving never blinked,
+    # the next poll retries.
     "reload": {
         "t": (_NUM, True),
         "from_step": ((int,), True),
         "to_step": ((int,), True),
         "ms": (_NUM, False),
+        "ok": ((bool,), False),
+        "error": ((str,), False),
     },
 }
 

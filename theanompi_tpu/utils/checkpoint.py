@@ -17,12 +17,14 @@ changing this API.
 
 from __future__ import annotations
 
+import errno
 import os
 import re
 import tempfile
+import threading
 import time
 import zlib
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 import numpy as np
 
@@ -97,6 +99,113 @@ def _with_integrity(flat: dict) -> dict:
     manifest = {k: _array_crc(np.asarray(v)) for k, v in flat.items()}
     flat[_INTEGRITY_KEY] = np.asarray(_json.dumps(manifest))
     return flat
+
+
+# --------------------------------------------------------------------------
+# injectable writer shim (chaos PR): storage faults — ENOSPC mid-write,
+# slow/stalled writes — happen INSIDE the filesystem write, where no
+# step-loop hook can reach. Both save formats funnel their serialize+
+# rename through _atomic_savez, which consults the installed hook with
+# the step being saved; utils/faults.FaultInjector.write_fault is the
+# one production hook (deterministic KIND@STEP semantics), but any
+# callable ``step -> Optional[(kind, arg)]`` works.
+# --------------------------------------------------------------------------
+
+_WRITE_FAULT_HOOK: Optional[Callable[[int], Optional[tuple]]] = None
+
+
+def set_write_fault_hook(hook: Optional[Callable[[int], Optional[tuple]]]
+                         ) -> None:
+    """Install (or clear, with None) the process-wide checkpoint write
+    fault hook. The driver installs its FaultInjector's ``write_fault``
+    for the run and clears it in its finally — the hook is global
+    because the async writer thread has no per-save plumbing."""
+    global _WRITE_FAULT_HOOK
+    _WRITE_FAULT_HOOK = hook
+
+
+class _EnospcWriter:
+    """File wrapper that raises ``OSError(ENOSPC)`` once ``limit``
+    bytes have been written — the injected 'disk filled up mid-write':
+    a torn partial file exists under the TMP name when the error
+    surfaces, exactly what a real quota hit leaves behind.
+
+    After the failure the wrapper goes DEAD: writes are absorbed into a
+    simulated position instead of touching the (by then closed) real
+    file. np.savez's internal ZipFile survives the exception holding
+    this object as its ``fp``; its garbage-collected ``close()`` then
+    flushes a central directory into the void coherently instead of
+    spraying 'Exception ignored in ZipFile.__del__' noise over the
+    real error."""
+
+    def __init__(self, f, limit: int):
+        self._f = f
+        self._limit = int(limit)
+        self._written = 0
+        self._dead = False
+        self._pos = 0  # simulated position once dead
+
+    def write(self, data):
+        if self._dead:
+            self._pos += len(data)
+            return len(data)
+        if self._written + len(data) > self._limit:
+            space = max(0, self._limit - self._written)
+            if space:
+                self._f.write(data[:space])
+                self._written += space
+            self._dead = True
+            self._pos = self._written
+            raise OSError(errno.ENOSPC,
+                          "No space left on device (injected enospc)")
+        self._written += len(data)
+        return self._f.write(data)
+
+    def seek(self, offset, whence=0):
+        if not self._dead:
+            return self._f.seek(offset, whence)
+        if whence == 0:
+            self._pos = offset
+        elif whence == 1:
+            self._pos += offset
+        return self._pos
+
+    def tell(self):
+        return self._pos if self._dead else self._f.tell()
+
+    def flush(self):
+        if not self._dead:
+            self._f.flush()
+
+    def __getattr__(self, name):
+        return getattr(self._f, name)
+
+
+def _atomic_savez(directory: str, path: str, flat: dict, step: int) -> None:
+    """The one serialize+rename both save formats use: np.savez into a
+    tmp file in ``directory``, then atomic ``os.replace`` onto ``path``.
+    Any failure (a real OSError or an injected write fault) removes the
+    torn tmp — the chain is never left holding a partial file under a
+    final name."""
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            sink = f
+            fault = _WRITE_FAULT_HOOK(step) if _WRITE_FAULT_HOOK else None
+            if fault is not None:
+                kind, arg = fault
+                if kind == "slow_write":
+                    time.sleep(2.0 if arg is None else float(arg))
+                elif kind == "enospc":
+                    # default low enough that even a tiny state's save
+                    # tears mid-write (any real .npz exceeds it)
+                    sink = _EnospcWriter(f, 256 if arg is None else int(arg))
+            np.savez(sink, **flat)
+        os.replace(tmp, path)  # atomic on POSIX
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
 
 
 def _pull_to_host(leaf) -> np.ndarray:
@@ -191,15 +300,7 @@ def save_checkpoint(
         return None
     os.makedirs(directory, exist_ok=True)
     path = os.path.join(directory, f"ckpt_{step}.npz")
-    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            np.savez(f, **_with_integrity(flat))
-        os.replace(tmp, path)  # atomic on POSIX
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
+    _atomic_savez(directory, path, _with_integrity(flat), step)
     _prune(directory, keep)
     _prune_sharded(directory, keep)  # a dir toggled from --ckpt-sharded
     return path
@@ -212,7 +313,13 @@ def _prune(directory: str, keep: int) -> None:
         if (m := _CKPT_RE.search(f))
     )
     for _, f in ckpts[:-keep] if keep else []:
-        os.unlink(os.path.join(directory, f))
+        try:
+            os.unlink(os.path.join(directory, f))
+        except FileNotFoundError:
+            # the background scrubber may have quarantined (moved) the
+            # member between our listing and this unlink — gone either
+            # way, and a hygiene race must not fail a save
+            pass
 
 
 # --------------------------------------------------------------------------
@@ -321,18 +428,11 @@ def save_checkpoint_sharded(
 
     os.makedirs(directory, exist_ok=True)
     path = os.path.join(directory, f"ckpt_{step}.proc{me}of{n_proc}.npz")
-    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
-    try:
-        # checkpoint_write span (obs/spans.py): the serialize+rename of
-        # this host's shard files (distinct from the driver's
-        # 'checkpoint' bracket — see save_checkpoint's gather span note)
-        with obs_span("checkpoint_write"), os.fdopen(fd, "wb") as f:
-            np.savez(f, **_with_integrity(flat))
-        os.replace(tmp, path)
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
+    # checkpoint_write span (obs/spans.py): the serialize+rename of
+    # this host's shard files (distinct from the driver's
+    # 'checkpoint' bracket — see save_checkpoint's gather span note)
+    with obs_span("checkpoint_write"):
+        _atomic_savez(directory, path, _with_integrity(flat), step)
     _prune_sharded(directory, keep)
     if jax.process_index() == 0:
         _prune(directory, keep)  # a dir toggled from single-file saves
@@ -1030,7 +1130,19 @@ class AsyncCheckpointer:
     - ONE save in flight: a new ``save()`` first waits for the previous
       one, so checkpoints land in step order.
     - worker errors don't vanish: they re-raise at the next ``save()`` /
-      ``wait()`` / ``close()``.
+      ``wait()`` / ``close()`` — EXCEPT *transient* storage-exhaustion
+      errors (ENOSPC, EDQUOT, EIO, ESTALE: a full disk, a flaky NFS
+      mount), which fail the ATTEMPT without failing the run: the torn
+      tmp was already cleaned (``os.replace`` never ran, the keep-chain
+      is untouched), so the failure is logged, counted in
+      ``storage_failures`` (newest exception in ``last_storage_error``),
+      and training continues to the next boundary save — a full disk
+      must degrade checkpoint cadence, not kill a healthy training run
+      whose older checkpoints remain valid. Configuration errors
+      (ENOTDIR, EACCES, EEXIST...) are NOT transient: they still
+      re-raise, because every future attempt would fail identically
+      and an epoch whose checkpoint silently never lands must not
+      return a success summary.
     - ``close()`` drains the queue — call before reading "the latest
       checkpoint" or letting the process exit.
 
@@ -1044,7 +1156,9 @@ class AsyncCheckpointer:
         from concurrent.futures import ThreadPoolExecutor
 
         self._pool = ThreadPoolExecutor(1, thread_name_prefix="tmpi-ckpt")
-        self._pending = None
+        self._pending = None  # (future, step) of the in-flight save
+        self.storage_failures = 0
+        self.last_storage_error: Optional[OSError] = None
         # per-host sharded writes touch only ADDRESSABLE shards, so they
         # are collective-free and async-safe even in multi-host runs —
         # the gather-to-rank-0 sync fallback below applies to the
@@ -1083,22 +1197,193 @@ class AsyncCheckpointer:
         state = jax.tree_util.tree_map(snap, state)
         if rng is not None:
             rng = snap(rng)
-        self._pending = self._pool.submit(
+        self._pending = (self._pool.submit(
             save_fn, directory, state, step, rng, keep, extra_meta, topology
-        )
+        ), int(step))
+
+    # errnos that mean "storage is full/flaky RIGHT NOW", not "this
+    # path will never work" — the only failures an attempt may absorb
+    _TRANSIENT_ERRNOS = frozenset(
+        e for e in (errno.ENOSPC, getattr(errno, "EDQUOT", None),
+                    errno.EIO, getattr(errno, "ESTALE", None))
+        if e is not None
+    )
 
     def wait(self) -> None:
         """Block until the in-flight save (if any) is durable; re-raises
-        its error here if it failed."""
-        if self._pending is not None:
-            pending, self._pending = self._pending, None
+        its error here if it failed — except transient storage-
+        exhaustion errors (class docstring), which fail only the
+        attempt: logged, counted, swallowed, keep-chain intact."""
+        if self._pending is None:
+            return
+        (pending, step), self._pending = self._pending, None
+        try:
             pending.result()
+        except OSError as e:
+            if e.errno not in self._TRANSIENT_ERRNOS:
+                raise
+            self.storage_failures += 1
+            self.last_storage_error = e
+            print(
+                f"[checkpoint] async save at step {step} failed on a "
+                f"storage error ({e!r}); the torn attempt left the "
+                "keep-chain intact — training continues, next boundary "
+                "save retries",
+                flush=True,
+            )
 
     def close(self) -> None:
         try:
             self.wait()
         finally:
             self._pool.shutdown(wait=True)
+
+
+# --------------------------------------------------------------------------
+# checkpoint scrubber (chaos PR): at-rest bit-rot is silent until the
+# moment of resume — and a corrupt member sitting in the keep-chain
+# makes EVERY verify=True discovery re-pay a decompress+CRC walk past
+# it. The scrubber re-verifies the chain in the background and moves
+# corrupt members into <ckpt_dir>/quarantine/ (moved, not deleted: the
+# bytes stay available for forensics), so the next latest_checkpoint
+# walk-back is O(1) and a flipped-bit newest file can never shadow the
+# last good checkpoint. The supervisor also runs one synchronous pass
+# before each retry's resume discovery (launch/supervisor.py).
+# --------------------------------------------------------------------------
+
+QUARANTINE_DIR = "quarantine"
+
+
+def scrub_checkpoint_dir(directory: str,
+                         quarantine: str = QUARANTINE_DIR,
+                         memo: Optional[dict] = None) -> dict:
+    """One scrub pass over ``directory``'s keep-chain: every
+    checkpoint-looking file (single-file saves AND individual sharded
+    members — a set with one bad member is poisoned whole, but only the
+    bad member is quarantined) is re-verified (:func:`_verify_npz`) and
+    corrupt members are MOVED into ``<directory>/<quarantine>/``.
+    Files pruned underneath the pass are skipped silently. Returns
+    ``{"checked", "corrupt", "quarantined": [names], "seconds"}``.
+
+    ``memo`` (a dict the caller owns across passes): members already
+    verified at an unchanged ``(size, mtime_ns)`` are skipped — a
+    steady-state pass over multi-GB checkpoints then costs stats, not
+    a full decompress+CRC of every byte. The memo deliberately canNOT
+    see disk-level rot that leaves metadata untouched, so a periodic
+    memo-free full pass is still required (the background scrubber
+    does one every :data:`CheckpointScrubber.FULL_EVERY` passes; the
+    supervisor's retry-time call is always memo-free).
+
+    Safe against a concurrent writer: visible final-name files are
+    complete (tmp+rename atomicity), ``.tmp`` spill files never match
+    the checkpoint patterns, and a valid file can never fail verify.
+    Quarantined names keep their filename (suffixed ``.N`` on
+    collision), so a quarantined member is inert: nothing under
+    ``quarantine/`` matches the keep-chain walk."""
+    t0 = time.perf_counter()
+    out = {"checked": 0, "corrupt": 0, "quarantined": [], "seconds": 0.0}
+    if not os.path.isdir(directory):
+        return out
+    names = [f for f in sorted(os.listdir(directory))
+             if _CKPT_RE.search(f) or _SHARD_RE.search(f)]
+    for f in names:
+        p = os.path.join(directory, f)
+        try:
+            st = os.stat(p)
+        except OSError:
+            continue  # pruned underneath the listing
+        out["checked"] += 1
+        sig = (st.st_size, st.st_mtime_ns)
+        if memo is not None and memo.get(f) == sig:
+            continue  # verified before at this exact size+mtime
+        if _verify_npz(p):
+            if memo is not None:
+                memo[f] = sig
+            continue
+        if not os.path.exists(p):
+            continue  # pruned mid-verify: absence is not corruption
+        qdir = os.path.join(directory, quarantine)
+        os.makedirs(qdir, exist_ok=True)
+        dst = os.path.join(qdir, f)
+        n = 1
+        while os.path.exists(dst):
+            dst = os.path.join(qdir, f"{f}.{n}")
+            n += 1
+        try:
+            os.replace(p, dst)
+        except OSError:
+            continue  # raced a prune; the member is gone either way
+        out["quarantined"].append(f)
+        print(f"[scrub] quarantined corrupt checkpoint member {f!r} "
+              f"-> {dst!r}", flush=True)
+    out["corrupt"] = len(out["quarantined"])
+    out["seconds"] = time.perf_counter() - t0
+    return out
+
+
+class CheckpointScrubber:
+    """Background keep-chain scrubber: run
+    :func:`scrub_checkpoint_dir` every ``interval`` seconds until
+    :meth:`stop`. ``on_result`` (e.g. ``Observability.note_scrub``)
+    receives each pass's result dict — ``kind=scrub`` records and the
+    ``tmpi_scrub_*`` gauges ride it; a callback failure is suppressed
+    (telemetry must never take down the scrubber, and the scrubber
+    must never take down training). ``scrub_once()`` is the
+    deterministic unit tests drive directly.
+
+    Passes are memoized on ``(size, mtime_ns)`` so steady-state scrubs
+    of multi-GB checkpoints cost stats, not bytes — with a memo-FREE
+    full pass every :data:`FULL_EVERY` passes (and on the first), since
+    disk-level rot can flip bits without touching file metadata."""
+
+    FULL_EVERY = 10
+
+    def __init__(self, ckpt_dir: str, *, interval: float = 60.0,
+                 on_result=None):
+        self.ckpt_dir = ckpt_dir
+        self.interval = float(interval)
+        self.on_result = on_result
+        self.runs = 0
+        self.quarantined_total = 0
+        self._memo: dict = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def scrub_once(self) -> dict:
+        if self.runs % self.FULL_EVERY == 0:
+            self._memo.clear()  # periodic full re-verify (docstring)
+        res = scrub_checkpoint_dir(self.ckpt_dir, memo=self._memo)
+        self.runs += 1
+        self.quarantined_total += res["corrupt"]
+        if self.on_result is not None:
+            try:
+                self.on_result(res)
+            except Exception as e:  # noqa: BLE001
+                print(f"[scrub] result callback failed (suppressed): "
+                      f"{e!r}", flush=True)
+        return res
+
+    def start(self) -> None:
+        if self._thread is not None:
+            raise RuntimeError("scrubber already started")
+        self._thread = threading.Thread(
+            target=self._loop, name="tmpi-ckpt-scrub", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.scrub_once()
+            except Exception as e:  # noqa: BLE001
+                print(f"[scrub] pass failed ({e!r}); retrying next "
+                      "interval", flush=True)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
 
 
 # --------------------------------------------------------------------------

@@ -36,8 +36,39 @@ Fault kinds (``KIND@STEP`` or ``KIND@STEP:ARG``):
 - ``grow``         like ``shrink`` but ARG grows the world (capacity
                    returned; ``grow@3:4``)
 
+Storage-level kinds (chaos PR) — the fault matrix used to stop at the
+process boundary; these reach into the checkpoint write path itself:
+
+- ``enospc``       the first checkpoint save at/after STEP raises
+                   ``OSError(ENOSPC)`` MID-WRITE via the injectable
+                   writer shim in ``utils/checkpoint.py`` (disk full /
+                   quota: the torn attempt must read as absent and the
+                   keep-chain must stay restorable)
+- ``slow_write``   the first save at/after STEP stalls ARG seconds
+                   (default 2.0) inside the writer (a degraded NFS
+                   mount: the async checkpointer's NEXT save blocks the
+                   driver — the stall watchdog's territory)
+- ``bitrot``       flip bytes in the newest COMMITTED keep-chain member
+                   after the first save at/after STEP (at-rest
+                   bit-corruption: the CRC32 chain must catch it and
+                   the scrubber must quarantine it)
+- ``partial_set``  delete one member of the newest sharded checkpoint
+                   set after the first save at/after STEP (a host's
+                   file lost: completeness-by-counting must read the
+                   torn set as absent)
+
 Injection points live in ``launch/worker.py``'s train loops; all hooks
 are host-side and sync-free (``tools/check_hot_loop.py`` stays green).
+
+Cross-process once-only semantics: the in-process supervisor threads
+ONE injector through every retry, so fired flags persist. A run that is
+relaunched as a NEW process (SIGKILL under an outer chaos campaign,
+rc-75 preemption) would re-fire every fault — unless the injector is
+armed with a ``ledger`` file: every fired spec is appended (and fsynced
+BEFORE the fault's side effect, so even a SIGKILL cannot lose the
+entry) and specs already in the ledger arm as fired. ``--fault-ledger``
+on the CLI wires it; ``tools/chaos.py`` relies on it to relaunch killed
+runs without replaying their faults.
 """
 
 from __future__ import annotations
@@ -94,7 +125,19 @@ class Preempted(RuntimeError):
 FAULT_KINDS = (
     "crash", "sigterm", "sigkill", "ckpt_truncate", "nan_batch",
     "loader_stall", "shrink", "grow",
+    # storage-level kinds (chaos PR): enospc/slow_write fire INSIDE the
+    # write via the checkpoint writer shim; bitrot/partial_set mutate a
+    # COMMITTED file after the save lands (like ckpt_truncate)
+    "enospc", "slow_write", "bitrot", "partial_set",
 )
+
+# post-save mutators: applied to a durable checkpoint after the first
+# save at/after the spec's step (the ckpt_truncate family)
+STORAGE_MUTATION_KINDS = ("ckpt_truncate", "bitrot", "partial_set")
+
+# during-write faults: consulted by the checkpoint writer shim
+# (utils/checkpoint.set_write_fault_hook) at each save's step
+WRITE_FAULT_KINDS = ("enospc", "slow_write")
 
 
 @dataclass
@@ -152,15 +195,47 @@ class FaultInjector:
 
     The driver calls :meth:`check_step` with the 1-based step it is
     ABOUT to dispatch (fused dispatch passes the group's step range),
-    :meth:`poison_batch` on the batch feeding that step, and
-    :meth:`truncate_due`/:meth:`truncate_newest` around checkpoint
-    saves. Deterministic by construction: same specs + same step
-    sequence = same failures.
+    :meth:`poison_batch` on the batch feeding that step,
+    :meth:`storage_mutations_due`/:meth:`apply_storage_mutation` around
+    checkpoint saves, and installs :meth:`write_fault` as the
+    checkpoint writer shim for the during-write kinds. Deterministic by
+    construction: same specs + same step sequence = same failures.
+
+    ``ledger``: optional path of a fired-fault ledger (module
+    docstring) — specs already recorded there arm as fired, and every
+    fire appends+fsyncs its line BEFORE the fault's side effect, so a
+    relaunched process armed with the same ledger never replays a
+    fault that already happened.
     """
 
-    def __init__(self, specs: Sequence[Union[str, FaultSpec]]):
+    def __init__(self, specs: Sequence[Union[str, FaultSpec]],
+                 ledger: Optional[str] = None):
         self.specs = [parse_fault_spec(s) for s in (specs or [])]
         self._fire_seq = 0
+        self._ledger = ledger
+        if ledger and os.path.exists(ledger):
+            # arm-as-fired anything a previous incarnation already did.
+            # Duplicate specs (crash@3 twice) consume ledger entries
+            # positionally: two recorded fires mark two specs fired.
+            with open(ledger) as f:
+                seen = [ln.strip() for ln in f if ln.strip()]
+            for entry in seen:
+                for s in self.specs:
+                    if not s.fired and f"{s.kind}@{s.step}" == entry:
+                        s.fired = True
+                        s.fired_seq = self._fire_seq
+                        self._fire_seq += 1
+                        break
+
+    def _record_fire(self, s: FaultSpec) -> None:
+        """Durably note a fired spec BEFORE its side effect (a SIGKILL
+        one line later must not lose the entry)."""
+        if not self._ledger:
+            return
+        with open(self._ledger, "a") as f:
+            f.write(f"{s.kind}@{s.step}\n")
+            f.flush()
+            os.fsync(f.fileno())
 
     def _take(self, kind: str, first: int, last: Optional[int] = None
               ) -> Optional[FaultSpec]:
@@ -172,6 +247,7 @@ class FaultInjector:
                 s.fired = True
                 s.fired_seq = self._fire_seq
                 self._fire_seq += 1
+                self._record_fire(s)
                 return s
         return None
 
@@ -227,14 +303,64 @@ class FaultInjector:
             return None
         return int(max(fired, key=lambda s: s.fired_seq).arg)
 
+    def _take_at_or_after(self, kind: str, step: int) -> Optional[FaultSpec]:
+        """The unfired spec of ``kind`` due at/after ``step`` (marked
+        fired + ledgered) — the save-boundary firing rule: a save can
+        land later than the spec's step (epoch cadence), and the fault
+        applies to the first save that reaches it."""
+        for s in self.specs:
+            if s.kind == kind and not s.fired and step >= s.step:
+                s.fired = True
+                s.fired_seq = self._fire_seq
+                self._fire_seq += 1
+                self._record_fire(s)
+                return s
+        return None
+
     def truncate_due(self, step: int) -> bool:
         """True once when a ``ckpt_truncate`` spec is due at/after
         ``step`` (the driver checks after each checkpoint save)."""
-        for s in self.specs:
-            if s.kind == "ckpt_truncate" and not s.fired and step >= s.step:
-                s.fired = True
-                return True
-        return False
+        return self._take_at_or_after("ckpt_truncate", step) is not None
+
+    def storage_mutations_due(self, step: int) -> list:
+        """Every post-save storage mutation (``ckpt_truncate`` /
+        ``bitrot`` / ``partial_set``) due at/after ``step``, each fired
+        once — the driver applies them with
+        :meth:`apply_storage_mutation` after the save is DURABLE (an
+        async save must be waited first, or the previous file would be
+        the one mutated)."""
+        out = []
+        for kind in STORAGE_MUTATION_KINDS:
+            s = self._take_at_or_after(kind, step)
+            if s is not None:
+                out.append(s)
+        return out
+
+    @staticmethod
+    def apply_storage_mutation(spec: FaultSpec, ckpt_dir: str) -> Optional[str]:
+        """Apply one fired post-save mutation to ``ckpt_dir``; returns
+        the mangled/removed path (None when nothing qualified)."""
+        if spec.kind == "ckpt_truncate":
+            return FaultInjector.truncate_newest(ckpt_dir)
+        if spec.kind == "bitrot":
+            return FaultInjector.bitrot_newest(ckpt_dir)
+        if spec.kind == "partial_set":
+            return FaultInjector.drop_sharded_member(ckpt_dir)
+        raise ValueError(f"{spec.kind!r} is not a storage mutation")
+
+    def write_fault(self, step: int) -> Optional[tuple]:
+        """The checkpoint writer shim hook
+        (``utils/checkpoint.set_write_fault_hook``): called by the save
+        path with the step being saved; returns ``(kind, arg)`` for a
+        due ``enospc``/``slow_write`` spec (fired once), else None. May
+        run on the async writer thread — the injector's firing state is
+        only ever advanced from one save at a time (the writer
+        serializes saves)."""
+        for kind in WRITE_FAULT_KINDS:
+            s = self._take_at_or_after(kind, step)
+            if s is not None:
+                return (kind, s.arg)
+        return None
 
     @staticmethod
     def truncate_newest(ckpt_dir: str) -> Optional[str]:
@@ -250,3 +376,37 @@ class FaultInjector:
         with open(path, "r+b") as f:
             f.truncate(max(1, size // 2))
         return path
+
+    @staticmethod
+    def bitrot_newest(ckpt_dir: str) -> Optional[str]:
+        """Flip bytes in the middle of the newest COMMITTED checkpoint
+        file (at-rest bit-rot: size and name intact, content corrupt —
+        only the CRC32 integrity chain can tell). Returns the path."""
+        from theanompi_tpu.utils.checkpoint import latest_checkpoint
+
+        path = latest_checkpoint(ckpt_dir)
+        if path is None:
+            return None
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.seek(size // 2)
+            chunk = f.read(8)
+            f.seek(size // 2)
+            f.write(bytes(b ^ 0xFF for b in chunk))
+        return path
+
+    @staticmethod
+    def drop_sharded_member(ckpt_dir: str) -> Optional[str]:
+        """Delete one member of the newest COMPLETE sharded checkpoint
+        set (a host's file lost after the save landed): the set must
+        then read as ABSENT via completeness-by-counting. Returns the
+        removed path (None when no sharded set exists)."""
+        from theanompi_tpu.utils.checkpoint import _sharded_sets
+
+        sets = _sharded_sets(ckpt_dir)
+        if not sets:
+            return None
+        files = sets[max(sets)]
+        victim = files[-1]  # the highest-proc member: deterministic
+        os.unlink(victim)
+        return victim
