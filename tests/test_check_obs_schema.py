@@ -85,6 +85,13 @@ def test_valid_records_pass():
          "violations": "parity,no_refeed", "runs": 5,
          "shrunk_schedule": "crash@5",
          "repro": "--inject-fault crash@5"},
+        # thread-stress harness (tools/analyze/stress.py)
+        {"kind": "stress", "t": 1.0, "scenario": "metrics-sink-locked",
+         "seed": 2, "rounds": 10, "ok": True, "violations": "",
+         "seconds": 0.4, "switch_interval_min": 1e-6},
+        {"kind": "stress", "t": 1.0, "scenario": "serve-param-swap",
+         "seed": 5, "rounds": 4, "ok": False,
+         "violations": "round 1 (seed 5, switch 1e-06): deadlock"},
     ]
     for rec in good:
         assert validate_record(rec) == [], rec
@@ -149,6 +156,11 @@ def test_valid_records_pass():
       "schedule": "crash@2", "ok": 1}, "is int, want bool"),
     ({"kind": "reload", "t": 1.0, "from_step": 1, "to_step": -1,
       "ok": "no"}, "is str, want bool"),
+    ({"kind": "stress", "t": 1.0, "scenario": "x", "seed": 1,
+      "rounds": 3}, "missing required field 'ok'"),
+    ({"kind": "stress", "t": 1.0, "scenario": "x", "seed": 1,
+      "rounds": 3, "ok": True, "violations": ["a"]},
+     "is list, want str"),
 ])
 def test_invalid_records_flagged(rec, frag):
     errs = validate_record(rec)

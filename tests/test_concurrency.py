@@ -1,0 +1,546 @@
+"""Host-concurrency race analyzer (tools/analyze/concurrency.py,
+ISSUE 14): thread-model discovery, the RACE001–005 rule family,
+mutation self-tests (one seeded defect per rule, each caught by its
+rule ID), and the clean-tree zero-findings gate.
+
+The defects seeded here are the exact classes the analyzer exists
+for — the classes every release so far shipped one of by hand: a
+shared counter with no lock, a sink guarded at some write sites and
+bare at others (the PR-13 metrics.jsonl lock, removed), two locks
+taken in opposite orders, exists-then-unlink racing the prune/scrubber
+threads, and a two-field publish a locked reader can see torn.
+"""
+
+import os
+import textwrap
+
+import pytest
+
+from theanompi_tpu.tools.analyze import concurrency as C
+
+FIXTURE = "/fixture/threaded.py"
+
+
+def _findings(snippet: str):
+    src = "import threading, os, queue\n" + textwrap.dedent(snippet)
+    # check_golden=False: adding a fixture file IS a thread-model
+    # change — the golden gate is exercised by its own tests below
+    return C.concurrency_findings({FIXTURE: src}, check_golden=False)
+
+
+def _rules(snippet: str):
+    return [f.rule for f in _findings(snippet)]
+
+
+# --------------------------------------------------------------------------
+# clean tree + thread model
+# --------------------------------------------------------------------------
+
+
+def test_clean_tree_has_zero_findings():
+    """The committed tree is race-lint clean — the ISSUE 14 satellite:
+    every true positive the analyzer found (metrics-sink writes bare in
+    set_traffic_model/note_reshard, unserialized scrubber passes, the
+    layout-sidecar exists-then-remove) was FIXED, not exempted."""
+    fs = C.concurrency_findings()
+    assert fs == [], [(f.rule, f.path, f.line, f.message) for f in fs]
+
+
+def test_thread_inventory_discovers_the_host_thread_model():
+    """The discovered spawn inventory covers the real thread model —
+    the same roles the watchdog's stacks.txt groups by."""
+    inv = C.thread_inventory()
+    roles = {s["role"] for s in inv}
+    targets = {s["target"] for s in inv}
+    assert "tmpi-serve-batcher" in roles
+    assert "tmpi-serve-reload" in roles
+    assert "tmpi-ckpt-scrub" in roles
+    assert "tmpi-heartbeat-r" in roles       # f-string prefix
+    assert "tmpi-stall-watchdog-r" in roles
+    assert "http" in roles                   # ThreadingHTTPServer handlers
+    assert "ServeEngine._loop" in targets
+    assert "CheckpointReloader._loop" in targets
+    assert "CheckpointScrubber._loop" in targets
+    # the AsyncCheckpointer pool submit is a thread context too
+    assert any(s["target"] == "save_checkpoint" for s in inv)
+
+
+def test_contexts_propagate_through_callbacks_and_receivers():
+    """The load-bearing propagation: the scrubber's on_result callback
+    registration puts Observability.note_scrub on the scrubber thread,
+    the reload poller's engine calls put ServeEngine.set_params on the
+    reload thread, and obs_span puts SpanRecorder.finish on the
+    prefetch producer and the checkpoint writer pool."""
+    m = C.build_model()
+
+    def ctx(cls, meth):
+        return m.classes[cls].methods[meth].contexts
+
+    assert "tmpi-ckpt-scrub" in ctx("Observability", "note_scrub")
+    assert "tmpi-serve-reload" in ctx("ServeEngine", "set_params")
+    assert "http" in ctx("ServeEngine", "submit")
+    assert "tmpi-serve-batcher" in ctx("ServeEngine", "_serve_batch")
+    assert "caller" not in ctx("ServeEngine", "_serve_batch")
+    assert "tmpi-stall-watchdog-r" in ctx("FlightRecorder", "dump")
+    spans = ctx("SpanRecorder", "finish")
+    assert "tmpi-prefetch" in spans
+    assert any("pool" in c for c in spans)
+
+
+# --------------------------------------------------------------------------
+# RACE001 — unguarded shared write
+# --------------------------------------------------------------------------
+
+RACY = """
+class Racey:
+    def __init__(self):
+        self._n = 0
+        self._thread = threading.Thread(
+            target=self._run, name="tmpi-fix", daemon=True)
+
+    def _run(self):
+        self._n += 1
+
+    def bump(self):
+        self._n += 1
+"""
+
+
+def test_race001_unguarded_shared_write():
+    fs = _findings(RACY)
+    assert [f.rule for f in fs] == ["RACE001"]
+    assert "_n" in fs[0].message and "tmpi-fix" in fs[0].message
+
+
+def test_race001_single_context_writes_are_not_flagged():
+    assert _rules("""
+    class SingleWriter:
+        def __init__(self):
+            self._n = 0
+            self._thread = threading.Thread(target=self._run, daemon=True)
+
+        def _run(self):
+            while True:
+                self._n += 1   # only the worker writes; readers are free
+
+        def value(self):
+            return self._n
+    """) == []
+
+
+def test_race001_init_writes_and_safe_types_exempt():
+    assert _rules("""
+    class Safe:
+        def __init__(self):
+            self._q = queue.Queue(4)
+            self._stop = threading.Event()
+            self._thread = threading.Thread(target=self._run, daemon=True)
+
+        def _run(self):
+            self._stop.set()          # Event: internally synchronized
+            self._q.put(1)
+
+        def close(self):
+            self._stop.set()
+    """) == []
+
+
+def test_race001_locked_both_sides_is_clean():
+    assert _rules("""
+    class Guarded:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+            self._thread = threading.Thread(target=self._run, daemon=True)
+
+        def _run(self):
+            with self._lock:
+                self._n += 1
+
+        def bump(self):
+            with self._lock:
+                self._n += 1
+    """) == []
+
+
+# --------------------------------------------------------------------------
+# RACE002 — inconsistent guarding
+# --------------------------------------------------------------------------
+
+INCONSISTENT = """
+class HalfLocked:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sink = open(os.devnull, "w")
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        with self._lock:
+            self._sink.write("a")
+
+    def emit(self):
+        self._sink.write("b")   # bare: the lock protects nothing
+"""
+
+
+def test_race002_locked_one_context_bare_in_another():
+    fs = _findings(INCONSISTENT)
+    assert [f.rule for f in fs] == ["RACE002"]
+    assert "_sink" in fs[0].message and "BARE" in fs[0].message
+
+
+def test_race002_nested_lock_holds_share_the_serializing_lock():
+    """A write under `with a: with b:` and another under `with a:`
+    shares lock a at every site — NOT 'different locks' (review
+    regression: the union comparison fired on nested holds)."""
+    assert _rules("""
+    class Nested:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+            self.x = 0
+            self._thread = threading.Thread(target=self._run, daemon=True)
+
+        def _run(self):
+            with self._a:
+                with self._b:
+                    self.x = 1
+
+        def poke(self):
+            with self._a:
+                self.x = 2
+    """) == []
+
+
+def test_race002_disjoint_locks_still_flagged():
+    assert _rules("""
+    class Disjoint:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+            self.x = 0
+            self._thread = threading.Thread(target=self._run, daemon=True)
+
+        def _run(self):
+            with self._a:
+                self.x = 1
+
+        def poke(self):
+            with self._b:
+                self.x = 2
+    """) == ["RACE002"]
+
+
+def test_race002_suppression_requires_reason(tmp_path):
+    """spmd_exempt with a written reason suppresses a RACE finding
+    through tmpi lint's shared mechanics (findings still listed under
+    suppressed)."""
+    from theanompi_tpu.tools.lint import LintReport, _add
+
+    src = ("import threading, os\n"
+           + textwrap.dedent(INCONSISTENT).replace(
+               'self._sink.write("b")   # bare: the lock protects nothing',
+               'self._sink.write("b")  # spmd_exempt: single-threaded '
+               'in this deployment'))
+    p = tmp_path / "half_locked.py"
+    p.write_text(src)
+    fs = C.concurrency_findings({str(p): src}, check_golden=False)
+    assert [f.rule for f in fs] == ["RACE002"]
+    report = LintReport()
+    _add(report, fs[0].rule, str(p), fs[0].line, fs[0].message)
+    assert report.findings == [] and len(report.suppressed) == 1
+
+
+# --------------------------------------------------------------------------
+# RACE003 — lock-order inversion
+# --------------------------------------------------------------------------
+
+
+def test_race003_lock_order_inversion():
+    rules = _rules("""
+    class TwoLocks:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+            self.x = 0
+            self.y = 0
+            self._thread = threading.Thread(target=self._run, daemon=True)
+
+        def _run(self):
+            with self._a:
+                with self._b:
+                    self.x = 1
+
+        def poke(self):
+            with self._b:
+                with self._a:
+                    self.y = 1
+    """)
+    assert "RACE003" in rules
+
+
+def test_race003_consistent_order_is_clean():
+    assert "RACE003" not in _rules("""
+    class OneOrder:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+            self.x = 0
+            self._thread = threading.Thread(target=self._run, daemon=True)
+
+        def _run(self):
+            with self._a:
+                with self._b:
+                    self.x = 1
+
+        def poke(self):
+            with self._a:
+                with self._b:
+                    self.x = 2
+    """)
+
+
+def test_race003_sees_one_call_deep():
+    rules = _rules("""
+    class NestedCall:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+            self.x = 0
+            self._thread = threading.Thread(target=self._run, daemon=True)
+
+        def _grab_b(self):
+            with self._b:
+                self.x = 1
+
+        def _run(self):
+            with self._a:
+                self._grab_b()      # a -> b through the call
+
+        def poke(self):
+            with self._b:
+                with self._a:       # b -> a directly
+                    self.x = 2
+    """)
+    assert "RACE003" in rules
+
+
+# --------------------------------------------------------------------------
+# RACE004 — filesystem TOCTOU
+# --------------------------------------------------------------------------
+
+
+def test_race004_exists_then_unlink_bare():
+    fs = _findings("""
+    def cleanup(d):
+        p = os.path.join(d, "x.npz")
+        if os.path.exists(p):
+            os.unlink(p)
+    """)
+    assert [f.rule for f in fs] == ["RACE004"]
+    assert "unlink" in fs[0].message
+
+
+def test_race004_try_guard_is_the_fix():
+    assert _rules("""
+    def cleanup(d):
+        p = os.path.join(d, "x.npz")
+        if os.path.exists(p):
+            try:
+                os.unlink(p)
+            except FileNotFoundError:
+                pass
+    """) == []
+
+
+def test_race004_else_branch_is_not_gated_by_the_check():
+    """A sink in the else/elif of an exists-check runs when the check
+    was FALSE — not a TOCTOU on it (review regression: orelse was
+    scanned as if gated)."""
+    assert _rules("""
+    def f(p):
+        if os.path.exists(p):
+            return None
+        else:
+            open(p, "w")
+
+    def g(p, q):
+        if os.path.exists(p):
+            return 1
+        elif q:
+            open(p)
+    """) == []
+
+
+def test_race004_cleanup_inside_except_handler_exempt():
+    """The _atomic_savez pattern: exists-then-unlink of a private tmp
+    inside an except handler is a cleanup of an already-failed write,
+    not a cross-thread race."""
+    assert _rules("""
+    def save(d, tmp):
+        try:
+            os.replace(tmp, d)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+    """) == []
+
+
+# --------------------------------------------------------------------------
+# RACE005 — non-atomic multi-field publish
+# --------------------------------------------------------------------------
+
+TORN = """
+class TornPublish:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.params = None
+        self.step = -1
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while True:
+            with self._lock:
+                pair = (self.params, self.step)
+
+    def publish(self, p, s):
+        self.params = p
+        self.step = s
+"""
+
+
+def test_race005_bare_pair_publish_vs_locked_reader():
+    fs = _findings(TORN)
+    assert [f.rule for f in fs] == ["RACE005"]
+    assert "params" in fs[0].message and "step" in fs[0].message
+
+
+def test_race005_locked_publish_is_clean():
+    assert _rules(TORN.replace(
+        """    def publish(self, p, s):
+        self.params = p
+        self.step = s""",
+        """    def publish(self, p, s):
+        with self._lock:
+            self.params = p
+            self.step = s""")) == []
+
+
+# --------------------------------------------------------------------------
+# mutation self-tests on the REAL tree (ISSUE 14 acceptance, static
+# half — the dynamic half is tests/test_stress.py)
+# --------------------------------------------------------------------------
+
+_OBS_PATH = [p for p in C.CONCURRENCY_FILES
+             if p.endswith(os.path.join("obs", "__init__.py"))][0]
+
+_LOCKED_SCRUB_BLOCK = '''        if self._metrics_f is not None and not self._closed:
+            with self._metrics_lock:
+                if not self._closed:
+                    self._metrics_f.write(_json.dumps(line) + "\\n")
+                    self._metrics_f.flush()'''
+
+_BARE_SCRUB_BLOCK = '''        if self._metrics_f is not None and not self._closed:
+            self._metrics_f.write(_json.dumps(line) + "\\n")
+            self._metrics_f.flush()'''
+
+
+def test_mutation_dropped_metrics_lock_caught_static():
+    """Remove the PR-13 metrics.jsonl lock from note_scrub (the exact
+    seeded defect of the ISSUE 14 acceptance): the analyzer must flag
+    the now-bare sink writes as RACE002 — _metrics_f stays locked at
+    every OTHER write site, so the inconsistency is the signal."""
+    src = open(_OBS_PATH).read()
+    assert _LOCKED_SCRUB_BLOCK in src, (
+        "note_scrub's locked sink block moved — update the mutation")
+    mutated = src.replace(_LOCKED_SCRUB_BLOCK, _BARE_SCRUB_BLOCK, 1)
+    fs = C.concurrency_findings({_OBS_PATH: mutated})
+    assert any(f.rule == "RACE002" and "_metrics_f" in f.message
+               for f in fs), [(f.rule, f.message) for f in fs]
+
+
+def test_mutation_dropped_scrubber_pass_lock_caught():
+    """Remove the scrubber's pass lock (this PR's own fix): scrub_once
+    is reachable from both the background loop and public callers, so
+    its counter/memo writes go RACE001."""
+    path = [p for p in C.CONCURRENCY_FILES
+            if p.endswith(os.path.join("utils", "checkpoint.py"))][0]
+    src = open(path).read()
+    needle = "        with self._pass_lock:\n"
+    assert needle in src
+    # drop the with and dedent its body one level (stop at the first
+    # line that falls back out of the block)
+    lines = src.splitlines(keepends=True)
+    i = lines.index(needle)
+    out = lines[:i]
+    j = i + 1
+    while j < len(lines):
+        ln = lines[j]
+        if ln.strip() == "":
+            out.append(ln)
+        elif ln.startswith("            "):
+            out.append(ln.replace("    ", "", 1))
+        else:
+            break
+        j += 1
+    out.extend(lines[j:])
+    mutated = "".join(out)
+    fs = C.concurrency_findings({path: mutated})
+    assert any(f.rule == "RACE001" and "CheckpointScrubber" in f.message
+               for f in fs), [(f.rule, f.message) for f in fs]
+
+
+def test_mutation_unnamed_serve_drain_thread_caught_by_golden():
+    """Dropping the tmpi-serve-drain name must not lose the spawn from
+    the inventory (attribution degrades, discovery must not) — and the
+    now-nameless spawn drifts the thread-model golden (RACE101), so it
+    cannot land unreviewed."""
+    path = [p for p in C.CONCURRENCY_FILES
+            if p.endswith(os.path.join("serve", "cli.py"))][0]
+    src = open(path).read()
+    assert 'name="tmpi-serve-drain", ' in src
+    mutated = src.replace('name="tmpi-serve-drain", ', "", 1)
+    m = C.build_model({path: mutated})
+    assert any("_drain_then_stop" in s.target for s in m.spawns)
+    named = [s for s in C.build_model().spawns
+             if "_drain_then_stop" in s.target]
+    assert named and named[0].named and named[0].role == "tmpi-serve-drain"
+    fs = C.concurrency_findings({path: mutated})
+    assert any(f.rule == "RACE101" for f in fs), \
+        [(f.rule, f.message) for f in fs]
+
+
+def test_thread_model_golden_matches_and_regenerates(tmp_path,
+                                                     monkeypatch):
+    """The committed golden matches the discovered model; a divergent
+    golden is RACE101; --update-golden rewrites it."""
+    import json
+
+    m = C.build_model()
+    assert C.check_thread_model_golden(m) == []
+    fake = tmp_path / "thread_model.json"
+    monkeypatch.setattr(C, "GOLDEN_THREAD_MODEL", str(fake))
+    fs = C.check_thread_model_golden(m)
+    assert [f.rule for f in fs] == ["RACE101"]          # missing
+    assert C.check_thread_model_golden(m, update=True) == []
+    assert C.check_thread_model_golden(m) == []          # regenerated
+    stored = json.loads(fake.read_text())
+    stored[0]["role"] = "renamed"
+    fake.write_text(json.dumps(stored))
+    fs = C.check_thread_model_golden(m)
+    assert [f.rule for f in fs] == ["RACE101"]
+    assert "changed" in fs[0].message
+
+
+# --------------------------------------------------------------------------
+# lint integration
+# --------------------------------------------------------------------------
+
+
+def test_lint_rules_include_race_family():
+    from theanompi_tpu.tools.lint import RULES
+
+    for rule in ("RACE001", "RACE002", "RACE003", "RACE004", "RACE005",
+                 "RACE101"):
+        assert rule in RULES

@@ -32,7 +32,7 @@ def test_full_lint_includes_analyzer_and_stays_in_budget():
     assert elapsed < 90.0, f"tmpi lint took {elapsed:.1f}s"
     assert set(report.timings_s) >= {
         "hot_loop", "codec_coverage", "schema", "spmd", "memory",
-        "precision",
+        "precision", "concurrency",
     }
     assert all(v >= 0 for v in report.timings_s.values())
     # the compiling families dominate; their time is attributed to
@@ -48,11 +48,12 @@ def test_lint_json_report_shape(capsys):
     # stable rule IDs ship with the report so CI can key on them
     assert "SPMD002" in out["rules"] and "HOT002" in out["rules"]
     assert "MEM002" in out["rules"] and "PREC003" in out["rules"]
+    assert "RACE001" in out["rules"] and "RACE005" in out["rules"]
     assert set(out["rules"]) == set(RULES)
-    # per-rule-family wall time rides the CI report (ISSUE 12
+    # per-rule-family wall time rides the CI report (ISSUE 12/14
     # satellite) so future budget regressions are attributable
     t = out["timings_s"]
-    assert {"memory", "precision", "spmd"} <= set(t)
+    assert {"memory", "precision", "spmd", "concurrency"} <= set(t)
     assert all(isinstance(v, (int, float)) for v in t.values())
 
 

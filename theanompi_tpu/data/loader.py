@@ -42,7 +42,8 @@ class PrefetchLoader:
         self._err: Optional[BaseException] = None
         self._stop = threading.Event()
         self._thread = threading.Thread(
-            target=self._run, args=(iter(batches),), daemon=True
+            target=self._run, args=(iter(batches),),
+            name="tmpi-prefetch", daemon=True,
         )
         self._thread.start()
 

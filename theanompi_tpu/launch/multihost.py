@@ -111,7 +111,7 @@ def spawn_local(
         if p.stdout is not None:
             t = threading.Thread(
                 target=lambda pid=pid, p=p: outputs.__setitem__(pid, p.stdout.read()),
-                daemon=True,
+                name=f"tmpi-mh-drain-p{pid}", daemon=True,
             )
             t.start()
             drains.append(t)

@@ -129,7 +129,10 @@ def pipeline_layout_guard(
                 _json.dump(current, f)
             os.replace(tmp, path)  # atomic: no truncated sidecar
         elif os.path.exists(path):
-            os.remove(path)  # back to the layout-invariant default
+            try:
+                os.remove(path)  # back to the layout-invariant default
+            except FileNotFoundError:
+                pass  # another run cleaning the same dir got there first
     return current
 
 

@@ -315,10 +315,14 @@ class Observability:
             import json as _json
             import time as _time
 
-            self._metrics_f.write(
-                _json.dumps({"t": _time.time(), **tm.as_record()}) + "\n"
-            )
-            self._metrics_f.flush()
+            line = _json.dumps({"t": _time.time(), **tm.as_record()})
+            # under the sink lock: the scrubber thread's kind=scrub
+            # records share this file (RACE002 — a lock only some
+            # writers take protects nothing)
+            with self._metrics_lock:
+                if not self._closed and self._metrics_f is not None:
+                    self._metrics_f.write(line + "\n")
+                    self._metrics_f.flush()
 
     def set_numerics_model(self, nm: Optional["NumericsModel"]) -> None:
         """Record the active rule's numerics declaration (engine-
@@ -510,8 +514,12 @@ class Observability:
         if per_replica_batch is not None:
             line["per_replica_batch"] = int(per_replica_batch)
         if self._metrics_f is not None and not self._closed:
-            self._metrics_f.write(_json.dumps(line) + "\n")
-            self._metrics_f.flush()
+            # same sink lock as note_scrub/snapshot: the background
+            # scrubber writes this file concurrently
+            with self._metrics_lock:
+                if not self._closed and self._metrics_f is not None:
+                    self._metrics_f.write(_json.dumps(line) + "\n")
+                    self._metrics_f.flush()
         else:
             print(f"[rank {self.rank}] elastic reshard: {line}",
                   file=sys.stderr, flush=True)

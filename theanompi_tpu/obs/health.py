@@ -94,8 +94,23 @@ def arm_profiler_capture(trace_dir: str, capture_s: float = 2.0,
 
 def thread_stacks() -> dict[str, list[str]]:
     """``{thread_name: [formatted frames...]}`` for every live Python
-    thread (the stall report payload)."""
+    thread (the stall report payload). Ordered for triage: the main
+    thread first (the driver's frame shows which dispatch blocks),
+    then the framework's stable ``tmpi-<role>`` threads sorted by role
+    so repeated dumps group attributably, then everything else — the
+    same names the thread-model inventory
+    (tools/analyze/concurrency.thread_inventory) and the stress
+    harness report."""
     names = {t.ident: t.name for t in threading.enumerate()}
+
+    def rank(item):
+        name = item[0]
+        if name.startswith("MainThread"):
+            return (0, name)
+        if name.startswith("tmpi-"):
+            return (1, name)
+        return (2, name)
+
     stacks = {}
     for ident, frame in sys._current_frames().items():
         name = names.get(ident, f"thread-{ident}")
@@ -103,7 +118,7 @@ def thread_stacks() -> dict[str, list[str]]:
             line.rstrip("\n")
             for line in traceback.format_stack(frame)
         ]
-    return stacks
+    return dict(sorted(stacks.items(), key=rank))
 
 
 class Heartbeat:

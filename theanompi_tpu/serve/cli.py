@@ -163,12 +163,17 @@ def serve_main(argv=None) -> int:
         def _graceful(signum, frame):
             # SIGTERM: flip to draining (healthz -> 503 rotates the
             # replica out), serve the queued backlog, then stop the
-            # accept loop — all off the signal handler's thread
+            # accept loop — all off the signal handler's thread.
+            # _shutdown (not a bare drain): the reloader must stop
+            # BEFORE the engine retires its sink, or a poll landing
+            # mid-drain prints past the final serve record and its
+            # reload record is silently dropped
             def _drain_then_stop():
-                engine.drain(timeout=30.0)
+                _shutdown()
                 httpd.shutdown()
 
-            threading.Thread(target=_drain_then_stop, daemon=True).start()
+            threading.Thread(target=_drain_then_stop,
+                             name="tmpi-serve-drain", daemon=True).start()
 
         signal.signal(signal.SIGTERM, _graceful)
         print(f"[serve] http on {args.host}:{httpd.server_address[1]} "

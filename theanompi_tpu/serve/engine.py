@@ -234,6 +234,7 @@ class ServeEngine:
         self._fill_sum = 0.0
         self._serve_f = None
         self._sink_lock = threading.Lock()
+        self._sink_retired = False
 
         self.registry = registry or MetricsRegistry()
         self._h_latency = self.registry.histogram(
@@ -379,13 +380,28 @@ class ServeEngine:
                 None if deadline is None else max(0.0, deadline - time.monotonic())
             )
             drained = not self._thread.is_alive()
-        if not self._stopped.is_set():
-            # one final serve record, then retire the sink (idempotent:
-            # a second drain finds _stopped set and skips both)
-            self._write_serve_record()
+        # claim the final record exactly once, under the sink lock:
+        # drain is reachable from the SIGTERM drain thread AND the
+        # CLI's finally concurrently, and a bare check-then-act here
+        # wrote the final record twice
+        with self._sink_lock:
+            first = not self._stopped.is_set()
             self._stopped.set()
+        if first and self.obs_dir is not None:
+            # compute the record outside the lock (it reads the
+            # internally-locked counters), then write-and-retire in
+            # ONE hold — a straggling reloader write can land before
+            # the final record, never after it
+            rec = self.serve_record()
             with self._sink_lock:
-                if self._serve_f is not None:
+                if not self._sink_retired:
+                    if self._serve_f is None:
+                        os.makedirs(self.obs_dir, exist_ok=True)
+                        self._serve_f = open(
+                            os.path.join(self.obs_dir, "serve.jsonl"), "a"
+                        )
+                    self._serve_f.write(json.dumps(rec) + "\n")
+                    self._sink_retired = True
                     self._serve_f.close()
                     self._serve_f = None
         return drained
@@ -558,7 +574,7 @@ class ServeEngine:
         if self.obs_dir is None:
             return
         with self._sink_lock:
-            if self._stopped.is_set() and self._serve_f is None:
+            if self._sink_retired:
                 return
             if self._serve_f is None:
                 os.makedirs(self.obs_dir, exist_ok=True)

@@ -74,6 +74,9 @@ class CheckpointReloader:
         self.ckpt_dir = ckpt_dir
         self.interval = float(interval)
         self._stop = threading.Event()
+        # stop() is reachable from the SIGTERM drain thread and the
+        # CLI's finally concurrently — the handoff must be atomic
+        self._stop_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
 
     def poll_once(self) -> Optional[int]:
@@ -109,12 +112,13 @@ class CheckpointReloader:
 
     # -- background polling -------------------------------------------------
     def start(self) -> None:
-        if self._thread is not None:
-            raise RuntimeError("reloader already started")
-        self._thread = threading.Thread(
-            target=self._loop, name="tmpi-serve-reload", daemon=True
-        )
-        self._thread.start()
+        with self._stop_lock:
+            if self._thread is not None:
+                raise RuntimeError("reloader already started")
+            self._thread = threading.Thread(
+                target=self._loop, name="tmpi-serve-reload", daemon=True
+            )
+            self._thread.start()
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval):
@@ -126,6 +130,7 @@ class CheckpointReloader:
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=10.0)
-            self._thread = None
+        with self._stop_lock:
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10.0)

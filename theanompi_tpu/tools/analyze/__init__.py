@@ -33,7 +33,16 @@ interpretation rather than execution:
   rank-divergence taint lint and the use-after-donation alias lint
   over the launch/checkpoint sources;
 - :mod:`~theanompi_tpu.tools.analyze.golden` stores the per-engine
-  signature snapshots (``tmpi lint --update-golden`` regenerates).
+  signature snapshots (``tmpi lint --update-golden`` regenerates);
+- :mod:`~theanompi_tpu.tools.analyze.concurrency` is the HOST-side
+  concurrency half (ISSUE 14): thread-model discovery over the
+  dispatcher/checkpointer/scrubber/serve/health sources, the shared-
+  mutable-state + lock-discipline computation, and the RACE001–005
+  rule family plus the RACE101 thread-model golden;
+- :mod:`~theanompi_tpu.tools.analyze.stress` is its dynamic twin: the
+  deterministic seeded thread-stress harness the tier-1 stress tests
+  drive (switch-interval shrinking, barrier-released threads,
+  injectable delay hooks, ``kind=stress`` records).
 
 Everything surfaces through ``tmpi lint`` (tools/lint.py) with stable
 rule IDs and per-line ``spmd_exempt: <reason>`` suppressions; rule

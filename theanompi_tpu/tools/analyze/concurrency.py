@@ -1,0 +1,1235 @@
+"""Host-concurrency race analyzer: the RACE rule family.
+
+The host side of a run is now genuinely concurrent — MetricsDispatcher
+drains, the AsyncCheckpointer writer, the CheckpointScrubber, the serve
+batcher (`_loop`), the CheckpointReloader poller, heartbeat + stall
+watchdog, ThreadingHTTPServer handler threads, the prefetch producer —
+coordinated by ad-hoc ``threading.Lock``s. Every release so far shipped
+a hand-found race in exactly this layer (scrubber-vs-prune unlink,
+metrics.jsonl writer vs scrubber, serve reloader TOCTOU). Theano-MPI's
+own async exchanger/monitor split bred the same class of bug; finding
+them one post-mortem at a time does not scale to a production serving
+fleet. This pass finds them from the AST, before they run.
+
+**Thread-model discovery.** Over :data:`CONCURRENCY_FILES` the pass
+maps every thread spawn to the code that runs on it:
+
+- ``threading.Thread(target=self.m, name="tmpi-<role>")`` /
+  ``threading.Timer`` → method ``m`` (and everything it reaches
+  through ``self`` calls) executes in context ``<role>``;
+- ``self._pool.submit(f, ...)`` on a ``ThreadPoolExecutor`` attr →
+  ``f`` runs on the pool thread;
+- classes derived from ``BaseHTTPRequestHandler`` → every handler
+  method runs on a per-request server thread (context ``http``);
+- module/local functions used as thread targets (the serve CLI's
+  drain thread, the profiler-capture closure) get their own context;
+- **callback propagation**: a callable ATTRIBUTE invoked from a
+  thread context (``self.on_result(...)`` in the scrubber loop) marks
+  the parameter that stored it as thread-borne; every registration
+  site (``CheckpointScrubber(..., on_result=obs.note_scrub)``) then
+  pulls the registered method into that thread's context. Method
+  calls on other objects (``self.engine.set_params(...)`` from the
+  reload poller) propagate by constructor-typed locals where
+  available, falling back to unique-method-name matching.
+
+Public methods additionally carry the ``caller`` context (the driver /
+test / HTTP-frontend thread that owns the object). A method reachable
+from a thread entry AND publicly callable therefore runs in ≥2
+contexts — the definition of shared.
+
+**Rules.** ``self``-attribute state written from ≥2 contexts (plain
+assignment, subscript stores, or mutating calls like ``.write()`` /
+``.append()`` — attributes holding ``Event``/``Queue``/locks/registry
+metrics are internally synchronized and exempt; ``__init__`` writes
+precede any thread and are exempt):
+
+======== ===============================================================
+RACE001  shared attribute written with NO lock anywhere
+RACE002  inconsistent guarding: locked at some write sites, bare (or
+         under a DIFFERENT lock) at others — the lock protects nothing
+RACE003  lock-order inversion: lock B acquired under A at one site,
+         A under B at another (potential deadlock), same-class locks,
+         one ``self``-call deep
+RACE004  filesystem TOCTOU: ``os.path.exists``/``stat`` gating an
+         ``open``/``unlink``/``replace`` on the same path with no
+         OSError guard — racing the prune/scrubber/reload threads
+         that mutate checkpoint and obs directories underneath
+RACE005  non-atomic multi-field publish: one method writes ≥2 plain
+         attributes bare while another context reads them together
+         under a lock — the reader's lock cannot give it a coherent
+         pair the writer never published atomically
+======== ===============================================================
+
+All findings honor the shared per-line ``spmd_exempt: <reason>``
+suppression (tools/lint.py). The model itself is exposed via
+:func:`thread_inventory` — the stress harness
+(tools/analyze/stress.py) and the README thread-model table consume
+it, and the watchdog's ``stacks.txt`` grouping mirrors its
+``tmpi-<role>`` names.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+from theanompi_tpu.tools.analyze.astlint import AstFinding
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# every file that spawns, or runs on, a background thread — the host
+# concurrency surface (module docstring)
+CONCURRENCY_FILES = tuple(
+    os.path.join(_PKG_ROOT, *parts) for parts in (
+        ("obs", "__init__.py"),
+        ("obs", "health.py"),
+        ("obs", "flight.py"),
+        ("obs", "metrics.py"),
+        ("obs", "spans.py"),
+        ("serve", "engine.py"),
+        ("serve", "reload.py"),
+        ("serve", "frontend.py"),
+        ("serve", "cli.py"),
+        ("utils", "checkpoint.py"),
+        ("utils", "dispatch.py"),
+        ("data", "loader.py"),
+        ("launch", "worker.py"),
+        ("launch", "supervisor.py"),
+        ("launch", "multihost.py"),
+        # launch/session.py is deliberately absent: its blocking=False
+        # thread RUNS the driver (run_training executes on it
+        # exclusively; wait() joins it) — it replaces the caller
+        # context rather than racing it, and including it would smear a
+        # phantom second context over the entire driver call tree
+    )
+)
+
+# attribute initializers that make an attribute internally synchronized
+# (mutating calls on them are not unguarded shared writes)
+_SAFE_CTORS = {
+    "Event", "Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
+    "ThreadPoolExecutor", "local", "Barrier",
+}
+# lock-like initializers: `with self.<attr>:` regions count as guarded
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+# registry-created metric families lock internally (obs/metrics.py)
+_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
+
+# mutating method names: a call `self.attr.<name>(...)` writes `attr`
+_MUTATORS = {
+    "write", "writelines", "flush", "close", "append", "appendleft",
+    "extend", "extendleft", "insert", "pop", "popleft", "remove",
+    "discard", "add", "clear", "update", "setdefault", "sort",
+    "reverse", "truncate",
+}
+
+# names that are method calls on OTHER objects too generic to resolve
+# by name alone (a thread-context `t.start()` must not smear its
+# context over every class defining `start`)
+_GENERIC_NAMES = {
+    "start", "stop", "run", "join", "close", "wait", "get", "put",
+    "set", "clear", "read", "write", "flush", "append", "pop", "send",
+    "submit_stub", "items", "keys", "values", "update", "result",
+    "shutdown", "cancel", "acquire", "release", "notify", "notify_all",
+    "is_set", "is_alive", "poll", "kill", "terminate",
+}
+
+_EXISTS_FUNCS = {"exists", "isfile", "getsize", "stat", "lstat"}
+_TOCTOU_SINKS = {"open", "load", "unlink", "remove", "replace",
+                 "rename", "getsize", "stat"}
+
+_CALLER = "caller"
+
+
+def _term(func: ast.expr) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _self_attr(node: ast.expr) -> Optional[str]:
+    """``x`` for a single-level ``self.x``, else None."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _name_literal(call: ast.Call) -> Optional[str]:
+    """The thread's ``name=`` kwarg as best-effort text (constant, or
+    the constant prefix of an f-string like ``f"tmpi-hb-r{rank}"``)."""
+    val = _kwarg(call, "name")
+    if isinstance(val, ast.Constant) and isinstance(val.value, str):
+        return val.value
+    if isinstance(val, ast.JoinedStr):
+        parts = []
+        for v in val.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                break
+        if parts:
+            return "".join(parts)
+    return None
+
+
+@dataclass(eq=False)  # identity hash: FuncInfos key dicts/sets
+class FuncInfo:
+    """One analyzable function body: a method, module function, or a
+    local def / lambda used as a thread target or callback."""
+
+    name: str
+    qualname: str
+    path: str
+    node: ast.AST  # FunctionDef / AsyncFunctionDef / Lambda
+    cls: Optional["ClassInfo"] = None
+    contexts: set = field(default_factory=set)
+
+    @property
+    def line(self) -> int:
+        return getattr(self.node, "lineno", 0)
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    path: str
+    node: ast.ClassDef
+    methods: dict = field(default_factory=dict)       # name -> FuncInfo
+    lock_attrs: set = field(default_factory=set)
+    safe_attrs: set = field(default_factory=set)
+    # thread-entry method name -> role label
+    entries: dict = field(default_factory=dict)
+    is_http_handler: bool = False
+    # callback attr -> set of contexts it is invoked from
+    callback_ctx: dict = field(default_factory=dict)
+    # param name -> attr name it is stored into (across methods)
+    param_stores: dict = field(default_factory=dict)
+
+
+@dataclass
+class ThreadSpawn:
+    """One discovered thread spawn site (the thread-model inventory)."""
+
+    role: str
+    path: str
+    line: int
+    target: str   # qualified target description
+    named: bool   # carries an explicit tmpi-<role> name= kwarg
+
+
+class _Model:
+    """The parsed multi-file concurrency model."""
+
+    def __init__(self, sources: dict):
+        self.sources = sources
+        self.trees: dict = {}
+        self.classes: dict = {}          # name -> ClassInfo (last wins)
+        self.module_funcs: dict = {}     # name -> FuncInfo
+        self.funcs: list = []            # every FuncInfo
+        self.spawns: list = []           # ThreadSpawn inventory
+        self.parents: dict = {}
+        # ast node (FunctionDef/Lambda) -> FuncInfo for local targets
+        self.local_funcs: dict = {}
+        for path, src in sources.items():
+            tree = ast.parse(src)
+            self.trees[path] = tree
+            for node in ast.walk(tree):
+                for child in ast.iter_child_nodes(node):
+                    self.parents[child] = node
+        self._collect()
+        self._find_threads()
+        self._propagate()
+
+    # -- structure ----------------------------------------------------------
+    def _collect(self) -> None:
+        for path, tree in self.trees.items():
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ClassDef):
+                    ci = ClassInfo(name=node.name, path=path, node=node)
+                    for b in node.bases:
+                        if _term(b) == "BaseHTTPRequestHandler":
+                            ci.is_http_handler = True
+                    for item in node.body:
+                        if isinstance(item, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+                            fi = FuncInfo(
+                                name=item.name,
+                                qualname=f"{node.name}.{item.name}",
+                                path=path, node=item, cls=ci,
+                            )
+                            ci.methods[item.name] = fi
+                            self.funcs.append(fi)
+                    self._classify_attrs(ci)
+                    self.classes[node.name] = ci
+            for item in tree.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fi = FuncInfo(name=item.name, qualname=item.name,
+                                  path=path, node=item)
+                    self.module_funcs[item.name] = fi
+                    self.funcs.append(fi)
+        # single-inheritance merge: a subclass shares its base's locks
+        # and synchronized attrs (Counter._series is guarded by the
+        # _Metric base lock) — iterate to cover chains
+        for _ in range(4):
+            changed = False
+            for ci in self.classes.values():
+                for b in ci.node.bases:
+                    base = self.classes.get(_term(b))
+                    if base is None:
+                        continue
+                    if not (base.lock_attrs <= ci.lock_attrs and
+                            base.safe_attrs <= ci.safe_attrs):
+                        ci.lock_attrs |= base.lock_attrs
+                        ci.safe_attrs |= base.safe_attrs
+                        changed = True
+                    if base.is_http_handler and not ci.is_http_handler:
+                        ci.is_http_handler = True
+                        changed = True
+            if not changed:
+                break
+
+    def _classify_attrs(self, ci: ClassInfo) -> None:
+        """Lock attrs / internally-synchronized attrs from every
+        ``self.x = <ctor>()`` in the class body."""
+        assigned: dict = {}
+        for node in ast.walk(ci.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                attr = _self_attr(node.targets[0])
+                if attr is None:
+                    continue
+                kind = None
+                v = node.value
+                if isinstance(v, ast.Call):
+                    name = _term(v.func)
+                    if name in _LOCK_CTORS:
+                        kind = "lock"
+                    elif name in _SAFE_CTORS or name in _METRIC_FACTORIES:
+                        kind = "safe"
+                assigned.setdefault(attr, set()).add(kind)
+        for attr, kinds in assigned.items():
+            if kinds == {"lock"}:
+                ci.lock_attrs.add(attr)
+            elif kinds <= {"lock", "safe"}:
+                if "safe" in kinds:
+                    ci.safe_attrs.add(attr)
+
+    # -- thread spawns ------------------------------------------------------
+    def _enclosing_func(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            cur = self.parents.get(cur)
+        return cur
+
+    def _enclosing_class(self, node: ast.AST) -> Optional[str]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur.name
+            cur = self.parents.get(cur)
+        return None
+
+    def _local_def(self, scope: ast.AST, name: str) -> Optional[ast.AST]:
+        """A FunctionDef named ``name`` defined inside ``scope``
+        (memoized per scope — the fixpoint hits this hot)."""
+        cache = getattr(self, "_local_def_cache", None)
+        if cache is None:
+            cache = self._local_def_cache = {}
+        defs = cache.get(id(scope))
+        if defs is None:
+            defs = {}
+            for sub in ast.walk(scope):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    defs.setdefault(sub.name, sub)
+            cache[id(scope)] = defs
+        return defs.get(name)
+
+    def _register_local_target(self, path: str, fn_node: ast.AST,
+                               role: str) -> FuncInfo:
+        fi = self.local_funcs.get(fn_node)
+        if fi is None:
+            name = getattr(fn_node, "name", "<lambda>")
+            fi = FuncInfo(name=name, qualname=f"{role}:{name}",
+                          path=path, node=fn_node)
+            self.local_funcs[fn_node] = fi
+            self.funcs.append(fi)
+        fi.contexts.add(role)
+        return fi
+
+    def _find_threads(self) -> None:
+        for path, tree in self.trees.items():
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = _term(node.func)
+                if callee in ("Thread", "Timer"):
+                    target = _kwarg(node, "target")
+                    if target is None and callee == "Timer" \
+                            and len(node.args) >= 2:
+                        target = node.args[1]
+                    if target is None and node.args:
+                        target = node.args[0]
+                    self._spawn(path, node, target)
+                elif callee == "submit" and isinstance(
+                        node.func, ast.Attribute) and node.args:
+                    # pool.submit(f, ...): a ThreadPoolExecutor attr
+                    pool = _self_attr(node.func.value)
+                    cls = self._enclosing_class(node)
+                    ci = self.classes.get(cls) if cls else None
+                    if ci is not None and pool in ci.safe_attrs:
+                        self._spawn(path, node, node.args[0],
+                                    role_hint=f"{cls}-pool")
+        for ci in self.classes.values():
+            if ci.is_http_handler:
+                for m in ci.methods.values():
+                    m.contexts.add("http")
+                self.spawns.append(ThreadSpawn(
+                    role="http", path=ci.path, line=ci.node.lineno,
+                    target=f"{ci.name} (per-request server thread)",
+                    named=False))
+
+    def _spawn(self, path: str, call: ast.Call, target: Optional[ast.expr],
+               role_hint: Optional[str] = None) -> None:
+        if target is None:
+            return
+        name = _name_literal(call)
+        cls = self._enclosing_class(call)
+        attr = _self_attr(target) if isinstance(target, ast.Attribute) \
+            else None
+        role = name or role_hint or "thread"
+        role = role.rstrip("-")
+        if attr and cls and attr in self.classes.get(
+                cls, ClassInfo("", "", None)).methods:
+            ci = self.classes[cls]
+            role = name or role_hint or f"{cls}.{attr}"
+            ci.entries[attr] = role
+            ci.methods[attr].contexts.add(role)
+            self.spawns.append(ThreadSpawn(
+                role=role, path=path, line=call.lineno,
+                target=f"{cls}.{attr}", named=bool(name)))
+            return
+        if isinstance(target, ast.Name):
+            scope = self._enclosing_func(call)
+            # a local function target (the serve CLI drain thread, the
+            # profiler capture closure) — or a local alias of module
+            # functions (`save_fn = save_checkpoint`)
+            fn_node = self._local_def(scope, target.id) if scope else None
+            if fn_node is None and scope is not None:
+                for mf in self._alias_module_funcs(scope, target.id):
+                    mf.contexts.add(role if name else
+                                    (role_hint or f"{mf.name}-thread"))
+                    self.spawns.append(ThreadSpawn(
+                        role=role_hint or role, path=path,
+                        line=call.lineno, target=mf.qualname,
+                        named=bool(name)))
+                return
+            if fn_node is None and target.id in self.module_funcs:
+                fn_node = self.module_funcs[target.id].node
+            if fn_node is not None:
+                role = name or role_hint or f"{target.id}-thread"
+                fi = self._register_local_target(path, fn_node, role)
+                self.spawns.append(ThreadSpawn(
+                    role=role, path=path, line=call.lineno,
+                    target=fi.qualname, named=bool(name)))
+        elif isinstance(target, ast.Lambda):
+            role = name or role_hint or "lambda-thread"
+            self._register_local_target(path, target, role)
+            self.spawns.append(ThreadSpawn(
+                role=role, path=path, line=call.lineno,
+                target="<lambda>", named=bool(name)))
+
+    def _alias_module_funcs(self, scope: ast.AST, name: str) -> list:
+        """Module functions a local name may alias (simple assignments,
+        incl. conditional expressions)."""
+        out = []
+        for sub in ast.walk(scope):
+            if isinstance(sub, ast.Assign):
+                if any(isinstance(t, ast.Name) and t.id == name
+                       for t in sub.targets):
+                    for n in ast.walk(sub.value):
+                        if isinstance(n, ast.Name) and \
+                                n.id in self.module_funcs:
+                            out.append(self.module_funcs[n.id])
+        return out
+
+    # -- context propagation ------------------------------------------------
+    def _ctor_types(self, scope: ast.AST) -> dict:
+        """Local name -> class name for ``x = ClassName(...)`` bindings
+        in ``scope`` (constructor-typed locals). Memoized per scope."""
+        cache = getattr(self, "_ctor_cache", None)
+        if cache is None:
+            cache = self._ctor_cache = {}
+        hit = cache.get(id(scope))
+        if hit is not None:
+            return hit
+        types: dict = {}
+        cache[id(scope)] = types
+        for sub in ast.walk(scope):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 and \
+                    isinstance(sub.targets[0], ast.Name) and \
+                    isinstance(sub.value, ast.Call):
+                cname = _term(sub.value.func)
+                if cname in self.classes:
+                    types[sub.targets[0].id] = cname
+        return types
+
+    def _resolve_method(self, recv: ast.expr, mname: str,
+                        types: dict) -> list:
+        """FuncInfos a call ``recv.mname(...)`` may dispatch to."""
+        if isinstance(recv, ast.Name) and recv.id in types:
+            ci = self.classes[types[recv.id]]
+            m = ci.methods.get(mname)
+            return [m] if m is not None else []
+        if mname in _GENERIC_NAMES or mname in _MUTATORS:
+            # container-mutation names (`x.add`, `x.discard`) collide
+            # with real methods (Gauge.add) — never name-resolve them
+            return []
+        hits = [ci.methods[mname] for ci in self.classes.values()
+                if mname in ci.methods]
+        return hits if len(hits) == 1 else []
+
+    def _scope_chain(self, node: ast.AST) -> list:
+        """The function node plus its enclosing function scopes —
+        closures see their parents' locals (the serve CLI's
+        ``_drain_then_stop`` calls its sibling ``_shutdown`` and uses
+        ``engine``/``reloader`` bound in ``serve_main``)."""
+        chain = [node]
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                chain.append(cur)
+            cur = self.parents.get(cur)
+        return chain
+
+    def _callees(self, fi: FuncInfo) -> list:
+        """(callee FuncInfo, via_callback) edges out of one function.
+        Memoized: the edge set is static across fixpoint iterations
+        (only context SETS change)."""
+        cache = getattr(self, "_callee_cache", None)
+        if cache is None:
+            cache = self._callee_cache = {}
+        hit = cache.get(fi)
+        if hit is not None:
+            return hit
+        out = []
+        cache[fi] = out
+        body = fi.node
+        chain = self._scope_chain(body)
+        types: dict = {}
+        for scope in reversed(chain):  # innermost bindings win
+            types.update(self._ctor_types(scope))
+        # simple local aliases: p = self._x  (callback alias pattern)
+        self_aliases: dict = {}
+        if fi.cls is not None:
+            for sub in ast.walk(body):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                        and isinstance(sub.targets[0], ast.Name):
+                    attr = _self_attr(sub.value)
+                    if attr is not None:
+                        self_aliases[sub.targets[0].id] = attr
+        for sub in ast.walk(body):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            if isinstance(f, ast.Name):
+                # local def (own body or an enclosing closure scope),
+                # alias-of-self-attr callback, or module fn
+                local = None
+                for scope in chain:
+                    local = self._local_def(scope, f.id)
+                    if local is not None:
+                        break
+                if local is not None and local is not body:
+                    lf = self.local_funcs.get(local)
+                    if lf is None:
+                        lf = FuncInfo(name=f.id,
+                                      qualname=f"{fi.qualname}.{f.id}",
+                                      path=fi.path, node=local, cls=fi.cls)
+                        self.local_funcs[local] = lf
+                        self.funcs.append(lf)
+                    out.append(lf)
+                elif f.id in self_aliases and fi.cls is not None:
+                    out.append(("callback", fi.cls, self_aliases[f.id]))
+                elif f.id in self.module_funcs:
+                    out.append(self.module_funcs[f.id])
+            elif isinstance(f, ast.Attribute):
+                attr = _self_attr(f)
+                if attr is not None and fi.cls is not None:
+                    m = fi.cls.methods.get(attr)
+                    if m is not None:
+                        out.append(m)
+                    elif attr not in fi.cls.lock_attrs and \
+                            attr not in fi.cls.safe_attrs:
+                        out.append(("callback", fi.cls, attr))
+                elif isinstance(f.value, ast.Name) and \
+                        f.value.id == "self":
+                    pass
+                else:
+                    out.extend(self._resolve_method(f.value, f.attr, types))
+        return out
+
+    def _param_stores(self) -> None:
+        """``self.X = <param>`` stores: which constructor/setter param
+        lands in which attribute (callback registration resolution)."""
+        for ci in self.classes.values():
+            for m in ci.methods.values():
+                params = {a.arg for a in m.node.args.args} | \
+                         {a.arg for a in m.node.args.kwonlyargs}
+                for sub in ast.walk(m.node):
+                    if isinstance(sub, ast.Assign) and \
+                            len(sub.targets) == 1:
+                        attr = _self_attr(sub.targets[0])
+                        if attr is None:
+                            continue
+                        v = sub.value
+                        if isinstance(v, ast.Name) and v.id in params:
+                            ci.param_stores.setdefault(
+                                (m.name, v.id), set()).add(attr)
+                        # `x = y or default` / conditional stores
+                        elif isinstance(v, (ast.BoolOp, ast.IfExp)):
+                            for n in ast.walk(v):
+                                if isinstance(n, ast.Name) and \
+                                        n.id in params:
+                                    ci.param_stores.setdefault(
+                                        (m.name, n.id), set()).add(attr)
+
+    def _registration_sites(self) -> list:
+        """Every call that may store a callable into a class attribute:
+        ``(class, attr, value expr, enclosing scope, path)``."""
+        sites = []
+        for path, tree in self.trees.items():
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                cname = _term(node.func)
+                target_cls = None
+                via_method = None
+                if cname in self.classes and isinstance(
+                        node.func, (ast.Name, ast.Attribute)):
+                    target_cls = self.classes[cname]
+                    via_method = "__init__"
+                elif isinstance(node.func, ast.Attribute):
+                    # obj.setter(cb): resolve the setter by unique name
+                    scope = self._enclosing_func(node)
+                    types = self._ctor_types(scope) if scope else {}
+                    hits = self._resolve_method(
+                        node.func.value, node.func.attr, types)
+                    if len(hits) == 1 and hits[0].cls is not None:
+                        target_cls = hits[0].cls
+                        via_method = hits[0].name
+                if target_cls is None or via_method is None or \
+                        via_method not in target_cls.methods:
+                    continue
+                sig = target_cls.methods[via_method].node.args
+                pos_params = [a.arg for a in sig.args][1:]  # skip self
+                bound = []
+                for i, a in enumerate(node.args):
+                    if i < len(pos_params):
+                        bound.append((pos_params[i], a))
+                for kw in node.keywords:
+                    if kw.arg is not None:
+                        bound.append((kw.arg, kw.value))
+                for pname, val in bound:
+                    attrs = target_cls.param_stores.get(
+                        (via_method, pname))
+                    if attrs:
+                        for attr in attrs:
+                            sites.append((target_cls, attr, val,
+                                          self._enclosing_func(node), path))
+        return sites
+
+    def _propagate(self) -> None:
+        self._param_stores()
+        # seed: caller context on public methods and module functions.
+        # HTTP handler methods are invoked only by the server machinery
+        # on per-request threads — no caller context
+        for fi in self.funcs:
+            if fi.cls is not None and fi.cls.is_http_handler:
+                continue
+            if not fi.name.startswith("_") and fi.name != "__init__":
+                fi.contexts.add(_CALLER)
+        sites = self._registration_sites()
+        for _ in range(12):
+            changed = False
+            for fi in list(self.funcs):
+                if not fi.contexts:
+                    continue
+                src = set(fi.contexts)
+                for edge in self._callees(fi):
+                    if isinstance(edge, tuple):  # callback invocation
+                        _, ci, attr = edge
+                        cur = ci.callback_ctx.setdefault(attr, set())
+                        if not src <= cur:
+                            cur |= src
+                            changed = True
+                        continue
+                    if edge.name == "__init__":
+                        continue
+                    if not src <= edge.contexts:
+                        edge.contexts |= src
+                        changed = True
+            # registered callbacks inherit the contexts their storing
+            # attribute is invoked from
+            for ci_target, attr, val, scope, path in sites:
+                ctxs = ci_target.callback_ctx.get(attr) or set()
+                ctxs = ctxs - {_CALLER}
+                if not ctxs:
+                    continue
+                marks = []
+                if isinstance(val, ast.Attribute):
+                    types = self._ctor_types(scope) if scope else {}
+                    marks = self._resolve_method(val.value, val.attr, types)
+                elif isinstance(val, ast.Name) and scope is not None:
+                    local = self._local_def(scope, val.id)
+                    if local is not None:
+                        lf = self.local_funcs.get(local)
+                        if lf is None:
+                            lf = FuncInfo(
+                                name=val.id, qualname=f"cb:{val.id}",
+                                path=path, node=local)
+                            self.local_funcs[local] = lf
+                            self.funcs.append(lf)
+                        marks = [lf]
+                    elif val.id in self.module_funcs:
+                        marks = [self.module_funcs[val.id]]
+                elif isinstance(val, ast.Lambda):
+                    lf = self.local_funcs.get(val)
+                    if lf is None:
+                        lf = FuncInfo(name="<lambda>", qualname="cb:<lambda>",
+                                      path=path, node=val)
+                        self.local_funcs[val] = lf
+                        self.funcs.append(lf)
+                    marks = [lf]
+                for m in marks:
+                    if not ctxs <= m.contexts:
+                        m.contexts |= ctxs
+                        changed = True
+            if not changed:
+                break
+
+
+# --------------------------------------------------------------------------
+# write/read/lock extraction
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class _Access:
+    attr: str
+    func: FuncInfo
+    line: int
+    locks: frozenset
+    kind: str  # "assign" | "mutate" | "read"
+
+
+def _method_accesses(fi: FuncInfo) -> list:
+    """Every self-attribute access in one method, annotated with the
+    lock set held at that statement (enclosing ``with self.<lock>:``
+    blocks)."""
+    ci = fi.cls
+    if ci is None or fi.name in ("__init__", "__post_init__"):
+        return []
+    out: list = []
+
+    def walk(node: ast.AST, locks: frozenset) -> None:
+        if isinstance(node, ast.With):
+            acquired = set()
+            for item in node.items:
+                attr = _self_attr(item.context_expr)
+                if attr in ci.lock_attrs:
+                    acquired.add(attr)
+                elif isinstance(item.context_expr, ast.Call):
+                    # cond.wait-style or lock factory calls are not
+                    # acquisitions of a tracked class lock
+                    pass
+            inner = locks | frozenset(acquired)
+            for item in node.items:
+                walk(item.context_expr, locks)
+            for stmt in node.body:
+                walk(stmt, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not fi.node:
+            return  # nested defs execute in their own (callback) context
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is None and isinstance(t, ast.Subscript):
+                    attr = _self_attr(t.value)
+                if attr is None and isinstance(t, ast.Tuple):
+                    for el in t.elts:
+                        a = _self_attr(el)
+                        if a is not None:
+                            out.append(_Access(a, fi, node.lineno,
+                                               locks, "assign"))
+                if attr is not None:
+                    out.append(_Access(attr, fi, node.lineno, locks,
+                                       "assign"))
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                attr = _self_attr(t) or (
+                    _self_attr(t.value) if isinstance(t, ast.Subscript)
+                    else None)
+                if attr is not None:
+                    out.append(_Access(attr, fi, node.lineno, locks,
+                                       "assign"))
+        if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute):
+            attr = _self_attr(node.func.value)
+            if attr is not None and node.func.attr in _MUTATORS:
+                out.append(_Access(attr, fi, node.lineno, locks, "mutate"))
+        if isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, ast.Load):
+            attr = _self_attr(node)
+            if attr is not None:
+                out.append(_Access(attr, fi, node.lineno, locks, "read"))
+        for child in ast.iter_child_nodes(node):
+            walk(child, locks)
+
+    for stmt in fi.node.body:
+        walk(stmt, frozenset())
+    return out
+
+
+def _class_accesses(ci: ClassInfo) -> dict:
+    """Per-attribute access lists for one class. Cached on the class:
+    both the shared-write and publish rules consume it, and the
+    extraction walks every method body."""
+    cached = getattr(ci, "_access_cache", None)
+    if cached is not None:
+        return cached
+    by_attr: dict = {}
+    for m in ci.methods.values():
+        for acc in _method_accesses(m):
+            if acc.attr in ci.lock_attrs or acc.attr in ci.safe_attrs:
+                continue
+            by_attr.setdefault(acc.attr, []).append(acc)
+    ci._access_cache = by_attr
+    return by_attr
+
+
+# --------------------------------------------------------------------------
+# rules
+# --------------------------------------------------------------------------
+
+
+def _contexts(accs: list) -> set:
+    out: set = set()
+    for a in accs:
+        out |= a.func.contexts
+    return out
+
+
+def _race_shared_writes(ci: ClassInfo) -> list:
+    """RACE001 (unguarded shared write) + RACE002 (inconsistent
+    guarding) over one class."""
+    findings = []
+    for attr, accs in sorted(_class_accesses(ci).items()):
+        writes = [a for a in accs if a.kind != "read"]
+        if not writes:
+            continue
+        ctxs = _contexts(writes)
+        if len(ctxs) < 2:
+            continue
+        locked = [a for a in writes if a.locks]
+        bare = [a for a in writes if not a.locks]
+        roles = ", ".join(sorted(ctxs))
+        if not locked:
+            a = bare[0]
+            findings.append(AstFinding(
+                rule="RACE001", path=ci.path, line=a.line,
+                message=(
+                    f"'{ci.name}.{attr}' is written from {len(ctxs)} "
+                    f"thread contexts ({roles}) with no lock anywhere "
+                    f"— writes at lines "
+                    f"{sorted({w.line for w in writes})}; guard every "
+                    "write with one lock (or spmd_exempt with the "
+                    "single-writer argument)"
+                ),
+            ))
+            continue
+        lock_names = {ln for a in locked for ln in a.locks}
+        for a in bare:
+            findings.append(AstFinding(
+                rule="RACE002", path=ci.path, line=a.line,
+                message=(
+                    f"'{ci.name}.{attr}' is guarded by "
+                    f"{sorted(lock_names)} at "
+                    f"{sorted({w.line for w in locked})} but written "
+                    f"BARE here while reachable from {len(ctxs)} thread "
+                    f"contexts ({roles}) — a lock that only some "
+                    "writers take protects nothing; take the same lock "
+                    "here (or spmd_exempt with why this site cannot "
+                    "race)"
+                ),
+            ))
+        if not bare:
+            # DIFFERENT locks only when no single lock is held at
+            # EVERY write site — nested holds (a,b here, a alone
+            # there) still share the serializing lock
+            common = frozenset.intersection(*(a.locks for a in locked))
+            if not common:
+                per_lock: dict = {}
+                for a in locked:
+                    for ln in a.locks:
+                        per_lock.setdefault(ln, []).append(a.line)
+                a = locked[0]
+                findings.append(AstFinding(
+                    rule="RACE002", path=ci.path, line=a.line,
+                    message=(
+                        f"'{ci.name}.{attr}' is written under "
+                        f"DIFFERENT locks "
+                        f"({ {k: sorted(v) for k, v in per_lock.items()} }) "
+                        f"from {len(ctxs)} contexts ({roles}) — no one "
+                        "lock covers every write, so two locks "
+                        "serialize nothing against each other; pick one"
+                    ),
+                ))
+    return findings
+
+
+def _race_lock_order(ci: ClassInfo) -> list:
+    """RACE003: same-class lock-order inversion, one self-call deep."""
+    # direct acquisition orders: lock B taken while A held
+    direct: dict = {}  # method name -> set of (held, acquired, line)
+    acquires: dict = {}  # method name -> set of locks acquired anywhere
+    callsites: dict = {}  # method -> [(callee, locks held, line)]
+
+    for m in ci.methods.values():
+        edges = set()
+        owned = set()
+        calls = []
+
+        def walk(node, locks, m=m, edges=edges, owned=owned, calls=calls):
+            if isinstance(node, ast.With):
+                acquired = {
+                    a for item in node.items
+                    if (a := _self_attr(item.context_expr))
+                    in ci.lock_attrs
+                }
+                for a in acquired:
+                    owned.add(a)
+                    for held in locks:
+                        edges.add((held, a, node.lineno))
+                inner = locks | acquired
+                for stmt in node.body:
+                    walk(stmt, inner)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not m.node:
+                return
+            if isinstance(node, ast.Call):
+                attr = _self_attr(node.func) if isinstance(
+                    node.func, ast.Attribute) else None
+                if attr in ci.methods:
+                    calls.append((attr, frozenset(locks), node.lineno))
+            for child in ast.iter_child_nodes(node):
+                walk(child, locks)
+
+        for stmt in m.node.body:
+            walk(stmt, set())
+        direct[m.name] = edges
+        acquires[m.name] = owned
+        callsites[m.name] = calls
+
+    edges: dict = {}
+    for mname, es in direct.items():
+        for held, acq, line in es:
+            edges.setdefault((held, acq), []).append(
+                (ci.methods[mname].qualname, line))
+    # one call deep: holding A while calling a method that acquires B
+    for mname, calls in callsites.items():
+        for callee, locks, line in calls:
+            for held in locks:
+                for acq in acquires.get(callee, ()):
+                    if acq != held:
+                        edges.setdefault((held, acq), []).append(
+                            (f"{ci.methods[mname].qualname} -> {callee}",
+                             line))
+    findings = []
+    seen = set()
+    for (a, b), sites in sorted(edges.items()):
+        if (b, a) in edges and (b, a) not in seen:
+            seen.add((a, b))
+            other = edges[(b, a)]
+            findings.append(AstFinding(
+                rule="RACE003", path=ci.path, line=sites[0][1],
+                message=(
+                    f"lock-order inversion in {ci.name}: '{b}' is "
+                    f"acquired under '{a}' at {sites[0][0]} (line "
+                    f"{sites[0][1]}) but '{a}' under '{b}' at "
+                    f"{other[0][0]} (line {other[0][1]}) — two threads "
+                    "taking the pair in opposite orders deadlock; "
+                    "impose one global order"
+                ),
+            ))
+    return findings
+
+
+def _race_toctou(path: str, tree: ast.Module, parents: dict) -> list:
+    """RACE004: exists/stat-then-use on one path without an OSError
+    guard, in files whose directories background threads mutate."""
+
+    def _arg_names(call: ast.Call) -> set:
+        names = set()
+        for a in call.args:
+            for n in ast.walk(a):
+                if isinstance(n, ast.Name):
+                    names.add(n.id)
+        return names
+
+    def _guarded(node: ast.AST) -> bool:
+        """Inside a try whose handlers catch OSError-family (or
+        broader), or inside an except handler (cleanup path — the
+        original operation already failed)."""
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ExceptHandler):
+                return True
+            if isinstance(cur, ast.Try):
+                for h in cur.handlers:
+                    if h.type is None:
+                        return True
+                    names = {_term(t) for t in (
+                        h.type.elts if isinstance(h.type, ast.Tuple)
+                        else [h.type])}
+                    if names & {"OSError", "IOError", "FileNotFoundError",
+                                "Exception", "BaseException", "EnvironmentError"}:
+                        return True
+            cur = parents.get(cur)
+        return False
+
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.If, ast.While)):
+            continue
+        checks: dict = {}
+        for sub in ast.walk(node.test):
+            if isinstance(sub, ast.Call) and _term(sub.func) \
+                    in _EXISTS_FUNCS:
+                for nm in _arg_names(sub):
+                    checks[nm] = sub
+        if not checks:
+            continue
+        if _guarded(node):
+            continue
+        # body only: an else/elif branch runs when the exists-check
+        # was FALSE — a sink there is not gated by it
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call) and _term(sub.func) \
+                        in _TOCTOU_SINKS:
+                    hit = _arg_names(sub) & set(checks)
+                    if hit and not _guarded(sub):
+                        nm = sorted(hit)[0]
+                        findings.append(AstFinding(
+                            rule="RACE004", path=path, line=sub.lineno,
+                            message=(
+                                f"'{_term(sub.func)}({nm})' is gated by "
+                                f"a '{_term(checks[nm].func)}({nm})' "
+                                f"check at line {node.lineno} with no "
+                                "OSError guard: the prune/scrubber/"
+                                "reload threads mutate these "
+                                "directories between check and use — "
+                                "wrap the use in try/except "
+                                "(FileNotFoundError is a normal "
+                                "outcome here), or spmd_exempt with "
+                                "why no other thread touches the path"
+                            ),
+                        ))
+    return findings
+
+
+def _race_publish(ci: ClassInfo) -> list:
+    """RACE005: a method writes >=2 plain attributes bare while another
+    context reads >=2 of them inside a lock-held region — the reader's
+    lock implies it wants a coherent pair the writer never publishes
+    atomically."""
+    by_attr = _class_accesses(ci)
+    # locked group reads: (func, lockset) -> attrs read under the lock
+    group_reads: dict = {}
+    # per-method bare writes, from the same (cached) extraction
+    per_method_bare: dict = {}
+    for attr, accs in by_attr.items():
+        for a in accs:
+            if a.kind == "read" and a.locks:
+                group_reads.setdefault((a.func, a.locks), set()).add(attr)
+            elif a.kind != "read" and not a.locks:
+                per_method_bare.setdefault(a.func, {}).setdefault(attr, a)
+    findings = []
+    for m in ci.methods.values():
+        bare_writes = per_method_bare.get(m, {})
+        if len(bare_writes) < 2:
+            continue
+        for (reader, locks), attrs in group_reads.items():
+            if reader is m:
+                continue
+            shared = attrs & set(bare_writes)
+            if len(shared) < 2:
+                continue
+            if not (reader.contexts - m.contexts) and not (
+                    m.contexts - reader.contexts):
+                continue  # same contexts: no interleaving possible
+            first = min((bare_writes[a] for a in shared),
+                        key=lambda a: a.line)
+            findings.append(AstFinding(
+                rule="RACE005", path=ci.path, line=first.line,
+                message=(
+                    f"{ci.name}.{m.name} publishes "
+                    f"{sorted(shared)} bare while "
+                    f"{reader.qualname} reads the pair under "
+                    f"{sorted(locks)} — the reader's lock cannot make "
+                    "a multi-field publish atomic; write both fields "
+                    "under the same lock, or publish one immutable "
+                    "tuple by reference"
+                ),
+            ))
+            break
+    return findings
+
+
+# --------------------------------------------------------------------------
+# entry points
+# --------------------------------------------------------------------------
+
+
+def load_sources(
+        overrides: Optional[dict] = None) -> dict:
+    sources = {}
+    for p in CONCURRENCY_FILES:
+        with open(p) as f:
+            sources[p] = f.read()
+    if overrides:
+        sources.update(overrides)
+    return sources
+
+
+def build_model(source_overrides: Optional[dict] = None) -> _Model:
+    return _Model(load_sources(source_overrides))
+
+
+def thread_inventory(model: Optional[_Model] = None) -> list:
+    """The discovered thread model: one dict per spawn site (role,
+    target, file, line, whether it carries a stable ``tmpi-<role>``
+    name). The stress harness and the README table consume this."""
+    model = model or build_model()
+    return [
+        {"role": s.role, "target": s.target,
+         "path": os.path.relpath(s.path, _PKG_ROOT), "line": s.line,
+         "named": s.named}
+        for s in sorted(model.spawns,
+                        key=lambda s: (s.path, s.line))
+    ]
+
+
+# the reviewed thread-model snapshot: every spawn site (role, target,
+# file, named-ness). A new background thread, a renamed role, or a
+# spawn losing its stable tmpi-<role> name is a wire-protocol-grade
+# change for post-mortem attribution — it fails CI until accepted via
+# `tmpi lint --update-golden`, exactly like the collective-signature
+# and preflight goldens.
+GOLDEN_THREAD_MODEL = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "golden",
+    "thread_model.json")
+
+
+def _inventory_payload(model: "_Model") -> list:
+    """The golden-stable projection of the inventory: no line numbers
+    (they churn on unrelated edits), sorted."""
+    rows = [
+        {"role": s["role"], "target": s["target"], "path": s["path"],
+         "named": s["named"]}
+        for s in thread_inventory(model)
+    ]
+    return sorted(rows, key=lambda r: (r["path"], r["target"], r["role"]))
+
+
+def check_thread_model_golden(model: "_Model",
+                              update: bool = False) -> list:
+    """RACE101: the discovered thread model drifted from its golden."""
+    import json
+
+    payload = _inventory_payload(model)
+    if update:
+        os.makedirs(os.path.dirname(GOLDEN_THREAD_MODEL), exist_ok=True)
+        with open(GOLDEN_THREAD_MODEL, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+        return []
+    if not os.path.isfile(GOLDEN_THREAD_MODEL):
+        return [AstFinding(
+            rule="RACE101", path=GOLDEN_THREAD_MODEL, line=0,
+            message="thread-model golden missing — run `tmpi lint "
+                    "--update-golden` and review the inventory")]
+    with open(GOLDEN_THREAD_MODEL) as f:
+        stored = json.load(f)
+    if stored == payload:
+        return []
+    key = lambda r: (r["path"], r["target"], r["role"])  # noqa: E731
+    stored_keys = {key(r) for r in stored}
+    new_keys = {key(r) for r in payload}
+    added = sorted(new_keys - stored_keys)
+    removed = sorted(stored_keys - new_keys)
+    changed = [k for k in sorted(new_keys & stored_keys)
+               if next(r for r in payload if key(r) == k)
+               != next(r for r in stored if key(r) == k)]
+    return [AstFinding(
+        rule="RACE101", path=GOLDEN_THREAD_MODEL, line=0,
+        message=(
+            "discovered thread model drifted from the reviewed golden "
+            f"(added: {added or 'none'}; removed: {removed or 'none'}; "
+            f"changed: {changed or 'none'}) — a new or renamed "
+            "background thread changes post-mortem attribution; give "
+            "it a stable tmpi-<role> name and accept with `tmpi lint "
+            "--update-golden`"
+        ))]
+
+
+def concurrency_findings(
+        source_overrides: Optional[dict] = None,
+        update_golden: bool = False,
+        check_golden: bool = True) -> list:
+    """Run every RACE rule over the concurrency file set (optionally
+    with in-memory source overrides — the mutation self-tests feed
+    edited sources through here; fixture-only overrides usually pass
+    ``check_golden=False`` since an added fixture file IS a thread-
+    model change)."""
+    model = build_model(source_overrides)
+    findings: list = []
+    if update_golden or check_golden:
+        findings.extend(check_thread_model_golden(
+            model, update=update_golden))
+    for ci in sorted(model.classes.values(), key=lambda c: (c.path, c.name)):
+        if ci.is_http_handler:
+            # per-request instances are thread-confined: every request
+            # gets a fresh handler object on its own server thread
+            continue
+        findings.extend(_race_shared_writes(ci))
+        findings.extend(_race_lock_order(ci))
+        findings.extend(_race_publish(ci))
+    for path in sorted(model.trees):
+        tree = model.trees[path]
+        findings.extend(_race_toctou(path, tree, model.parents))
+    return findings
+
+
+def run_concurrency_lints(update_golden: bool = False) -> list:
+    """tools/lint.py entry point."""
+    return concurrency_findings(update_golden=update_golden)

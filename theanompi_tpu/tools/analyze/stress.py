@@ -1,0 +1,283 @@
+"""Deterministic thread-stress harness: the RACE analyzer's dynamic twin.
+
+The static pass (tools/analyze/concurrency.py) proves lock DISCIPLINE;
+it cannot prove the discipline is sufficient. This harness shakes the
+real objects — dispatcher flush-vs-drain, serve param-swap under
+request hammering, the metrics sink under scrubber-vs-close — hard
+enough that a dropped lock actually loses the race inside a bounded
+tier-1 test:
+
+- **Seeded switch-interval shrinking.** Rounds run under
+  ``sys.setswitchinterval`` values descending to 1e-6 s — thousands of
+  preemption points per critical section instead of the default
+  5 ms — with the schedule drawn from a seeded RNG so a failure
+  reproduces from its seed.
+- **Barrier-released threads.** Every scenario thread blocks on one
+  barrier and starts in the same scheduler quantum: the interleaving
+  the race needs happens in round one, not round ten thousand.
+- **Injectable delay hooks.** :func:`inject_delay` wraps a method (or
+  any attribute lookup) of a live object with a seeded pre/post sleep
+  — widening exactly the windows the static analyzer identified as
+  critical sections, so "check passes then the world changes" races
+  become near-deterministic instead of one-in-a-million.
+- **Thread-exception capture.** ``threading.excepthook`` is patched
+  per round: a worker thread dying (ValueError on a closed file, an
+  AttributeError off a torn publish) is a recorded violation, not a
+  silent stderr line.
+- **Deadlock bounding.** Threads that fail to join inside the round
+  budget are a ``deadlock:`` violation; the harness never hangs the
+  suite (the stuck daemon thread is abandoned, the run reports it).
+
+Every run can drop one ``kind=stress`` JSONL record (schema:
+tools/check_obs_schema.py) into ``<obs_dir>/stress.jsonl`` so stress
+evidence rides the same telemetry stream as everything else.
+
+Usage (tests/test_stress.py are the canonical drivers)::
+
+    h = StressHarness(seed=0)
+    res = h.run("metrics-sink", make_scenario, rounds=30)
+    assert res.ok, res.violations
+
+where ``make_scenario(rng)`` returns a :class:`Scenario` — fresh
+objects per round, ``threads`` callables to race, and a ``check()``
+returning invariant-violation strings.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+# descending preemption pressure; the smallest value yields a context
+# switch roughly every few bytecodes
+DEFAULT_SWITCH_INTERVALS = (0.005, 1e-4, 1e-6)
+
+
+@dataclass
+class Scenario:
+    """One stress round: fresh ``threads`` to race (each a 0-arg
+    callable), an invariant ``check`` run after they join (returns a
+    list of violation strings), and an optional ``cleanup``."""
+
+    threads: List[Callable[[], None]]
+    check: Optional[Callable[[], List[str]]] = None
+    cleanup: Optional[Callable[[], None]] = None
+
+
+@dataclass
+class StressResult:
+    scenario: str
+    seed: int
+    rounds: int = 0
+    violations: List[str] = field(default_factory=list)
+    seconds: float = 0.0
+    switch_interval_min: float = min(DEFAULT_SWITCH_INTERVALS)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def as_record(self) -> dict:
+        """One ``kind=stress`` JSONL record (schema:
+        tools/check_obs_schema.py)."""
+        return {
+            "kind": "stress",
+            "t": time.time(),
+            "scenario": self.scenario,
+            "seed": int(self.seed),
+            "rounds": int(self.rounds),
+            "ok": self.ok,
+            "violations": ",".join(
+                v.splitlines()[0][:160] for v in self.violations[:8]),
+            "seconds": round(self.seconds, 3),
+            "switch_interval_min": self.switch_interval_min,
+        }
+
+
+def inject_delay(obj, name: str, rng: random.Random,
+                 before_s: float = 0.0, after_s: float = 0.0):
+    """Wrap ``obj.name`` (a bound method or callable attribute) with a
+    seeded sleep of up to ``before_s``/``after_s`` seconds around each
+    call — the injectable delay hook that widens an
+    analyzer-identified critical section. Returns an ``undo``
+    callable. The jitter is drawn from ``rng`` per call, so the
+    schedule is deterministic under a fixed seed and a fixed thread
+    interleaving."""
+    orig = getattr(obj, name)
+    was_instance_attr = name in vars(obj)
+
+    def wrapped(*args, **kwargs):
+        if before_s:
+            time.sleep(rng.random() * before_s)
+        try:
+            return orig(*args, **kwargs)
+        finally:
+            if after_s:
+                time.sleep(rng.random() * after_s)
+
+    setattr(obj, name, wrapped)
+
+    def undo():
+        if was_instance_attr:
+            setattr(obj, name, orig)
+        else:
+            # the original came from the class: drop the instance
+            # shadow instead of pinning a bound method onto it
+            delattr(obj, name)
+
+    return undo
+
+
+class _NullLock:
+    """A lock that locks nothing — stand-in used by the mutation
+    self-tests to simulate a DROPPED lock on a live object without
+    source surgery (replacing ``obj._lock`` with this is semantically
+    the seeded defect the static pass flags as RACE002).
+
+    ``enter_delay``: optional 0-arg callable run on ``__enter__`` —
+    the dropped lock's acquisition point is exactly where the removed
+    serialization used to sit, so a seeded sleep there widens the
+    check-then-act window the way an unlucky scheduler preemption
+    would, making the loss near-deterministic inside a bounded test."""
+
+    def __init__(self, enter_delay: Optional[Callable[[], None]] = None):
+        self._enter_delay = enter_delay
+
+    def __enter__(self):
+        if self._enter_delay is not None:
+            self._enter_delay()
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def acquire(self, *a, **k):
+        if self._enter_delay is not None:
+            self._enter_delay()
+        return True
+
+    def release(self):
+        pass
+
+
+class StressHarness:
+    """Run scenarios under shrinking switch intervals with exception
+    capture and a wall budget. ``obs_dir``: write one ``kind=stress``
+    record per :meth:`run` into ``stress.jsonl``."""
+
+    def __init__(self, seed: int = 0, obs_dir: Optional[str] = None):
+        self.seed = int(seed)
+        self.obs_dir = obs_dir
+
+    def run(
+        self,
+        scenario: str,
+        make_scenario: Callable[[random.Random], Scenario],
+        rounds: int = 20,
+        switch_intervals=DEFAULT_SWITCH_INTERVALS,
+        join_s: float = 20.0,
+        wall_budget_s: float = 60.0,
+    ) -> StressResult:
+        rng = random.Random(self.seed)
+        res = StressResult(scenario=scenario, seed=self.seed,
+                           switch_interval_min=min(switch_intervals))
+        prev_interval = sys.getswitchinterval()
+        prev_hook = threading.excepthook
+        t0 = time.perf_counter()
+        try:
+            for i in range(rounds):
+                if time.perf_counter() - t0 > wall_budget_s:
+                    break  # bounded: a tier-1 stress must end on time
+                # shrinking schedule: the first rounds sweep every
+                # interval (coarse preemption finds the easy races),
+                # the long tail hammers the finest one
+                si = (switch_intervals[i % len(switch_intervals)]
+                      if i < 2 * len(switch_intervals)
+                      else min(switch_intervals))
+                errors: list = []
+
+                def hook(args, _errors=errors):
+                    _errors.append(
+                        f"{args.thread.name}: "
+                        f"{args.exc_type.__name__}: {args.exc_value}")
+
+                sc = make_scenario(rng)
+                barrier = threading.Barrier(len(sc.threads) + 1)
+
+                def release_then(fn, barrier=barrier):
+                    def runner():
+                        barrier.wait(timeout=join_s)
+                        fn()
+                    return runner
+
+                threads = [
+                    threading.Thread(target=release_then(fn),
+                                     name=f"tmpi-stress-{j}", daemon=True)
+                    for j, fn in enumerate(sc.threads)
+                ]
+                threading.excepthook = hook
+                sys.setswitchinterval(si)
+                broken = None
+                try:
+                    for t in threads:
+                        t.start()
+                    barrier.wait(timeout=join_s)  # all start together
+                    deadline = time.monotonic() + join_s
+                    for t in threads:
+                        t.join(max(0.0, deadline - time.monotonic()))
+                    stuck = [t.name for t in threads if t.is_alive()]
+                except (threading.BrokenBarrierError, RuntimeError) as e:
+                    # an overloaded box delaying a spawn past join_s
+                    # breaks the barrier (or t.start() hits the thread
+                    # limit) — a recorded violation, never an escaped
+                    # exception aborting the tier-1 test
+                    broken = repr(e)
+                    stuck = [t.name for t in threads if t.is_alive()]
+                finally:
+                    sys.setswitchinterval(prev_interval)
+                    threading.excepthook = prev_hook
+                res.rounds += 1
+                if broken is not None:
+                    res.violations.append(
+                        f"round {i} (seed {self.seed}, switch {si}): "
+                        f"start barrier broken: {broken} (stuck: "
+                        f"{stuck or 'none'})")
+                    continue
+                if stuck:
+                    res.violations.append(
+                        f"round {i} (seed {self.seed}, switch {si}): "
+                        f"deadlock: threads still alive after "
+                        f"{join_s:.0f}s: {stuck}")
+                    # abandoned daemons: do not run check/cleanup
+                    # against state they still mutate
+                    continue
+                for e in errors:
+                    res.violations.append(
+                        f"round {i} (seed {self.seed}, switch {si}): "
+                        f"thread exception: {e}")
+                if sc.check is not None:
+                    for v in sc.check():
+                        res.violations.append(
+                            f"round {i} (seed {self.seed}, switch {si}): "
+                            f"{v}")
+                if sc.cleanup is not None:
+                    sc.cleanup()
+        finally:
+            sys.setswitchinterval(prev_interval)
+            threading.excepthook = prev_hook
+            res.seconds = time.perf_counter() - t0
+            self._write_record(res)
+        return res
+
+    def _write_record(self, res: StressResult) -> None:
+        if self.obs_dir is None:
+            return
+        os.makedirs(self.obs_dir, exist_ok=True)
+        with open(os.path.join(self.obs_dir, "stress.jsonl"), "a") as f:
+            f.write(json.dumps(res.as_record()) + "\n")

@@ -173,6 +173,23 @@ SCHEMAS: dict[str, dict[str, tuple[tuple, bool]]] = {
         "quarantined": ((str,), True),
         "seconds": (_NUM, True),
     },
+    # thread-stress harness (tools/analyze/stress.py): one record per
+    # StressHarness.run — the scenario name, the seed that reproduces
+    # the schedule, rounds actually executed, the verdict (`ok` with
+    # `violations` comma-joined; empty string = clean), the run's wall
+    # seconds, and the finest switch interval applied. Written to
+    # <obs_dir>/stress.jsonl by the tier-1 stress tests and ad-hoc
+    # stress runs.
+    "stress": {
+        "t": (_NUM, True),
+        "scenario": ((str,), True),
+        "seed": ((int,), True),
+        "rounds": ((int,), True),
+        "ok": ((bool,), True),
+        "violations": ((str,), False),
+        "seconds": (_NUM, False),
+        "switch_interval_min": (_NUM, False),
+    },
     # chaos campaign runner (tools/chaos.py, `tmpi chaos`): one record
     # per fuzzed fault schedule — the seed that generated it, the
     # engine/codec config label, the schedule itself ('+'-joined

@@ -39,7 +39,21 @@ PREC001 fp32 island inside a low-precision model's hot path
 PREC002 long reduction accumulating in bf16
 PREC003 fused-update epilogue math below fp32
 PREC101 dtype-flow signature drifted from golden
+RACE001 shared attribute written from >=2 thread contexts, no lock
+RACE002 inconsistent guarding (locked at some writes, bare at others)
+RACE003 lock-order inversion (potential deadlock)
+RACE004 filesystem exists/stat-then-use TOCTOU across threads
+RACE005 non-atomic multi-field publish vs a locked reader
+RACE101 discovered thread model drifted from the reviewed golden
 ======== ================================================================
+
+The RACE family is the host-concurrency analyzer
+(tools/analyze/concurrency.py): it discovers the thread model
+(``threading.Thread``/``Timer``/pool submits/HTTP handler threads plus
+callback registrations), computes the shared-mutable-state set and the
+lock discipline actually used, and checks them against each other.
+Its dynamic twin is the deterministic thread-stress harness
+(tools/analyze/stress.py).
 
 The MEM/PREC families are the memory & precision pre-flight (ISSUE
 12): every engine x codec x --fused-update configuration is LOWERED
@@ -114,6 +128,19 @@ RULES = {
     "PREC101": "dtype-flow signature drifted from golden, or the "
                "config could not be traced "
                "(tmpi lint --update-golden to accept a reviewed drift)",
+    "RACE001": "shared attribute written from >=2 thread contexts with "
+               "no lock anywhere (tools/analyze/concurrency.py)",
+    "RACE002": "inconsistent guarding: attribute locked at some write "
+               "sites, bare (or differently locked) at others",
+    "RACE003": "lock-order inversion across two locks (potential "
+               "deadlock)",
+    "RACE004": "filesystem exists/stat-then-use TOCTOU racing the "
+               "prune/scrubber/reload threads, no OSError guard",
+    "RACE005": "non-atomic multi-field publish read as a pair under a "
+               "lock in another thread context",
+    "RACE101": "discovered thread model drifted from the reviewed "
+               "golden (tools/analyze/golden/thread_model.json; "
+               "tmpi lint --update-golden to accept)",
 }
 
 _EXEMPT_RE = re.compile(r"spmd_exempt:[ \t]*(\S[^\n]*)")
@@ -198,7 +225,8 @@ def _add(report: LintReport, rule: str, path: str, line: int,
     # per-line written-reason suppression; HOT/CODEC/SCHEMA keep their
     # own exemption mechanics
     reason = _exemption_reason(path, line) if (
-        suppressible and rule.startswith(("SPMD", "MEM", "PREC"))) else None
+        suppressible and rule.startswith(("SPMD", "MEM", "PREC", "RACE"))
+    ) else None
     if reason:
         f.suppressed = True
         f.exempt_reason = reason
@@ -299,6 +327,15 @@ def _run_precision(report: LintReport, update_golden: bool) -> None:
         _add(report, f.rule, f.path, f.line, f.message)
 
 
+def _run_concurrency(report: LintReport, update_golden: bool) -> None:
+    # pure AST over the threaded host files — needs no devices, so it
+    # also runs under --no-analyze-free fast paths cheaply
+    from theanompi_tpu.tools.analyze.concurrency import run_concurrency_lints
+
+    for f in run_concurrency_lints(update_golden=update_golden):
+        _add(report, f.rule, f.path, f.line, f.message)
+
+
 def _timed(report: LintReport, family: str, fn, *args) -> None:
     import time
 
@@ -314,6 +351,10 @@ def run_lint(paths: Optional[list] = None, update_golden: bool = False,
     _timed(report, "hot_loop", _run_hot_loop)
     _timed(report, "codec_coverage", _run_codec_coverage)
     _timed(report, "schema", _run_schema, paths)
+    # the RACE family (host-concurrency analyzer) is AST-only and
+    # cheap — it runs even on the classic fast path, like the other
+    # source lints
+    _timed(report, "concurrency", _run_concurrency, update_golden)
     if analyze:
         _timed(report, "spmd", _run_analyzer, update_golden)
         # the preflight families lower+compile the engine matrix (the

@@ -1346,15 +1346,21 @@ class CheckpointScrubber:
         self.runs = 0
         self.quarantined_total = 0
         self._memo: dict = {}
+        # serializes passes: scrub_once is both the background loop's
+        # body AND a public entry (the supervisor's retry-time pass,
+        # unit tests) — two concurrent passes would race on the memo
+        # dict and the counters
+        self._pass_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def scrub_once(self) -> dict:
-        if self.runs % self.FULL_EVERY == 0:
-            self._memo.clear()  # periodic full re-verify (docstring)
-        res = scrub_checkpoint_dir(self.ckpt_dir, memo=self._memo)
-        self.runs += 1
-        self.quarantined_total += res["corrupt"]
+        with self._pass_lock:
+            if self.runs % self.FULL_EVERY == 0:
+                self._memo.clear()  # periodic full re-verify (docstring)
+            res = scrub_checkpoint_dir(self.ckpt_dir, memo=self._memo)
+            self.runs += 1
+            self.quarantined_total += res["corrupt"]
         if self.on_result is not None:
             try:
                 self.on_result(res)
