@@ -85,6 +85,13 @@ def test_valid_records_pass():
          "violations": "parity,no_refeed", "runs": 5,
          "shrunk_schedule": "crash@5",
          "repro": "--inject-fault crash@5"},
+        # sharding analyzer lint-report record (tools/analyze/
+        # sharding.py, `tmpi lint --obs-dir`)
+        {"kind": "shard", "t": 1.0, "engine": "zero1", "codec": "int8:ef",
+         "fused": False, "n_devices": 2, "leaves": 8, "mismatched": 0,
+         "hidden_bytes": 0.0, "compiled_wire_bytes": 26036.0,
+         "traced_wire_bytes": 26036.0, "declared_raw_bytes": 26024.0,
+         "findings": 0},
         # thread-stress harness (tools/analyze/stress.py)
         {"kind": "stress", "t": 1.0, "scenario": "metrics-sink-locked",
          "seed": 2, "rounds": 10, "ok": True, "violations": "",
@@ -122,6 +129,9 @@ def test_valid_records_pass():
       "error": "x", "backoff_s": 0.5, "resumable": 1}, "want bool"),
     ({"kind": "rollback", "rank": 0, "t": 1.0, "step": 7,
       "budget_left": 1}, "missing required field 'restore_step'"),
+    ({"kind": "shard", "t": 1.0, "engine": "bsp", "codec": "none",
+      "n_devices": 2, "leaves": 9, "hidden_bytes": 0.0},
+     "missing required field 'mismatched'"),
     ({"kind": "serve", "t": 1.0, "metrics": {}},
      "missing required field 'params_step'"),
     ({"kind": "serve", "t": 1.0, "params_step": 1,

@@ -19,12 +19,17 @@ def test_lint_all_passes_on_the_tree():
 
 def test_full_lint_includes_analyzer_and_stays_in_budget():
     """`tmpi lint` runs the SPMD analyzer (golden signatures, traffic
-    cross-check, donation audit, AST lints) AND the memory & precision
-    pre-flight families (ISSUE 12 — the one step that COMPILES: every
-    engine x codec x fused config is lowered for XLA memory analysis)
+    cross-check, donation audit, AST lints), the memory & precision
+    pre-flight families (ISSUE 12 — every engine x codec x fused
+    config lowered for XLA memory analysis), AND the sharding & layout
+    analyzer (ISSUE 15 — the same executables' input_shardings +
+    optimized-HLO collective set vs the ShardingRecipe declarations),
     and the whole pass stays tier-1-runnable under the 90 s CPU
     budget. Per-family wall time is recorded so a budget regression is
-    attributable to the family that grew."""
+    attributable to the family that grew; the sharding family must ride
+    the memory family's compiled executables (tools/analyze/lowering.py
+    cache), so its marginal cost is parsing, not a second 20-config
+    compile."""
     t0 = time.monotonic()
     report = run_lint()
     elapsed = time.monotonic() - t0
@@ -32,7 +37,7 @@ def test_full_lint_includes_analyzer_and_stays_in_budget():
     assert elapsed < 90.0, f"tmpi lint took {elapsed:.1f}s"
     assert set(report.timings_s) >= {
         "hot_loop", "codec_coverage", "schema", "spmd", "memory",
-        "precision", "concurrency",
+        "precision", "concurrency", "sharding",
     }
     assert all(v >= 0 for v in report.timings_s.values())
     # the compiling families dominate; their time is attributed to
@@ -49,11 +54,13 @@ def test_lint_json_report_shape(capsys):
     assert "SPMD002" in out["rules"] and "HOT002" in out["rules"]
     assert "MEM002" in out["rules"] and "PREC003" in out["rules"]
     assert "RACE001" in out["rules"] and "RACE005" in out["rules"]
+    assert "SHARD001" in out["rules"] and "SHARD101" in out["rules"]
     assert set(out["rules"]) == set(RULES)
-    # per-rule-family wall time rides the CI report (ISSUE 12/14
+    # per-rule-family wall time rides the CI report (ISSUE 12/14/15
     # satellite) so future budget regressions are attributable
     t = out["timings_s"]
-    assert {"memory", "precision", "spmd", "concurrency"} <= set(t)
+    assert {"memory", "precision", "spmd", "concurrency",
+            "sharding"} <= set(t)
     assert all(isinstance(v, (int, float)) for v in t.values())
 
 

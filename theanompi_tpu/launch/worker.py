@@ -655,6 +655,13 @@ def run_training(
     _espec = getattr(engine, "elastic_spec", None)
     if _espec is not None:
         topo_meta["elastic"] = _espec()
+    # the engine's ShardingRecipe identity (parallel/recipe.py) rides
+    # the manifest too: the stamp then records both the DECLARED spec
+    # source and the live-array specs it placed, so the sharding
+    # analyzer's train->serve handoff check reads one artifact
+    _srecipe = getattr(engine, "sharding_recipe", None)
+    if _srecipe is not None:
+        topo_meta["recipe"] = _srecipe().as_json()
     # Forward the run's LR-scale anchor (see base_world above): resumed
     # runs keep the ORIGINAL world; fresh runs anchor to the world they
     # launch on.

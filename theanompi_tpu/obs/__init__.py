@@ -77,7 +77,15 @@ On-disk layout under ``obs_dir`` (schemas:
                             corrupt count, the quarantined filenames
                             (comma-joined), pass seconds — next to the
                             tmpi_scrub_checked / tmpi_scrub_runs_total
-                            / tmpi_scrub_quarantined_total gauges
+                            / tmpi_scrub_quarantined_total gauges; a
+                            `tmpi lint --obs-dir` run appends one
+                            kind=shard record per analyzed engine x
+                            codec x fused config (tools/analyze/
+                            sharding.py): leaf counts, declared-vs-
+                            compiled mismatches, and the GSPMD-inserted
+                            hidden-collective bytes next to the
+                            compiled/traced/declared wire totals —
+                            the sharding analyzer's lint-report line
     chaos.jsonl             chaos campaign log (tools/chaos.py, written
                             under the campaign's --out dir): one
                             kind=chaos record per fuzzed fault

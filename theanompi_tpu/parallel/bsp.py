@@ -22,7 +22,7 @@ import os
 
 import jax
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from theanompi_tpu.models.contract import Model
 from theanompi_tpu.parallel.mesh import DATA_AXIS
@@ -57,17 +57,20 @@ def _axes_tuple(axis_name) -> tuple:
 from theanompi_tpu.parallel.mesh import fold_linear_index as _fold_linear_index
 
 
-def _bsp_state_spec(codec, axes):
-    """shard_map spec for the BSP TrainState: everything replicated,
-    EXCEPT the codec's error-feedback residuals, which are per-device
-    (stacked ``[n, ...]``) and must be declared sharded over the data
-    axes — a blanket ``P()`` would stamp device-varying residuals as
-    replicated with no error under ``check_vma=False``."""
-    from theanompi_tpu.train import TrainState as _TS
+def _bsp_recipe(mesh, axis_name, codec):
+    """The BSP :class:`~theanompi_tpu.parallel.recipe.ShardingRecipe`:
+    everything replicated, EXCEPT the codec's error-feedback residuals,
+    which are per-device (stacked ``[n, ...]``) and must be declared
+    sharded over the data axes — a blanket replicated spec would stamp
+    device-varying residuals as replicated with no error under
+    ``check_vma=False``. THE single spec source for this engine's
+    shard_map specs, memory factors, and topology stamp."""
+    from theanompi_tpu.parallel.recipe import ShardingRecipe
 
-    if codec is not None and codec.error_feedback:
-        return _TS(P(), P(), P(), P(), P(axes))
-    return P()
+    return ShardingRecipe.bsp(
+        mesh, axis_name,
+        ef_sharded=codec is not None and codec.error_feedback,
+    )
 
 
 def _bsp_grad_sync(strategy, axis_name, n, codec, checked,
@@ -184,13 +187,14 @@ def make_bsp_train_step(
     # classic pmap AD semantics (psum transpose = psum) — see
     # make_train_step's note. TMPI_CHECKED_VMA=1 flips this engine to
     # the migrated checked-mode semantics (_checked_vma docstring).
-    spec = P(axes)  # P accepts a 1-tuple identically to the bare name
-    sspec = _bsp_state_spec(codec, axes)
+    recipe = _bsp_recipe(mesh, axis_name, codec)
+    spec = recipe.batch_spec
+    sspec = recipe.state_spec(TrainState)
     mapped = jax.shard_map(
         sharded_step,
         mesh=mesh,
-        in_specs=(sspec, spec, spec, P()),
-        out_specs=(sspec, P()),
+        in_specs=(sspec, spec, spec, recipe.scalar),
+        out_specs=(sspec, recipe.scalar),
         check_vma=checked,
     )
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
@@ -282,13 +286,14 @@ def make_bsp_fused_step(
     # donate like the unfused n>1 step: without it every dispatch holds a
     # second full params+opt copy (the n==1 no-donate rationale in
     # make_bsp_train_step applies to single-chip tunneled backends only)
-    spec = P(None, axes)
-    sspec = _bsp_state_spec(codec, axes)
+    recipe = _bsp_recipe(mesh, axis_name, codec)
+    spec = recipe.stacked_batch_spec
+    sspec = recipe.state_spec(TrainState)
     mapped = jax.shard_map(
         sharded_step,
         mesh=mesh,
-        in_specs=(sspec, spec, spec, P()),
-        out_specs=(sspec, P()),
+        in_specs=(sspec, spec, spec, recipe.scalar),
+        out_specs=(sspec, recipe.scalar),
         check_vma=checked,
     )
     return jax.jit(mapped, donate_argnums=(0,))
@@ -352,6 +357,11 @@ class BSPEngine:
         for a in _axes_tuple(axis_name):
             n *= mesh.shape[a]
         self.donates_state = n > 1  # single-device path does not donate
+        # THE spec source for this engine (parallel/recipe.py): the
+        # analyzer (SHARD001-004) verifies these declared specs against
+        # the compiled executable, memory_model divides by their
+        # extents, and the checkpoint topology stamp carries them
+        self.sharding = _bsp_recipe(mesh, axis_name, self.codec)
         self._steps = {False: make_bsp_train_step(model, mesh, **self._build)}
         self._eval = make_bsp_eval_step(
             model, mesh, axis_name=axis_name, input_transform=input_transform,
@@ -406,6 +416,13 @@ class BSPEngine:
         from theanompi_tpu.parallel.mesh import first_local_value
 
         return int(first_local_value(state.step))
+
+    def sharding_recipe(self):
+        """The engine's :class:`~theanompi_tpu.parallel.recipe.
+        ShardingRecipe` — the declared spec table the sharding analyzer
+        (tools/analyze/sharding.py) verifies against GSPMD's compiled
+        truth and the worker stamps into the ``__topology__`` manifest."""
+        return self.sharding
 
     def elastic_spec(self) -> dict:
         """Per-leaf reshard policies stamped into every checkpoint's
@@ -463,19 +480,25 @@ class BSPEngine:
         tools/analyze/memory.py). BSP state is replicated on every
         device — shard factor 1 everywhere — except the codec's
         error-feedback residuals, stacked ``[n, ...]`` and sharded over
-        the data axes. ``state`` may be abstract (eval_shape structs)."""
+        the data axes. Factors and specs both come from the engine's
+        ShardingRecipe (parallel/recipe.py), so the 1/n claims here can
+        never drift from the specs the step actually shards with
+        (SHARD003 verifies the pair against the compiled program).
+        ``state`` may be abstract (eval_shape structs)."""
         from theanompi_tpu.utils.flops import state_memory_model
 
         n = 1
         for a in _axes_tuple(self._build["axis_name"]):
             n *= self.mesh.shape[a]
+        lf = self.sharding.leaf_factors(state)
 
         def factor(path, leaf):
-            return n if path.startswith(".ef") and n > 1 else 1
+            return lf.get(path, (1, None))[0]
 
         return state_memory_model(
             state, "bsp", n, factor,
             detail={"note": "replicated state; ef stacked per-device"},
+            specs={p: s for p, (_f, s) in lf.items()},
         )
 
     def cost_model(self, state, global_batch: int):
@@ -519,12 +542,15 @@ def make_bsp_eval_step(
     def sharded(state: TrainState, images, labels):
         return lax.pmean(base(state, images, labels), axis_name)
 
-    spec = P(axes)
+    # eval states carry no codec residuals (the engine strips ef), so
+    # the recipe's whole-state spec is replicated
+    recipe = _bsp_recipe(mesh, axis_name, None)
+    spec = recipe.batch_spec
     mapped = jax.shard_map(
         sharded,
         mesh=mesh,
-        in_specs=(P(), spec, spec),
-        out_specs=P(),
+        in_specs=(recipe.scalar, spec, spec),
+        out_specs=recipe.scalar,
         check_vma=_checked_vma(),
     )
     return jax.jit(mapped)

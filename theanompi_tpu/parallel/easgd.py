@@ -47,7 +47,7 @@ from typing import Any, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from theanompi_tpu.models.contract import Model
 from theanompi_tpu.parallel.mesh import DATA_AXIS, stack_replicas
@@ -112,7 +112,6 @@ class EASGDEngine:
         # exchange) across slices; see make_worker_group_mesh
         mesh, gspec, grad_sync = make_worker_group_mesh(mesh, g, n_slices=n_slices)
         ax = mesh.axis_names[0] if g > 1 else axis_name
-        bspec_ = gspec if g > 1 else P(ax)
         self.mesh = mesh
         self.axis_name = ax
         self.n = mesh.shape[ax]  # number of WORKERS
@@ -124,7 +123,6 @@ class EASGDEngine:
             model, input_transform=input_transform, views=eval_views
         )
         a = self.alpha
-        bspec = bspec_
         all_axes = tuple(mesh.axis_names)
 
         from theanompi_tpu.parallel.mesh import fold_linear_index
@@ -185,11 +183,19 @@ class EASGDEngine:
             return sharded_step
 
         self._make_sharded_step = make_sharded_step
-        # ef residuals are per-worker (stacked, sharded) like workers —
-        # P(ax) broadcasts over an empty () subtree when the codec is off
-        self._state_spec = EASGDState(P(ax), P(), P(), P(ax))
+        # THE spec source (parallel/recipe.py): worker stack + ef
+        # residuals sharded over the worker axis, center replicated —
+        # the worker-axis prefix broadcasts over an empty () ef subtree
+        # when the codec is off
+        from theanompi_tpu.parallel.recipe import ShardingRecipe
+
+        self.sharding = ShardingRecipe.easgd(
+            mesh, ax, group_batch_spec=gspec if g > 1 else None)
+        self._state_spec = self.sharding.state_spec(EASGDState)
         sspec = self._state_spec
-        self._bspec = bspec
+        scalar = self.sharding.scalar
+        self._bspec = self.sharding.batch_spec
+        bspec = self._bspec
         self._fused: dict = {}
 
         def jit_step(numerics: bool):
@@ -197,8 +203,8 @@ class EASGDEngine:
                 jax.shard_map(
                     make_sharded_step(numerics),
                     mesh=mesh,
-                    in_specs=(sspec, bspec, bspec, P()),
-                    out_specs=(sspec, P()),
+                    in_specs=(sspec, bspec, bspec, scalar),
+                    out_specs=(sspec, scalar),
                     check_vma=False,
                 ),
                 donate_argnums=(0,),
@@ -256,7 +262,7 @@ class EASGDEngine:
                 sharded_eval,
                 mesh=mesh,
                 in_specs=(sspec, bspec, bspec),
-                out_specs=P(),
+                out_specs=scalar,
                 check_vma=False,
             )
         )
@@ -309,7 +315,9 @@ class EASGDEngine:
 
             self._fused[numerics] = fuse_sharded_step(
                 step_and_maybe_exchange, self.mesh, self._state_spec,
-                (P(None, *self._bspec), P(None, *self._bspec), P()), True,
+                (self.sharding.stacked_batch_spec,
+                 self.sharding.stacked_batch_spec,
+                 self.sharding.scalar), True,
             )
         return self._fused[numerics](state, images, labels, rngs)
 
@@ -323,6 +331,11 @@ class EASGDEngine:
         from theanompi_tpu.parallel.mesh import first_local_value
 
         return int(first_local_value(state.workers.step))
+
+    def sharding_recipe(self):
+        """The engine's ShardingRecipe (parallel/recipe.py) — declared
+        spec table for the sharding analyzer and the topology stamp."""
+        return self.sharding
 
     def elastic_spec(self) -> dict:
         """Per-leaf reshard policies for the topology manifest
@@ -359,21 +372,22 @@ class EASGDEngine:
         replicas are stacked ``(n_workers, ...)`` and sharded over the
         worker axis — each device holds ONE worker's params+opt — while
         the elastic center (params + refreshed BN state) is replicated
-        on every device; error-feedback residuals are per-worker."""
+        on every device; error-feedback residuals are per-worker.
+        Factors/specs come from the engine's ShardingRecipe (SHARD003
+        checks them against the compiled program)."""
         from theanompi_tpu.utils.flops import state_memory_model
 
         n = self.n
+        lf = self.sharding.leaf_factors(state)
 
         def factor(path, leaf):
-            if n > 1 and (path.startswith(".workers")
-                          or path.startswith(".ef")):
-                return n
-            return 1
+            return lf.get(path, (1, None))[0]
 
         return state_memory_model(
             state, "easgd", n, factor,
             detail={"note": "worker stack sharded 1/n; center "
                             "replicated on every device"},
+            specs={p: s for p, (_f, s) in lf.items()},
         )
 
     def cost_model(self, state, global_batch: int):

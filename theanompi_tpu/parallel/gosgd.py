@@ -56,7 +56,7 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from theanompi_tpu.models.contract import Model
 from theanompi_tpu.parallel.mesh import DATA_AXIS
@@ -121,7 +121,13 @@ class GOSGDEngine:
         mesh, gspec, grad_sync = make_worker_group_mesh(mesh, g, n_slices=n_slices)
         if g > 1:
             axis_name = mesh.axis_names[0]
-        bspec = gspec if g > 1 else P(axis_name)
+        # THE spec source (parallel/recipe.py): replicas, gossip shares
+        # and ef residuals all per-worker
+        from theanompi_tpu.parallel.recipe import ShardingRecipe
+
+        self.sharding = ShardingRecipe.gosgd(
+            mesh, axis_name, group_batch_spec=gspec if g > 1 else None)
+        bspec = self.sharding.batch_spec
         self.mesh = mesh
         self.axis_name = axis_name
         self.n = mesh.shape[axis_name]  # number of WORKERS
@@ -290,7 +296,7 @@ class GOSGDEngine:
 
         self._make_flag_fn = make_flag_fn
         self._sharded_step_flag = make_flag_fn(False)
-        self._state_spec = GOSGDState(P(ax), P(ax), P(ax))
+        self._state_spec = self.sharding.state_spec(GOSGDState)
         self._bspec = bspec
         self._fused: dict = {}
 
@@ -306,8 +312,9 @@ class GOSGDEngine:
                 jax.shard_map(
                     sharded_step,
                     mesh=mesh,
-                    in_specs=(self._state_spec, bspec, bspec, P()),
-                    out_specs=(self._state_spec, P()),
+                    in_specs=(self._state_spec, bspec, bspec,
+                              self.sharding.scalar),
+                    out_specs=(self._state_spec, self.sharding.scalar),
                     check_vma=False,
                 ),
                 donate_argnums=(0,),
@@ -338,7 +345,7 @@ class GOSGDEngine:
                 sharded_eval,
                 mesh=mesh,
                 in_specs=(self._state_spec, bspec, bspec),
-                out_specs=P(),
+                out_specs=self.sharding.scalar,
                 check_vma=False,
             )
         )
@@ -409,7 +416,9 @@ class GOSGDEngine:
 
             self._fused[numerics] = fuse_sharded_step(
                 substep, self.mesh, self._state_spec,
-                (P(None, *self._bspec), P(None, *self._bspec), P(), P()),
+                (self.sharding.stacked_batch_spec,
+                 self.sharding.stacked_batch_spec,
+                 self.sharding.scalar, self.sharding.scalar),
                 True,
             )
         out = self._fused[numerics](state, images, labels, rngs, counts)
@@ -429,6 +438,11 @@ class GOSGDEngine:
         from theanompi_tpu.parallel.mesh import first_local_value
 
         return int(first_local_value(state.workers.step))
+
+    def sharding_recipe(self):
+        """The engine's ShardingRecipe (parallel/recipe.py) — declared
+        spec table for the sharding analyzer and the topology stamp."""
+        return self.sharding
 
     def elastic_spec(self) -> dict:
         """Per-leaf reshard policies for the topology manifest
@@ -462,18 +476,22 @@ class GOSGDEngine:
         ``MemoryModel``; see BSPEngine.memory_model). Everything in
         GoSGD state is per-worker — the stacked replicas, the share
         weights, and the codec residuals all shard ``1/n`` over the
-        worker axis; there is no replicated center."""
+        worker axis; there is no replicated center. Factors/specs come
+        from the engine's ShardingRecipe (SHARD003 checks them against
+        the compiled program)."""
         from theanompi_tpu.utils.flops import state_memory_model
 
         n = self.n
+        lf = self.sharding.leaf_factors(state)
 
         def factor(path, leaf):
-            return n if n > 1 else 1
+            return lf.get(path, (1, None))[0]
 
         return state_memory_model(
             state, "gosgd", n, factor,
             detail={"note": "all state per-worker (stack + alpha + ef "
                             "sharded 1/n); no replicated center"},
+            specs={p: s for p, (_f, s) in lf.items()},
         )
 
     def cost_model(self, state, global_batch: int):

@@ -191,7 +191,8 @@ class NDEngine:
                     f"for <10%)"
                 )
             # [M, B, T]: M replicated, B on dp, T on sp
-            tok_spec = P(None, dp_axis, sp_axis)
+            tok_entry = dp_axis
+            microbatched = True
             batch_axes = (dp_axis,) if dp_axis else ()
         elif ep_axis is not None:
             from theanompi_tpu.models.moe import ep_spec_setup
@@ -206,7 +207,8 @@ class NDEngine:
             init_params = arch.init
             # batch dim over (dp, ep) jointly, dp-major: host slices
             # stay contiguous under multi-controller feeds
-            tok_spec = P((dp_axis, ep_axis) if dp_axis else ep_axis, sp_axis)
+            tok_entry = (dp_axis, ep_axis) if dp_axis else ep_axis
+            microbatched = False
             batch_axes = ((dp_axis,) if dp_axis else ()) + (ep_axis,)
         else:
             axes, n_total, param_specs = nd_spec_setup(
@@ -214,7 +216,8 @@ class NDEngine:
             )
             loss_fn = lambda p, t: arch.loss(p, t, sp_axis, tp_axis=tp_axis)  # noqa: E731
             init_params = arch.init
-            tok_spec = P(dp_axis, sp_axis)
+            tok_entry = dp_axis
+            microbatched = False
             batch_axes = (dp_axis,) if dp_axis else ()
 
         from theanompi_tpu.parallel.codec import get_codec
@@ -225,33 +228,20 @@ class NDEngine:
         self.codec = codec
         use_ef = codec.active and codec.error_feedback
 
-        def _psum_axes(spec):
-            """The participating axes a leaf's grad is psummed over —
-            the complement of its sharded axes (the same rule
-            transformer.sync_grads_by_spec applies)."""
-            sharded_on = set()
-            for entry in spec:
-                if isinstance(entry, (tuple, list)):
-                    sharded_on.update(entry)
-                elif entry is not None:
-                    sharded_on.add(entry)
-            return tuple(a for a in axes if a not in sharded_on)
+        from theanompi_tpu.parallel.recipe import (
+            ShardingRecipe,
+            psum_axes as _recipe_psum_axes,
+        )
 
         _is_spec = lambda x: isinstance(x, P)  # noqa: E731
         self._spec_leaves = jax.tree_util.tree_leaves(
             param_specs, is_leaf=_is_spec
         )
-        # which leaves actually cross a wire (psummed over >= 1 axis)
-        self._wire_axes = [_psum_axes(s) for s in self._spec_leaves]
-        ef_specs: Any = ()
-        if use_ef:
-            # one residual block per device: leading stack dim sharded
-            # over exactly the psummed axes (a leaf's own sharded axes
-            # cannot reappear in its ef spec)
-            ef_specs = jax.tree_util.tree_map(
-                lambda spec: P(_psum_axes(spec) or None, *spec),
-                param_specs, is_leaf=_is_spec,
-            )
+        # which leaves actually cross a wire (psummed over >= 1 axis) —
+        # the complement rule lives in parallel/recipe.py::psum_axes
+        # (same rule transformer.sync_grads_by_spec applies)
+        self._wire_axes = [_recipe_psum_axes(s, tuple(axes))
+                           for s in self._spec_leaves]
         self._ef_stack = [
             int(np.prod([sizes[a] for a in ax_t])) if ax_t else 1
             for ax_t in self._wire_axes
@@ -260,15 +250,25 @@ class NDEngine:
         opt_template = jax.eval_shape(
             lambda: opt.init(jax.eval_shape(init_params, jax.random.PRNGKey(0)))
         )
-        opt_specs = opt_state_specs(opt_template, param_specs)
-        state_specs = NDTrainState(param_specs, opt_specs, P(), ef_specs)
+        # THE spec source (parallel/recipe.py): the per-leaf param
+        # specs (model spec setup), their like-sharded optimizer
+        # accumulators, the ef residual stacks (leading dim over each
+        # leaf's psummed axes), and the token sharding — one recipe the
+        # step, analyzer, memory model, and topology stamp all consume
+        self.sharding = ShardingRecipe.nd(
+            mesh, tuple(axes), param_specs, opt_template, use_ef,
+            tok_entry, sp_axis, microbatched=microbatched,
+        )
+        state_specs = self.sharding.state_spec(NDTrainState)
+        tok_spec = self.sharding.batch_spec
         self._state_specs = state_specs
         self._init_params = init_params
         self._opt = opt
         self._tok_spec = tok_spec
         self._tok_sharding = NamedSharding(mesh, tok_spec)
         # fused dispatch: group dim replicated ahead of the token spec
-        self._stacked_sharding = NamedSharding(mesh, P(None, *tok_spec))
+        self._stacked_sharding = NamedSharding(
+            mesh, self.sharding.stacked_batch_spec)
         self._donate = donate
         self.donates_state = bool(donate)
         self._fused: dict = {}
@@ -367,8 +367,8 @@ class NDEngine:
                 jax.shard_map(
                     make_sharded_step(numerics),
                     mesh=mesh,
-                    in_specs=(state_specs, tok_spec, P()),
-                    out_specs=(state_specs, P()),
+                    in_specs=(state_specs, tok_spec, self.sharding.scalar),
+                    out_specs=(state_specs, self.sharding.scalar),
                     check_vma=False,
                 ),
                 donate_argnums=(0,) if donate else (),
@@ -388,7 +388,7 @@ class NDEngine:
                 sharded_eval,
                 mesh=mesh,
                 in_specs=(state_specs, tok_spec),
-                out_specs=P(),
+                out_specs=self.sharding.scalar,
                 check_vma=False,
             )
         )
@@ -449,9 +449,9 @@ class NDEngine:
         spec0 = self._tok_spec[0]
         if spec0 is None:
             return None
-        idx_map = NamedSharding(self.mesh, P(spec0)).addressable_devices_indices_map(
-            (global_batch,)
-        )
+        idx_map = NamedSharding(
+            self.mesh, self.sharding.leading_batch_spec
+        ).addressable_devices_indices_map((global_batch,))
         rows: set[int] = set()
         for idx in idx_map.values():
             s = idx[0]
@@ -566,7 +566,8 @@ class NDEngine:
             self._fused[numerics] = fuse_sharded_step(
                 self._make_sharded_step(numerics), self.mesh,
                 self._state_specs,
-                (P(None, *self._tok_spec), P()), self._donate,
+                (self.sharding.stacked_batch_spec, self.sharding.scalar),
+                self._donate,
             )
         return self._fused[numerics](state, tokens_g, rngs)
 
@@ -581,6 +582,11 @@ class NDEngine:
         from theanompi_tpu.parallel.mesh import first_local_value
 
         return int(first_local_value(state.step))
+
+    def sharding_recipe(self):
+        """The engine's ShardingRecipe (parallel/recipe.py) — declared
+        spec table for the sharding analyzer and the topology stamp."""
+        return self.sharding
 
     def elastic_spec(self) -> dict:
         """Per-leaf reshard policies for the topology manifest
@@ -616,35 +622,22 @@ class NDEngine:
         (``self._state_specs`` — the same per-leaf specs the
         checkpoint topology manifest stamps), so tp/pipe/expert-sharded
         params and their like-sharded accumulators divide by their
-        sharding ways while replicated leaves count in full."""
-        import jax as _jax
-
+        sharding ways while replicated leaves count in full. Factors
+        and specs are resolved per STATE leaf by the recipe, so prefix
+        specs broadcast correctly (SHARD003 verifies the table against
+        the compiled program)."""
         from theanompi_tpu.utils.flops import state_memory_model
 
-        sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
-
-        def spec_extent(spec) -> int:
-            ways = 1
-            for dim in tuple(spec):
-                for ax in (dim if isinstance(dim, tuple) else (dim,)):
-                    if ax is not None:
-                        ways *= int(sizes.get(ax, 1))
-            return ways
-
-        factors = {
-            _jax.tree_util.keystr(path): spec_extent(spec)
-            for path, spec in _jax.tree_util.tree_flatten_with_path(
-                self._state_specs,
-                is_leaf=lambda x: isinstance(x, P))[0]
-        }
+        lf = self.sharding.leaf_factors(state)
 
         def factor(path, leaf):
-            return factors.get(path, 1)
+            return lf.get(path, (1, None))[0]
 
         return state_memory_model(
             state, "nd", self.mesh.devices.size, factor,
             detail={"note": "per-leaf PartitionSpec extents "
                             "(tp/pipe/expert sharding)"},
+            specs={p: s for p, (_f, s) in lf.items()},
         )
 
     def cost_model(self, state, global_batch: int):

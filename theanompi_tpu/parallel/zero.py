@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.flatten_util import ravel_pytree
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from theanompi_tpu.models.contract import Model
 from theanompi_tpu.ops.optimizers import apply_updates, get_optimizer
@@ -65,50 +65,10 @@ class ZeroTrainState(NamedTuple):
     ef: PyTree = ()  # codec error-feedback residuals (or ())
 
 
-def make_zero1_train_step(
-    model: Model,
-    mesh: Mesh,
-    *,
-    axis_name: str = DATA_AXIS,
-    optimizer=None,
-    steps_per_epoch: int = 1,
-    input_transform: Optional[Callable] = None,
-    donate: bool = True,
-    fused: bool = False,
-    numerics: bool = False,
-    wire_codec=None,
-    fused_update: bool = False,
-):
-    """Build ``(init_state, train_step)`` for ZeRO-1 BSP training over
-    ``mesh``'s ``axis_name``.
-
-    ``init_state(key) -> ZeroTrainState`` (host-callable; jitted and
-    sharded). ``train_step(state, x, y, rng) -> (state, metrics)`` with
-    ``x``/``y`` sharded over the axis (the global batch, exactly like
-    parallel/bsp.py). ``optimizer`` defaults to the model recipe's.
-    With ``fused=True`` the returned step instead takes stacked
-    ``[g, batch, ...]`` groups + ``[g]`` keys and scans ``g`` sub-steps
-    in one program (``steps_per_dispatch``; metrics stacked).
-    """
-    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-    if axis_name not in sizes:
-        raise ValueError(f"axis {axis_name!r} not in mesh axes {mesh.axis_names}")
-    if len(mesh.axis_names) > 1:
-        # collectives here run over axis_name ONLY; on a multi-axis mesh
-        # the P() out-specs would stamp dcn-divergent params as
-        # replicated with no error
-        raise ValueError(
-            f"ZeRO-1 runs on a 1-D data mesh; got axes {mesh.axis_names} "
-            "(for multi-slice, flatten to one data axis — XLA still "
-            "routes the collectives hierarchically over ICI/DCN)"
-        )
-    from theanompi_tpu.parallel.codec import get_codec
-
-    n = sizes[axis_name]
-    codec = get_codec(wire_codec)
-    if n == 1:
-        codec = get_codec(None)  # no peers, no wire to compress
-    use_ef = codec.active and codec.error_feedback
+def _resolve_optimizer(model, optimizer, fused_update: bool):
+    """The one optimizer-resolution rule for ZeRO-1 (shared by the step
+    builder and the engine's ShardingRecipe construction, so the spec
+    table is derived from the SAME optimizer state the step runs)."""
     if fused_update:
         # fused one-pass epilogue over the flat 1/n segment: ZeRO-1
         # reuses the SAME kernel the replicated engines run, applied to
@@ -144,27 +104,119 @@ def make_zero1_train_step(
                 " rank's local segment, not the global gradient (drop "
                 "clip_norm or run the replicated engines)"
             )
-        opt = fuse_optimizer(name, **opt_kwargs)
-    else:
-        opt = (
-            get_optimizer(optimizer)
-            if isinstance(optimizer, str)
-            else (optimizer or model.optimizer())
-        )
-    schedule_lr = make_schedule_fn(model, steps_per_epoch)
+        return fuse_optimizer(name, **opt_kwargs)
+    return (
+        get_optimizer(optimizer)
+        if isinstance(optimizer, str)
+        else (optimizer or model.optimizer())
+    )
 
-    # flat-buffer geometry, from an abstract init (nothing materialized)
+
+def _flat_geometry(model, n: int) -> tuple:
+    """``(flat_size, seg)`` of the packed parameter buffer: total
+    elements and the padded per-rank segment — from an abstract init
+    (nothing materialized)."""
     import math
 
-    params_shapes = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0))[0])
+    params_shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0))[0]
+    )
     flat_size = sum(
-        math.prod(l.shape) for l in jax.tree_util.tree_leaves(params_shapes)
+        math.prod(l.shape)
+        for l in jax.tree_util.tree_leaves(params_shapes)
     )
-    seg = -(-flat_size // n)  # padded segment per rank
-    opt_shapes = jax.eval_shape(lambda: opt.init(jnp.zeros((seg,), jnp.float32)))
-    opt_specs = jax.tree_util.tree_map(
-        lambda l: P(axis_name) if l.ndim else P(), opt_shapes
+    return flat_size, -(-flat_size // n)  # padded segment per rank
+
+
+class _Zero1Setup(NamedTuple):
+    """The ONE derivation of a ZeRO-1 configuration's codec, optimizer,
+    flat geometry, and ShardingRecipe — shared by the step builder and
+    the engine so the declared spec table can only describe the program
+    that compiled (no second copy to drift)."""
+
+    codec: Any
+    use_ef: bool
+    opt: Any
+    flat_size: int
+    seg: int
+    opt_shapes: Any
+    recipe: Any  # parallel/recipe.ShardingRecipe
+
+
+def _zero1_setup(model, mesh, axis_name, optimizer, fused_update,
+                 wire_codec) -> _Zero1Setup:
+    from theanompi_tpu.parallel.codec import get_codec
+    from theanompi_tpu.parallel.recipe import ShardingRecipe
+
+    n = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
+    codec = get_codec(wire_codec)
+    if n == 1:
+        codec = get_codec(None)  # no peers, no wire to compress
+    use_ef = codec.active and codec.error_feedback
+    opt = _resolve_optimizer(model, optimizer, fused_update)
+    flat_size, seg = _flat_geometry(model, n)
+    opt_shapes = jax.eval_shape(
+        lambda: opt.init(jnp.zeros((seg,), jnp.float32))
     )
+    return _Zero1Setup(
+        codec=codec, use_ef=use_ef, opt=opt, flat_size=flat_size,
+        seg=seg, opt_shapes=opt_shapes,
+        recipe=ShardingRecipe.zero1(mesh, axis_name, opt_shapes, use_ef),
+    )
+
+
+def make_zero1_train_step(
+    model: Model,
+    mesh: Mesh,
+    *,
+    axis_name: str = DATA_AXIS,
+    optimizer=None,
+    steps_per_epoch: int = 1,
+    input_transform: Optional[Callable] = None,
+    donate: bool = True,
+    fused: bool = False,
+    numerics: bool = False,
+    wire_codec=None,
+    fused_update: bool = False,
+    _setup: "Optional[_Zero1Setup]" = None,
+):
+    """Build ``(init_state, train_step)`` for ZeRO-1 BSP training over
+    ``mesh``'s ``axis_name``.
+
+    ``_setup``: a pre-derived :class:`_Zero1Setup` for this EXACT
+    configuration (the engine passes its own so builder and engine
+    share one derivation — never pass one built from different args).
+
+    ``init_state(key) -> ZeroTrainState`` (host-callable; jitted and
+    sharded). ``train_step(state, x, y, rng) -> (state, metrics)`` with
+    ``x``/``y`` sharded over the axis (the global batch, exactly like
+    parallel/bsp.py). ``optimizer`` defaults to the model recipe's.
+    With ``fused=True`` the returned step instead takes stacked
+    ``[g, batch, ...]`` groups + ``[g]`` keys and scans ``g`` sub-steps
+    in one program (``steps_per_dispatch``; metrics stacked).
+    """
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if axis_name not in sizes:
+        raise ValueError(f"axis {axis_name!r} not in mesh axes {mesh.axis_names}")
+    if len(mesh.axis_names) > 1:
+        # collectives here run over axis_name ONLY; on a multi-axis mesh
+        # the P() out-specs would stamp dcn-divergent params as
+        # replicated with no error
+        raise ValueError(
+            f"ZeRO-1 runs on a 1-D data mesh; got axes {mesh.axis_names} "
+            "(for multi-slice, flatten to one data axis — XLA still "
+            "routes the collectives hierarchically over ICI/DCN)"
+        )
+    n = sizes[axis_name]
+    # THE one config derivation (codec, optimizer, geometry, recipe) —
+    # the engine hands its own _Zero1Setup down, so the declared spec
+    # table and the compiled program share one derivation
+    setup = _setup if _setup is not None else _zero1_setup(
+        model, mesh, axis_name, optimizer, fused_update, wire_codec)
+    codec, use_ef, opt = setup.codec, setup.use_ef, setup.opt
+    flat_size, seg = setup.flat_size, setup.seg
+    schedule_lr = make_schedule_fn(model, steps_per_epoch)
+    recipe = setup.recipe
 
     def _seg_slice(flat, rank):
         padded = jnp.pad(flat, (0, n * seg - flat_size))
@@ -182,15 +234,12 @@ def make_zero1_train_step(
             params, model_state, opt_state, jnp.zeros((), jnp.int32), ef
         )
 
-    ef_specs = (
-        {"g": P(axis_name), "p": P(axis_name)} if use_ef else ()
-    )
-    state_specs = ZeroTrainState(P(), P(), opt_specs, P(), ef_specs)
+    state_specs = recipe.state_spec(ZeroTrainState)
     init_state = jax.jit(
         jax.shard_map(
             sharded_init,
             mesh=mesh,
-            in_specs=(P(),),
+            in_specs=(recipe.scalar,),
             out_specs=state_specs,
             check_vma=False,
         )
@@ -308,15 +357,17 @@ def make_zero1_train_step(
 
         return init_state, fuse_sharded_step(
             sharded_step, mesh, state_specs,
-            (P(None, axis_name), P(None, axis_name), P()), donate,
+            (recipe.stacked_batch_spec, recipe.stacked_batch_spec,
+             recipe.scalar), donate,
         )
 
     train_step = jax.jit(
         jax.shard_map(
             sharded_step,
             mesh=mesh,
-            in_specs=(state_specs, P(axis_name), P(axis_name), P()),
-            out_specs=(state_specs, P()),
+            in_specs=(state_specs, recipe.batch_spec, recipe.batch_spec,
+                      recipe.scalar),
+            out_specs=(state_specs, recipe.scalar),
             check_vma=False,
         ),
         # donate like parallel/bsp.py: without it every dispatch holds a
@@ -363,10 +414,18 @@ class ZeroEngine:
         self.model = model
         self.mesh = mesh
         self.codec = get_codec(wire_codec)
+        # ONE _zero1_setup derivation, handed to every step variant the
+        # engine builds (per-numerics + fused dispatch) via _build — the
+        # declared spec table (sharding analyzer, memory_model, topology
+        # stamp) and the compiled programs share it by construction
+        setup = _zero1_setup(model, mesh, DATA_AXIS, None,
+                             bool(fused_update), self.codec)
+        self.sharding = setup.recipe
         self._build = dict(steps_per_epoch=steps_per_epoch,
                            input_transform=input_transform,
                            wire_codec=self.codec,
-                           fused_update=bool(fused_update))
+                           fused_update=bool(fused_update),
+                           _setup=setup)
         self._init, step = make_zero1_train_step(model, mesh, **self._build)
         self._steps = {False: step}
         self._fused: dict = {}
@@ -411,6 +470,11 @@ class ZeroEngine:
 
         return int(first_local_value(state.step))
 
+    def sharding_recipe(self):
+        """The engine's ShardingRecipe (parallel/recipe.py) — declared
+        spec table for the sharding analyzer and the topology stamp."""
+        return self.sharding
+
     def elastic_spec(self) -> dict:
         """Per-leaf reshard policies for the topology manifest
         (utils/checkpoint.load_resharded). ZeRO is THE shape-changing
@@ -420,15 +484,7 @@ class ZeroEngine:
         logical ``F``-element prefix and re-pads for the target world.
         Params/BN state are replicated (``global``); error-feedback
         residuals are per-device and reset."""
-        import math
-
-        params_shapes = jax.eval_shape(
-            lambda: self.model.init(jax.random.PRNGKey(0))[0]
-        )
-        flat_size = sum(
-            math.prod(l.shape)
-            for l in jax.tree_util.tree_leaves(params_shapes)
-        )
+        flat_size, _ = _flat_geometry(self.model, self.mesh.devices.size)
         return {"policies": {
             ".opt_state": {"policy": "flat_padded",
                            "logical": int(flat_size)},
@@ -452,21 +508,23 @@ class ZeroEngine:
         ``MemoryModel``; see BSPEngine.memory_model). ZeRO-1's point IS
         this table: params/BN state replicated (factor 1), the flat
         optimizer accumulators sharded ``1/n`` over the data axis, the
-        codec's error-feedback residuals likewise per-device."""
+        codec's error-feedback residuals likewise per-device. Factors
+        and specs come from the engine's ShardingRecipe — the 1/n claim
+        and the step's actual sharding are one declaration (SHARD003
+        checks it against the compiled program)."""
         from theanompi_tpu.utils.flops import state_memory_model
 
         n = self.mesh.devices.size
+        lf = self.sharding.leaf_factors(state)
 
         def factor(path, leaf):
-            if n > 1 and (path.startswith(".opt_state")
-                          or path.startswith(".ef")):
-                return n
-            return 1
+            return lf.get(path, (1, None))[0]
 
         return state_memory_model(
             state, "zero1", n, factor,
             detail={"note": "optimizer state flat-sharded 1/n "
                             "(the ZeRO-1 memory claim)"},
+            specs={p: s for p, (_f, s) in lf.items()},
         )
 
     def cost_model(self, state, global_batch: int):

@@ -221,6 +221,14 @@ class ServeEngine:
             return fwd(params, model_state, x)
 
         self._fwd = jax.jit(_counted)
+        # the serving ShardingRecipe (parallel/recipe.py): params/BN
+        # replicated on the serving mesh — the DECLARED placement the
+        # train->serve handoff check (tools/analyze/sharding.py,
+        # SHARD004) compares against the training engine's stamped
+        # ``__topology__`` specs, and the placement set_params uses
+        from theanompi_tpu.parallel.recipe import ShardingRecipe
+
+        self.sharding = ShardingRecipe.serve()
 
         self._served: Optional[ServedParams] = None
         self._swap_lock = threading.Lock()
@@ -296,14 +304,15 @@ class ServeEngine:
         device_put runs OUTSIDE the swap lock (it is the slow part),
         and the step check re-runs under it, so two racing publishers
         cannot interleave check and assignment."""
-        import jax
-
         step = int(step)
         current = self._served
         if current is not None and step <= current.step:
             return False
-        params = jax.device_put(params)
-        model_state = jax.device_put(model_state)
+        # placement per the serving recipe (replicated; plain
+        # device_put on the single-device mesh — see
+        # ShardingRecipe.place_replicated)
+        params = self.sharding.place_replicated(params)
+        model_state = self.sharding.place_replicated(model_state)
         with self._swap_lock:
             current = self._served
             if current is not None and step <= current.step:

@@ -52,6 +52,22 @@ def load_for_serving(path: str, model):
     return state.params, state.model_state, checkpoint_step(path)
 
 
+def serving_leaf_specs(model) -> list:
+    """The DECLARED per-leaf serving specs for the leaves the engine
+    actually serves (params + model_state), resolved by the serving
+    ShardingRecipe over the same template ``load_for_serving`` loads
+    with. This is the serve half of the train->serve handoff check
+    (tools/analyze/sharding.py SHARD004): the training engine's recipe
+    stamps its per-leaf specs into every checkpoint's ``__topology__``
+    manifest, and the analyzer verifies this table agrees with it."""
+    from theanompi_tpu.parallel.recipe import ShardingRecipe
+
+    recipe = ShardingRecipe.serve()
+    return [(p, s) for p, s in
+            recipe.leaf_specs(serving_state_template(model))
+            if p.startswith(".params") or p.startswith(".model_state")]
+
+
 class CheckpointReloader:
     """Poll a training run's keep-chain; swap the engine's params.
 

@@ -104,6 +104,42 @@ def update_preflight_golden(engine: str, codec: str, fused: bool,
     return path
 
 
+# --------------------------------------------------------------------------
+# sharding goldens (sharding & layout analyzer, ISSUE 15): one JSON per
+# (engine, codec, fused) triple pinning the DECLARED per-leaf
+# PartitionSpec table (the engine's ShardingRecipe resolution) — any
+# drift fails `tmpi lint` (SHARD101) until `tmpi lint --update-golden`
+# regenerates it and the diff is reviewed as a deliberate layout change.
+# --------------------------------------------------------------------------
+
+
+def sharding_golden_path(engine: str, codec: str, fused: bool) -> str:
+    tag = codec.replace(":", "_")
+    knob = "fused" if fused else "unfused"
+    return os.path.join(GOLDEN_DIR, f"sharding_{engine}_{tag}_{knob}.json")
+
+
+def load_sharding_golden(engine: str, codec: str,
+                         fused: bool) -> Optional[dict]:
+    path = sharding_golden_path(engine, codec, fused)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def write_sharding_golden(engine: str, codec: str, fused: bool,
+                          payload: dict) -> str:
+    os.makedirs(GOLDEN_DIR, exist_ok=True)
+    path = sharding_golden_path(engine, codec, fused)
+    full = {"engine": engine, "codec": codec, "fused": bool(fused),
+            **payload}
+    with open(path, "w") as f:
+        json.dump(full, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
 def diff_payload(gold, current, prefix: str = "") -> list:
     """Human-readable recursive diff strings between two JSON-shaped
     payloads ([] = identical) — shared by the preflight golden

@@ -268,7 +268,15 @@ class PreflightTrace:
     """One preflight configuration: the built engine, its ABSTRACT
     state/operands (nothing materialized), the jitted numerics-off step
     ready to lower, the raw traced jaxpr for the dtype-flow pass, and
-    the engine's declared memory model."""
+    the engine's declared memory model.
+
+    ``parts`` is THE per-engine traced-program enumeration —
+    ``(name, jitted_fn, args, weight)`` with weight the fraction of
+    training steps the program runs on (EASGD adds its elastic exchange
+    at 1/avg_freq, mirroring the SPMD harness) — so consumers like the
+    sharding analyzer iterate one list instead of re-hardcoding which
+    engines carry a second program. ``parts[0]`` is always the step
+    (== ``step_fn``/``step_args``)."""
 
     engine: str
     codec: str
@@ -281,6 +289,7 @@ class PreflightTrace:
     memory: Any = None  # utils/flops.MemoryModel
     declared_donates: bool = False
     module_file: str = ""
+    parts: list = field(default_factory=list)
     error: Optional[str] = None
 
 
@@ -349,6 +358,13 @@ def _build_preflight(name: str, codec: str, fused: bool) -> PreflightTrace:
         out.step_fn = step_fn
         out.step_args = args
         out.jaxpr = jax.make_jaxpr(step_fn)(*args)
+        out.parts = [("step", step_fn, args, 1.0)]
+        if name == "easgd":
+            # the elastic exchange is a second compiled program, run
+            # every avg_freq steps — same enumeration the SPMD harness
+            # traces (_build_one's step_parts)
+            out.parts.append(("exchange", eng._exchange, (state,),
+                              1.0 / EASGD_AVG_FREQ))
         out.memory = eng.memory_model(state)
         out.declared_donates = bool(getattr(eng, "donates_state", False))
         out.module_file = inspect.getsourcefile(type(eng)) or ""

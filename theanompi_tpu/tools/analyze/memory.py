@@ -85,46 +85,11 @@ class XlaMemory:
                 "generated_code_bytes": int(self.generated_code_bytes)}
 
 
-def lowered_memory(jitted, *args, **kwargs) -> XlaMemory:
-    """XLA ``memory_analysis()`` of one jitted callable lowered over
-    abstract operands — compiles, never executes. Raises when the
-    backend provides no memory analysis (the caller converts that into
-    a per-config finding rather than crashing the lint).
-
-    The persistent compilation cache is bypassed for this compile: a
-    cache-DESERIALIZED executable reports ``alias_size_in_bytes == 0``
-    (the stats don't survive serialization), which would read as every
-    donation silently failing — the exact false positive MEM002 must
-    never produce. Measured on this container's jax: a warm-cache
-    reload of a donated program loses its alias bytes while
-    argument/temp survive. On this container's jax the cache decision
-    is LATCHED process-wide at the first compile (``is_cache_used``
-    memoizes), so clearing the dir alone is not enough once anything
-    compiled cache-enabled — the cache state is reset around the
-    bypass and again after, so surrounding code re-initializes with
-    its configured dir."""
-    import jax
-
-    try:
-        from jax._src import compilation_cache as _cc
-    except Exception:  # noqa: BLE001 — private module; degrade to dir-only
-        _cc = None
-
-    def _reset():
-        if _cc is not None:
-            try:
-                _cc.reset_cache()
-            except Exception:  # noqa: BLE001
-                pass
-
-    prev = jax.config.jax_compilation_cache_dir
-    try:
-        jax.config.update("jax_compilation_cache_dir", None)
-        _reset()
-        compiled = jitted.lower(*args, **kwargs).compile()
-    finally:
-        jax.config.update("jax_compilation_cache_dir", prev)
-        _reset()
+def compiled_memory(compiled) -> XlaMemory:
+    """Read ``memory_analysis()`` off an already-compiled executable.
+    Raises when the backend provides no memory analysis (the caller
+    converts that into a per-config finding rather than crashing the
+    lint)."""
     ma = compiled.memory_analysis()
     if ma is None:
         raise RuntimeError("backend returned no memory_analysis()")
@@ -136,6 +101,22 @@ def lowered_memory(jitted, *args, **kwargs) -> XlaMemory:
         generated_code_bytes=int(
             getattr(ma, "generated_code_size_in_bytes", 0)),
     )
+
+
+def lowered_memory(jitted, *args, **kwargs) -> XlaMemory:
+    """XLA ``memory_analysis()`` of one jitted callable lowered over
+    abstract operands — compiles, never executes, with the persistent
+    compilation cache BYPASSED: a cache-DESERIALIZED executable reports
+    ``alias_size_in_bytes == 0`` (the stats don't survive
+    serialization), which would read as every donation silently failing
+    — the exact false positive MEM002 must never produce. The bypass
+    (and the process-wide cache-latch workaround) lives in the shared
+    tools/analyze/lowering.py, because the sharding analyzer needs the
+    same discipline: a cache-deserialized executable also drops its
+    sharding metadata."""
+    from theanompi_tpu.tools.analyze.lowering import lowered_compile
+
+    return compiled_memory(lowered_compile(jitted, *args, **kwargs))
 
 
 @dataclass
@@ -191,7 +172,12 @@ class MemoryReport:
         rows = [
             {"name": l.path, "bytes": int(l.per_device_bytes),
              "dtype": l.dtype, "shape": list(l.shape),
-             "kind": "state"}
+             "kind": "state",
+             # the recipe-DECLARED spec the per-device division derives
+             # from (None on legacy bare-factor callers) — `tmpi
+             # preflight` prints it instead of re-deriving sharding
+             "spec": getattr(l, "spec", None),
+             "shard_factor": int(l.shard_factor)}
             for l in self.model.leaves
         ]
         batch = max(0, int(self.xla.argument_bytes)
@@ -331,10 +317,20 @@ def config_report(name: str, codec: str, fused: bool,
             _REPORT_CACHE[key] = (None, pre.error)
         else:
             try:
-                report = analyze_step_memory(
-                    pre.step_fn, pre.step_args, pre.memory,
-                    pre.declared_donates, engine=name, codec=codec,
-                    fused=fused,
+                # compile through the shared per-config executable
+                # cache (tools/analyze/lowering.py): the sharding
+                # family reads input_shardings/HLO off the SAME
+                # executable, so the matrix compiles once per process
+                from theanompi_tpu.tools.analyze.lowering import (
+                    config_executable,
+                )
+
+                report = MemoryReport(
+                    engine=name, codec=codec, fused=bool(fused),
+                    xla=compiled_memory(config_executable(
+                        key, pre.step_fn, pre.step_args)),
+                    model=pre.memory,
+                    declared_donates=bool(pre.declared_donates),
                 )
                 _REPORT_CACHE[key] = (report, None)
             except Exception as e:  # noqa: BLE001 — becomes a finding

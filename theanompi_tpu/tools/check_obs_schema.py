@@ -296,6 +296,28 @@ SCHEMAS: dict[str, dict[str, tuple[tuple, bool]]] = {
         "device_kind": ((str,), False),
         "findings": ((int,), False),
     },
+    # sharding & layout analyzer (tools/analyze/sharding.py, `tmpi
+    # lint --obs-dir`): one record per analyzed engine x codec x
+    # --fused-update config. `leaves` is the declared spec-table size,
+    # `mismatched` the leaves whose compiled input sharding disagrees
+    # with the recipe, `hidden_bytes` the GSPMD-inserted collective
+    # wire (per-device, amortized) absent from the traced program —
+    # the SHARD002 hidden-wire total, next to the compiled/traced/
+    # declared byte figures it was reconciled against.
+    "shard": {
+        "t": (_NUM, True),
+        "engine": ((str,), True),
+        "codec": ((str,), True),
+        "n_devices": ((int,), True),
+        "leaves": ((int,), True),
+        "mismatched": ((int,), True),
+        "hidden_bytes": (_NUM, True),
+        "fused": ((bool,), False),
+        "compiled_wire_bytes": (_NUM, False),
+        "traced_wire_bytes": (_NUM, False),
+        "declared_raw_bytes": (_NUM, False),
+        "findings": ((int,), False),
+    },
     # serving engine (serve/engine.py): periodic + drain-time stats
     # records in <obs_dir>/serve.jsonl. `params_step` is the checkpoint
     # step being served (-1 before the first load); `metrics` is a flat

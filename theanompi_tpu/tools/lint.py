@@ -45,7 +45,22 @@ RACE003 lock-order inversion (potential deadlock)
 RACE004 filesystem exists/stat-then-use TOCTOU across threads
 RACE005 non-atomic multi-field publish vs a locked reader
 RACE101 discovered thread model drifted from the reviewed golden
+SHARD001 declared spec vs compiled leaf sharding mismatch
+SHARD002 implicit resharding: hidden (or elided) collective wire
+SHARD003 replication bloat: declared-sharded leaf compiled replicated
+SHARD004 train->serve handoff spec drift
+SHARD101 declared per-leaf spec table drifted from golden
 ======== ================================================================
+
+The SHARD family is the sharding & layout analyzer
+(tools/analyze/sharding.py, ISSUE 15): every engine x codec x
+``--fused-update`` configuration is LOWERED through the shared
+cache-bypassing compile (tools/analyze/lowering.py — the same
+executable the memory family reads, compiled once per config) and the
+COMPILED truth — per-leaf ``input_shardings`` and the optimized-HLO
+collective set — is checked against the engine's ShardingRecipe
+declaration (parallel/recipe.py), the traced jaxpr signature, and
+``traffic_model()``. Hidden wire is a finding, not a footnote.
 
 The RACE family is the host-concurrency analyzer
 (tools/analyze/concurrency.py): it discovers the thread model
@@ -141,6 +156,21 @@ RULES = {
     "RACE101": "discovered thread model drifted from the reviewed "
                "golden (tools/analyze/golden/thread_model.json; "
                "tmpi lint --update-golden to accept)",
+    "SHARD001": "declared ShardingRecipe spec disagrees with the "
+                "compiled executable's leaf sharding (or a hand-rolled "
+                "PartitionSpec outside parallel/recipe.py)",
+    "SHARD002": "GSPMD-inserted (or elided) collective wire absent "
+                "from the traced program, or compiled wire bytes "
+                "drifting from traffic_model() beyond the SPMD101 "
+                "tolerance",
+    "SHARD003": "leaf declared sharded but compiled fully replicated "
+                "— memory_model()'s 1/n division is a lie",
+    "SHARD004": "train->serve handoff drift: serve template specs vs "
+                "the training recipe's stamped __topology__ specs",
+    "SHARD101": "declared per-leaf spec table drifted from golden, or "
+                "the config could not be lowered "
+                "(tmpi lint --update-golden to accept a reviewed "
+                "drift)",
 }
 
 _EXEMPT_RE = re.compile(r"spmd_exempt:[ \t]*(\S[^\n]*)")
@@ -225,7 +255,8 @@ def _add(report: LintReport, rule: str, path: str, line: int,
     # per-line written-reason suppression; HOT/CODEC/SCHEMA keep their
     # own exemption mechanics
     reason = _exemption_reason(path, line) if (
-        suppressible and rule.startswith(("SPMD", "MEM", "PREC", "RACE"))
+        suppressible and rule.startswith(("SPMD", "MEM", "PREC", "RACE",
+                                          "SHARD"))
     ) else None
     if reason:
         f.suppressed = True
@@ -327,6 +358,16 @@ def _run_precision(report: LintReport, update_golden: bool) -> None:
         _add(report, f.rule, f.path, f.line, f.message)
 
 
+def _run_sharding(report: LintReport, update_golden: bool,
+                  obs_dir: Optional[str] = None) -> None:
+    _ensure_virtual_devices()
+    from theanompi_tpu.tools.analyze.sharding import analyze_sharding
+
+    for f in analyze_sharding(update_golden=update_golden,
+                              obs_dir=obs_dir):
+        _add(report, f.rule, f.path, f.line, f.message)
+
+
 def _run_concurrency(report: LintReport, update_golden: bool) -> None:
     # pure AST over the threaded host files — needs no devices, so it
     # also runs under --no-analyze-free fast paths cheaply
@@ -346,7 +387,8 @@ def _timed(report: LintReport, family: str, fn, *args) -> None:
 
 
 def run_lint(paths: Optional[list] = None, update_golden: bool = False,
-             analyze: bool = True) -> LintReport:
+             analyze: bool = True,
+             obs_dir: Optional[str] = None) -> LintReport:
     report = LintReport()
     _timed(report, "hot_loop", _run_hot_loop)
     _timed(report, "codec_coverage", _run_codec_coverage)
@@ -362,6 +404,10 @@ def run_lint(paths: Optional[list] = None, update_golden: bool = False,
         # budget is attributable via timings_s
         _timed(report, "memory", _run_memory, update_golden)
         _timed(report, "precision", _run_precision, update_golden)
+        # the sharding family reads the SAME compiled executables the
+        # memory family lowered (tools/analyze/lowering.py memoizes
+        # them), so its marginal cost is parsing, not compiling
+        _timed(report, "sharding", _run_sharding, update_golden, obs_dir)
     return report
 
 
@@ -385,11 +431,16 @@ def main(argv: Optional[list] = None) -> int:
                          "snapshots instead of diffing against them")
     ap.add_argument("--no-analyze", action="store_true",
                     help="skip the SPMD analyzer (classic lints only)")
+    ap.add_argument("--obs-dir", default=None,
+                    help="append one kind=shard record per analyzed "
+                         "config to <dir>/metrics.jsonl "
+                         "(tools/check_obs_schema.py)")
     args = ap.parse_args(argv)
     try:
         report = run_lint(paths=args.paths or None,
                           update_golden=args.update_golden,
-                          analyze=not args.no_analyze)
+                          analyze=not args.no_analyze,
+                          obs_dir=args.obs_dir)
     except Exception as e:  # noqa: BLE001 — rc 2 = the lint itself broke
         print(f"tmpi lint: internal failure: {type(e).__name__}: {e}",
               file=sys.stderr)

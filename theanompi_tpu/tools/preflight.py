@@ -270,9 +270,16 @@ def format_report(report: dict, top: int = 12) -> str:
     lines.append(f"  per-leaf residency (top {top}):")
     for r in report["buffers"][:top]:
         shape = "x".join(str(d) for d in r["shape"]) if r["shape"] else ""
+        # the sharding column is the engine recipe's DECLARED spec
+        # (parallel/recipe.py leaf_factors -> MemoryLeaf.spec), not a
+        # re-derivation: [] = replicated, [['data']] = dim 0 on 'data'
+        spec = r.get("spec")
+        sharded = (f"  P{spec} 1/{r['shard_factor']}"
+                   if spec and r.get("shard_factor", 1) > 1 else "")
         lines.append(
             f"    {_fmt(r['bytes']):>12}  {r['name']}"
             + (f"  [{r['dtype']} {shape}]" if r["dtype"] else "")
+            + sharded
         )
     for f in report["findings"]:
         lines.append(f"  {f['rule']}: {f['message']}")

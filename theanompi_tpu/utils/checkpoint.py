@@ -77,12 +77,19 @@ def _topology_manifest(state: PyTree, topology: Optional[dict]) -> Optional[dict
     leaves = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
         leaves[_path_key(path)] = {"spec": leaf_spec_json(leaf)}
-    return {
+    out = {
         "version": TOPOLOGY_VERSION,
         "mesh": topology.get("mesh"),
         "elastic": topology.get("elastic") or {},
         "leaves": leaves,
     }
+    if topology.get("recipe") is not None:
+        # the engine's ShardingRecipe identity (parallel/recipe.py
+        # ``as_json``): the DECLARED spec source the live-array specs
+        # above were placed by — the sharding analyzer's SHARD004
+        # train->serve handoff check keys on this declaration
+        out["recipe"] = topology["recipe"]
+    return out
 
 
 def _array_crc(arr: np.ndarray) -> dict:

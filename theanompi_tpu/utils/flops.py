@@ -250,6 +250,11 @@ class MemoryLeaf:
     shape: tuple  # global logical shape
     global_bytes: int
     shard_factor: int  # mesh extent the leaf is divided over (>= 1)
+    # serialized PartitionSpec (parallel/mesh.spec_to_json) the factor
+    # derives from — the engine's ShardingRecipe declaration, so the
+    # preflight byte table and the sharding analyzer read ONE source
+    # (None on legacy callers that still pass bare factors)
+    spec: Optional[list] = None
 
     @property
     def per_device_bytes(self) -> int:
@@ -266,7 +271,8 @@ class MemoryLeaf:
                 "shape": list(self.shape),
                 "global_bytes": int(self.global_bytes),
                 "per_device_bytes": int(self.per_device_bytes),
-                "shard_factor": int(self.shard_factor)}
+                "shard_factor": int(self.shard_factor),
+                "spec": self.spec}
 
 
 @dataclass
@@ -321,13 +327,20 @@ class MemoryModel:
 
 
 def state_memory_model(state, rule: str, n_devices: int, shard_factor,
-                       detail: Optional[dict] = None) -> MemoryModel:
+                       detail: Optional[dict] = None,
+                       specs: Optional[dict] = None) -> MemoryModel:
     """Build a :class:`MemoryModel` from a (possibly abstract) engine
     state pytree. ``shard_factor(path_str, leaf) -> int`` is the
     engine's own per-leaf sharding knowledge — the mesh extent the
-    leaf's global shape is divided over (1 = replicated). Works on
+    leaf's global shape is divided over (1 = replicated). ``specs``
+    optionally maps each leaf path to the declared PartitionSpec the
+    factor derives from (the engine's ShardingRecipe table — see
+    parallel/recipe.py ``leaf_factors``); it rides every leaf into the
+    preflight byte table and the residency goldens. Works on
     ``jax.eval_shape`` structs: only ``.shape``/``.dtype`` are read."""
     import jax
+
+    from theanompi_tpu.parallel.mesh import spec_to_json
 
     leaves = []
     for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
@@ -342,10 +355,12 @@ def state_memory_model(state, rule: str, n_devices: int, shard_factor,
             n_elems *= int(d)
         nbytes = int(n_elems * _np.dtype(dtype).itemsize)
         pstr = jax.tree_util.keystr(path)
+        spec = (specs or {}).get(pstr)
         leaves.append(MemoryLeaf(
             path=pstr, dtype=str(dtype), shape=shape,
             global_bytes=nbytes,
             shard_factor=max(1, int(shard_factor(pstr, leaf))),
+            spec=spec_to_json(spec) if spec is not None else None,
         ))
     return MemoryModel(rule=rule, n_devices=int(n_devices), leaves=leaves,
                        detail=dict(detail or {}))
