@@ -1,0 +1,482 @@
+"""Fleet telemetry plane (ISSUE 16): the obs/fleet.py cross-rank
+tailer + straggler/frozen/skew detectors, the obs/exporter.py chief
+HTTP exporter, ``tmpi top``, and the satellites (kind=fleet schema,
+multi-rank trace clock alignment, the plot_history fleet panel, the
+silent-rank regression, and a seeded thread-stress scenario).
+
+The canonical fixture fabricates a 4-rank obs dir: ranks 0/1 healthy,
+rank 2 a persistent straggler (3.5x the fleet-median step time, skewed
+numerics), rank 3 frozen (spans and heartbeat stop at step 10 while
+the fleet reaches 30) — the ISSUE 16 acceptance scenario.
+"""
+
+import json
+import os
+import re
+import socket
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from theanompi_tpu.obs.exporter import FleetExporter
+from theanompi_tpu.obs.fleet import FleetTailer, fleet_topology
+from theanompi_tpu.tools.analyze.stress import Scenario, StressHarness
+from theanompi_tpu.tools.check_obs_schema import main as schema_main
+from theanompi_tpu.tools.check_obs_schema import validate_record
+from theanompi_tpu.tools.top import render, top_main
+
+# Prometheus text exposition: comment lines, or `name{labels} value`
+_PROM_LINE = re.compile(
+    r"[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? \S+")
+
+
+def _span(rank, t0, dur, **extra):
+    row = {"kind": "span", "name": "step", "rank": rank, "t0": t0,
+           "dur": dur, "depth": 0}
+    row.update(extra)
+    return json.dumps(row) + "\n"
+
+
+def write_fleet_dir(obs, *, t_end, straggler=True, frozen=True):
+    """Fabricate the 4-rank obs dir (every record schema-valid)."""
+    os.makedirs(obs, exist_ok=True)
+    t0 = t_end - 60.0
+    for r in range(4):
+        n = 10 if (frozen and r == 3) else 30
+        dur = 0.35 if (straggler and r == 2) else 0.1
+        with open(os.path.join(obs, f"spans_rank{r}.jsonl"), "w") as f:
+            for i in range(n):
+                f.write(_span(r, t0 + 1.5 * i, dur))
+        hb_t = (t_end - 48.0) if (frozen and r == 3) else t_end
+        hb_step = 10 if (frozen and r == 3) else 30
+        with open(os.path.join(obs, f"heartbeat_rank{r}.json"), "w") as f:
+            json.dump({"kind": "heartbeat", "rank": r, "t": hb_t,
+                       "step": hb_step, "pid": 1000 + r}, f)
+        nm = 100.0 if (straggler and r == 2) else 1.0
+        # the frozen rank's last records stop where its spans did
+        nm_t = (t_end - 48.5) if (frozen and r == 3) else t_end - 10.0
+        nm_step = 10 if (frozen and r == 3) else 25
+        with open(os.path.join(obs, f"numerics_rank{r}.jsonl"), "w") as f:
+            f.write(json.dumps({
+                "kind": "numerics", "rank": r, "t": nm_t,
+                "step": nm_step, "metrics": {"nm_grad_norm": nm}}) + "\n")
+    with open(os.path.join(obs, "metrics.jsonl"), "w") as f:
+        f.write(json.dumps({
+            "kind": "metrics", "t": t_end - 10.0, "step": 25,
+            "metrics": {"tmpi_comm_gbps": 12.5}}) + "\n")
+        for r in range(4):
+            f.write(json.dumps({
+                "kind": "profile", "rank": r,
+                "t": (t_end - 48.5) if (frozen and r == 3)
+                else t_end - 10.0,
+                "step": 10 if (frozen and r == 3) else 25,
+                "step_seconds": 0.35 if r == 2 else 0.1,
+                "fractions": {"compute": 0.8, "comm": 0.15, "host": 0.05},
+                "classification": "compute-bound",
+                "mfu": 0.40 - 0.05 * r}) + "\n")
+    with open(os.path.join(obs, "supervisor.jsonl"), "w") as f:
+        f.write(json.dumps({
+            "kind": "retry", "rank": 0, "t": t_end - 30.0, "attempt": 1,
+            "step": 12, "error": "InjectedCrash('boom')",
+            "backoff_s": 0.5}) + "\n")
+
+
+# --------------------------------------------------------------------------
+# tentpole: detectors over the fabricated 4-rank dir
+# --------------------------------------------------------------------------
+
+
+def test_detector_verdicts_post_mortem(tmp_path):
+    """One post-mortem refresh reaches the acceptance verdicts: rank 2
+    persistent straggler (and numerics-skewed), rank 3 frozen."""
+    obs = str(tmp_path / "obs")
+    write_fleet_dir(obs, t_end=10_000.0)
+    tailer = FleetTailer(obs, write_records=True)
+    v = tailer.refresh()
+    assert v.stragglers == [2]
+    assert v.frozen == [3]
+    assert v.missed == [3]
+    assert v.skewed == [2]
+    assert v.step == 30 and v.step_spread == 20
+    assert v.slowest_rank == 2
+    assert not v.healthy
+    reasons = " ".join(v.unhealthy_reasons())
+    assert "rank 2" in reasons and "rank 3" in reasons
+    assert v.step_s_p50 == pytest.approx(0.1)
+    assert v.step_s_max == pytest.approx(0.35)
+    assert v.comm_gbps == pytest.approx(12.5)
+    assert v.link_class == "ici"  # no dcn axis -> single slice
+    assert v.retries == 1
+    assert v.mfu_min == pytest.approx(0.25)
+    rows = {row["rank"]: row for row in v.rows}
+    assert rows[2]["straggler"] and rows[2]["skewed"]
+    assert rows[3]["frozen"] and rows[3]["missed"]
+    assert rows[0]["step"] == 30 and rows[3]["step"] == 10
+    # the kind=fleet record validates, landed on disk, and the whole
+    # fabricated dir (fleet.jsonl included) is schema-clean
+    assert validate_record(v.record()) == []
+    assert os.path.exists(os.path.join(obs, "fleet.jsonl"))
+    assert schema_main([obs, "-q"]) == 0
+    # a second refresh over unchanged files keeps the verdict (offsets
+    # already at EOF) and emits no duplicate record (change-gated)
+    n_lines = sum(1 for _ in open(os.path.join(obs, "fleet.jsonl")))
+    v2 = tailer.refresh()
+    assert v2.stragglers == [2] and v2.frozen == [3]
+    assert sum(1 for _ in open(os.path.join(obs, "fleet.jsonl"))) == n_lines
+    # tmpi_fleet_* gauges mirror the view
+    prom = tailer.registry.to_prometheus()
+    assert "tmpi_fleet_stragglers 1" in prom
+    assert "tmpi_fleet_frozen 1" in prom
+    assert "tmpi_fleet_healthy 0" in prom
+    assert 'tmpi_fleet_rank_step{rank="3"} 10' in prom
+
+
+def test_healthy_finished_dir_stays_healthy(tmp_path):
+    """Post-mortem 'now' is the dir's newest timestamp, not wall clock
+    — a finished healthy run must not read as universally frozen."""
+    obs = str(tmp_path / "obs")
+    write_fleet_dir(obs, t_end=10_000.0, straggler=False, frozen=False)
+    v = FleetTailer(obs).refresh()
+    assert v.healthy
+    assert v.stragglers == [] and v.frozen == [] and v.missed == []
+    assert v.step_spread == 0
+    assert v.skewed == []
+
+
+def test_incremental_resume_partial_lines_and_truncation(tmp_path):
+    obs = tmp_path / "obs"
+    obs.mkdir()
+    p = obs / "spans_rank0.jsonl"
+    p.write_text(_span(0, 100.0, 0.1) + _span(0, 101.0, 0.1))
+    tailer = FleetTailer(str(obs))
+    assert tailer.refresh().rows[0]["step"] == 2
+    # a partial trailing line (writer mid-append) stays unconsumed...
+    whole = _span(0, 102.0, 0.1)
+    head, tail = whole[:20], whole[20:]
+    with open(p, "a") as f:
+        f.write(_span(0, 103.0, 0.1) + head)
+    assert tailer.refresh().rows[0]["step"] == 3
+    # ...until its newline lands, then it parses whole
+    with open(p, "a") as f:
+        f.write(tail)
+    assert tailer.refresh().rows[0]["step"] == 4
+    # truncation/rotation: a file that shrank re-reads from offset 0
+    # instead of crashing on a stale offset
+    p.write_text(_span(0, 104.0, 0.1))
+    assert tailer.refresh().rows[0]["step"] == 5
+    # vanished file: tolerated, verdict retained
+    os.unlink(p)
+    assert tailer.refresh().rows[0]["step"] == 5
+
+
+def test_fleet_topology_slices(tmp_path):
+    """No ckpt dir / empty dir degrade to None (single-slice view)."""
+    assert fleet_topology(None) is None
+    assert fleet_topology(str(tmp_path)) is None
+    obs = str(tmp_path / "obs")
+    write_fleet_dir(obs, t_end=10_000.0)
+    topo = {"mesh": {"axes": ["dcn", "data"], "shape": [2, 2]}}
+    v = FleetTailer(obs, topology=topo).refresh()
+    assert v.link_class == "dcn"
+    assert [s["slice"] for s in v.slices] == [0, 1]
+    assert [s["ranks"] for s in v.slices] == [[0, 1], [2, 3]]
+    # the bad ranks roll up to their slice
+    assert v.slices[1]["stragglers"] == [2]
+    assert v.slices[1]["frozen"] == [3]
+
+
+# --------------------------------------------------------------------------
+# tentpole: chief HTTP exporter
+# --------------------------------------------------------------------------
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=5) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_exporter_endpoints(tmp_path):
+    obs = str(tmp_path / "obs")
+    write_fleet_dir(obs, t_end=time.time())
+    exp = FleetExporter(obs, 0, poll_interval=0.25).start()
+    try:
+        assert exp.port != 0  # port=0 resolved to the bound ephemeral
+        deadline = time.time() + 10.0
+        data = {}
+        while time.time() < deadline:
+            code, body = _get(exp.url + "/fleet.json")
+            assert code == 200
+            data = json.loads(body)
+            if data.get("n_ranks") == 4:
+                break
+            time.sleep(0.1)
+        # /fleet.json identifies the bad ranks by id
+        assert data["n_ranks"] == 4
+        assert data["stragglers"] == [2]
+        assert data["frozen"] == [3]
+        assert data["healthy"] is False
+        assert {row["rank"] for row in data["ranks"]} == {0, 1, 2, 3}
+        # /healthz flips 503 and names them
+        code, body = _get(exp.url + "/healthz")
+        hz = json.loads(body)
+        assert code == 503
+        assert hz["healthy"] is False
+        assert hz["stragglers"] == [2] and hz["frozen"] == [3]
+        assert any("rank 2" in r for r in hz["reasons"])
+        assert any("rank 3" in r for r in hz["reasons"])
+        # /metrics is well-formed Prometheus text exposition
+        code, body = _get(exp.url + "/metrics")
+        assert code == 200
+        text = body.decode()
+        lines = [ln for ln in text.splitlines() if ln]
+        assert any(ln.startswith("# HELP tmpi_fleet_") for ln in lines)
+        assert any(ln.startswith("# TYPE tmpi_fleet_") for ln in lines)
+        for ln in lines:
+            if not ln.startswith("#"):
+                assert _PROM_LINE.fullmatch(ln), ln
+        assert "tmpi_fleet_healthy 0" in text
+        assert 'tmpi_fleet_comm_gbps{link="ici"} 12.5' in text
+        code, _ = _get(exp.url + "/nope")
+        assert code == 404
+    finally:
+        exp.stop()
+    exp.stop()  # idempotent
+    # the exporter's record-writing tailer left a schema-clean dir
+    assert os.path.exists(os.path.join(obs, "fleet.jsonl"))
+    assert schema_main([obs, "-q"]) == 0
+
+
+def test_exporter_port_conflict_raises(tmp_path):
+    """A taken port raises OSError — the worker/supervisor callers
+    degrade to no-exporter with a warning instead of failing the run."""
+    obs = tmp_path / "obs"
+    obs.mkdir()
+    s = socket.socket()
+    try:
+        s.bind(("127.0.0.1", 0))
+        s.listen(1)
+        with pytest.raises(OSError):
+            FleetExporter(str(obs), s.getsockname()[1]).start()
+    finally:
+        s.close()
+
+
+# --------------------------------------------------------------------------
+# tentpole: tmpi top
+# --------------------------------------------------------------------------
+
+
+def test_top_once_cli(tmp_path, capsys):
+    """`tmpi top OBS_DIR --once` (via the cli dispatch) names both bad
+    ranks post-mortem — and never grows the dir it reads."""
+    from theanompi_tpu.cli import main as cli_main
+
+    obs = str(tmp_path / "obs")
+    write_fleet_dir(obs, t_end=10_000.0)
+    assert cli_main(["top", obs, "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "UNHEALTHY" in out
+    assert "rank 2" in out and "rank 3" in out
+    by_rank = {ln.split()[0]: ln for ln in out.splitlines()
+               if ln.strip() and ln.split()[0].isdigit()}
+    assert "STRAGGLER" in by_rank["2"]
+    assert "FROZEN" in by_rank["3"]
+    assert "SKEW" in by_rank["2"]
+    assert by_rank["0"].rstrip().endswith("ok")
+    # read-only viewer: no fleet.jsonl appeared
+    assert not os.path.exists(os.path.join(obs, "fleet.jsonl"))
+
+
+def test_top_render_empty_dir(tmp_path):
+    assert top_main([str(tmp_path), "--once"]) == 0
+    assert "no telemetry" in render(FleetTailer(str(tmp_path)).refresh())
+
+
+# --------------------------------------------------------------------------
+# satellite: multi-rank trace clock alignment
+# --------------------------------------------------------------------------
+
+
+def test_spans_clock_alignment(tmp_path):
+    from theanompi_tpu.tools.spans_to_trace import clock_offsets, convert
+
+    a = tmp_path / "spans_rank0.jsonl"
+    b = tmp_path / "spans_rank1.jsonl"
+    a.write_text("".join(_span(0, 100.0 + i, 0.5) for i in range(3)))
+    # rank 1's clock runs 5s ahead; an amortized span must NOT anchor
+    b.write_text(_span(1, 90.0, 0.5, amortized=True)
+                 + "".join(_span(1, 105.0 + i, 0.5) for i in range(3)))
+    assert clock_offsets([str(a), str(b)]) == {0: 0.0, 1: -5.0}
+
+    def step_ts(trace):
+        out = {}
+        for ev in trace["traceEvents"]:
+            if ev.get("name") == "step" and not ev["args"].get("amortized"):
+                out.setdefault(ev["pid"], []).append(ev["ts"])
+        return out
+
+    aligned = step_ts(convert([str(a), str(b)]))
+    assert aligned[0] == aligned[1]  # matching step boundaries coincide
+    raw = step_ts(convert([str(a), str(b)], align=False))
+    assert raw[1][0] - raw[0][0] == pytest.approx(5e6)
+    # fewer than two anchored ranks: nothing to align against
+    assert clock_offsets([str(a)]) == {}
+
+
+def test_spans_to_trace_no_align_flag(tmp_path):
+    from theanompi_tpu.tools.spans_to_trace import main as trace_main
+
+    (tmp_path / "spans_rank0.jsonl").write_text(_span(0, 100.0, 0.5))
+    (tmp_path / "spans_rank1.jsonl").write_text(_span(1, 105.0, 0.5))
+    out = tmp_path / "trace.json"
+    assert trace_main([str(tmp_path), "-o", str(out), "--no-align"]) == 0
+    trace = json.loads(out.read_text())
+    ts = sorted(ev["ts"] for ev in trace["traceEvents"]
+                if ev.get("name") == "step")
+    assert ts[1] - ts[0] == pytest.approx(5e6)
+
+
+# --------------------------------------------------------------------------
+# satellite: plot_history fleet panel series
+# --------------------------------------------------------------------------
+
+
+def test_plot_history_fleet_series(tmp_path):
+    from theanompi_tpu.tools.plot_history import load_obs
+
+    run = tmp_path / "run"
+    obs = run / "obs"
+    obs.mkdir(parents=True)
+    jsonl = run / "history.jsonl"
+    jsonl.write_text("")
+
+    def rec(step, *, p50, mx, stragglers="", frozen=""):
+        return json.dumps({
+            "kind": "fleet", "t": float(step), "step": step, "ranks": 4,
+            "step_seconds_min": 0.1, "step_seconds_p50": p50,
+            "step_seconds_max": mx, "stragglers": stragglers,
+            "straggler_count": len([s for s in stragglers.split(",") if s]),
+            "frozen": frozen}) + "\n"
+
+    (obs / "fleet.jsonl").write_text(
+        rec(10, p50=0.10, mx=0.12)
+        + rec(20, p50=0.11, mx=0.40, stragglers="2", frozen="3"))
+    o = load_obs(str(jsonl))
+    assert o["fleet_step"] == [10, 20]
+    assert o["fleet_max"] == [0.12, 0.40]
+    assert o["fleet_frozen"] == [0, 1]
+    assert o["straggler_steps"] == [20]  # the red-vline steps
+    # append-mode rerun into the same dir: step restart resets the
+    # series so the newest run's band wins (rerun-safe)
+    with open(obs / "fleet.jsonl", "a") as f:
+        f.write(rec(5, p50=0.10, mx=0.11))
+    o = load_obs(str(jsonl))
+    assert o["fleet_step"] == [5]
+    assert o["straggler_steps"] == []
+
+
+# --------------------------------------------------------------------------
+# satellite: silent-rank (frozen heartbeat) regression
+# --------------------------------------------------------------------------
+
+
+def test_frozen_rank_regression(tmp_path):
+    """The silent-rank bug: heartbeat files were written per rank but
+    nothing ever compared them — a rank whose heartbeat froze while
+    the fleet advanced must be flagged BY ID even with healthy step
+    times everywhere."""
+    obs = tmp_path / "obs"
+    obs.mkdir()
+    t_end = 500.0
+    for r, (n, hb_t, hb_step) in enumerate([(20, 500.0, 20),
+                                            (5, 460.0, 5)]):
+        (obs / f"spans_rank{r}.jsonl").write_text(
+            "".join(_span(r, 400.0 + 2.0 * i, 0.1) for i in range(n)))
+        (obs / f"heartbeat_rank{r}.json").write_text(json.dumps(
+            {"kind": "heartbeat", "rank": r, "t": hb_t, "step": hb_step,
+             "pid": 1 + r}))
+    v = FleetTailer(str(obs)).refresh()
+    assert v.missed == [1] and v.frozen == [1]
+    assert v.stragglers == []  # identical step times: not a straggler
+    assert not v.healthy
+    assert any("frozen" in r and "rank 1" in r
+               for r in v.unhealthy_reasons())
+    out = render(v)
+    row1 = [ln for ln in out.splitlines()
+            if ln.strip().startswith("1 ")][0]
+    assert "FROZEN" in row1
+    # stale but NOT behind the fleet (both frozen at the same step):
+    # missed, not frozen — distinguishes a dead fleet from a dead rank
+    (obs / "heartbeat_rank1.json").write_text(json.dumps(
+        {"kind": "heartbeat", "rank": 1, "t": 460.0, "step": 20,
+         "pid": 2}))
+    (obs / f"spans_rank1.jsonl").write_text(
+        "".join(_span(1, 400.0 + 2.0 * i, 0.1) for i in range(20)))
+    v = FleetTailer(str(obs)).refresh()
+    assert v.missed == [1] and v.frozen == []
+
+
+# --------------------------------------------------------------------------
+# satellite: seeded thread-stress scenario (RACE lint's dynamic twin)
+# --------------------------------------------------------------------------
+
+
+def test_stress_fleet_tailer_concurrent_tail(tmp_path):
+    """A writer appending telemetry while refresh() races exporter-style
+    readers and the registry renderer: the lock discipline the static
+    analyzer certifies (tmpi-fleet-tail rows) must actually hold."""
+    N = 40
+
+    def make(rng):
+        d = tempfile.mkdtemp(dir=str(tmp_path))
+        span_path = os.path.join(d, "spans_rank0.jsonl")
+        hb_path = os.path.join(d, "heartbeat_rank0.json")
+        tailer = FleetTailer(d, write_records=True)
+
+        def writer():
+            for i in range(N):
+                with open(span_path, "a") as f:
+                    f.write(_span(0, 100.0 + i, 0.1))
+                if i % 8 == 0:
+                    tmp = hb_path + ".tmp"
+                    with open(tmp, "w") as f:
+                        json.dump({"kind": "heartbeat", "rank": 0,
+                                   "t": 100.0 + i, "step": i, "pid": 1},
+                                  f)
+                    os.replace(tmp, hb_path)
+
+        def refresher():
+            for _ in range(20):
+                tailer.refresh()
+
+        def reader():
+            for _ in range(20):
+                v = tailer.view()
+                if v is not None:
+                    json.dumps(v.as_dict())
+                tailer.registry.to_prometheus()
+
+        def check():
+            v = tailer.refresh()  # drain whatever the race left behind
+            errs = []
+            if len(v.rows) != 1 or v.rows[0]["rank"] != 0:
+                errs.append(f"rank rows torn: {v.rows}")
+            elif v.rows[0]["step"] != N:
+                # every appended span must be counted exactly once —
+                # a raced byte offset loses or double-reads lines
+                errs.append(f"step {v.rows[0]['step']} != {N}")
+            return errs
+
+        return Scenario(threads=[writer, refresher, reader],
+                        check=check, cleanup=tailer.stop)
+
+    res = StressHarness(seed=2, obs_dir=str(tmp_path)).run(
+        "fleet-tail-concurrent", make, rounds=6, wall_budget_s=30.0)
+    assert res.ok, res.violations
+    assert validate_record(res.as_record()) == []

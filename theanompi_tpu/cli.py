@@ -212,6 +212,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-snapshot-freq", type=int, default=0,
                    help="write a metrics snapshot every N steps (0 = epoch "
                         "boundaries only); requires --obs-dir")
+    p.add_argument("--fleet-exporter-port", type=int, default=0,
+                   help="chief-only fleet telemetry exporter (obs/"
+                        "exporter.py): serve /metrics, /fleet.json and "
+                        "/healthz on this port, aggregated across ranks "
+                        "by tailing the obs dir (0 = off; requires "
+                        "--obs-dir). Under --max-retries the exporter "
+                        "outlives retries. Watch interactively with "
+                        "`tmpi top OBS_DIR`")
     p.add_argument("--numerics-freq", type=int, default=0,
                    help="numerics flight recorder: compute in-graph "
                         "sentinels (grad/update/param norms, fused "
@@ -398,6 +406,12 @@ def main(argv=None) -> int:
         from theanompi_tpu.tools.chaos import chaos_main
 
         return chaos_main(argv[1:])
+    if argv[:1] == ["top"]:
+        # fleet console (tools/top.py): read-only viewer over an obs
+        # dir (live or post-mortem) — no jax, no platform setup
+        from theanompi_tpu.tools.top import top_main
+
+        return top_main(argv[1:])
     if argv[:1] == ["serve"]:
         # inference subcommand: its own parser + driver (serve/cli.py);
         # dispatched before the training parser, whose first positional
@@ -498,6 +512,10 @@ def main(argv=None) -> int:
     if (args.stall_timeout or args.metrics_snapshot_freq) and not args.obs_dir:
         print("WARNING: --stall-timeout/--metrics-snapshot-freq need "
               "--obs-dir; observability is off", flush=True)
+    if args.fleet_exporter_port and not args.obs_dir:
+        print("WARNING: --fleet-exporter-port needs --obs-dir (the "
+              "exporter tails the obs dir); the fleet exporter is off",
+              flush=True)
     # (--numerics-freq without --obs-dir warns inside run_training,
     # which covers API callers too)
     if args.scrub_interval and not args.ckpt_dir:
@@ -589,6 +607,7 @@ def main(argv=None) -> int:
             obs_dir=args.obs_dir,
             stall_timeout=args.stall_timeout,
             metrics_snapshot_freq=args.metrics_snapshot_freq,
+            fleet_exporter_port=args.fleet_exporter_port,
             numerics_freq=args.numerics_freq,
             flight_window=args.flight_window,
             on_anomaly=args.on_anomaly,

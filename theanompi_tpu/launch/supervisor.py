@@ -230,6 +230,12 @@ def supervise_training(
     obs_dir: Optional[str] = None,
     resume: bool = False,
     elastic: bool = False,
+    # fleet telemetry exporter (obs/exporter.py): explicit kwarg so it
+    # is NOT forwarded to run_training — the supervisor owns the
+    # exporter, started ONCE before the retry loop and stopped after
+    # it, so the port stays bound and scrapers keep answering while
+    # attempts die and resume
+    fleet_exporter_port: int = 0,
     **run_kwargs: Any,
 ) -> dict:
     """Run :func:`run_training` under the supervisor (module docstring).
@@ -313,6 +319,50 @@ def supervise_training(
         resume = True
         print(f"[supervisor] resumable marker found in {ckpt_dir!r}; "
               "auto-resuming", flush=True)
+    fleet_exporter = None
+    if fleet_exporter_port and obs_dir and \
+            int(os.environ.get("TMPI_PROCESS_ID", 0) or 0) == 0:
+        # chief-only, once per SUPERVISED run (not per attempt): the
+        # /healthz endpoint keeps answering through the backoff gaps a
+        # dying attempt leaves, which is exactly when a prober needs it
+        try:
+            from theanompi_tpu.obs.exporter import FleetExporter
+
+            fleet_exporter = FleetExporter(
+                obs_dir, fleet_exporter_port, ckpt_dir=ckpt_dir
+            ).start()
+            print(f"[supervisor] fleet exporter on {fleet_exporter.url} "
+                  "(/metrics /fleet.json /healthz)", flush=True)
+        except OSError as e:
+            fleet_exporter = None
+            print(f"[supervisor] WARNING: fleet exporter failed to bind "
+                  f"port {fleet_exporter_port}: {e!r}; continuing "
+                  "without it", flush=True)
+    try:
+        return _supervise_loop(
+            run_training, log, ckpt_dir=ckpt_dir, obs_dir=obs_dir,
+            resume=resume, elastic=elastic, max_retries=max_retries,
+            backoff_base=backoff_base, backoff_max=backoff_max,
+            retry_jitter=retry_jitter, injector=injector,
+            requested_world=requested_world, retries=retries,
+            preempts=preempts, attempt=attempt, world=world,
+            retry_causes=retry_causes, jitter_rng=_jitter_rng,
+            prev_sleep=_prev_sleep, run_kwargs=run_kwargs,
+        )
+    finally:
+        if fleet_exporter is not None:
+            fleet_exporter.stop()
+
+
+def _supervise_loop(run_training, log, *, ckpt_dir, obs_dir, resume,
+                    elastic, max_retries, backoff_base, backoff_max,
+                    retry_jitter, injector, requested_world, retries,
+                    preempts, attempt, world, retry_causes, jitter_rng,
+                    prev_sleep, run_kwargs) -> dict:
+    """The retry loop proper, split out so the exporter's try/finally
+    wraps it without re-indenting the recovery logic."""
+    _jitter_rng = jitter_rng
+    _prev_sleep = prev_sleep
     while True:
         attempt += 1
         if elastic:

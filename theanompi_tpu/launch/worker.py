@@ -201,6 +201,12 @@ def run_training(
     obs_dir: Optional[str] = None,
     stall_timeout: float = 0.0,
     metrics_snapshot_freq: int = 0,
+    # fleet telemetry exporter (obs/exporter.py): chief-only HTTP
+    # server on this port tailing obs_dir into the merged FleetView
+    # (/metrics, /fleet.json, /healthz); 0 = off. Under the supervisor
+    # the exporter is started ONCE outside the retry loop instead
+    # (launch/supervisor.py), so it survives retries.
+    fleet_exporter_port: int = 0,
     # numerics flight recorder (obs/numerics.py, obs/flight.py):
     # numerics_freq > 0 compiles the sentinel gauges into every Nth
     # step (grad/update/param norms, fused non-finite count, per-rule
@@ -920,6 +926,27 @@ def run_training(
         flight_window=flight_window,
         on_anomaly=on_anomaly,
     )
+    fleet_exporter = None
+    if fleet_exporter_port and obs.enabled and jax.process_index() == 0:
+        # chief-only fleet telemetry plane (obs/exporter.py): tail the
+        # obs dir every rank writes into, serve the merged FleetView
+        # over HTTP. Best-effort — a taken port degrades to
+        # no-exporter, never to a failed run. (Supervised runs start
+        # the exporter in launch/supervisor.py instead, outside the
+        # retry loop, and do not forward the port here.)
+        try:
+            from theanompi_tpu.obs.exporter import FleetExporter
+
+            fleet_exporter = FleetExporter(
+                obs_dir, fleet_exporter_port, topology=topo_meta
+            ).start()
+            print(f"[rank 0] fleet exporter on {fleet_exporter.url} "
+                  "(/metrics /fleet.json /healthz)", flush=True)
+        except OSError as e:
+            fleet_exporter = None
+            print(f"[rank 0] WARNING: fleet exporter failed to bind "
+                  f"port {fleet_exporter_port}: {e!r}; continuing "
+                  "without it", flush=True)
     if pending_reshard is not None:
         # the reshard ran before the obs facade existed; emit its
         # kind=reshard record + tmpi_reshard_* metrics now
@@ -1566,6 +1593,16 @@ def run_training(
                         obs.close()
                     finally:
                         try:
+                            if fleet_exporter is not None:
+                                # server down + tailer joined; the last
+                                # merged view stays in fleet.jsonl for
+                                # post-mortem `tmpi top --once`
+                                try:
+                                    fleet_exporter.stop()
+                                except Exception as e:  # noqa: BLE001
+                                    print(f"fleet exporter stop failed "
+                                          f"(suppressed): {e!r}",
+                                          flush=True)
                             if faults is not None:
                                 # uninstall the process-global writer
                                 # shim (installed where faults armed) —

@@ -126,6 +126,31 @@ On-disk layout under ``obs_dir`` (schemas:
                             also appends a final kind=metrics snapshot
                             (source="supervisor") carrying
                             tmpi_retries_total to metrics.jsonl
+    fleet.jsonl             fleet telemetry plane (obs/fleet.py): one
+                            kind=fleet record per CHANGED merged view
+                            (fleet step advance, or the straggler/
+                            frozen/missed/skewed rank sets changing) —
+                            fleet max step + spread, the step-time
+                            distribution over ranks (min/p50/p99/max
+                            of each rank's EWMA), slowest rank,
+                            rank-id flag lists comma-joined, MFU
+                            min/median, comm GB/s with its link class
+                            (ici, or dcn on a multislice mesh).
+                            Written only by a record-writing
+                            FleetTailer — in practice the chief's
+                            fleet exporter (obs/exporter.py), started
+                            chief-only by --fleet-exporter-port (or
+                            once per supervised run, outside the
+                            retry loop) and stopped in the worker/
+                            supervisor shutdown path after obs.close();
+                            its tmpi-fleet-tail thread tails every
+                            per-rank stream above byte-offset-
+                            incrementally and its tmpi-fleet-exporter
+                            thread serves /metrics (tmpi_fleet_*
+                            Prometheus), /fleet.json and /healthz.
+                            `tmpi top` reads the same streams but
+                            NEVER writes this file (viewers must not
+                            grow the dir they watch)
     serve.jsonl             serving engine telemetry (serve/engine.py,
                             written when ``tmpi serve`` runs with
                             --obs-dir): periodic + drain-time

@@ -1,0 +1,152 @@
+"""Chief-side fleet exporter: the FleetTailer behind an HTTP wire.
+
+Reuses the serve frontend's stdlib ``ThreadingHTTPServer`` pattern
+(serve/frontend.py) — no new dependencies, handler threads only read —
+to expose the merged fleet view (obs/fleet.py) from the chief while a
+run (or a whole supervised retry sequence) is in flight:
+
+Routes::
+
+    GET /metrics    -> Prometheus text of the tmpi_fleet_* registry
+                       (same exposition shape as obs/metrics.py:
+                       # HELP/# TYPE + name{label="v"} value)
+    GET /fleet.json -> FleetView.as_dict(): per-rank rows + aggregates
+    GET /healthz    -> 200 healthy / 503 on missed heartbeats or
+                       persistent stragglers, body naming the rank ids
+                       — the pager-facing probe
+
+Lifecycle: ``start()`` builds a live, record-writing FleetTailer,
+binds the server (``port=0`` picks an ephemeral port, re-read from
+``.port`` — the tests' path), and spawns ``serve_forever`` on a
+``tmpi-fleet-exporter`` daemon thread. ``stop()`` shuts the server
+down and joins the tailer. Started chief-only by launch/worker.py
+(``--fleet-exporter-port``); under the supervisor the exporter is
+started ONCE outside the retry loop (launch/supervisor.py), so the
+port stays bound and scrapers keep answering across retries.
+
+Concurrency: handler threads are per-request and only call
+``tailer.view()`` / ``registry.to_prometheus()`` — both internally
+locked; all mutation stays on the tailer's ``tmpi-fleet-tail`` thread.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from theanompi_tpu.obs.fleet import FleetTailer, fleet_topology
+
+
+def make_fleet_handler(tailer: FleetTailer):
+    class _FleetHandler(BaseHTTPRequestHandler):
+        # scrape logging off the stderr: Prometheus polls every few
+        # seconds for the life of the run
+        def log_message(self, fmt, *args):  # noqa: D102
+            pass
+
+        def _reply(self, code: int, body: dict):
+            data = json.dumps(body).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+            if self.path == "/metrics":
+                data = tailer.registry.to_prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            elif self.path == "/fleet.json":
+                view = tailer.view()
+                self._reply(200, view.as_dict() if view is not None
+                            else {"t": 0.0, "ranks": [], "healthy": True,
+                                  "warming_up": True})
+            elif self.path == "/healthz":
+                view = tailer.view()
+                if view is None:
+                    self._reply(200, {"healthy": True, "warming_up": True})
+                    return
+                body = {
+                    "healthy": view.healthy,
+                    "reasons": view.unhealthy_reasons(),
+                    "stragglers": view.stragglers,
+                    "frozen": view.frozen,
+                    "missed": view.missed,
+                    "step": view.step,
+                }
+                self._reply(200 if view.healthy else 503, body)
+            else:
+                self._reply(404, {"error": f"no route {self.path}"})
+
+    return _FleetHandler
+
+
+class FleetExporter:
+    """Own one live FleetTailer + one bound HTTP server."""
+
+    def __init__(self, obs_dir: str, port: int, *,
+                 host: str = "127.0.0.1", ckpt_dir: Optional[str] = None,
+                 topology: Optional[dict] = None,
+                 poll_interval: float = 2.0):
+        if topology is None and ckpt_dir:
+            topology = fleet_topology(ckpt_dir)
+        self.obs_dir = obs_dir
+        self.host = host
+        self.port = int(port)
+        self.poll_interval = float(poll_interval)
+        self.tailer = FleetTailer(obs_dir, topology=topology, live=True,
+                                  write_records=True)
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def start(self) -> "FleetExporter":
+        """Bind, tail, serve. Raises OSError if the port is taken — the
+        caller (worker/supervisor) degrades to no-exporter with a
+        warning rather than failing the run."""
+        with self._lock:
+            if self._server is not None:
+                return self
+            server = ThreadingHTTPServer(
+                (self.host, self.port), make_fleet_handler(self.tailer)
+            )
+            self._server = server
+            self.port = server.server_address[1]  # resolve port=0
+            self.tailer.start(self.poll_interval)
+            t = threading.Thread(target=self._serve_loop,
+                                 name="tmpi-fleet-exporter", daemon=True)
+            self._thread = t
+        t.start()
+        return self
+
+    def _serve_loop(self) -> None:
+        with self._lock:
+            server = self._server
+        if server is not None:  # stop() can win the race to the lock
+            server.serve_forever(poll_interval=0.2)
+
+    def stop(self) -> None:
+        """Idempotent shutdown: server first (stop answering), then the
+        tailer (one final view is left in place for post-mortem)."""
+        with self._lock:
+            server, t = self._server, self._thread
+            self._server = None
+            self._thread = None
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if t is not None:
+            t.join(timeout=10.0)
+        self.tailer.stop()
+
+    close = stop
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"

@@ -1,0 +1,701 @@
+"""Fleet telemetry plane: cross-rank aggregation over obs dirs.
+
+Every obs artifact this framework writes is per-rank — metrics.jsonl
+(chief), ``spans_rank{r}.jsonl``, ``numerics_rank{r}.jsonl``,
+``heartbeat_rank{r}.json`` — and the reference inherited Theano-MPI's
+shape of per-process logs with no cross-rank view. The north-star
+workloads are fleet-sized (serving SLOs, 256-chip multislice with
+slice-granularity failure), and their defining pathologies — a
+straggler rank stretching every synchronous step, a silently frozen
+rank, cross-rank numerics divergence — are *fleet* properties that no
+single rank's stream can show. This module is the merge point:
+
+- :class:`FleetTailer` incrementally tails every rank's JSONL streams
+  (byte-offset resumable: each refresh reads only the bytes appended
+  since the last one, and a truncated/rotated file resets to 0), plus
+  the atomic-replace heartbeat files, and folds them into a live
+  :class:`FleetView` keyed by step — per-rank step progress, the
+  step-time distribution over ranks (p50/p99/max), per-slice rollups
+  derived from the checkpoint ``__topology__`` mesh (the ShardingRecipe
+  axes: a ``dcn`` axis partitions ranks into slices), and comm GB/s
+  tagged with the link class the bytes ride (``dcn`` when the mesh is
+  multislice, else ``ici``);
+- a straggler/skew detector: each rank's step time keeps an EWMA
+  (alpha matching obs/numerics.py's AnomalyDetector) compared against
+  the fleet median; a rank whose last ``straggler_windows`` step
+  durations ALL exceed ``straggler_factor`` x the fleet median is a
+  *persistent* straggler (trailing-window form, so one post-mortem
+  refresh over a finished dir reaches the same verdict as a live
+  tailer). Numerics skew reuses the ``numerics_model()`` ``nm_*``
+  gauges: a rank whose latest gauge sits more than ``skew_factor`` x
+  away from the cross-rank median (either side) is flagged;
+- the silent-rank detector (the bug this PR fixes): heartbeat files
+  are written per rank but nothing ever compared them — a rank whose
+  heartbeat went stale (``frozen_after`` seconds behind "now") is
+  ``missed``, and stale *while the rest of the fleet advanced past it*
+  is ``frozen``. "now" is wall clock for a live tailer and the newest
+  timestamp observed anywhere in the dir for post-mortem reads, so a
+  finished healthy run does not read as universally frozen;
+- ``kind=fleet`` JSONL records (schema: tools/check_obs_schema.py)
+  appended to ``<obs_dir>/fleet.jsonl`` on change (step advanced or a
+  flag set changed), plus ``tmpi_fleet_*`` gauges in a private
+  :class:`~theanompi_tpu.obs.metrics.MetricsRegistry` — the exporter
+  (obs/exporter.py) serves that registry as ``/metrics``.
+
+Consumers: ``obs/exporter.py`` (chief HTTP exporter, live),
+``tools/top.py`` (``tmpi top``, live or post-mortem), and anything
+reading ``fleet.jsonl`` (tools/plot_history.py's fleet panel).
+
+Concurrency: one ``tmpi-fleet-tail`` daemon thread runs the refresh
+loop; ``self._lock`` serializes every refresh against viewers, so the
+exporter's handler threads and ``stop()`` never observe a half-merged
+view. Viewers are read-only (``write_records`` stays False in ``tmpi
+top``) — a viewer must never grow the obs dir it is watching.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+import re
+import statistics
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from theanompi_tpu.obs.metrics import MetricsRegistry
+
+# EWMA smoothing for per-rank step time — matches the numerics
+# AnomalyDetector's default so "persistent" means the same thing in
+# both detectors' documentation
+EWMA_ALPHA = 0.2
+# a rank is straggling when its step time exceeds factor x fleet median
+STRAGGLER_FACTOR = 1.5
+# ... and PERSISTENTLY so when its last K step durations all do
+STRAGGLER_WINDOWS = 3
+# heartbeat staleness (seconds behind "now") before a rank is missed
+FROZEN_AFTER_S = 30.0
+# numerics skew: |gauge| outside [median/factor, median*factor]
+SKEW_FACTOR = 10.0
+
+_RANK_FILE_RE = re.compile(r"_rank(\d+)\.jsonl?$")
+
+
+def _rank_of(path: str) -> Optional[int]:
+    m = _RANK_FILE_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def _percentile(values, q: float) -> float:
+    """Linear-interpolated q-quantile (0..1) of a small sample."""
+    s = sorted(values)
+    if not s:
+        return 0.0
+    k = (len(s) - 1) * q
+    lo, hi = math.floor(k), math.ceil(k)
+    if lo == hi:
+        return float(s[lo])
+    return float(s[lo] + (s[hi] - s[lo]) * (k - lo))
+
+
+def fleet_topology(ckpt_dir: Optional[str]) -> Optional[dict]:
+    """The ``__topology__`` manifest off the newest checkpoint in
+    ``ckpt_dir``, or None (no dir / no checkpoint / pre-elastic file).
+    Best-effort by design: the fleet view degrades to a single-slice
+    interpretation, it never blocks on checkpoint state."""
+    if not ckpt_dir:
+        return None
+    try:
+        from theanompi_tpu.utils.checkpoint import (
+            latest_checkpoint,
+            read_topology_manifest,
+        )
+
+        path = latest_checkpoint(ckpt_dir)
+        return read_topology_manifest(path) if path else None
+    except Exception:  # noqa: BLE001 — viewer must survive any ckpt state
+        return None
+
+
+def _n_slices(topology: Optional[dict]) -> int:
+    """Slice count from a ``__topology__`` manifest: the size of the
+    mesh's ``dcn`` axis when one exists (multislice), else 1."""
+    try:
+        mesh = (topology or {}).get("mesh") or {}
+        axes = list(mesh.get("axes") or [])
+        shape = list(mesh.get("shape") or [])
+        if "dcn" in axes:
+            return max(1, int(shape[axes.index("dcn")]))
+    except (TypeError, ValueError, AttributeError):
+        pass
+    return 1
+
+
+class _RankState:
+    """Mutable per-rank accumulator (plain data; every mutation happens
+    under the owning tailer's lock)."""
+
+    def __init__(self, rank: int):
+        self._lock = threading.Lock()  # guards the span accumulators
+        self.rank = rank
+        self.step = -1               # best known absolute step
+        self.spanned_steps = 0       # count of name=="step" spans seen
+        self.durations = deque(maxlen=64)  # recent step-span durations
+        self.ewma: Optional[float] = None  # smoothed step seconds
+        self.hb_t: Optional[float] = None  # last heartbeat wall time
+        self.hb_step: Optional[int] = None
+        self.pid: Optional[int] = None
+        self.mfu: Optional[float] = None
+        self.anomalies = 0
+        self.nm: dict[str, float] = {}     # latest nm_* gauge values
+        self.last_t = 0.0            # newest timestamp from this rank
+
+    def note_step_span(self, t0: float, dur: float) -> None:
+        with self._lock:
+            self.spanned_steps += 1
+            self.durations.append(float(dur))
+            self.ewma = (
+                float(dur) if self.ewma is None
+                else (1 - EWMA_ALPHA) * self.ewma + EWMA_ALPHA * float(dur)
+            )
+            self.step = max(self.step, self.spanned_steps)
+            self.last_t = max(self.last_t, t0 + dur)
+
+
+class FleetView:
+    """One merged snapshot of the fleet. ``ranks`` holds one plain-dict
+    row per rank (sorted by rank id); aggregate fields mirror the
+    ``tmpi_fleet_*`` gauges. Immutable by convention — the tailer
+    builds a fresh view per refresh and swaps the reference."""
+
+    def __init__(self, *, t: float, rows: list, step: int,
+                 step_spread: int, step_s_min: float, step_s_p50: float,
+                 step_s_p99: float,
+                 step_s_max: float, slowest_rank: int, stragglers: list,
+                 frozen: list, missed: list, skewed: list,
+                 mfu_min: Optional[float], mfu_median: Optional[float],
+                 comm_gbps: Optional[float], link_class: str,
+                 slices: list, retries: int):
+        self.t = t
+        self.rows = rows
+        self.step = step
+        self.step_spread = step_spread
+        self.step_s_min = step_s_min
+        self.step_s_p50 = step_s_p50
+        self.step_s_p99 = step_s_p99
+        self.step_s_max = step_s_max
+        self.slowest_rank = slowest_rank
+        self.stragglers = stragglers
+        self.frozen = frozen
+        self.missed = missed
+        self.skewed = skewed
+        self.mfu_min = mfu_min
+        self.mfu_median = mfu_median
+        self.comm_gbps = comm_gbps
+        self.link_class = link_class
+        self.slices = slices
+        self.retries = retries
+
+    @property
+    def healthy(self) -> bool:
+        """False on missed heartbeats or persistent stragglers — the
+        exporter's ``/healthz`` verdict."""
+        return not self.missed and not self.stragglers
+
+    def unhealthy_reasons(self) -> list[str]:
+        out = []
+        if self.missed:
+            out.append("missed heartbeat: rank "
+                       + ",".join(str(r) for r in self.missed))
+        if self.frozen:
+            out.append("frozen: rank "
+                       + ",".join(str(r) for r in self.frozen))
+        if self.stragglers:
+            out.append("persistent straggler: rank "
+                        + ",".join(str(r) for r in self.stragglers))
+        return out
+
+    def as_dict(self) -> dict:
+        """JSON-safe form — the exporter's ``/fleet.json`` body."""
+        return {
+            "t": self.t,
+            "step": self.step,
+            "n_ranks": len(self.rows),
+            "healthy": self.healthy,
+            "unhealthy_reasons": self.unhealthy_reasons(),
+            "step_spread": self.step_spread,
+            "step_seconds": {"min": self.step_s_min,
+                             "p50": self.step_s_p50,
+                             "p99": self.step_s_p99,
+                             "max": self.step_s_max},
+            "slowest_rank": self.slowest_rank,
+            "stragglers": self.stragglers,
+            "frozen": self.frozen,
+            "missed": self.missed,
+            "skewed": self.skewed,
+            "mfu_min": self.mfu_min,
+            "mfu_median": self.mfu_median,
+            "comm_gbps": self.comm_gbps,
+            "link_class": self.link_class,
+            "slices": self.slices,
+            "retries": self.retries,
+            "ranks": self.rows,
+        }
+
+    def record(self) -> dict:
+        """The ``kind=fleet`` JSONL record (scalar fields only; rank
+        lists comma-joined like scrub's ``quarantined``)."""
+        rec = {
+            "kind": "fleet",
+            "t": self.t,
+            "step": int(self.step),
+            "ranks": len(self.rows),
+            "step_spread": int(self.step_spread),
+            "step_seconds_min": self.step_s_min,
+            "step_seconds_p50": self.step_s_p50,
+            "step_seconds_p99": self.step_s_p99,
+            "step_seconds_max": self.step_s_max,
+            "slowest_rank": int(self.slowest_rank),
+            "straggler_count": len(self.stragglers),
+            "stragglers": ",".join(str(r) for r in self.stragglers),
+            "frozen": ",".join(str(r) for r in self.frozen),
+            "missed": ",".join(str(r) for r in self.missed),
+            "skewed": ",".join(str(r) for r in self.skewed),
+            "link_class": self.link_class,
+            "slices": len(self.slices) or 1,
+            "retries": int(self.retries),
+        }
+        if self.mfu_min is not None:
+            rec["mfu_min"] = self.mfu_min
+        if self.mfu_median is not None:
+            rec["mfu_median"] = self.mfu_median
+        if self.comm_gbps is not None:
+            rec["comm_gbps"] = self.comm_gbps
+        return rec
+
+
+class FleetTailer:
+    """Incremental multi-rank telemetry tailer over one obs dir.
+
+    ``live=True`` (the exporter) measures heartbeat staleness against
+    wall clock; ``live=False`` (post-mortem ``tmpi top --once``)
+    measures it against the newest timestamp in the dir, so a finished
+    run keeps its in-run verdicts. ``write_records=True`` additionally
+    appends ``kind=fleet`` records to ``<obs_dir>/fleet.jsonl`` — keep
+    it False in viewers (``tmpi top`` must not grow the dir it reads).
+    """
+
+    def __init__(self, obs_dir: str, *, topology: Optional[dict] = None,
+                 live: bool = False, write_records: bool = False,
+                 straggler_factor: float = STRAGGLER_FACTOR,
+                 straggler_windows: int = STRAGGLER_WINDOWS,
+                 frozen_after: float = FROZEN_AFTER_S,
+                 skew_factor: float = SKEW_FACTOR):
+        self.obs_dir = obs_dir
+        self.topology = topology
+        self.live = bool(live)
+        self.write_records = bool(write_records)
+        self.straggler_factor = float(straggler_factor)
+        self.straggler_windows = max(1, int(straggler_windows))
+        self.frozen_after = float(frozen_after)
+        self.skew_factor = float(skew_factor)
+        self.registry = MetricsRegistry()
+        self._fleet_path = os.path.join(obs_dir, "fleet.jsonl")
+        # RLock: refresh() holds it across the whole scan+detect pass
+        # while the helpers it calls re-acquire at their write sites
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._offsets: dict[str, int] = {}   # byte offset per tailed file
+        self._ranks: dict[int, _RankState] = {}
+        self._comm_gbps: Optional[float] = None
+        self._retries = 0
+        self._refresh_errors = 0
+        self._emitted_sig: Optional[tuple] = None
+        self._view: Optional[FleetView] = None
+        self._closed = False
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self, interval: float = 2.0) -> "FleetTailer":
+        """Spawn the ``tmpi-fleet-tail`` daemon refresh loop."""
+        with self._lock:
+            if self._thread is not None or self._closed:
+                return self
+            self._interval = max(0.2, float(interval))
+            t = threading.Thread(target=self._tail_loop,
+                                 name="tmpi-fleet-tail", daemon=True)
+            self._thread = t
+        t.start()
+        return self
+
+    def _tail_loop(self) -> None:
+        # immediate first refresh: the exporter's endpoints answer with
+        # real data as soon as the server binds, not an interval later
+        while True:
+            try:
+                self.refresh()
+            except Exception:  # noqa: BLE001 — the loop must outlive
+                # any malformed telemetry line or racing writer; the
+                # error count is surfaced as a gauge, not a crash
+                with self._lock:
+                    self._refresh_errors += 1
+            if self._stop.wait(self._interval):
+                return
+
+    def stop(self) -> None:
+        """Idempotent: signal the loop, join it, mark closed."""
+        self._stop.set()
+        with self._lock:
+            t = self._thread
+        if t is not None:
+            t.join(timeout=10.0)
+        with self._lock:
+            self._closed = True
+
+    close = stop
+
+    # -- tailing ------------------------------------------------------------
+    def _read_new_lines(self, path: str) -> list:
+        """Parsed rows appended to ``path`` since the last read.
+        Byte-offset resumable; a file that shrank (truncate/rotate)
+        re-reads from 0; a partial trailing line (a writer mid-append)
+        stays unconsumed until its newline lands."""
+        try:
+            fd = os.open(path, os.O_RDONLY)
+        except OSError:
+            return []
+        try:
+            size = os.fstat(fd).st_size
+            off = self._offsets.get(path, 0)
+            if size < off:
+                off = 0
+            data = os.pread(fd, size - off, off) if size > off else b""
+        except OSError:
+            return []
+        finally:
+            os.close(fd)
+        cut = data.rfind(b"\n")
+        if cut < 0:
+            return []
+        with self._lock:
+            self._offsets[path] = off + cut + 1
+        rows = []
+        for line in data[:cut + 1].splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except ValueError:
+                continue
+        return rows
+
+    def _rank(self, r: int) -> _RankState:
+        st = self._ranks.get(r)
+        if st is None:
+            with self._lock:
+                st = self._ranks.setdefault(r, _RankState(r))
+        return st
+
+    def _scan(self) -> None:
+        """One incremental pass over every telemetry stream in the dir."""
+        base = self.obs_dir
+        for path in sorted(glob.glob(os.path.join(base, "spans_rank*.jsonl"))):
+            rank = _rank_of(path)
+            for row in self._read_new_lines(path):
+                if row.get("kind") == "span" and row.get("name") == "step" \
+                        and not row.get("amortized"):
+                    r = row.get("rank", rank)
+                    if isinstance(r, int):
+                        try:
+                            self._rank(r).note_step_span(
+                                float(row["t0"]), float(row["dur"]))
+                        except (KeyError, TypeError, ValueError):
+                            continue
+        for path in sorted(glob.glob(os.path.join(base,
+                                                  "numerics_rank*.jsonl"))):
+            rank = _rank_of(path)
+            for row in self._read_new_lines(path):
+                self._ingest_numerics(row, rank)
+        for row in self._read_new_lines(os.path.join(base, "metrics.jsonl")):
+            self._ingest_metrics(row)
+        for row in self._read_new_lines(os.path.join(base,
+                                                     "supervisor.jsonl")):
+            if row.get("kind") == "retry":
+                with self._lock:
+                    self._retries += 1
+        for path in sorted(glob.glob(os.path.join(base,
+                                                  "heartbeat_rank*.json"))):
+            self._ingest_heartbeat(path)
+
+    def _ingest_numerics(self, row: dict, rank_hint: Optional[int]) -> None:
+        kind = row.get("kind")
+        r = row.get("rank", rank_hint)
+        if not isinstance(r, int):
+            return
+        st = self._rank(r)
+        t = row.get("t")
+        if isinstance(t, (int, float)):
+            st.last_t = max(st.last_t, float(t))
+        if kind == "numerics":
+            step = row.get("step")
+            if isinstance(step, int):
+                st.step = max(st.step, step)
+            metrics = row.get("metrics")
+            if isinstance(metrics, dict):
+                for k, v in metrics.items():
+                    if isinstance(k, str) and k.startswith("nm_") \
+                            and isinstance(v, (int, float)) \
+                            and not isinstance(v, bool):
+                        st.nm[k] = float(v)
+        elif kind == "anomaly":
+            st.anomalies += 1
+
+    def _ingest_metrics(self, row: dict) -> None:
+        kind = row.get("kind")
+        if kind == "metrics":
+            metrics = row.get("metrics")
+            if isinstance(metrics, dict):
+                v = metrics.get("tmpi_comm_gbps")
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    with self._lock:
+                        self._comm_gbps = float(v)
+        elif kind == "profile":
+            r = row.get("rank")
+            if not isinstance(r, int):
+                return
+            st = self._rank(r)
+            step = row.get("step")
+            if isinstance(step, int):
+                st.step = max(st.step, step)
+            mfu = row.get("mfu", row.get("mfu_calibrated"))
+            if isinstance(mfu, (int, float)) and not isinstance(mfu, bool):
+                st.mfu = float(mfu)
+            t = row.get("t")
+            if isinstance(t, (int, float)):
+                st.last_t = max(st.last_t, float(t))
+
+    def _ingest_heartbeat(self, path: str) -> None:
+        # atomic-replace file: re-read whole each refresh (no offsets)
+        try:
+            with open(path) as f:
+                row = json.load(f)
+        except (OSError, ValueError):
+            return
+        r = row.get("rank")
+        if not isinstance(r, int):
+            return
+        st = self._rank(r)
+        t, step, pid = row.get("t"), row.get("step"), row.get("pid")
+        if isinstance(t, (int, float)):
+            st.hb_t = float(t)
+            st.last_t = max(st.last_t, float(t))
+        if isinstance(step, int):
+            st.hb_step = step
+            st.step = max(st.step, step)
+        if isinstance(pid, int):
+            st.pid = pid
+
+    # -- merge + detect -----------------------------------------------------
+    def refresh(self) -> FleetView:
+        """One scan + detect pass; returns (and retains) the new view."""
+        with self._lock:
+            self._scan()
+            view = self._detect()
+            self._view = view
+            self._export(view)
+            if self.write_records:
+                self._maybe_emit(view)
+            return view
+
+    def view(self) -> Optional[FleetView]:
+        with self._lock:
+            return self._view
+
+    def _now(self) -> float:
+        if self.live:
+            return time.time()
+        newest = [st.last_t for st in self._ranks.values() if st.last_t]
+        return max(newest) if newest else 0.0
+
+    def _detect(self) -> FleetView:
+        now = self._now()
+        states = [self._ranks[r] for r in sorted(self._ranks)]
+        steps = [st.step for st in states if st.step >= 0]
+        fleet_step = max(steps) if steps else -1
+        spread = (max(steps) - min(steps)) if steps else 0
+
+        ewmas = {st.rank: st.ewma for st in states if st.ewma is not None}
+        med = statistics.median(ewmas.values()) if ewmas else 0.0
+        step_samples = list(ewmas.values())
+        slowest = max(ewmas, key=ewmas.get) if ewmas else -1
+
+        stragglers, frozen, missed, skewed = [], [], [], []
+        for st in states:
+            # straggling NOW: smoothed step time vs the fleet median
+            st_straggling = bool(
+                len(ewmas) >= 2 and med > 0.0 and st.ewma is not None
+                and st.ewma >= self.straggler_factor * med
+            )
+            # PERSISTENT: the last K raw durations all exceeded the
+            # threshold — trailing-window form, so a single post-mortem
+            # refresh reaches the same verdict as K live windows
+            tail = list(st.durations)[-self.straggler_windows:]
+            persistent = bool(
+                st_straggling and len(tail) >= self.straggler_windows
+                and all(d >= self.straggler_factor * med for d in tail)
+            )
+            if persistent:
+                stragglers.append(st.rank)
+            # silent-rank detection: heartbeat stale vs "now"
+            stale = (st.hb_t is not None and now > 0
+                     and now - st.hb_t > self.frozen_after)
+            if stale:
+                missed.append(st.rank)
+                if st.step < fleet_step:
+                    frozen.append(st.rank)
+            st._straggling_now = st_straggling
+            st._persistent = persistent
+            st._stale = stale
+
+        # numerics skew: per nm_* key with >= 2 reporting ranks,
+        # |value| more than skew_factor from the cross-rank median
+        keys = set()
+        for st in states:
+            keys.update(st.nm)
+        skewed_set = set()
+        for k in keys:
+            vals = {st.rank: abs(st.nm[k]) for st in states if k in st.nm}
+            if len(vals) < 2:
+                continue
+            m = statistics.median(vals.values())
+            if m <= 0.0:
+                continue
+            for r, v in vals.items():
+                if v > self.skew_factor * m or v * self.skew_factor < m:
+                    skewed_set.add(r)
+        skewed = sorted(skewed_set)
+
+        mfus = [st.mfu for st in states if st.mfu is not None]
+        n_slices = _n_slices(self.topology)
+        n_ranks = max(1, len(states))
+        link = "dcn" if n_slices > 1 else "ici"
+        slices = []
+        if states:
+            per_slice: dict[int, list] = {}
+            for st in states:
+                s = st.rank * n_slices // n_ranks if n_slices > 1 else 0
+                per_slice.setdefault(s, []).append(st)
+            for s in sorted(per_slice):
+                members = per_slice[s]
+                s_steps = [m.step for m in members if m.step >= 0]
+                s_ewmas = [m.ewma for m in members if m.ewma is not None]
+                slices.append({
+                    "slice": s,
+                    "ranks": [m.rank for m in members],
+                    "step": max(s_steps) if s_steps else -1,
+                    "step_seconds_max": max(s_ewmas) if s_ewmas else 0.0,
+                    "stragglers": [m.rank for m in members
+                                   if m.rank in stragglers],
+                    "frozen": [m.rank for m in members if m.rank in frozen],
+                })
+
+        rows = []
+        for st in states:
+            rows.append({
+                "rank": st.rank,
+                "step": st.step,
+                "step_seconds": st.ewma,
+                "mfu": st.mfu,
+                "anomalies": st.anomalies,
+                "heartbeat_t": st.hb_t,
+                "heartbeat_age_s": (max(0.0, now - st.hb_t)
+                                    if st.hb_t is not None and now else None),
+                "pid": st.pid,
+                "slice": (st.rank * n_slices // n_ranks
+                          if n_slices > 1 else 0),
+                "straggling": st._straggling_now,
+                "straggler": st._persistent,
+                "missed": st._stale,
+                "frozen": st.rank in frozen,
+                "skewed": st.rank in skewed_set,
+            })
+
+        return FleetView(
+            t=now, rows=rows, step=fleet_step, step_spread=spread,
+            step_s_min=_percentile(step_samples, 0.0),
+            step_s_p50=_percentile(step_samples, 0.50),
+            step_s_p99=_percentile(step_samples, 0.99),
+            step_s_max=_percentile(step_samples, 1.0),
+            slowest_rank=slowest, stragglers=stragglers, frozen=frozen,
+            missed=missed, skewed=skewed,
+            mfu_min=min(mfus) if mfus else None,
+            mfu_median=statistics.median(mfus) if mfus else None,
+            comm_gbps=self._comm_gbps, link_class=link, slices=slices,
+            retries=self._retries,
+        )
+
+    def _export(self, view: FleetView) -> None:
+        """Refresh the ``tmpi_fleet_*`` gauge family from one view."""
+        g = self.registry.gauge
+        g("tmpi_fleet_ranks", "ranks reporting telemetry").set(len(view.rows))
+        g("tmpi_fleet_step", "fleet max step").set(view.step)
+        g("tmpi_fleet_step_spread",
+          "max-min step over ranks").set(view.step_spread)
+        g("tmpi_fleet_slowest_rank",
+          "rank with the highest smoothed step time").set(view.slowest_rank)
+        g("tmpi_fleet_stragglers",
+          "persistent stragglers").set(len(view.stragglers))
+        g("tmpi_fleet_frozen",
+          "silent ranks behind the fleet").set(len(view.frozen))
+        g("tmpi_fleet_missed_heartbeats",
+          "ranks with stale heartbeats").set(len(view.missed))
+        g("tmpi_fleet_skewed",
+          "numerics-skewed ranks").set(len(view.skewed))
+        g("tmpi_fleet_healthy", "1 healthy / 0 unhealthy").set(
+            1.0 if view.healthy else 0.0)
+        g("tmpi_fleet_refresh_errors",
+          "suppressed refresh exceptions").set(self._refresh_errors)
+        g("tmpi_fleet_retries",
+          "supervisor retry records observed").set(view.retries)
+        sg = g("tmpi_fleet_step_seconds",
+               "step-time distribution over ranks")
+        sg.set(view.step_s_min, q="min")
+        sg.set(view.step_s_p50, q="p50")
+        sg.set(view.step_s_p99, q="p99")
+        sg.set(view.step_s_max, q="max")
+        if view.mfu_min is not None:
+            g("tmpi_fleet_mfu_min", "min MFU over ranks").set(view.mfu_min)
+        if view.mfu_median is not None:
+            g("tmpi_fleet_mfu_median",
+              "median MFU over ranks").set(view.mfu_median)
+        if view.comm_gbps is not None:
+            g("tmpi_fleet_comm_gbps",
+              "achieved collective GB/s by link class").set(
+                view.comm_gbps, link=view.link_class)
+        rg = g("tmpi_fleet_rank_step", "per-rank step progress")
+        for row in view.rows:
+            rg.set(row["step"], rank=row["rank"])
+        if len(view.slices) > 1:
+            slg = g("tmpi_fleet_slice_step", "per-slice max step")
+            for s in view.slices:
+                slg.set(s["step"], slice=s["slice"])
+
+    def _maybe_emit(self, view: FleetView) -> None:
+        """Append one ``kind=fleet`` record on change (first view, step
+        advance, or any flag set changing) — a quiet fleet stays quiet
+        on disk."""
+        sig = (view.step, tuple(view.stragglers), tuple(view.frozen),
+               tuple(view.missed), tuple(view.skewed), len(view.rows))
+        if sig == self._emitted_sig:
+            return
+        with self._lock:
+            self._emitted_sig = sig
+        try:
+            with open(self._fleet_path, "a") as f:
+                f.write(json.dumps(view.record()) + "\n")
+        except OSError:
+            return

@@ -89,6 +89,9 @@ CONCURRENCY_FILES = tuple(
         ("obs", "flight.py"),
         ("obs", "metrics.py"),
         ("obs", "spans.py"),
+        ("obs", "fleet.py"),
+        ("obs", "exporter.py"),
+        ("tools", "top.py"),
         ("serve", "engine.py"),
         ("serve", "reload.py"),
         ("serve", "frontend.py"),

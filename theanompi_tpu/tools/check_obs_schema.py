@@ -318,6 +318,38 @@ SCHEMAS: dict[str, dict[str, tuple[tuple, bool]]] = {
         "declared_raw_bytes": (_NUM, False),
         "findings": ((int,), False),
     },
+    # fleet telemetry plane (obs/fleet.py): one record per CHANGED
+    # fleet view (step advance or a flag set changing), appended to
+    # fleet.jsonl by a record-writing FleetTailer (the chief exporter;
+    # `tmpi top` is read-only). `step` is the fleet max step, `ranks`
+    # how many ranks reported telemetry; rank-id lists (stragglers /
+    # frozen / missed / skewed) ride comma-joined like scrub's
+    # `quarantined` (empty string = none). `step_seconds_*` is the
+    # step-time distribution over ranks' smoothed step times;
+    # `link_class` tags comm_gbps with the interconnect the bytes ride
+    # (dcn when the __topology__ mesh is multislice, else ici).
+    "fleet": {
+        "t": (_NUM, True),
+        "step": ((int,), True),
+        "ranks": ((int,), True),
+        "step_spread": ((int,), False),
+        "step_seconds_min": (_NUM, False),
+        "step_seconds_p50": (_NUM, False),
+        "step_seconds_p99": (_NUM, False),
+        "step_seconds_max": (_NUM, False),
+        "slowest_rank": ((int,), False),
+        "straggler_count": ((int,), False),
+        "stragglers": ((str,), False),
+        "frozen": ((str,), False),
+        "missed": ((str,), False),
+        "skewed": ((str,), False),
+        "mfu_min": (_NUM, False),
+        "mfu_median": (_NUM, False),
+        "comm_gbps": (_NUM, False),
+        "link_class": ((str,), False),
+        "slices": ((int,), False),
+        "retries": ((int,), False),
+    },
     # serving engine (serve/engine.py): periodic + drain-time stats
     # records in <obs_dir>/serve.jsonl. `params_step` is the checkpoint
     # step being served (-1 before the first load); `metrics` is a flat
@@ -374,6 +406,28 @@ SERVE_METRIC_PREFIX = "tmpi_serve_"
 #   tmpi_cost_hbm_bytes_per_step  gauge  XLA bytes-accessed/step
 # kind=profile fractions must sum to 1 within this absolute tolerance
 PROFILE_FRACTION_SUM_TOL = 0.02
+
+# the fleet-aggregation gauge family (obs/fleet.py; refreshed on every
+# tailer pass, served by obs/exporter.py `/metrics`; documentation like
+# the tmpi_mfu block — kind=fleet records are the enforced surface):
+#   tmpi_fleet_ranks             gauge  ranks reporting telemetry
+#   tmpi_fleet_step              gauge  fleet max step
+#   tmpi_fleet_step_spread       gauge  max-min step over ranks
+#   tmpi_fleet_step_seconds      gauge  by q=min|p50|p99|max over ranks
+#   tmpi_fleet_slowest_rank      gauge  highest smoothed step time
+#   tmpi_fleet_stragglers        gauge  persistent-straggler count
+#   tmpi_fleet_frozen            gauge  silent ranks behind the fleet
+#   tmpi_fleet_missed_heartbeats gauge  ranks with stale heartbeats
+#   tmpi_fleet_skewed            gauge  numerics-skewed ranks
+#   tmpi_fleet_healthy           gauge  1 healthy / 0 unhealthy
+#   tmpi_fleet_mfu_min           gauge  min MFU over ranks
+#   tmpi_fleet_mfu_median        gauge  median MFU over ranks
+#   tmpi_fleet_comm_gbps         gauge  by link=ici|dcn
+#   tmpi_fleet_rank_step         gauge  by rank=R, per-rank progress
+#   tmpi_fleet_slice_step        gauge  by slice=S (multislice only)
+#   tmpi_fleet_retries           gauge  supervisor retries observed
+#   tmpi_fleet_refresh_errors    gauge  suppressed tailer exceptions
+FLEET_METRIC_PREFIX = "tmpi_fleet_"
 
 
 def _check_numeric_map(d: dict, what: str) -> list[str]:

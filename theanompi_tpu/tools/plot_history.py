@@ -29,8 +29,13 @@ engine declared a cost model add an ATTRIBUTION row from the
 ``kind=profile`` records (obs/attribution.py): stacked step-time
 fractions (compute/comm/host/residual — where the step goes) on the
 left, the MFU trend (spec MFU, or the calibrated stand-in dashed) on
-the right. Runs without obs/numerics/profile data plot exactly as
-before — extra rows only render when at least one run has them.
+the right. Runs watched by a record-writing FleetTailer (obs/fleet.py:
+the chief exporter) add a FLEET row from ``obs/fleet.jsonl``: the
+per-rank step-time spread band (min..max over ranks, median line) with
+red vlines where the persistent-straggler detector fired (left), and
+the frozen/silent-rank count (right) — append-mode rerun safe like the
+comm panel. Runs without obs/numerics/profile/fleet data plot exactly
+as before — extra rows only render when at least one run has them.
 """
 
 from __future__ import annotations
@@ -192,6 +197,55 @@ def load_obs(jsonl_path: str) -> dict:
                         out["anomaly_steps"].append(row["step"])
         except (OSError, ValueError):
             pass  # partial/corrupt telemetry: plot what parses
+    # fleet telemetry (obs/fleet.py kind=fleet records): per-rank
+    # step-time spread band (min/median/max over ranks) + the steps
+    # where the persistent-straggler detector fired
+    out.update({"fleet_step": [], "fleet_min": [], "fleet_p50": [],
+                "fleet_max": [], "fleet_frozen": [],
+                "straggler_steps": []})
+    fleet = os.path.join(obs_dir, "fleet.jsonl")
+    if os.path.exists(fleet):
+        try:
+            with open(fleet) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    row = json.loads(line)
+                    if row.get("kind") != "fleet" or "step" not in row:
+                        continue
+                    if out["fleet_step"] and (
+                        row["step"] < out["fleet_step"][-1]
+                    ):
+                        # append-mode rerun into the same obs dir: the
+                        # step counter restarted — newest run's series
+                        # wins (mirrors the comm-series rule)
+                        for k in ("fleet_step", "fleet_min", "fleet_p50",
+                                  "fleet_max", "fleet_frozen",
+                                  "straggler_steps"):
+                            out[k] = []
+                    if out["fleet_step"] and (
+                        row["step"] == out["fleet_step"][-1]
+                    ):
+                        # flag-change record at an unchanged step:
+                        # newest values win
+                        for k in ("fleet_step", "fleet_min", "fleet_p50",
+                                  "fleet_max", "fleet_frozen"):
+                            out[k].pop()
+                    out["fleet_step"].append(row["step"])
+                    out["fleet_min"].append(
+                        row.get("step_seconds_min", 0.0))
+                    out["fleet_p50"].append(
+                        row.get("step_seconds_p50", 0.0))
+                    out["fleet_max"].append(
+                        row.get("step_seconds_max", 0.0))
+                    out["fleet_frozen"].append(
+                        len([r for r in (row.get("frozen") or "").split(",")
+                             if r]))
+                    if row.get("straggler_count", 0) or row.get("stragglers"):
+                        out["straggler_steps"].append(row["step"])
+        except (OSError, ValueError):
+            pass  # partial/corrupt telemetry: plot what parses
     return out
 
 
@@ -281,10 +335,12 @@ def plot(runs: dict[str, str], out: str, show: bool = False,
         for o in obs.values()
     )
     has_prof = any(o["prof_step"] for o in obs.values())
-    n_rows = 2 + int(has_obs) + int(has_nm) + int(has_prof)
+    has_fleet = any(o["fleet_step"] for o in obs.values())
+    n_rows = 2 + int(has_obs) + int(has_nm) + int(has_prof) + int(has_fleet)
     fig, axes = plt.subplots(n_rows, 2, figsize=(11, 3.5 * n_rows))
     (ax_loss, ax_val), (ax_ips, ax_lr) = axes[0], axes[1]
     ax_comm = ax_frac = ax_nm = ax_div = ax_attr = ax_mfu = None
+    ax_fleet = ax_frozen = None
     row = 2
     if has_obs:
         ax_comm, ax_frac = axes[row]
@@ -294,6 +350,9 @@ def plot(runs: dict[str, str], out: str, show: bool = False,
         row += 1
     if has_prof:
         ax_attr, ax_mfu = axes[row]
+        row += 1
+    if has_fleet:
+        ax_fleet, ax_frozen = axes[row]
     frac_kinds: list[str] = []
     for o in obs.values():
         frac_kinds += [k for k in o["fractions"] if k not in frac_kinds]
@@ -364,6 +423,21 @@ def plot(runs: dict[str, str], out: str, show: bool = False,
                 # cannot be misread as a real utilization number
                 ax_mfu.plot(*zip(*cal), linestyle="--",
                             label=f"{label} mfu (calibrated)")
+        if ax_fleet is not None and o["fleet_step"]:
+            # spread band: min..max step time over ranks, median on top —
+            # a widening band IS the straggler story at a glance
+            ax_fleet.fill_between(o["fleet_step"], o["fleet_min"],
+                                  o["fleet_max"], alpha=0.25,
+                                  label=f"{label} min..max")
+            ax_fleet.plot(o["fleet_step"], o["fleet_p50"],
+                          label=f"{label} median")
+            for j, s in enumerate(sorted(set(o["straggler_steps"]))):
+                ax_fleet.axvline(
+                    s, color="red", alpha=0.5, linestyle="-",
+                    label=f"{label} straggler" if j == 0 else None)
+        if ax_frozen is not None and o["fleet_step"]:
+            ax_frozen.step(o["fleet_step"], o["fleet_frozen"],
+                           where="post", label=f"{label} frozen ranks")
         if o["anomaly_steps"]:
             # anomaly markers on both numerics panels: first marker per
             # run carries the legend entry, the rest stay unlabeled
@@ -415,6 +489,12 @@ def plot(runs: dict[str, str], out: str, show: bool = False,
         ax_mfu.set(title="MFU trend (dashed = calibrated peak)",
                    xlabel="step")
         all_axes += [ax_attr, ax_mfu]
+    if ax_fleet is not None:
+        ax_fleet.set(title="fleet step-time spread (band min..max over "
+                           "ranks; red = persistent straggler)",
+                     xlabel="step")
+        ax_frozen.set(title="frozen (silent) ranks", xlabel="step")
+        all_axes += [ax_fleet, ax_frozen]
     for ax in all_axes:
         ax.grid(True, alpha=0.3)
         if ax.lines or ax.patches or ax.collections:

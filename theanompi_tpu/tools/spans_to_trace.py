@@ -25,6 +25,18 @@ Usage::
 Directories are searched for ``spans_rank*.jsonl``. Timestamps are the
 span log's wall-clock ``t0`` (seconds) converted to microseconds, so
 multi-rank traces align on real time.
+
+Multi-rank merges additionally get **clock alignment** (on by default,
+``--no-align`` to keep raw wall clocks): per-host clocks skew, so raw
+``t0`` values from different ranks can offset the whole timeline by
+more than a step. Each rank's FIRST ``name == "step"`` span is a
+matching step boundary across ranks (synchronous data-parallel steps
+start together at the first collective); the lowest anchored rank is
+the reference and every other rank's events shift by the difference of
+first-step anchors. Only the *initial* offset is corrected — later
+divergence is preserved, which is the point: a straggler's growing gap
+stays visible on the shared timeline instead of hiding inside clock
+skew.
 """
 
 from __future__ import annotations
@@ -59,14 +71,58 @@ def discover(paths: list[str]) -> list[str]:
     return files
 
 
-def convert(paths: list[str]) -> dict:
+def _first_step_anchor(path: str) -> Optional[float]:
+    """``t0`` of the file's first measured ``name == "step"`` span (the
+    cross-rank alignment anchor), or None when the file has none."""
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if (row.get("kind") == "span" and row.get("name") == "step"
+                    and not row.get("amortized")):
+                try:
+                    return float(row["t0"])
+                except (KeyError, TypeError, ValueError):
+                    return None
+    return None
+
+
+def clock_offsets(paths: list[str]) -> dict[int, float]:
+    """Per-rank additive clock corrections (seconds), anchored on each
+    rank's first step-boundary span: ranks started a synchronous step
+    together, so differing anchors are clock skew. The lowest anchored
+    rank is the reference (offset 0); ranks without a step span get no
+    correction. Empty when fewer than two ranks anchor (nothing to
+    align against)."""
+    anchors: dict[int, float] = {}
+    for i, path in enumerate(paths):
+        rank = _rank_of(path, fallback=i)
+        a = _first_step_anchor(path)
+        if a is not None and (rank not in anchors or a < anchors[rank]):
+            anchors[rank] = a
+    if len(anchors) < 2:
+        return {}
+    ref = anchors[min(anchors)]
+    return {rank: ref - a for rank, a in anchors.items()}
+
+
+def convert(paths: list[str], align: bool = True) -> dict:
     """``{"traceEvents": [...], "displayTimeUnit": "ms"}`` from span
     files. Unparseable / non-span lines are skipped (partial telemetry
-    still converts)."""
+    still converts). ``align`` applies :func:`clock_offsets` so a
+    multi-rank merge shares one timeline (straggler gaps are real
+    divergence, not clock skew)."""
+    offsets = clock_offsets(paths) if align else {}
     events = []
     seen_ranks = set()
     for i, path in enumerate(paths):
         rank = _rank_of(path, fallback=i)
+        shift = offsets.get(rank, 0.0)
         with open(path) as f:
             for line in f:
                 line = line.strip()
@@ -82,7 +138,7 @@ def convert(paths: list[str]) -> dict:
                     events.append({
                         "name": row["name"],
                         "ph": "X",
-                        "ts": row["t0"] * 1e6,
+                        "ts": (row["t0"] + shift) * 1e6,
                         "dur": max(0.0, row["dur"] * 1e6),
                         "pid": rank,
                         "tid": 1 if amortized else 0,
@@ -94,7 +150,7 @@ def convert(paths: list[str]) -> dict:
                     events.append({
                         "name": "span_summary",
                         "ph": "i",  # instant: fractions ride in args
-                        "ts": (row.get("t0", 0.0)
+                        "ts": (row.get("t0", 0.0) + shift
                                + row.get("wall_s", 0.0)) * 1e6,
                         "pid": rank,
                         "tid": 0,
@@ -122,9 +178,12 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("-o", "--out", default="trace.json",
                     help="output trace_event JSON (chrome://tracing, "
                          "Perfetto)")
+    ap.add_argument("--no-align", action="store_true",
+                    help="keep raw per-rank wall clocks (skip the "
+                         "first-step-span clock alignment)")
     args = ap.parse_args(argv)
     files = discover(args.paths)
-    trace = convert(files)
+    trace = convert(files, align=not args.no_align)
     with open(args.out, "w") as f:
         json.dump(trace, f)
     n_spans = sum(1 for e in trace["traceEvents"] if e["ph"] == "X")

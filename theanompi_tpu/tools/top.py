@@ -1,0 +1,145 @@
+"""``tmpi top``: live (or post-mortem) fleet console over an obs dir.
+
+Renders the merged FleetView (obs/fleet.py) as a terminal table — one
+row per rank: step progress, smoothed step seconds, MFU, anomaly
+count, and flags (STRAGGLER / FROZEN / STALE / SKEW) — plus a fleet
+summary line (step spread, step-time p50/p99/max, slowest rank, comm
+GB/s by link class, supervisor retries, health verdict).
+
+Two modes::
+
+    tmpi top OBS_DIR            # live: redraws every --interval s
+    tmpi top OBS_DIR --once     # one snapshot, then exit — works on
+                                # any FINISHED obs dir (post-mortem:
+                                # staleness is judged against the
+                                # newest timestamp in the dir, not
+                                # wall clock)
+
+Read-only by construction: the tailer runs with ``write_records=False``
+(a viewer must never grow the dir it watches) and everything happens on
+the main thread — no ``tmpi-`` thread to leak into the run's thread
+model. ANSI color/clearing only when stdout is a tty (pipes get plain
+text, so tests and ``| head`` stay clean).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import Optional
+
+from theanompi_tpu.obs.fleet import FleetTailer, FleetView, fleet_topology
+
+_CLEAR = "\x1b[2J\x1b[H"
+_RED = "\x1b[31m"
+_YELLOW = "\x1b[33m"
+_GREEN = "\x1b[32m"
+_RESET = "\x1b[0m"
+
+
+def _fmt(v, spec: str = "", none: str = "-") -> str:
+    if v is None:
+        return none
+    try:
+        return format(v, spec)
+    except (TypeError, ValueError):
+        return str(v)
+
+
+def render(view: Optional[FleetView], *, color: bool = False) -> str:
+    """The fleet table as one string (no trailing clear codes)."""
+    if view is None or not view.rows:
+        return "fleet: no telemetry yet\n"
+
+    def paint(text: str, code: str) -> str:
+        return f"{code}{text}{_RESET}" if color else text
+
+    lines = []
+    lines.append(
+        f"fleet step {view.step}  spread {view.step_spread}  "
+        f"step_s p50/p99/max {view.step_s_p50:.3f}/{view.step_s_p99:.3f}"
+        f"/{view.step_s_max:.3f}  slowest rank {view.slowest_rank}  "
+        f"comm {_fmt(view.comm_gbps, '.1f')} GB/s ({view.link_class})  "
+        f"retries {view.retries}"
+    )
+    verdict = ("HEALTHY" if view.healthy
+               else "UNHEALTHY: " + "; ".join(view.unhealthy_reasons()))
+    lines.append(paint(verdict, _GREEN if view.healthy else _RED))
+    if len(view.slices) > 1:
+        for s in view.slices:
+            lines.append(
+                f"  slice {s['slice']}: ranks {s['ranks']} step {s['step']}"
+                + (f"  stragglers {s['stragglers']}" if s["stragglers"]
+                   else "")
+                + (f"  frozen {s['frozen']}" if s["frozen"] else "")
+            )
+    header = (f"{'rank':>4} {'step':>8} {'step s':>8} {'mfu':>6} "
+              f"{'comm GB/s':>10} {'anom':>5} {'hb age':>7}  flags")
+    lines.append(header)
+    lines.append("-" * len(header))
+    for row in view.rows:
+        flags = []
+        if row["frozen"]:
+            flags.append(paint("FROZEN", _RED))
+        elif row["missed"]:
+            flags.append(paint("STALE", _YELLOW))
+        if row["straggler"]:
+            flags.append(paint("STRAGGLER", _RED))
+        elif row["straggling"]:
+            flags.append(paint("SLOW", _YELLOW))
+        if row["skewed"]:
+            flags.append(paint("SKEW", _YELLOW))
+        lines.append(
+            f"{row['rank']:>4} {row['step']:>8} "
+            f"{_fmt(row['step_seconds'], '.3f'):>8} "
+            f"{_fmt(row['mfu'], '.2f'):>6} "
+            f"{_fmt(view.comm_gbps, '.1f'):>10} "
+            f"{row['anomalies']:>5} "
+            f"{_fmt(row['heartbeat_age_s'], '.0f'):>7}  "
+            + (" ".join(flags) if flags else "ok")
+        )
+    return "\n".join(lines) + "\n"
+
+
+def top_main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tmpi top", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument("obs_dir", help="obs directory to watch (live run or "
+                                    "finished post-mortem)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one snapshot and exit (post-mortem mode: "
+                         "staleness vs the dir's newest timestamp)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="live refresh period in seconds (default 2)")
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="checkpoint dir whose __topology__ manifest "
+                         "drives per-slice rollups")
+    args = ap.parse_args(argv)
+
+    tailer = FleetTailer(
+        args.obs_dir,
+        topology=fleet_topology(args.ckpt_dir),
+        live=not args.once,
+        write_records=False,  # a viewer never grows the dir it reads
+    )
+    tty = sys.stdout.isatty()
+    if args.once:
+        view = tailer.refresh()
+        sys.stdout.write(render(view, color=tty))
+        return 0
+    try:
+        while True:
+            view = tailer.refresh()
+            if tty:
+                sys.stdout.write(_CLEAR)
+            sys.stdout.write(render(view, color=tty))
+            sys.stdout.flush()
+            time.sleep(max(0.2, args.interval))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(top_main())
