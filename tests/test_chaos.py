@@ -194,3 +194,18 @@ def test_partial_set_dropped_member_reads_absent(tmp_path):
     # nothing verified to resume from (crash-save path may still have
     # provided a mid-epoch anchor; either way parity held above)
     assert retry
+
+
+def test_slice_down_absorbed_by_elastic_reshard(tmp_path):
+    """Directed smoke for the topology fault (hierarchical-collectives
+    PR): a whole-slice loss mid-run (slice_down@4 on a 2x2 multislice
+    mesh) is absorbed by the elastic supervisor — the survivors' world
+    (2 chips, 1 slice) resumes from the last committed checkpoint and
+    finishes every step with zero invariant violations."""
+    cfg = ChaosConfig("bsp_none")
+    schedule = ["slice_down@4"]
+    res = run_schedule(cfg, schedule, str(tmp_path / "run"))
+    assert res.launches == ["ok"]
+    assert res.final_summary and res.final_summary["steps"] == cfg.total_steps
+    baseline = BaselineCache(str(tmp_path / "base"))
+    assert check_invariants(cfg, schedule, res, baseline) == []

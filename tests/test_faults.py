@@ -252,3 +252,64 @@ def test_fault_ledger_survives_process_boundary(tmp_path):
         inj2.check_step(5)                  # the unfired spec still fires
     assert open(ledger).read().splitlines() == [
         "crash@3", "enospc@2", "crash@5"]
+
+
+# -- whole-slice loss (hierarchical-collectives PR) ------------------------
+
+
+def test_parse_slice_down_specs():
+    s = parse_fault_spec("slice_down@3")
+    assert (s.kind, s.step, s.arg) == ("slice_down", 3, None)  # 1 slice
+    s = parse_fault_spec("slice_down@3:2")
+    assert (s.kind, s.step, s.arg) == ("slice_down", 3, 2.0)
+    for bad in ("slice_down@3:0", "slice_down@3:1.5"):
+        with pytest.raises(ValueError, match="slices lost"):
+            parse_fault_spec(bad)
+
+
+def test_slice_down_resolves_survivors_from_topology():
+    from theanompi_tpu.utils.faults import TopologyChanged
+
+    inj = FaultInjector(["slice_down@3"])
+    inj.set_topology(2, 4)  # 2 slices x 4 chips
+    inj.check_step(1)
+    with pytest.raises(TopologyChanged) as ei:
+        inj.check_step(3)
+    assert ei.value.kind == "slice_down" and ei.value.new_world == 4
+    # sticky like shrink: the dead slice stays dead across retries
+    inj.check_step(3)
+    assert inj.world_override() == 4
+
+
+def test_slice_down_needs_multislice_topology():
+    inj = FaultInjector(["slice_down@2"])
+    with pytest.raises(ValueError, match="multislice topology"):
+        inj.check_step(2)  # never registered
+    inj2 = FaultInjector(["slice_down@2"])
+    inj2.set_topology(1, 8)  # flat mesh: no slice to lose
+    with pytest.raises(ValueError, match="multislice topology"):
+        inj2.check_step(2)
+
+
+def test_slice_down_refuses_to_kill_the_last_slice():
+    inj = FaultInjector(["slice_down@2:2"])
+    inj.set_topology(2, 4)  # losing both slices leaves nobody
+    with pytest.raises(ValueError, match="no survivors"):
+        inj.check_step(2)
+
+
+def test_slice_down_retopology_between_attempts():
+    """An elastic retry re-registers the SHRUNK shape: the second
+    whole-slice loss subtracts from the world that actually survived."""
+    from theanompi_tpu.utils.faults import TopologyChanged
+
+    inj = FaultInjector(["slice_down@2", "slice_down@5"])
+    inj.set_topology(4, 2)  # 4 slices x 2 chips
+    with pytest.raises(TopologyChanged) as ei:
+        inj.check_step(2)
+    assert ei.value.new_world == 6
+    inj.set_topology(3, 2)  # the retry rebuilt a 3-slice mesh
+    with pytest.raises(TopologyChanged) as ei:
+        inj.check_step(5)
+    assert ei.value.new_world == 4
+    assert inj.world_override() == 4

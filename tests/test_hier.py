@@ -1,0 +1,149 @@
+"""Hierarchical collectives (ISSUE 17): the explicit in-slice
+reduce-scatter -> cross-slice DCN allreduce -> in-slice all-gather
+exchange must be numerically at parity with the flat psum it replaces,
+compose with the wire codec (DCN hop only) and bucketing, and declare a
+per-link-class TrafficModel that reconciles byte-exactly against the
+traced wire on every engine."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from theanompi_tpu.models.mlp import MLP
+from theanompi_tpu.parallel.bsp import BSPEngine
+from theanompi_tpu.parallel.mesh import (
+    make_multislice_mesh,
+    put_global_batch,
+    slice_topology,
+)
+
+BATCH = 32
+
+
+def _model():
+    return MLP(MLP.default_recipe().replace(batch_size=BATCH))
+
+
+def _mesh22():
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    return make_multislice_mesh(4, n_slices=2)
+
+
+def _run_steps(engine, n_steps=3, seed=0):
+    rng = np.random.RandomState(seed)
+    state = engine.init_state(jax.random.PRNGKey(seed))
+    mesh = engine.mesh
+    loss = None
+    for i in range(n_steps):
+        x = rng.randn(BATCH, *engine.model.recipe.input_shape).astype(
+            np.float32)
+        y = rng.randint(0, 10, BATCH).astype(np.int32)
+        xs = put_global_batch(mesh, x)
+        ys = put_global_batch(mesh, y)
+        state, m = engine.train_step(state, xs, ys, jax.random.PRNGKey(100 + i))
+        loss = float(m["loss"])
+    return state, loss
+
+
+def test_hier_matches_flat_psum_allclose():
+    """RS -> DCN-AR -> AG computes the identical mean gradient the flat
+    psum does (same mesh, same batches, same rng): after 3 steps the
+    parameters and loss agree to float tolerance."""
+    mesh = _mesh22()
+    results = {}
+    for strat in ("psum", "hier"):
+        eng = BSPEngine(_model(), mesh, steps_per_epoch=1, strategy=strat)
+        state, loss = _run_steps(eng)
+        results[strat] = (jax.tree_util.tree_leaves(state.params), loss)
+    np.testing.assert_allclose(results["psum"][1], results["hier"][1],
+                               rtol=1e-5)
+    for a, b in zip(results["psum"][0], results["hier"][0]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_hier_bucketed_int8ef_composition():
+    """The full knob stack — --strategy hier --allreduce-buckets
+    --wire-codec int8:ef — runs, stays finite, and tracks the exact
+    flat exchange within the codec's quantization tolerance (the int8
+    grid plus error feedback bounds per-step drift)."""
+    mesh = _mesh22()
+    exact = BSPEngine(_model(), mesh, steps_per_epoch=1, strategy="psum")
+    exact_state, exact_loss = _run_steps(exact)
+    composed = BSPEngine(_model(), mesh, steps_per_epoch=1, strategy="hier",
+                         wire_codec="int8:ef", allreduce_buckets=0.001)
+    state, loss = _run_steps(composed)
+    assert np.isfinite(loss)
+    np.testing.assert_allclose(loss, exact_loss, rtol=0.05)
+    for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                    jax.tree_util.tree_leaves(exact_state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=0.15, atol=5e-3)
+    # only the DCN hop is quantized: the declared model prices DCN at
+    # int8 wire bytes while ICI stays fp32 (raw == effective on ICI)
+    tm = composed.traffic_model(state)
+    assert tm.raw_ici_bytes_per_step == pytest.approx(tm.ici_bytes_per_step)
+    assert tm.dcn_bytes_per_step < tm.raw_dcn_bytes_per_step
+
+
+@pytest.mark.parametrize("engine", ["bsp", "bsp_hier", "zero1", "easgd",
+                                    "gosgd", "nd"])
+def test_traffic_link_split_reconciles_byte_exact(engine):
+    """Codec-off reconciliation: the traced per-link wire split (ICI vs
+    DCN, tools/analyze/signature.py::signature_link_bytes) must equal
+    the engine's DECLARED TrafficModel split byte-exactly once the
+    scalar metric psums (a few bytes of loss/err reductions, not
+    gradient traffic) are excluded — and the split must sum back to the
+    traced total exactly, by construction."""
+    from theanompi_tpu.tools.analyze.harness import trace_engine
+    from theanompi_tpu.tools.analyze.signature import (
+        collective_link_bytes,
+        collective_wire_bytes,
+        signature_link_bytes,
+        signature_raw_bytes,
+    )
+
+    tr = trace_engine(engine, "none")
+    assert tr.error is None, tr.error
+    traced = {"ici": 0.0, "dcn": 0.0}
+    for part in tr.parts:
+        lb = signature_link_bytes(part.signature, part.axis_sizes)
+        raw = signature_raw_bytes(part.signature, part.axis_sizes)
+        # identity: the split never invents or drops bytes
+        assert lb["ici"] + lb["dcn"] == pytest.approx(raw, abs=1e-6)
+        for c in part.signature.collectives:
+            if int(np.prod(c.shape or (1,))) <= 1:
+                continue  # scalar metric reduction, not gradient wire
+            clb = collective_link_bytes(c, part.axis_sizes)
+            assert clb["ici"] + clb["dcn"] == pytest.approx(
+                collective_wire_bytes(c, part.axis_sizes), abs=1e-9)
+            traced["ici"] += clb["ici"] * c.count * part.weight
+            traced["dcn"] += clb["dcn"] * c.count * part.weight
+    tm = tr.traffic
+    assert tm is not None
+    assert traced["dcn"] == pytest.approx(
+        float(tm.raw_dcn_bytes_per_step), abs=0.5)
+    assert traced["ici"] == pytest.approx(
+        float(tm.raw_ici_bytes_per_step), abs=0.5)
+    # single-slice engines must declare (and trace) zero DCN bytes
+    if engine != "bsp_hier":
+        assert traced["dcn"] == 0.0 and float(tm.raw_dcn_bytes_per_step) == 0.0
+
+
+def test_engine_traffic_models_split_on_multislice_mesh():
+    """Every engine's traffic_model() prices the flat-collective DCN
+    share via dcn_fraction on a multislice mesh: ici + dcn == total,
+    dcn > 0, and the fraction matches (r-1)/(n-1)."""
+    mesh = _mesh22()
+    n_slices, per_slice = slice_topology(mesh)
+    assert (n_slices, per_slice) == (2, 2)
+    eng = BSPEngine(_model(), mesh, steps_per_epoch=1, strategy="psum")
+    tm = eng.traffic_model(eng.init_state(jax.random.PRNGKey(0)))
+    total = float(tm.raw_bytes_per_step)
+    assert total > 0 and float(tm.raw_dcn_bytes_per_step) > 0
+    assert float(tm.raw_ici_bytes_per_step) + float(
+        tm.raw_dcn_bytes_per_step) == pytest.approx(total)
+    assert float(tm.raw_dcn_bytes_per_step) / total == pytest.approx(
+        (n_slices - 1) / (n_slices * per_slice - 1))

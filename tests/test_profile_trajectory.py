@@ -51,3 +51,52 @@ def test_gate_still_catches_a_seeded_regression(tmp_path):
     bad = tmp_path / "bad.json"
     bad.write_text(json.dumps(cur))
     assert main([BASELINE, str(bad)]) == 1
+
+
+# -- r17: flat-vs-hier multislice pair (hierarchical-collectives PR) ----
+
+R17_FLAT = os.path.join(_DIR, "r17_flat", "report.json")
+R17_HIER = os.path.join(_DIR, "r17_hier", "report.json")
+# timing-derived ratios (host_blocked_frac at sub-1% magnitude,
+# hbm_gbps) move ~30% between the two CPU runs because the exchange
+# structure differs; the per-link BYTE invariants are exact at any
+# band, and the acceptance mutation (2x = delta 1.0) still fails here
+R17_REL_TOL = 0.5
+
+
+def test_r17_pair_exists_with_strategy_provenance():
+    flat = _load(R17_FLAT)
+    hier = _load(R17_HIER)
+    for rep in (flat, hier):
+        assert rep["kind"] == "profile_report"
+        assert rep["model"] == "alexnet" and rep["steps"] == 20
+        assert rep["knobs"]["slices"] == 2
+        # both sides of the pair carry a nonzero DCN leg: the mesh IS
+        # multislice, whichever strategy moves the bytes
+        assert rep["traffic"]["dcn_bytes_per_step"] > 0
+        assert rep["traffic"]["ici_bytes_per_step"] > 0
+    assert flat["knobs"]["strategy"] == "psum"
+    assert hier["knobs"]["strategy"] == "hier"
+
+
+def test_r17_perf_gate_passes_and_diffs_the_link_split():
+    result = gate(_load(R17_FLAT), _load(R17_HIER), rel_tol=R17_REL_TOL)
+    assert result["errors"] == []
+    assert result["ok"], result["checks"]
+    # the per-link metrics must be among the diffed invariants — and at
+    # fp32 the ideal flat lowering ties hier byte-for-byte, so the pair
+    # also PINS that identity (delta 0.0 on both links)
+    for key in ("ici_bytes_per_step", "dcn_bytes_per_step"):
+        rows = [c for c in result["checks"] if c["metric"] == key]
+        assert rows and rows[0]["rel_delta"] == 0.0
+    assert main([R17_FLAT, R17_HIER, "--rel-tol", str(R17_REL_TOL)]) == 0
+
+
+def test_r17_gate_catches_seeded_dcn_regression(tmp_path):
+    """Not vacuous: a change that doubles the bytes crossing the slow
+    DCN link fails the committed pair."""
+    cur = _load(R17_HIER)
+    cur["traffic"]["dcn_bytes_per_step"] *= 2
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(cur))
+    assert main([R17_FLAT, str(bad), "--rel-tol", str(R17_REL_TOL)]) == 1

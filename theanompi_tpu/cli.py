@@ -74,8 +74,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("modelclass", help="model class name (e.g. WRN)")
     p.add_argument("--strategy", default="psum",
                    help="gradient exchange strategy (psum|ring|ring_bf16|ring_int8|"
-                        "psum_bf16 or reference names ar|asa32|asa16|nccl32|"
-                        "nccl16)")
+                        "psum_bf16|hier or reference names ar|asa32|asa16|nccl32|"
+                        "nccl16). 'hier' is the topology-aware "
+                        "hierarchical exchange for --slices N meshes: "
+                        "in-slice reduce-scatter over ICI, cross-slice "
+                        "allreduce over DCN on only the scattered shard "
+                        "(--wire-codec applies to that DCN hop alone), "
+                        "then in-slice all-gather; composes with "
+                        "--allreduce-buckets")
     p.add_argument("--wire-codec", default="none", metavar="CODEC[:ef]",
                    help="compressed-collectives codec (parallel/codec.py) "
                         "for EVERY engine's exchange: none|bf16|int8, "

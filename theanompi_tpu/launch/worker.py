@@ -521,6 +521,12 @@ def run_training(
         )
     if rule in per_worker_rules and strategy != "psum":
         raise ValueError("strategy applies to the BSP rule only")
+    if strategy == "hier" and not (n_slices and n_slices > 1):
+        raise ValueError(
+            "strategy 'hier' is the cross-slice hierarchical exchange — "
+            "it needs a multislice mesh (--slices N with N > 1); on a "
+            "single slice the flat 'psum' is already optimal"
+        )
     # fuse>1 works for every rule: BSP scans allreduce-inside steps;
     # EASGD embeds its elastic exchange at the avg_freq boundaries
     # inside the scan; GoSGD ships per-substep gossip-cadence flags
@@ -1048,6 +1054,13 @@ def run_training(
         from theanompi_tpu.utils.checkpoint import set_write_fault_hook
 
         set_write_fault_hook(faults.write_fault)
+        # slice-granular topology faults (slice_down) resolve their
+        # survivor world from the mesh THIS attempt actually built —
+        # re-registered every attempt, so an elastic retry's shrunk
+        # shape is what the next whole-slice loss subtracts from
+        from theanompi_tpu.parallel.mesh import slice_topology
+
+        faults.set_topology(*slice_topology(mesh))
     # background keep-chain scrubber (chaos PR): periodic re-verify +
     # quarantine of corrupt checkpoint members, reported through the
     # obs facade (kind=scrub + tmpi_scrub_* gauges)

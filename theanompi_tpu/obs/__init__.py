@@ -37,7 +37,16 @@ On-disk layout under ``obs_dir`` (schemas:
                             declared wire model — rule, wire codec,
                             raw_bytes vs wire_bytes (sustained
                             per-step, fp32 vs post-codec) and their
-                            compression_ratio; snapshots also carry
+                            compression_ratio; on a multislice mesh the
+                            comm record also splits the raw AND
+                            effective bytes by link class — ici_bytes /
+                            dcn_bytes (effective, post-codec on the DCN
+                            hop) and raw_ici_bytes / raw_dcn_bytes —
+                            matching the tmpi_comm_ici_bytes_per_step /
+                            tmpi_comm_dcn_bytes_per_step (+ raw_*)
+                            gauges and the achieved tmpi_comm_ici_gbps /
+                            tmpi_comm_dcn_gbps pair the step cadence
+                            refreshes; snapshots also carry
                             the tmpi_comm_raw_bytes_per_step /
                             tmpi_comm_compression_ratio /
                             tmpi_comm_gbps_raw gauges next to the
@@ -649,7 +658,7 @@ class Observability:
             if step_seconds:
                 gbps = self.traffic.achieved_gbps(step_seconds / substeps)
                 if gbps is not None:
-                    self._set_gbps_gauges(gbps)
+                    self._set_gbps_gauges(gbps, step_seconds / substeps)
         if (
             self.snapshot_freq
             and step - self._last_snapshot_step >= self.snapshot_freq
@@ -671,7 +680,7 @@ class Observability:
         if self.traffic is not None:
             gbps = self.traffic.achieved_gbps(per_step_seconds)
             if gbps is not None:
-                self._set_gbps_gauges(gbps)
+                self._set_gbps_gauges(gbps, per_step_seconds)
         if self.cost is not None:
             self._note_attribution(per_step_seconds)
 
@@ -709,11 +718,16 @@ class Observability:
                 help="step-time attribution (obs/attribution.py)",
             ).set(value)
 
-    def _set_gbps_gauges(self, gbps: float) -> None:
+    def _set_gbps_gauges(self, gbps: float,
+                         step_seconds: Optional[float] = None) -> None:
         """Effective GB/s gauge, plus the raw (uncompressed-equivalent)
         companion whenever a codec shrinks the wire — the pair is what
         makes codec runs visually distinguishable in plot_history's
-        comm panel."""
+        comm panel. On a multislice model the per-link-class pair
+        (``tmpi_comm_ici_gbps`` / ``tmpi_comm_dcn_gbps``) splits the
+        achieved rate by the link each byte rides — DCN is the
+        oversubscribed hop, so its gauge is the one that saturates
+        first."""
         self.registry.gauge(
             "tmpi_comm_gbps",
             help="achieved per-device interconnect GB/s "
@@ -727,6 +741,21 @@ class Observability:
                      "the same step time — effective * compression "
                      "ratio (obs/comm.py)",
             ).set(gbps * ratio)
+        if step_seconds and self.traffic.dcn_bytes_per_step > 0:
+            ici = self.traffic.ici_gbps(step_seconds)
+            dcn = self.traffic.dcn_gbps(step_seconds)
+            if ici is not None:
+                self.registry.gauge(
+                    "tmpi_comm_ici_gbps",
+                    help="achieved GB/s on in-slice (ICI) hops "
+                         "(analytic per-link bytes / measured step time)",
+                ).set(ici)
+            if dcn is not None:
+                self.registry.gauge(
+                    "tmpi_comm_dcn_gbps",
+                    help="achieved GB/s on cross-slice (DCN) hops "
+                         "(analytic per-link bytes / measured step time)",
+                ).set(dcn)
 
     def snapshot(self, step: Optional[int] = None) -> Optional[dict]:
         """Write one metrics snapshot line + refresh the Prometheus

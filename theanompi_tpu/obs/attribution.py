@@ -75,6 +75,17 @@ PROFILE_GAUGE_PREFIX = "tmpi_step_"  # + {compute,comm,host,residual}_frac
 # from CostModel.as_metrics()
 
 
+# Approximate per-chip DCN share (bytes/s, one direction) for
+# cross-slice hops: a multislice pod's data-center network is shared by
+# the whole slice, so the per-chip figure is the slice NIC bandwidth
+# divided across its chips — public multislice material puts the
+# usable per-chip share near 25 GB/s, an order of magnitude under any
+# ICI tier above. This single number is deliberately device-agnostic
+# (DCN is the facility fabric, not the chip); override per-run with
+# ``attribute_step(dcn_bps=...)`` when the deployment's share is known.
+_DCN_BYTES_PER_SEC_DEFAULT = 25e9
+
+
 def link_bytes_per_sec(device=None) -> Optional[float]:
     """Per-chip ICI bytes/s for ``device`` (default: first visible);
     None when unknown (CPU test meshes)."""
@@ -87,6 +98,12 @@ def link_bytes_per_sec(device=None) -> Optional[float]:
         if key in kind:
             return bw
     return None
+
+
+def dcn_bytes_per_sec() -> float:
+    """Per-chip cross-slice (DCN) bytes/s — the flat approximate share
+    documented on ``_DCN_BYTES_PER_SEC_DEFAULT``."""
+    return _DCN_BYTES_PER_SEC_DEFAULT
 
 
 @dataclass
@@ -157,13 +174,21 @@ def attribute_step(
     host_frac: Optional[float] = None,
     link_bps: Optional[float] = None,
     overlap_frac: Optional[float] = None,
+    dcn_bps: Optional[float] = None,
 ) -> Attribution:
     """Reconcile one measured per-step wall time against the analytic
     models (see module docstring for the calibrated-fallback rules).
 
     ``host_frac``: the measured fraction of the step the host spent
     blocked (dispatcher drain tax) or dispatching. ``link_bps``
-    overrides the device-table ICI bandwidth (tests; multislice DCN).
+    overrides the device-table ICI bandwidth (tests); ``dcn_bps``
+    overrides the flat cross-slice share (``dcn_bytes_per_sec``).
+    When the traffic model carries a per-link split
+    (``dcn_bytes_per_step > 0``), each link class is priced at its own
+    bandwidth — the DCN hop is ~10-25x slower per chip than ICI, so a
+    byte there books proportionally more comm seconds (this is exactly
+    the asymmetry the hierarchical strategy exploits by sending only
+    the scattered shard, codec'd, across slices).
 
     ``overlap_frac``: fraction of the collective that HIDES under
     backward compute (the bucketed allreduce's schedule estimate —
@@ -183,11 +208,21 @@ def attribute_step(
 
     comm_s = 0.0
     wire = float(traffic.bytes_per_step_amortized) if traffic is not None else 0.0
+    dcn_wire = float(traffic.dcn_bytes_per_step) if traffic is not None else 0.0
     if wire > 0:
         if link_bps is None:
             link_bps = link_bytes_per_sec()
         if link_bps:
-            comm_s = wire / link_bps
+            if dcn_wire > 0:
+                # per-link pricing: in-slice bytes at ICI speed, the
+                # cross-slice shard at the (much slower) DCN share
+                ici_s = max(0.0, wire - dcn_wire) / link_bps
+                dcn_s = dcn_wire / float(dcn_bps or dcn_bytes_per_sec())
+                comm_s = ici_s + dcn_s
+                detail["comm_ici_s"] = ici_s
+                detail["comm_dcn_s"] = dcn_s
+            else:
+                comm_s = wire / link_bps
             if overlap > 0:
                 detail["overlap_frac"] = overlap
                 detail["comm_hidden_s"] = comm_s * overlap

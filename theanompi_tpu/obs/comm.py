@@ -64,10 +64,28 @@ STRATEGY_WIRE_BYTES = {
     "psum": 4, "ring": 4,
     "psum_bf16": 2, "ring_bf16": 2,
     "ring_int8": CODEC_WIRE_BYTES["int8"],
+    # hier's dominant (ICI) wire is fp32; its DCN hop prices separately
+    # in bsp_traffic's two-hop model
+    "hier": 4,
     # reference aliases (strategies._ALIASES)
     "ar": 4, "cudaaware": 4, "copper": 4, "nccl32": 4,
     "nccl16": 2, "asa32": 4, "asa16": 2,
 }
+
+
+def dcn_fraction(n: int, n_slices: int) -> float:
+    """Cross-slice (DCN) share of one hierarchically-lowered n-way
+    reduction collective on a slice-major mesh of ``n_slices`` rows x
+    ``s = n/n_slices`` chips: the allreduce ``2(n-1)/n·N·b`` lowers to
+    ICI ``2(s-1)/s·N·b`` + DCN ``2(r-1)/r·(N/s)·b``, and the one-sided
+    RS/AG ``(n-1)/n·N·b`` forms split identically — both give the DCN
+    fraction ``(r-1)/(n-1)``. Used to decompose every flat (XLA-lowered)
+    collective's declared bytes into link classes; the explicit 'hier'
+    strategy prices its two hops directly instead."""
+    r = max(1, int(n_slices))
+    if n <= 1 or r <= 1:
+        return 0.0
+    return (r - 1) / (n - 1)
 
 
 @dataclass
@@ -86,6 +104,12 @@ class TrafficModel:
     codec: str = "none"  # wire codec spec (parallel/codec.py)
     raw_bytes_per_step: Optional[float] = None
     raw_bytes_per_exchange: Optional[float] = None
+    # per-link-class accounting (AMORTIZED basis): the cross-slice DCN
+    # share of the sustained per-step wire; ICI is the remainder —
+    # derived, so the two classes always sum to the totals SPMD101
+    # reconciles. 0 on single-slice meshes.
+    dcn_bytes_per_step: float = 0.0
+    raw_dcn_bytes_per_step: Optional[float] = None
     detail: dict = field(default_factory=dict)
 
     def __post_init__(self):
@@ -93,6 +117,8 @@ class TrafficModel:
             self.raw_bytes_per_step = self.bytes_per_step
         if self.raw_bytes_per_exchange is None:
             self.raw_bytes_per_exchange = self.bytes_per_exchange
+        if self.raw_dcn_bytes_per_step is None:
+            self.raw_dcn_bytes_per_step = self.dcn_bytes_per_step
 
     @property
     def bytes_per_step_amortized(self) -> float:
@@ -120,12 +146,34 @@ class TrafficModel:
         raw = self.raw_bytes_per_step_amortized
         return raw / eff if eff > 0 else 1.0
 
+    @property
+    def ici_bytes_per_step(self) -> float:
+        """In-slice (ICI) share of the sustained effective wire —
+        the amortized total minus the DCN share."""
+        return max(0.0,
+                   self.bytes_per_step_amortized - self.dcn_bytes_per_step)
+
+    @property
+    def raw_ici_bytes_per_step(self) -> float:
+        return max(0.0, self.raw_bytes_per_step_amortized
+                   - self.raw_dcn_bytes_per_step)
+
     def achieved_gbps(self, step_seconds: float) -> Optional[float]:
         """Sustained per-device interconnect GB/s implied by a measured
         step time (None when unmeasurable)."""
         if not step_seconds or step_seconds <= 0:
             return None
         return self.bytes_per_step_amortized / step_seconds / 1e9
+
+    def ici_gbps(self, step_seconds: float) -> Optional[float]:
+        if not step_seconds or step_seconds <= 0:
+            return None
+        return self.ici_bytes_per_step / step_seconds / 1e9
+
+    def dcn_gbps(self, step_seconds: float) -> Optional[float]:
+        if not step_seconds or step_seconds <= 0:
+            return None
+        return self.dcn_bytes_per_step / step_seconds / 1e9
 
     def as_metrics(self) -> dict:
         return {
@@ -139,6 +187,12 @@ class TrafficModel:
             "comm_raw_bytes_per_step_amortized":
                 self.raw_bytes_per_step_amortized,
             "comm_compression_ratio": self.compression_ratio,
+            # per-link-class accounting (amortized): ICI + DCN sum to
+            # the *_amortized totals above by construction
+            "comm_ici_bytes_per_step": self.ici_bytes_per_step,
+            "comm_dcn_bytes_per_step": self.dcn_bytes_per_step,
+            "comm_raw_ici_bytes_per_step": self.raw_ici_bytes_per_step,
+            "comm_raw_dcn_bytes_per_step": self.raw_dcn_bytes_per_step,
         }
 
     def as_record(self) -> dict:
@@ -153,6 +207,10 @@ class TrafficModel:
             "raw_bytes": self.raw_bytes_per_step_amortized,
             "wire_bytes": self.bytes_per_step_amortized,
             "compression_ratio": self.compression_ratio,
+            "ici_bytes": self.ici_bytes_per_step,
+            "dcn_bytes": self.dcn_bytes_per_step,
+            "raw_ici_bytes": self.raw_ici_bytes_per_step,
+            "raw_dcn_bytes": self.raw_dcn_bytes_per_step,
         }
 
 
@@ -191,9 +249,52 @@ def reduce_scatter_bytes(n_elements: int, n: int, wire_bytes: int = 4) -> float:
 all_gather_bytes = reduce_scatter_bytes  # same wire volume, other half
 
 
+def hier_traffic(n_elements: int, n: int, n_slices: int, codec=None,
+                 segments: Optional[list] = None,
+                 n_buckets: Optional[int] = None,
+                 overlap_frac: Optional[float] = None) -> TrafficModel:
+    """The explicit two-hop hierarchical exchange ('hier',
+    parallel/strategies.py::hierarchical_sync): per flat buffer of
+    ``L`` elements on an ``r x s`` mesh (``r = n_slices``,
+    ``s = n/n_slices``), ICI moves the reduce-scatter + all-gather
+    halves ``2(s-1)/s · s·ceil(L/s) · 4`` B and DCN moves the shard
+    allreduce ``2(r-1)/r · ceil(L/s) · b`` B — the codec compresses
+    ONLY the DCN hop (the fp32 figure is the raw side). ``segments``:
+    the per-bucket flat lengths of a bucketed schedule (each bucket
+    pads and scatters independently); default one buffer of
+    ``n_elements``."""
+    codec = get_codec(codec)
+    r = max(1, int(n_slices))
+    s = max(1, n // r)
+    segs = [-(-int(L) // s) for L in (segments or [n_elements])]
+    padded = sum(s * g for g in segs)
+    shard = sum(segs)
+    ici = (reduce_scatter_bytes(padded, s) + all_gather_bytes(padded, s))
+    dcn_raw = allreduce_bytes(shard, r)
+    b = codec.wire_bytes_per_element if codec.active else 4.0
+    dcn_eff = dcn_raw * b / 4.0
+    detail = {"strategy": "hier", "elements": padded,
+              "wire_bytes_per_element": b, "n_slices": r,
+              "per_slice": s, "dcn_shard_elements": shard}
+    if n_buckets is not None:
+        detail["n_buckets"] = int(n_buckets)
+        detail["overlap_frac"] = float(overlap_frac or 0.0)
+    return TrafficModel(
+        rule="bsp", n_workers=n,
+        bytes_per_step=ici + dcn_eff,
+        codec=codec.spec,
+        raw_bytes_per_step=ici + dcn_raw,
+        dcn_bytes_per_step=dcn_eff,
+        raw_dcn_bytes_per_step=dcn_raw,
+        detail=detail,
+    )
+
+
 def bsp_traffic(n_elements: int, n: int, strategy: str = "psum",
                 codec=None, n_buckets: Optional[int] = None,
-                overlap_frac: Optional[float] = None) -> TrafficModel:
+                overlap_frac: Optional[float] = None,
+                n_slices: int = 1,
+                segments: Optional[list] = None) -> TrafficModel:
     """BSP in-step gradient allreduce. Ring variants pad the flat buffer
     to ``n`` equal segments (128-multiples for int8) — accounted, since
     the padding rides the wire. ``codec``: the wire codec the exchange
@@ -206,7 +307,18 @@ def bsp_traffic(n_elements: int, n: int, strategy: str = "psum",
     (chunked), so the volume figures are untouched; the geometry lands
     in ``detail`` and ``overlap_frac`` tells the attribution model
     (obs/attribution.py) what fraction of the collective hides under
-    backward — so the comm fraction stays honest once comm overlaps."""
+    backward — so the comm fraction stays honest once comm overlaps.
+
+    ``n_slices``: slice count of a multi-slice mesh. The 'hier'
+    strategy routes to the explicit two-hop model (hier_traffic,
+    ``segments`` carrying a bucketed schedule's per-bucket lengths);
+    flat strategies keep their totals and split them into link classes
+    with ``dcn_fraction`` (XLA's hierarchical lowering moves the same
+    bytes, re-routed)."""
+    if strategy == "hier":
+        return hier_traffic(n_elements, n, n_slices, codec=codec,
+                            segments=segments, n_buckets=n_buckets,
+                            overlap_frac=overlap_frac)
     codec = get_codec(codec)
     b = wire_bytes_per_element(strategy)
     canonical = {"ar": "psum", "cudaaware": "psum", "copper": "psum",
@@ -232,32 +344,43 @@ def bsp_traffic(n_elements: int, n: int, strategy: str = "psum",
     if n_buckets is not None:
         detail["n_buckets"] = int(n_buckets)
         detail["overlap_frac"] = float(overlap_frac or 0.0)
+    if n_slices > 1:
+        detail["n_slices"] = int(n_slices)
+    frac = dcn_fraction(n, n_slices)
     return TrafficModel(
         rule="bsp", n_workers=n,
         bytes_per_step=allreduce_bytes(elems, n, b),
         codec=codec.spec,
         raw_bytes_per_step=allreduce_bytes(elems, n),
+        dcn_bytes_per_step=allreduce_bytes(elems, n, b) * frac,
+        raw_dcn_bytes_per_step=allreduce_bytes(elems, n) * frac,
         detail=detail,
     )
 
 
-def zero1_traffic(n_elements: int, n: int, codec=None) -> TrafficModel:
+def zero1_traffic(n_elements: int, n: int, codec=None,
+                  n_slices: int = 1) -> TrafficModel:
     """ZeRO-1: psum_scatter + all_gather over the flat fp32 buffer
     padded to ``n`` equal segments (parallel/zero.py pads to
     ``n * ceil(P/n)``) — same total wire as the plain allreduce. The
     codec compresses BOTH halves (grad scatter and param gather —
     parallel/zero.py quantizes each with its own error-feedback
-    residual), so the full volume shrinks."""
+    residual), so the full volume shrinks. On a multi-slice mesh the
+    scatter/gather halves split into link classes by ``dcn_fraction``
+    (same hierarchical lowering as the flat allreduce)."""
     codec = get_codec(codec)
     b = codec.wire_bytes_per_element
     seg = -(-n_elements // n) if n > 1 else n_elements
     padded = n * seg if n > 1 else n_elements
     raw = reduce_scatter_bytes(padded, n) + all_gather_bytes(padded, n)
+    frac = dcn_fraction(n, n_slices)
     return TrafficModel(
         rule="zero1", n_workers=n,
         bytes_per_step=raw * b / 4.0,
         codec=codec.spec,
         raw_bytes_per_step=raw,
+        dcn_bytes_per_step=raw * b / 4.0 * frac,
+        raw_dcn_bytes_per_step=raw * frac,
         detail={"elements": padded, "wire_bytes_per_element": b,
                 "padded_from": n_elements},
     )
@@ -265,27 +388,35 @@ def zero1_traffic(n_elements: int, n: int, codec=None) -> TrafficModel:
 
 def easgd_traffic(
     n_elements: int, n_workers: int, avg_freq: int, group_size: int = 1,
-    codec=None,
+    codec=None, n_slices: int = 1,
 ) -> TrafficModel:
     """EASGD: zero comm on local steps (the selling point) unless the
     worker is a chip GROUP (in-step grad psum over the group's data
     axis); every ``avg_freq`` steps one psum of the param-sized elastic
     differences over the worker axis. The codec compresses the ELASTIC
     EXCHANGE only — the group-internal grad psum rides dense ICI and
-    stays fp32 (parallel/easgd.py)."""
+    stays fp32 (parallel/easgd.py). On a multi-slice mesh the group
+    psum stays ICI by construction (make_worker_group_mesh pins each
+    group inside one slice); the worker-axis exchange spans slices and
+    splits by ``dcn_fraction`` over the worker count."""
     codec = get_codec(codec)
     per_step = (
         allreduce_bytes(n_elements, group_size) if group_size > 1 else 0.0
     )
     raw_exchange = allreduce_bytes(n_elements, n_workers)
+    eff_exchange = raw_exchange * codec.wire_bytes_per_element / 4.0
+    every = max(1, int(avg_freq))
+    frac = dcn_fraction(n_workers, n_slices)
     return TrafficModel(
         rule="easgd", n_workers=n_workers,
         bytes_per_step=per_step,
-        bytes_per_exchange=raw_exchange * codec.wire_bytes_per_element / 4.0,
-        exchange_every=max(1, int(avg_freq)),
+        bytes_per_exchange=eff_exchange,
+        exchange_every=every,
         codec=codec.spec,
         raw_bytes_per_step=per_step,
         raw_bytes_per_exchange=raw_exchange,
+        dcn_bytes_per_step=eff_exchange * frac / every,
+        raw_dcn_bytes_per_step=raw_exchange * frac / every,
         detail={"elements": n_elements,
                 "wire_bytes_per_element": codec.wire_bytes_per_element,
                 "group_size": group_size},
@@ -294,7 +425,7 @@ def easgd_traffic(
 
 def gosgd_traffic(
     n_elements: int, n_workers: int, gossip_every: int = 1,
-    group_size: int = 1, codec=None,
+    group_size: int = 1, codec=None, n_slices: int = 1,
 ) -> TrafficModel:
     """GoSGD: every gossip round is ONE ppermute of the packed
     ``(share*w, share)`` buffer — ``(N+1)*4`` bytes per device per
@@ -314,14 +445,22 @@ def gosgd_traffic(
     round_bytes = (
         gossip_wire_bytes(codec, n_elements) if n_workers > 1 else 0.0
     )
+    every = max(1, int(gossip_every))
+    # the gossip partner is uniform-random over workers: on a multi-
+    # slice mesh the ppermute hop is charged entirely to DCN
+    # (conservative — a same-slice draw is the exception, not the rule,
+    # once r > 1 and workers spread slice-major)
+    dcn = 1.0 if n_slices > 1 and n_workers > 1 else 0.0
     return TrafficModel(
         rule="gosgd", n_workers=n_workers,
         bytes_per_step=per_step,
         bytes_per_exchange=round_bytes,
-        exchange_every=max(1, int(gossip_every)),
+        exchange_every=every,
         codec=codec.spec,
         raw_bytes_per_step=per_step,
         raw_bytes_per_exchange=raw_round,
+        dcn_bytes_per_step=round_bytes * dcn / every,
+        raw_dcn_bytes_per_step=raw_round * dcn / every,
         detail={"elements": n_elements,
                 "wire_bytes_per_element": codec.wire_bytes_per_element,
                 "group_size": group_size},
@@ -329,7 +468,8 @@ def gosgd_traffic(
 
 
 def nd_traffic(
-    n_elements: int, dp: int, shard_ways: int = 1, codec=None
+    n_elements: int, dp: int, shard_ways: int = 1, codec=None,
+    n_slices: int = 1,
 ) -> TrafficModel:
     """ND engine, dp-axis grad sync only: each device allreduces its
     LOCAL (1/shard_ways) slice of the params over the dp axis; the
@@ -341,11 +481,14 @@ def nd_traffic(
     b = codec.wire_bytes_per_element
     local = n_elements / max(1, shard_ways)
     raw = allreduce_bytes(local, dp)
+    frac = dcn_fraction(dp, n_slices)
     return TrafficModel(
         rule="nd", n_workers=dp,
         bytes_per_step=raw * b / 4.0,
         codec=codec.spec,
         raw_bytes_per_step=raw,
+        dcn_bytes_per_step=raw * b / 4.0 * frac,
+        raw_dcn_bytes_per_step=raw * frac,
         detail={"elements": local, "wire_bytes_per_element": b,
                 "approx": True, "shard_ways": shard_ways,
                 "note": "dp grad sync only; activation collectives "

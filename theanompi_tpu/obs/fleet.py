@@ -178,7 +178,9 @@ class FleetView:
                  frozen: list, missed: list, skewed: list,
                  mfu_min: Optional[float], mfu_median: Optional[float],
                  comm_gbps: Optional[float], link_class: str,
-                 slices: list, retries: int):
+                 slices: list, retries: int,
+                 comm_ici_gbps: Optional[float] = None,
+                 comm_dcn_gbps: Optional[float] = None):
         self.t = t
         self.rows = rows
         self.step = step
@@ -198,6 +200,8 @@ class FleetView:
         self.link_class = link_class
         self.slices = slices
         self.retries = retries
+        self.comm_ici_gbps = comm_ici_gbps
+        self.comm_dcn_gbps = comm_dcn_gbps
 
     @property
     def healthy(self) -> bool:
@@ -239,6 +243,8 @@ class FleetView:
             "mfu_min": self.mfu_min,
             "mfu_median": self.mfu_median,
             "comm_gbps": self.comm_gbps,
+            "comm_ici_gbps": self.comm_ici_gbps,
+            "comm_dcn_gbps": self.comm_dcn_gbps,
             "link_class": self.link_class,
             "slices": self.slices,
             "retries": self.retries,
@@ -274,6 +280,10 @@ class FleetView:
             rec["mfu_median"] = self.mfu_median
         if self.comm_gbps is not None:
             rec["comm_gbps"] = self.comm_gbps
+        if self.comm_ici_gbps is not None:
+            rec["comm_ici_gbps"] = self.comm_ici_gbps
+        if self.comm_dcn_gbps is not None:
+            rec["comm_dcn_gbps"] = self.comm_dcn_gbps
         return rec
 
 
@@ -312,6 +322,8 @@ class FleetTailer:
         self._offsets: dict[str, int] = {}   # byte offset per tailed file
         self._ranks: dict[int, _RankState] = {}
         self._comm_gbps: Optional[float] = None
+        self._comm_ici_gbps: Optional[float] = None
+        self._comm_dcn_gbps: Optional[float] = None
         self._retries = 0
         self._refresh_errors = 0
         self._emitted_sig: Optional[tuple] = None
@@ -459,10 +471,14 @@ class FleetTailer:
         if kind == "metrics":
             metrics = row.get("metrics")
             if isinstance(metrics, dict):
-                v = metrics.get("tmpi_comm_gbps")
-                if isinstance(v, (int, float)) and not isinstance(v, bool):
-                    with self._lock:
-                        self._comm_gbps = float(v)
+                for key, attr in (("tmpi_comm_gbps", "_comm_gbps"),
+                                  ("tmpi_comm_ici_gbps", "_comm_ici_gbps"),
+                                  ("tmpi_comm_dcn_gbps", "_comm_dcn_gbps")):
+                    v = metrics.get(key)
+                    if isinstance(v, (int, float)) \
+                            and not isinstance(v, bool):
+                        with self._lock:
+                            setattr(self, attr, float(v))
         elif kind == "profile":
             r = row.get("rank")
             if not isinstance(r, int):
@@ -593,7 +609,7 @@ class FleetTailer:
                 members = per_slice[s]
                 s_steps = [m.step for m in members if m.step >= 0]
                 s_ewmas = [m.ewma for m in members if m.ewma is not None]
-                slices.append({
+                entry = {
                     "slice": s,
                     "ranks": [m.rank for m in members],
                     "step": max(s_steps) if s_steps else -1,
@@ -601,7 +617,16 @@ class FleetTailer:
                     "stragglers": [m.rank for m in members
                                    if m.rank in stragglers],
                     "frozen": [m.rank for m in members if m.rank in frozen],
-                })
+                }
+                if n_slices > 1:
+                    # the slice's cross-slice exchange rate: every slice
+                    # participates in the same DCN allreduce, so the
+                    # chief-reported per-link gauges apply to each
+                    if self._comm_dcn_gbps is not None:
+                        entry["dcn_gbps"] = self._comm_dcn_gbps
+                    if self._comm_ici_gbps is not None:
+                        entry["ici_gbps"] = self._comm_ici_gbps
+                slices.append(entry)
 
         rows = []
         for st in states:
@@ -636,6 +661,8 @@ class FleetTailer:
             mfu_median=statistics.median(mfus) if mfus else None,
             comm_gbps=self._comm_gbps, link_class=link, slices=slices,
             retries=self._retries,
+            comm_ici_gbps=self._comm_ici_gbps,
+            comm_dcn_gbps=self._comm_dcn_gbps,
         )
 
     def _export(self, view: FleetView) -> None:
@@ -676,6 +703,14 @@ class FleetTailer:
             g("tmpi_fleet_comm_gbps",
               "achieved collective GB/s by link class").set(
                 view.comm_gbps, link=view.link_class)
+        if view.comm_ici_gbps is not None:
+            g("tmpi_fleet_comm_gbps",
+              "achieved collective GB/s by link class").set(
+                view.comm_ici_gbps, link="ici")
+        if view.comm_dcn_gbps is not None:
+            g("tmpi_fleet_comm_gbps",
+              "achieved collective GB/s by link class").set(
+                view.comm_dcn_gbps, link="dcn")
         rg = g("tmpi_fleet_rank_step", "per-rank step progress")
         for row in view.rows:
             rg.set(row["step"], rank=row["rank"])

@@ -74,11 +74,13 @@ def _bsp_recipe(mesh, axis_name, codec):
 
 
 def _bsp_grad_sync(strategy, axis_name, n, codec, checked,
-                   allreduce_buckets):
+                   allreduce_buckets, axis_sizes=None):
     """The one place the BSP step builders resolve their exchanger:
     ``--allreduce-buckets`` swaps the single psum for the bucketed
     overlap scheduler (parallel/strategies.py::BucketedOverlapSync);
-    checked-mode AD has no exchanger collective to bucket and refuses."""
+    checked-mode AD has no exchanger collective to bucket and refuses.
+    ``axis_sizes``: the per-axis mesh extents (mesh-axis order) the
+    'hier' strategy needs to stage its two-hop schedule."""
     if allreduce_buckets:
         if checked:
             raise ValueError(
@@ -87,10 +89,11 @@ def _bsp_grad_sync(strategy, axis_name, n, codec, checked,
                 "cotangents, there is no exchanger collective"
             )
         return bucketed(strategy, axis_name, n, allreduce_buckets,
-                        codec=codec)
+                        codec=codec, axis_sizes=axis_sizes)
     return (
         checked_mode_strategy(strategy, axis_name, n, codec=codec) if checked
-        else get_strategy(strategy, axis_name, n, codec=codec)
+        else get_strategy(strategy, axis_name, n, codec=codec,
+                          axis_sizes=axis_sizes)
     )
 
 
@@ -137,13 +140,16 @@ def make_bsp_train_step(
     n = 1
     for a in axes:
         n *= mesh.shape[a]
+    axis_sizes = tuple(int(mesh.shape[a]) for a in axes)
     if n == 1:
         # validate early (bucketed also checks the strategy/codec pair);
         # a 1-device mesh has no collectives, so buckets are a no-op
         if allreduce_buckets:
-            bucketed(strategy, axis_name, n, allreduce_buckets, codec=codec)
+            bucketed(strategy, axis_name, n, allreduce_buckets, codec=codec,
+                     axis_sizes=axis_sizes)
         else:
-            get_strategy(strategy, axis_name, n, codec=codec)
+            get_strategy(strategy, axis_name, n, codec=codec,
+                         axis_sizes=axis_sizes)
         # Single-device fast path: no collectives exist, so skip the
         # shard_map machinery entirely (it pays real dispatch overhead on
         # some backends) — the plain jitted step is semantically identical.
@@ -163,7 +169,7 @@ def make_bsp_train_step(
 
     checked = _checked_vma()
     grad_sync = _bsp_grad_sync(strategy, axis_name, n, codec, checked,
-                               allreduce_buckets)
+                               allreduce_buckets, axis_sizes=axis_sizes)
     base_step = make_train_step(
         model, steps_per_epoch, grad_sync=grad_sync,
         input_transform=input_transform, accum_steps=accum_steps,
@@ -235,6 +241,7 @@ def make_bsp_fused_step(
     n = 1
     for a in axes:
         n *= mesh.shape[a]
+    axis_sizes = tuple(int(mesh.shape[a]) for a in axes)
     checked = _checked_vma()
 
     if n == 1:
@@ -243,9 +250,11 @@ def make_bsp_fused_step(
         # refusal does not apply — one device has no collective either
         # way, so the knob is the documented no-op
         if allreduce_buckets:
-            bucketed(strategy, axis_name, n, allreduce_buckets, codec=codec)
+            bucketed(strategy, axis_name, n, allreduce_buckets, codec=codec,
+                     axis_sizes=axis_sizes)
         else:
-            get_strategy(strategy, axis_name, n, codec=codec)
+            get_strategy(strategy, axis_name, n, codec=codec,
+                         axis_sizes=axis_sizes)
         base = make_train_step(
             model, steps_per_epoch, input_transform=input_transform,
             accum_steps=accum_steps, numerics=numerics,
@@ -261,7 +270,8 @@ def make_bsp_fused_step(
 
         return jax.jit(single)
     grad_sync = _bsp_grad_sync(  # also validates the name
-        strategy, axis_name, n, codec, checked, allreduce_buckets
+        strategy, axis_name, n, codec, checked, allreduce_buckets,
+        axis_sizes=axis_sizes,
     )
     base_step = make_train_step(
         model, steps_per_epoch, grad_sync=grad_sync,
@@ -374,11 +384,30 @@ class BSPEngine:
         for a in _axes_tuple(self._build["axis_name"]):
             n *= self.mesh.shape[a]
         if n > 1 and self.codec.error_feedback:
-            # per-device quantization residuals, stacked [n, ...] and
-            # sharded over the data axes by the step's state spec —
-            # checkpointed with the rest of the state (exact resume)
-            state = state._replace(ef=self.codec.init_ef(state.params,
-                                                         stack=n))
+            if self._build["strategy"] == "hier":
+                # hier feeds quantization error back on the DCN shard,
+                # not per grad leaf: one (n, seg) residual row-stack
+                # (per bucket, when bucketed) — see hier_ef_template
+                from theanompi_tpu.parallel.mesh import slice_topology
+                from theanompi_tpu.parallel.strategies import (
+                    hier_ef_template,
+                )
+
+                bb = None
+                if self._build["allreduce_buckets"]:
+                    bb = max(1, int(
+                        self._build["allreduce_buckets"] * 2 ** 20))
+                state = state._replace(ef=hier_ef_template(
+                    state.params, slice_topology(self.mesh),
+                    bucket_bytes=bb,
+                ))
+            else:
+                # per-device quantization residuals, stacked [n, ...]
+                # and sharded over the data axes by the step's state
+                # spec — checkpointed with the rest of the state (exact
+                # resume)
+                state = state._replace(ef=self.codec.init_ef(state.params,
+                                                             stack=n))
         return state
 
     def train_step(self, state, images, labels, rng, numerics: bool = False):
@@ -443,14 +472,20 @@ class BSPEngine:
         overlap fraction the attribution model prices comm at — rides
         the detail block, keeping the gauges and the SPMD101/102
         cross-checks truthful about the bucketed wire."""
+        import math as _math
+
         from theanompi_tpu.obs.comm import bsp_traffic, pytree_num_elements
+        from theanompi_tpu.parallel.mesh import slice_topology
 
         axes = _axes_tuple(self._build["axis_name"])
         n = 1
         for a in axes:
             n *= self.mesh.shape[a]
+        axis_sizes = tuple(int(self.mesh.shape[a]) for a in axes)
+        n_slices, _per = slice_topology(self.mesh)
         n_buckets = None
         overlap = None
+        segments = None
         if self._build["allreduce_buckets"] and n > 1:
             from theanompi_tpu.parallel.strategies import (
                 bucket_overlap_frac,
@@ -459,18 +494,33 @@ class BSPEngine:
             sync = bucketed(
                 self._build["strategy"], self._build["axis_name"], n,
                 self._build["allreduce_buckets"], codec=self.codec,
+                axis_sizes=axis_sizes,
             )
             # one bucket walk serves both figures (this runs on the
             # metrics-snapshot path)
-            n_buckets = sync.n_buckets(state.params)
+            buckets = sync.buckets_for(state.params)
+            n_buckets = len(buckets)
             overlap = (
                 bucket_overlap_frac(n_buckets) if sync.in_backward
                 else 0.0
             )
+            if self._build["strategy"] == "hier":
+                # each bucket pads and reduce-scatters its own flat
+                # buffer — the two-hop model prices the exact schedule
+                import jax as _jax
+
+                leaves = _jax.tree_util.tree_leaves(state.params)
+                segments = [
+                    sum(int(_math.prod(
+                        getattr(leaves[i], "shape", ()) or ()) or 1)
+                        for i in idx)
+                    for idx in buckets
+                ]
         return bsp_traffic(
             pytree_num_elements(state.params), n,
             strategy=self._build["strategy"], codec=self.codec,
             n_buckets=n_buckets, overlap_frac=overlap,
+            n_slices=n_slices, segments=segments,
         )
 
     def memory_model(self, state):

@@ -358,12 +358,13 @@ class EASGDEngine:
         param-sized psum of elastic differences every ``avg_freq``
         steps over the worker axis."""
         from theanompi_tpu.obs.comm import easgd_traffic, pytree_num_elements
+        from theanompi_tpu.parallel.mesh import slice_topology
 
         # workers leaves are stacked (n_workers, ...): per-worker size
         per_worker = pytree_num_elements(state.workers.params) // self.n
         return easgd_traffic(
             per_worker, self.n, self.avg_freq, group_size=self.group_size,
-            codec=self.codec,
+            codec=self.codec, n_slices=slice_topology(self.mesh)[0],
         )
 
     def memory_model(self, state):

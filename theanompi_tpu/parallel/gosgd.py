@@ -464,11 +464,13 @@ class GOSGDEngine:
         ``gossip_every`` steps), plus the group-internal grad psum when
         workers are chip groups."""
         from theanompi_tpu.obs.comm import gosgd_traffic, pytree_num_elements
+        from theanompi_tpu.parallel.mesh import slice_topology
 
         per_worker = pytree_num_elements(state.workers.params) // self.n
         return gosgd_traffic(
             per_worker, self.n, gossip_every=self.gossip_every,
             group_size=self.group_size, codec=self.codec,
+            n_slices=slice_topology(self.mesh)[0],
         )
 
     def memory_model(self, state):

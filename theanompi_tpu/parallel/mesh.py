@@ -144,6 +144,22 @@ def make_multislice_mesh(
     return Mesh(arr, (DCN_AXIS, DATA_AXIS))
 
 
+def slice_topology(mesh: Mesh) -> tuple[int, int]:
+    """``(n_slices, per_slice)`` of a mesh, read off the DCN axis — the
+    slice decomposition the hierarchical exchange strategy and the
+    per-link-class traffic accounting share. A mesh without a
+    ``DCN_AXIS`` is one slice: every hop is ICI."""
+    names = tuple(mesh.axis_names)
+    if DCN_AXIS not in names:
+        return 1, int(mesh.devices.size)
+    n_slices = int(mesh.shape[DCN_AXIS])
+    per = 1
+    for a in names:
+        if a != DCN_AXIS:
+            per *= int(mesh.shape[a])
+    return n_slices, per
+
+
 def make_worker_group_mesh(mesh: Mesh, group_size: int,
                            n_slices: Optional[int] = None):
     """Reshape a 1-D mesh for async-rule worker groups: ``(worker,

@@ -605,13 +605,14 @@ class NDEngine:
         ppermute, MoE all-to-all) are NOT modeled — the returned model
         is flagged ``approx`` in its detail."""
         from theanompi_tpu.obs.comm import nd_traffic, pytree_num_elements
+        from theanompi_tpu.parallel.mesh import slice_topology
 
         sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
         dp = sizes.get(self._dp_axis, 1) if self._dp_axis else 1
         shard_ways = max(1, self.mesh.devices.size // dp)
         return nd_traffic(
             pytree_num_elements(state.params), dp, shard_ways=shard_ways,
-            codec=self.codec,
+            codec=self.codec, n_slices=slice_topology(self.mesh)[0],
         )
 
     def memory_model(self, state):

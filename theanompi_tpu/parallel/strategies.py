@@ -252,6 +252,178 @@ def codec_psum_mean(axis_name, codec) -> Strategy:
 
 
 # --------------------------------------------------------------------------
+# hierarchical two-hop exchange — the topology-aware 'hier' strategy
+# (GC3-style staged schedule, arXiv:2201.11840; EQuARX's quantize-the-
+# starved-hop result, arXiv:2506.17615): in-slice reduce-scatter over
+# ICI, cross-slice allreduce over DCN on ONLY the scattered 1/s shards
+# (the wire codec applies to this hop alone, where bytes dominate),
+# then in-slice all-gather. Codec-off it moves exactly flat psum's
+# 2(n-1)/n·N·b total wire, re-split (s-1)/s·N·b + (s-1)/s·N·b on ICI
+# and 2(r-1)/r·(N/s)·b on DCN — but the DCN share shrinks by the slice
+# width s, which is what keeps scaling efficiency up when a second
+# slice joins the mesh (ROADMAP item 4).
+# --------------------------------------------------------------------------
+
+
+def hier_segment(n_elements: int, ici_size: int) -> int:
+    """Per-device DCN shard length of the hierarchical exchange: the
+    flat gradient buffer padded up to an ``ici_size`` multiple and
+    reduce-scattered — ``ceil(N / s)``. The declared two-hop
+    TrafficModel (obs/comm.py::bsp_traffic) prices the same geometry,
+    which is what makes SPMD101 reconcile byte-exact."""
+    return -(-int(n_elements) // max(1, int(ici_size)))
+
+
+def _check_hier_axes(axis_name, axis_sizes, axis_size=None):
+    if isinstance(axis_name, str) or len(tuple(axis_name)) != 2:
+        raise ValueError(
+            "strategy 'hier' needs a 2-axis (dcn, data) mesh — build it "
+            "with make_multislice_mesh (the --slices knob); on a 1-D "
+            "mesh there is no slice boundary to schedule around, use "
+            "'psum'"
+        )
+    if not axis_sizes or len(tuple(axis_sizes)) != 2:
+        raise ValueError(
+            "strategy 'hier' needs axis_sizes=(n_slices, per_slice) in "
+            "mesh-axis order (parallel/mesh.py::slice_topology)"
+        )
+    if axis_size is not None and \
+            int(axis_sizes[0]) * int(axis_sizes[1]) != int(axis_size):
+        raise ValueError(
+            f"hier axis_sizes {tuple(axis_sizes)} do not multiply to the "
+            f"mesh size {axis_size}"
+        )
+
+
+def _hier_exchange_flat(flat, dcn_axis, ici_axis, r: int, s: int,
+                        dcn_wire=None):
+    """One hierarchical allreduce (SUM — caller divides) on a flat fp32
+    buffer: reduce-scatter over the in-slice ICI axis (each device ends
+    holding the slice-local sum of its 1/s segment), allreduce over the
+    cross-slice DCN axis on only that segment (``dcn_wire`` value-space
+    compresses this hop alone), all-gather the reduced segments back
+    over ICI."""
+    L = flat.shape[0]
+    seg = hier_segment(L, s)
+    if s > 1:
+        buf = jnp.zeros((s * seg,), flat.dtype).at[:L].set(flat)
+        shard = lax.psum_scatter(buf, ici_axis, scatter_dimension=0,
+                                 tiled=True)
+    else:
+        shard = flat
+    if r > 1:
+        if dcn_wire is not None:
+            shard = dcn_wire(shard)
+        shard = lax.psum(shard, dcn_axis)
+    if s > 1:
+        out = lax.all_gather(shard, ici_axis, tiled=True)
+        return out[:L]
+    return shard
+
+
+def hierarchical_sync(axis_names, axis_sizes, codec=None) -> Strategy:
+    """The ``hier`` Strategy: ``axis_names = (dcn_axis, ici_axis)`` and
+    ``axis_sizes = (n_slices, per_slice)`` in mesh order
+    (make_multislice_mesh rows are slices). Codec-off it is a flat pmean
+    re-associated slice-first (allclose, not bit-identical — the
+    summation tree differs). An active codec compresses ONLY the DCN
+    hop: stateless codecs value-space-quantize the in-slice-reduced
+    shard before the cross-slice psum; ``:ef`` threads a per-device
+    residual on that shard through engine state (stacked ``(1, seg)``
+    rows — hier_ef_template), so quantization error is fed back exactly
+    where it is introduced."""
+    from theanompi_tpu.parallel.codec import get_codec
+
+    dcn_axis, ici_axis = tuple(axis_names)
+    r, s = int(axis_sizes[0]), int(axis_sizes[1])
+    n = r * s
+    codec = get_codec(codec)
+
+    if codec.active and codec.error_feedback:
+
+        def strategy(grads, ef):
+            flat, unravel = ravel_pytree(grads)
+            fl = flat.astype(jnp.float32)
+            L = fl.shape[0]
+            seg = hier_segment(L, s)
+            if s > 1:
+                buf = jnp.zeros((s * seg,), fl.dtype).at[:L].set(fl)
+                shard = lax.psum_scatter(buf, ici_axis,
+                                         scatter_dimension=0, tiled=True)
+            else:
+                shard = fl
+            if r > 1:
+                wire, ef = codec.compress_stacked(shard, ef)
+                shard = lax.psum(wire, dcn_axis)
+            shard = shard / n
+            out = (lax.all_gather(shard, ici_axis, tiled=True)[:L]
+                   if s > 1 else shard)
+            return unravel(out.astype(flat.dtype)), ef
+
+        strategy.stateful = True
+        return strategy
+
+    qdq = codec.qdq if codec.active else None
+
+    def strategy(grads):
+        flat, unravel = ravel_pytree(grads)
+        out = _hier_exchange_flat(
+            flat.astype(jnp.float32), dcn_axis, ici_axis, r, s,
+            dcn_wire=qdq,
+        ) / n
+        return unravel(out.astype(flat.dtype))
+
+    return strategy
+
+
+def hier_ef_template(params, axis_sizes, bucket_bytes=None):
+    """Global error-feedback template for the hier ``:ef`` composition:
+    the DCN-shard residual, stacked to one row per device — a single
+    ``(n, seg)`` fp32 zeros array whose dim 0 the recipe's ef prefix
+    spec shards, so each device holds its own ``(1, seg)`` row (the
+    compress_stacked convention). With ``bucket_bytes`` (the bucketed+
+    hier+``:ef`` composition) one such array per bucket, ordered like
+    assign_buckets, each keyed to that bucket's packed flat segment."""
+    r, s = int(axis_sizes[0]), int(axis_sizes[1])
+    n = r * s
+    leaves = jax.tree_util.tree_leaves(params)
+
+    def _zeros(n_elements):
+        return jnp.zeros((n, hier_segment(n_elements, s)), jnp.float32)
+
+    if bucket_bytes is None:
+        total = sum(
+            int(math.prod(getattr(l, "shape", ()) or ()) or 1)
+            for l in leaves
+        )
+        return _zeros(total)
+    return tuple(
+        _zeros(sum(
+            int(math.prod(getattr(leaves[i], "shape", ()) or ()) or 1)
+            for i in idx
+        ))
+        for idx in assign_buckets(leaves, bucket_bytes)
+    )
+
+
+def _pack_flat(leaves):
+    """Concatenate leaves into one flat fp32 buffer (the per-bucket
+    packing of the bucketed+hier composition)."""
+    flats = [l.astype(jnp.float32).reshape(-1) for l in leaves]
+    return flats[0] if len(flats) == 1 else jnp.concatenate(flats)
+
+
+def _unpack_flat(flat, leaves):
+    """Inverse of _pack_flat against the original leaves' shapes/dtypes."""
+    out, off = [], 0
+    for l in leaves:
+        sz = int(math.prod(getattr(l, "shape", ()) or ()) or 1)
+        out.append(flat[off:off + sz].reshape(jnp.shape(l)).astype(l.dtype))
+        off += sz
+    return out
+
+
+# --------------------------------------------------------------------------
 # bucketed overlap-with-backward allreduce — GC3-style collective
 # scheduling (PAPERS.md, arXiv:2201.11840): chunk the gradient pytree
 # into ~MB-sized buckets and launch each bucket's psum AS SOON AS its
@@ -320,9 +492,17 @@ class BucketedOverlapSync:
     pmean, stateful — bucketed wire scheduling without the structural
     overlap, EF residuals keyed per bucket's leaves. ``in_backward`` /
     ``stateful`` tell the step builder which contract applies.
+
+    Hierarchical composition (``axis_sizes`` set): each bucket's
+    cotangents pack into one flat buffer and run the two-hop
+    hierarchical exchange instead of a flat pmean — so every bucket's
+    DCN hop (the expensive one) overlaps the remaining backward, and a
+    codec compresses only that hop. ``:ef`` residuals become one
+    ``(1, seg_b)`` shard-row per bucket (hier_ef_template).
     """
 
-    def __init__(self, axis_name, bucket_mb: float = 8.0, codec=None):
+    def __init__(self, axis_name, bucket_mb: float = 8.0, codec=None,
+                 axis_sizes=None):
         from theanompi_tpu.parallel.codec import get_codec
 
         if not bucket_mb or bucket_mb <= 0:
@@ -334,6 +514,11 @@ class BucketedOverlapSync:
         self.bucket_mb = float(bucket_mb)
         self.bucket_bytes = max(1, int(bucket_mb * 2 ** 20))
         self.codec = get_codec(codec)
+        self.axis_sizes = (tuple(int(x) for x in axis_sizes)
+                           if axis_sizes is not None else None)
+        self.hier = self.axis_sizes is not None
+        if self.hier:
+            _check_hier_axes(axis_name, self.axis_sizes)
         self.stateful = self.codec.active and self.codec.error_feedback
         self.in_backward = not self.stateful
 
@@ -359,9 +544,22 @@ class BucketedOverlapSync:
         # compress path, minus the residual state
         return self.codec.qdq(c.astype(jnp.float32)).astype(c.dtype)
 
+    def _hier_mean(self, leaves):
+        """One bucket's hierarchical exchange: pack the leaves into a
+        flat fp32 buffer, two-hop mean (codec on the DCN hop only),
+        unpack — the bucketed+hier composition's collective."""
+        dcn_axis, ici_axis = tuple(self.axis_name)
+        r, s = self.axis_sizes
+        out = _hier_exchange_flat(
+            _pack_flat(leaves), dcn_axis, ici_axis, r, s,
+            dcn_wire=self.codec.qdq if self.codec.active else None,
+        ) / (r * s)
+        return _unpack_flat(out, leaves)
+
     def _make_tag(self):
         axis = self.axis_name
         qdq = self._qdq
+        hier_mean = self._hier_mean if self.hier else None
 
         @jax.custom_vjp
         def tag(*leaves):
@@ -371,6 +569,8 @@ class BucketedOverlapSync:
             return leaves, None
 
         def bwd(_, cts):
+            if hier_mean is not None:
+                return tuple(hier_mean(list(cts)))
             return tuple(lax.pmean(qdq(c), axis) for c in cts)
 
         tag.defvjp(fwd, bwd)
@@ -395,10 +595,19 @@ class BucketedOverlapSync:
         buckets = assign_buckets(leaves, self.bucket_bytes)
         if not self.stateful:
             out = list(leaves)
-            for idx in buckets:
-                for i in idx:
-                    out[i] = lax.pmean(self._qdq(leaves[i]), self.axis_name)
+            if self.hier:
+                for idx in buckets:
+                    red = self._hier_mean([leaves[i] for i in idx])
+                    for j, i in enumerate(idx):
+                        out[i] = red[j]
+            else:
+                for idx in buckets:
+                    for i in idx:
+                        out[i] = lax.pmean(self._qdq(leaves[i]),
+                                           self.axis_name)
             return jax.tree_util.tree_unflatten(treedef, out)
+        if self.hier:
+            return self._hier_stateful(leaves, treedef, buckets, ef)
         ef_leaves = jax.tree_util.tree_leaves(ef)
         if len(ef_leaves) != len(leaves):
             raise ValueError(
@@ -423,23 +632,71 @@ class BucketedOverlapSync:
             jax.tree_util.tree_unflatten(treedef, new_ef),
         )
 
+    def _hier_stateful(self, leaves, treedef, buckets, ef):
+        """The bucketed+hier+``:ef`` composition: per bucket, pack the
+        grads flat, in-slice reduce-scatter, ``compress_stacked`` the
+        DCN shard against that bucket's residual row, cross-slice psum,
+        in-slice all-gather, unpack. ``ef`` is one ``(1, seg_b)`` array
+        per bucket (hier_ef_template ordering — assign_buckets order)."""
+        dcn_axis, ici_axis = tuple(self.axis_name)
+        r, s = self.axis_sizes
+        n = r * s
+        ef_leaves = jax.tree_util.tree_leaves(ef)
+        if len(ef_leaves) != len(buckets):
+            raise ValueError(
+                f"hier error-feedback state has {len(ef_leaves)} shard "
+                f"rows for a {len(buckets)}-bucket schedule — engine "
+                "state was not initialized with hier_ef_template"
+            )
+        out = [None] * len(leaves)
+        new_ef = []
+        for b, idx in enumerate(buckets):
+            sub = [leaves[i] for i in idx]
+            flat = _pack_flat(sub)
+            L = flat.shape[0]
+            seg = hier_segment(L, s)
+            if s > 1:
+                buf = jnp.zeros((s * seg,), flat.dtype).at[:L].set(flat)
+                shard = lax.psum_scatter(buf, ici_axis,
+                                         scatter_dimension=0, tiled=True)
+            else:
+                shard = flat
+            e2 = ef_leaves[b]
+            if r > 1:
+                wire, e2 = self.codec.compress_stacked(shard, e2)
+                shard = lax.psum(wire, dcn_axis)
+            shard = shard / n
+            red = (lax.all_gather(shard, ici_axis, tiled=True)[:L]
+                   if s > 1 else shard)
+            for j, leaf in zip(idx, _unpack_flat(red, sub)):
+                out[j] = leaf
+            new_ef.append(e2)
+        return (
+            jax.tree_util.tree_unflatten(treedef, out),
+            tuple(new_ef),
+        )
+
 
 def bucketed(name: str, axis_name, axis_size: int, bucket_mb: float,
-             codec=None) -> BucketedOverlapSync:
+             codec=None, axis_sizes=None) -> BucketedOverlapSync:
     """``--allreduce-buckets`` entry: validate the (strategy, codec)
-    pair and return the bucketed scheduler. psum family only — the
+    pair and return the bucketed scheduler. psum and hier only — the
     explicit ring variants already own a segmented hop schedule that a
     leaf-bucket layer would fight, and checked-mode AD has no exchanger
     collective to bucket (callers gate on that)."""
-    del axis_size  # collectives are axis-name driven; kept for symmetry
     codec = _resolve_codec(name, codec)
     key = _ALIASES.get(name, name)
+    if key == "hier":
+        _check_hier_axes(axis_name, axis_sizes, axis_size)
+        return BucketedOverlapSync(axis_name, bucket_mb=bucket_mb,
+                                   codec=codec, axis_sizes=axis_sizes)
+    del axis_size  # collectives are axis-name driven; kept for symmetry
     if key != "psum":
         raise ValueError(
-            f"--allreduce-buckets needs strategy 'psum' (got {name!r}): "
-            "the explicit ring variants already schedule their own "
-            "segments, and compressed wires ride the codec knob "
-            "(--wire-codec) on the psum path"
+            f"--allreduce-buckets needs strategy 'psum' or 'hier' (got "
+            f"{name!r}): the explicit ring variants already schedule "
+            "their own segments, and compressed wires ride the codec "
+            "knob (--wire-codec) on the psum path"
         )
     return BucketedOverlapSync(axis_name, bucket_mb=bucket_mb, codec=codec)
 
@@ -519,7 +776,9 @@ def checked_mode_strategy(name: str, axis_name, axis_size: int,
             "--wire-codec or run the classic semantics"
         )
     key = _ALIASES.get(name, name)
-    if key in ("psum", "psum_bf16"):
+    # 'hier' degenerates with the psum family: AD already summed over
+    # every mesh axis, so there is no two-hop schedule left to stage
+    if key in ("psum", "psum_bf16", "hier"):
         return lambda grads: jax.tree_util.tree_map(
             lambda g: g / axis_size, grads
         )
@@ -532,10 +791,13 @@ def checked_mode_strategy(name: str, axis_name, axis_size: int,
 
 
 def get_strategy(name: str, axis_name, axis_size: int,
-                 codec=None) -> Strategy:
+                 codec=None, axis_sizes=None) -> Strategy:
     """``axis_name`` may be a tuple of mesh axes (multi-slice BSP): the
     psum family reduces over all of them (XLA lowers ICI-then-DCN); the
-    explicit ring variants are single-axis algorithms by construction.
+    explicit ring variants are single-axis algorithms by construction;
+    ``hier`` REQUIRES the 2-axis ``(dcn, data)`` form plus
+    ``axis_sizes=(n_slices, per_slice)`` and stages the hierarchy
+    explicitly (codec on the DCN hop only).
 
     ``codec``: a wire codec spec/instance (parallel/codec.py). On the
     psum path it returns the STATEFUL compressed strategy (error
@@ -544,11 +806,16 @@ def get_strategy(name: str, axis_name, axis_size: int,
     generalized); strategies that already compress refuse it."""
     codec = _resolve_codec(name, codec)
     key = _ALIASES.get(name, name)
+    if key == "hier":
+        _check_hier_axes(axis_name, axis_sizes, axis_size)
+        return hierarchical_sync(tuple(axis_name), tuple(axis_sizes),
+                                 codec)
     if not isinstance(axis_name, str) and key in ("ring", "ring_bf16", "ring_int8"):
         raise ValueError(
             f"strategy {name!r} is a single-axis ring; on a multi-slice "
             "mesh use 'psum'/'psum_bf16' (XLA lowers the ICI/DCN "
-            "hierarchy from the mesh layout)"
+            "hierarchy from the mesh layout) or 'hier' (explicit staged "
+            "schedule, codec on the DCN hop)"
         )
     if codec.active:
         if key == "psum":
@@ -564,5 +831,5 @@ def get_strategy(name: str, axis_name, axis_size: int,
     except KeyError:
         raise ValueError(
             f"unknown exchange strategy {name!r}; available: "
-            f"{sorted(_CANONICAL) + sorted(_ALIASES)}"
+            f"{sorted(_CANONICAL) + ['hier'] + sorted(_ALIASES)}"
         ) from None

@@ -497,10 +497,11 @@ class ZeroEngine:
         the plain allreduce, which is the module's headline claim; the
         codec compresses both halves."""
         from theanompi_tpu.obs.comm import pytree_num_elements, zero1_traffic
+        from theanompi_tpu.parallel.mesh import slice_topology
 
         return zero1_traffic(
             pytree_num_elements(state.params), self.mesh.devices.size,
-            codec=self.codec,
+            codec=self.codec, n_slices=slice_topology(self.mesh)[0],
         )
 
     def memory_model(self, state):

@@ -39,9 +39,14 @@ from theanompi_tpu.tools.analyze.signature import (
 # ``bsp_bucketed_fused`` pins the two PR-11 knobs COMBINED
 # (``--allreduce-buckets`` + ``--fused-update``): the per-bucket psum
 # schedule must survive the fused epilogue, so the pair gets its own
-# golden instead of only the knobs-in-isolation ones.
-ENGINE_NAMES = ("bsp", "bsp_bucketed", "bsp_bucketed_fused", "zero1",
-                "easgd", "gosgd", "nd")
+# golden instead of only the knobs-in-isolation ones. ``bsp_hier`` is
+# the hierarchical exchange on a 4-device 2-slice ('dcn','data') mesh:
+# in-slice reduce-scatter, cross-slice psum over only the scattered
+# shard (the codec'd hop), in-slice all-gather — its golden pins the
+# three-collective schedule and SPMD101's per-link split verifies the
+# DCN hop's bytes against the declared two-hop model.
+ENGINE_NAMES = ("bsp", "bsp_hier", "bsp_bucketed", "bsp_bucketed_fused",
+                "zero1", "easgd", "gosgd", "nd")
 CODEC_SPECS = ("none", "int8:ef")
 
 # the memory & precision pre-flight matrix (tools/analyze/memory.py /
@@ -133,6 +138,24 @@ def _mesh2():
     return Mesh(np.array(devs[:2]), ("data",))
 
 
+def _mesh22():
+    """2 slices x 2 chips: the smallest mesh where the hierarchical
+    exchange exercises both link classes (axis order matches
+    parallel/mesh.make_multislice_mesh: DCN outermost)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) < 4:
+        raise RuntimeError(
+            "hierarchical-exchange analysis needs >= 4 devices (2 "
+            "slices x 2 chips); run under the test conftest (8-way "
+            "virtual CPU) or let `tmpi lint` set "
+            "--xla_force_host_platform_device_count"
+        )
+    return Mesh(np.array(devs[:4]).reshape(2, 2), ("dcn", "data"))
+
+
 def _abstract_state(engine, rng):
     import jax
 
@@ -173,6 +196,16 @@ def _build_one(name: str, codec: str) -> EngineTrace:
                 else 0.0,
                 fused_update=name.endswith("_fused"),
             )
+            state = _abstract_state(eng, rng)
+            x = sds((16, 8, 8, 3), jnp.float32)
+            y = sds((16,), jnp.int32)
+            step_parts = [("step", eng._steps[False], (state, x, y, rng), 1.0)]
+        elif name == "bsp_hier":
+            from theanompi_tpu.parallel.bsp import BSPEngine
+
+            model = _tiny_model()
+            eng = BSPEngine(model, _mesh22(), strategy="hier",
+                            wire_codec=wire_codec)
             state = _abstract_state(eng, rng)
             x = sds((16, 8, 8, 3), jnp.float32)
             y = sds((16,), jnp.int32)

@@ -43,6 +43,7 @@ from theanompi_tpu.tools.analyze import harness
 from theanompi_tpu.tools.analyze.signature import (
     has_quantized_collective,
     signature_effective_bytes,
+    signature_link_bytes,
     signature_raw_bytes,
 )
 
@@ -143,6 +144,13 @@ def _traced_raw_amortized(trace) -> float:
     )
 
 
+def _traced_dcn_raw_amortized(trace) -> float:
+    return sum(
+        signature_link_bytes(p.signature, p.axis_sizes)["dcn"] * p.weight
+        for p in trace.parts
+    )
+
+
 def _traced_effective_amortized(trace, codec_bytes: float) -> float:
     return sum(
         signature_effective_bytes(p.signature, p.axis_sizes, codec_bytes)
@@ -158,22 +166,45 @@ def traffic_findings(trace_off, declared=None) -> list:
     if trace_off.error is not None:
         return []
     tm = declared if declared is not None else trace_off.traffic
+    out = []
     traced = _traced_raw_amortized(trace_off)
     want = float(tm.raw_bytes_per_step_amortized)
     tol = max(TRAFFIC_ABS_TOL, TRAFFIC_REL_TOL * max(traced, want))
-    if abs(traced - want) <= tol:
-        return []
-    return [Finding(
-        rule="SPMD101", path=trace_off.module_file, line=0,
-        engine=trace_off.engine,
-        message=(
-            f"[{trace_off.engine}] traffic_model() declares "
-            f"{want:.0f} raw B/step (amortized) but the traced jaxpr "
-            f"moves {traced:.0f} B/step — the tmpi_comm_* gauges are "
-            "drifting from the program; fix the analytic model or the "
-            "exchange"
-        ),
-    )]
+    if abs(traced - want) > tol:
+        out.append(Finding(
+            rule="SPMD101", path=trace_off.module_file, line=0,
+            engine=trace_off.engine,
+            message=(
+                f"[{trace_off.engine}] traffic_model() declares "
+                f"{want:.0f} raw B/step (amortized) but the traced "
+                f"jaxpr moves {traced:.0f} B/step — the tmpi_comm_* "
+                "gauges are drifting from the program; fix the "
+                "analytic model or the exchange"
+            ),
+        ))
+    # per-link-class leg: the DCN share of the traced wire (bytes on
+    # slice-spanning hops) vs the model's declared raw DCN bytes. ICI
+    # is the complement of the total, so total + DCN pins both classes.
+    # Single-slice engines are trivially consistent (both sides 0).
+    want_dcn = getattr(tm, "raw_dcn_bytes_per_step", None)
+    if want_dcn is not None:
+        traced_dcn = _traced_dcn_raw_amortized(trace_off)
+        want_dcn = float(want_dcn)
+        tol = max(TRAFFIC_ABS_TOL,
+                  TRAFFIC_REL_TOL * max(traced_dcn, want_dcn))
+        if abs(traced_dcn - want_dcn) > tol:
+            out.append(Finding(
+                rule="SPMD101", path=trace_off.module_file, line=0,
+                engine=trace_off.engine,
+                message=(
+                    f"[{trace_off.engine}] traffic_model() declares "
+                    f"{want_dcn:.0f} raw DCN B/step (amortized) but the "
+                    f"traced jaxpr puts {traced_dcn:.0f} B/step on "
+                    "slice-spanning hops — the per-link-class gauges "
+                    "(tmpi_comm_dcn_*) are drifting from the program"
+                ),
+            ))
+    return out
 
 
 def codec_findings(trace_off, trace_on, declared=None) -> list:

@@ -240,7 +240,17 @@ class _Walker:
                 out_vary = False
             elif name in _VARYING_OUT:
                 out_vary = True
-            out_quant = in_quant or _eqn_is_quant_marker(eqn)
+            if name in COLLECTIVE_PRIMS:
+                # quantization evidence applies to the wire the operand
+                # just CROSSED, not to every later collective in the
+                # chain: a reduced output is a fresh value (the hier
+                # strategy's in-slice all-gather after its codec'd DCN
+                # psum rides fp32 and must be priced fp32). The output
+                # stays marked only if it is itself low-bit (physical
+                # compressed wire, e.g. a bf16 psum result).
+                out_quant = _eqn_is_quant_marker(eqn)
+            else:
+                out_quant = in_quant or _eqn_is_quant_marker(eqn)
             for v in eqn.outvars:
                 self._set(varying, v, out_vary)
                 self._set(quant, v, out_quant)
@@ -448,6 +458,53 @@ def collective_wire_bytes(c: Collective, axis_sizes: dict) -> float:
     if c.prim == "ppermute":
         return nbytes
     return nbytes
+
+
+def collective_link_bytes(c: Collective, axis_sizes: dict,
+                          dcn_axis: str = "dcn") -> dict:
+    """Split one collective's per-device wire bytes by link class:
+    ``{"ici": ..., "dcn": ...}``. Axes that don't include ``dcn_axis``
+    are pure-ICI; a collective purely over ``dcn_axis`` is pure-DCN.
+    For a mixed-axis collective (flat allreduce over ('dcn','data')) a
+    ring over the combined axis crosses a slice boundary on ``r-1`` of
+    its ``n-1`` hops, so the DCN share of the wire is ``(r-1)/(n-1)``
+    for both the allreduce and one-sided forms — the same convention as
+    obs/comm.py's ``dcn_fraction``. A ppermute whose axes span slices
+    is priced all-DCN (worst case: every neighbor hop may cross)."""
+    total = collective_wire_bytes(c, axis_sizes)
+    out = {"ici": 0.0, "dcn": 0.0}
+    if total <= 0.0:
+        return out
+    if dcn_axis not in c.axes:
+        out["ici"] = total
+        return out
+    n = _axis_prod(c.axes, axis_sizes)
+    r = int(axis_sizes.get(dcn_axis, 1))
+    s = max(1, n // max(1, r))
+    if s == 1 or r <= 1:
+        out["dcn"] = total if r > 1 else 0.0
+        out["ici"] = total - out["dcn"]
+        return out
+    if c.prim == "ppermute":
+        out["dcn"] = total
+        return out
+    frac = (r - 1) / (n - 1) if n > 1 else 0.0
+    out["dcn"] = total * frac
+    out["ici"] = total - out["dcn"]
+    return out
+
+
+def signature_link_bytes(sig: Signature, axis_sizes: dict,
+                         dcn_axis: str = "dcn") -> dict:
+    """Per-link-class raw wire bytes per execution, dtype-honest:
+    ``{"ici": ..., "dcn": ...}`` totals over all collectives (count-
+    weighted). ``ici + dcn == signature_raw_bytes`` by construction."""
+    out = {"ici": 0.0, "dcn": 0.0}
+    for c in sig.collectives:
+        lb = collective_link_bytes(c, axis_sizes, dcn_axis)
+        out["ici"] += lb["ici"] * c.count
+        out["dcn"] += lb["dcn"] * c.count
+    return out
 
 
 def signature_raw_bytes(sig: Signature, axis_sizes: dict) -> float:

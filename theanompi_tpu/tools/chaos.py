@@ -80,6 +80,10 @@ import numpy as np
 #   subprocess: the fault kills the process — needs the sandbox
 #   sharded:    only meaningful for sharded checkpoint sets
 #   rollback:   needs numerics sentinels + --on-anomaly rollback armed
+#   elastic:    a topology fault — the run gets a 2-slice mesh and
+#               elastic supervision (reshard-to-survivors); inexact by
+#               nature (the survivor world re-partitions the batch, so
+#               final state legitimately differs from the flat baseline)
 MATRIX: dict[str, dict] = {
     "crash": {},
     "sigterm": {},
@@ -91,9 +95,12 @@ MATRIX: dict[str, dict] = {
     "slow_write": {"arg": 0.2},
     "bitrot": {},
     "partial_set": {"sharded": True},
+    "slice_down": {"exact": False, "elastic": True},
 }
 
 # the tier-1 smoke matrix: in-process, sleep-free, storage kinds included
+# (slice_down rides tier-1 as a DIRECTED smoke schedule instead —
+# tests/test_chaos.py — so the seeded fuzz draws stay stable)
 SMOKE_KINDS = ("crash", "ckpt_truncate", "enospc", "bitrot")
 
 INVARIANTS = (
@@ -162,9 +169,17 @@ def usable_kinds(cfg: ChaosConfig, kinds: list[str]) -> list[str]:
     working-as-designed, not a schedule worth fuzzing)."""
     out = [k for k in kinds
            if not MATRIX[k].get("sharded") or cfg.sharded_ckpt]
+    out = [k for k in out
+           if not MATRIX[k].get("rollback")
+           or cfg.steps_per_epoch + 1 <= cfg.total_steps]
+    # elastic (topology) kinds run on a 2-slice mesh and reshard to
+    # survivors: needs an even device count with at least one whole
+    # slice left, and the plain-BSP replicated state (ZeRO's sharded
+    # optimizer reshard across worlds is its own campaign)
     return [k for k in out
-            if not MATRIX[k].get("rollback")
-            or cfg.steps_per_epoch + 1 <= cfg.total_steps]
+            if not MATRIX[k].get("elastic")
+            or (cfg.devices >= 4 and cfg.devices % 2 == 0
+                and not cfg.zero and not cfg.sharded_ckpt)]
 
 
 def generate_schedule(rng: random.Random, cfg: ChaosConfig,
@@ -247,6 +262,10 @@ def _base_run_kwargs(cfg: ChaosConfig, ckpt_dir: str, obs_dir: Optional[str],
                   rollback_budget=len(schedule) + 1)
     if any(spec_kind(s) == "sigterm" for s in schedule):
         kw["sigterm_grace"] = 10.0
+    if any(MATRIX[spec_kind(s)].get("elastic") for s in schedule):
+        # whole-slice loss needs a slice to lose and a supervisor
+        # allowed to reshard onto the survivors
+        kw.update(n_slices=2, elastic=True)
     return kw
 
 
@@ -398,6 +417,8 @@ def _run_subprocess(cfg: ChaosConfig, schedule: list[str], workdir: str,
                  "--rollback-budget", str(len(schedule) + 1)]
     if any(spec_kind(s) == "sigterm" for s in schedule):
         args += ["--sigterm-grace", "10"]
+    if any(MATRIX[spec_kind(s)].get("elastic") for s in schedule):
+        args += ["--slices", "2", "--elastic"]
     for s in schedule:
         args += ["--inject-fault", s]
     env = _subprocess_env(mutate)

@@ -87,6 +87,18 @@ SCHEMAS: dict[str, dict[str, tuple[tuple, bool]]] = {
         "raw_bytes": (_NUM, True),
         "wire_bytes": (_NUM, True),
         "compression_ratio": (_NUM, True),
+        # per-link-class split (amortized, per device): the cross-slice
+        # DCN share of the effective and raw wire next to the in-slice
+        # ICI remainder — ici+dcn == wire_bytes and raw_ici+raw_dcn ==
+        # raw_bytes by construction (obs/comm.py TrafficModel). 0 on
+        # single-slice meshes; optional so pre-multislice records stay
+        # valid. Live companions: the tmpi_comm_{ici,dcn}_bytes_per_step
+        # (+ raw_*) gauges and the achieved tmpi_comm_{ici,dcn}_gbps
+        # pair (analytic per-link bytes / measured step seconds).
+        "ici_bytes": (_NUM, False),
+        "dcn_bytes": (_NUM, False),
+        "raw_ici_bytes": (_NUM, False),
+        "raw_dcn_bytes": (_NUM, False),
     },
     "heartbeat": {
         "rank": ((int,), True),
@@ -404,6 +416,14 @@ SERVE_METRIC_PREFIX = "tmpi_serve_"
 #   tmpi_step_residual_frac   gauge  unattributed remainder
 #   tmpi_cost_flops_per_step  gauge  XLA cost-analysis FLOPs/step
 #   tmpi_cost_hbm_bytes_per_step  gauge  XLA bytes-accessed/step
+# per-link-class comm gauges (obs/comm.py TrafficModel.as_metrics +
+# the obs facade's step cadence; 0 / absent on single-slice meshes):
+#   tmpi_comm_ici_bytes_per_step      gauge  in-slice effective B/step
+#   tmpi_comm_dcn_bytes_per_step      gauge  cross-slice effective B/step
+#   tmpi_comm_raw_ici_bytes_per_step  gauge  in-slice fp32 B/step
+#   tmpi_comm_raw_dcn_bytes_per_step  gauge  cross-slice fp32 B/step
+#   tmpi_comm_ici_gbps        gauge  achieved in-slice GB/s
+#   tmpi_comm_dcn_gbps        gauge  achieved cross-slice GB/s
 # kind=profile fractions must sum to 1 within this absolute tolerance
 PROFILE_FRACTION_SUM_TOL = 0.02
 

@@ -18,6 +18,12 @@ moved beyond its tolerance band:
   HBM (a ``tmpi preflight`` ``kind=preflight`` record, the
   ``tmpi_preflight_peak_bytes`` gauge, or a profile report's
   ``memory`` block) — the memory trajectory gated like MFU;
+- ``ici_bytes_per_step`` / ``dcn_bytes_per_step`` — the per-link-class
+  wire split (hierarchical-collectives PR): a change that silently
+  moves traffic onto the slow cross-slice DCN link — or grows it —
+  fails exactly like an MFU drop. A 0.0 DCN baseline (single-slice
+  runs) is carried and compared absolutely, so DCN bytes APPEARING
+  where there were none also fails;
 - per-file: a profile report's attribution fractions must sum to
   1.0 +/- the fraction tolerance (the decomposition's own invariant).
 
@@ -62,7 +68,8 @@ ZERO_BASELINE_ABS_TOL = 0.02
 
 # the ratio invariants the gate understands, in report order
 GATE_METRICS = ("mfu", "host_blocked_frac", "compression_ratio",
-                "hbm_gbps", "preflight_peak_bytes")
+                "hbm_gbps", "preflight_peak_bytes",
+                "ici_bytes_per_step", "dcn_bytes_per_step")
 
 
 def _num(v) -> Optional[float]:
@@ -122,6 +129,9 @@ def extract_invariants(obj: dict) -> dict:
         if n is None and key == "preflight_peak_bytes":
             n = _num(obj.get("memory", {}).get("peak_bytes")
                      if isinstance(obj.get("memory"), dict) else None)
+        if n is None and key in ("ici_bytes_per_step", "dcn_bytes_per_step"):
+            n = _num(obj.get("traffic", {}).get(key)
+                     if isinstance(obj.get("traffic"), dict) else None)
         if n is not None:
             out[key] = n
     return out

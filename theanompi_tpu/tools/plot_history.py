@@ -83,6 +83,10 @@ def load_obs(jsonl_path: str) -> dict:
     when the run has no (or unreadable) obs data, so callers degrade
     gracefully."""
     out: dict = {"comm_step": [], "comm_gbps": [], "comm_gbps_raw": [],
+                 # per-link-class series (multislice runs): the ICI and
+                 # DCN shares of the achieved rate, paired with
+                 # comm_step like the raw series (None when absent)
+                 "comm_gbps_ici": [], "comm_gbps_dcn": [],
                  "codec": None, "fractions": {},
                  # step-time attribution (kind=profile records,
                  # obs/attribution.py): stacked fractions + MFU trend
@@ -131,6 +135,8 @@ def load_obs(jsonl_path: str) -> dict:
                         continue
                     gbps = row.get("metrics", {}).get("tmpi_comm_gbps")
                     raw = row.get("metrics", {}).get("tmpi_comm_gbps_raw")
+                    ici = row.get("metrics", {}).get("tmpi_comm_ici_gbps")
+                    dcn = row.get("metrics", {}).get("tmpi_comm_dcn_gbps")
                     if gbps is not None:
                         if out["comm_step"] and row["step"] < out["comm_step"][-1]:
                             # append-mode rerun into the same obs dir:
@@ -139,17 +145,23 @@ def load_obs(jsonl_path: str) -> dict:
                             # last-summary-wins rule below)
                             out["comm_step"], out["comm_gbps"] = [], []
                             out["comm_gbps_raw"] = []
+                            out["comm_gbps_ici"] = []
+                            out["comm_gbps_dcn"] = []
                         if out["comm_step"] and row["step"] == out["comm_step"][-1]:
                             # epoch-end snapshot repeats the step of the
                             # last per-step snapshot: newest value wins
                             out["comm_gbps"][-1] = gbps
                             out["comm_gbps_raw"][-1] = raw
+                            out["comm_gbps_ici"][-1] = ici
+                            out["comm_gbps_dcn"][-1] = dcn
                         else:
                             out["comm_step"].append(row["step"])
                             out["comm_gbps"].append(gbps)
                             # paired with comm_step even when absent
                             # (codec-off runs): None rows drop at plot
                             out["comm_gbps_raw"].append(raw)
+                            out["comm_gbps_ici"].append(ici)
+                            out["comm_gbps_dcn"].append(dcn)
         except (OSError, ValueError):
             pass  # partial/corrupt telemetry: plot what parses
     # rank 0's trace is the driver view; one bar set per run
@@ -380,6 +392,18 @@ def plot(runs: dict[str, str], out: str, show: bool = False,
                 ax_comm.plot(*smoothed(list(rs), list(rv), smooth),
                              linestyle="--", color=line.get_color(),
                              alpha=0.6, label=f"{label} raw fp32")
+            # per-link-class split (multislice runs): ICI dotted, DCN
+            # dash-dot in the run's color — the DCN series is the one
+            # a wire codec visibly pulls down on the hierarchical rule
+            for key, style, cls in (("comm_gbps_ici", ":", "ici"),
+                                    ("comm_gbps_dcn", "-.", "dcn")):
+                pairs = [(s, v) for s, v in zip(o["comm_step"], o[key])
+                         if v is not None]
+                if pairs:
+                    ls, lv = zip(*pairs)
+                    ax_comm.plot(*smoothed(list(ls), list(lv), smooth),
+                                 linestyle=style, color=line.get_color(),
+                                 alpha=0.8, label=f"{label} {cls}")
         if ax_frac is not None and o["fractions"]:
             # grouped bars: one cluster per span kind, one bar per run
             width = 0.8 / max(1, len(runs))
@@ -466,7 +490,8 @@ def plot(runs: dict[str, str], out: str, show: bool = False,
     all_axes = [ax_loss, ax_val, ax_ips, ax_lr]
     if ax_comm is not None:
         ax_comm.set(title="interconnect GB/s (effective solid, raw fp32 "
-                          "dashed — gap = codec win)",
+                          "dashed — gap = codec win; ici dotted / dcn "
+                          "dash-dot on multislice runs)",
                     xlabel="step")
         ax_frac.set(title="span time fractions (of run wall clock)")
         if frac_kinds:

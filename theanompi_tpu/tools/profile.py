@@ -44,16 +44,18 @@ WARMUP_STEPS = 2
 
 def _build_engine(engine_name: str, model, mesh, codec: Optional[str],
                   avg_freq: int, fused_update: bool = False,
-                  allreduce_buckets: float = 0.0):
+                  allreduce_buckets: float = 0.0, strategy: str = "psum"):
     """The worker driver's engine selection, minimal (no datasets)."""
     if allreduce_buckets and engine_name != "bsp":
         raise ValueError(
             "--allreduce-buckets buckets the BSP in-step allreduce only"
         )
+    if strategy != "psum" and engine_name != "bsp":
+        raise ValueError("--strategy applies to the BSP engine only")
     if engine_name == "bsp":
         from theanompi_tpu.parallel.bsp import BSPEngine
 
-        return BSPEngine(model, mesh, wire_codec=codec,
+        return BSPEngine(model, mesh, strategy=strategy, wire_codec=codec,
                          fused_update=fused_update,
                          allreduce_buckets=allreduce_buckets)
     if engine_name == "zero1":
@@ -147,6 +149,8 @@ def run_profile(
     seed: int = 0,
     fused_update: bool = False,
     allreduce_buckets: float = 0.0,
+    strategy: str = "psum",
+    slices: int = 0,
 ) -> dict:
     """Run the warm-step measurement + attribution; returns (and
     writes) the report dict. See the module docstring."""
@@ -170,7 +174,18 @@ def run_profile(
     if engine_name not in ENGINES:
         raise ValueError(f"unknown engine {engine_name!r}; known: {ENGINES}")
     codec_obj = get_codec(codec if codec != "none" else None)
-    mesh = make_mesh(devices or None)
+    slices = int(slices or 0)
+    if slices > 1:
+        # the flat-vs-hierarchical comparison mesh: DCN-outermost 2-D
+        # shape, same device set — flat 'psum' over both axes and
+        # 'hier' over the split run on identical hardware
+        from theanompi_tpu.parallel.mesh import make_multislice_mesh
+
+        if engine_name != "bsp":
+            raise ValueError("--slices profiles the BSP engine only")
+        mesh = make_multislice_mesh(devices or None, n_slices=slices)
+    else:
+        mesh = make_mesh(devices or None)
     n_dev = mesh.devices.size
     model_cls, _ = zoo_entry(model_name)
     model, global_batch = resolve_model_and_batch(
@@ -178,7 +193,8 @@ def run_profile(
     engine = _build_engine(engine_name, model, mesh,
                            codec if codec_obj.active else None, avg_freq,
                            fused_update=fused_update,
-                           allreduce_buckets=allreduce_buckets)
+                           allreduce_buckets=allreduce_buckets,
+                           strategy=strategy)
 
     state = engine.init_state(jax.random.PRNGKey(seed))
     r = np.random.RandomState(seed)
@@ -346,7 +362,9 @@ def run_profile(
         # committed before/after pair (experiments/profile/) is
         # meaningless without them
         "knobs": {"fused_update": bool(fused_update),
-                  "allreduce_buckets": float(allreduce_buckets or 0.0)},
+                  "allreduce_buckets": float(allreduce_buckets or 0.0),
+                  "strategy": strategy,
+                  "slices": slices},
         "step_seconds": {
             "median_s": round(med, 6),
             "exchange_s_amortized": round(exch_s / steps, 6),
@@ -384,6 +402,12 @@ def run_profile(
             "raw_bytes_per_step": traffic.raw_bytes_per_step_amortized,
             "wire_bytes_per_step": traffic.bytes_per_step_amortized,
             "compression_ratio": traffic.compression_ratio,
+            # per-link-class split (0 on single-slice meshes): the
+            # perf-gate's DCN-byte invariant diffs these like MFU
+            "ici_bytes_per_step": traffic.ici_bytes_per_step,
+            "dcn_bytes_per_step": traffic.dcn_bytes_per_step,
+            "raw_ici_bytes_per_step": traffic.raw_ici_bytes_per_step,
+            "raw_dcn_bytes_per_step": traffic.raw_dcn_bytes_per_step,
             "crosscheck": crosscheck,
         },
         "attribution": {
@@ -439,6 +463,12 @@ def format_report(report: dict) -> str:
             f"/device (state {m['state_bytes_per_device'] / 1e6:.1f} MB, "
             f"temp {m['xla']['temp_bytes'] / 1e6:.1f} MB)" + fit
         )
+    if t.get("dcn_bytes_per_step"):
+        lines.append(
+            f"  per-link wire: ici {t['ici_bytes_per_step']:.0f} B + "
+            f"dcn {t['dcn_bytes_per_step']:.0f} B/step (raw dcn "
+            f"{t['raw_dcn_bytes_per_step']:.0f} B — the codec'd hop)"
+        )
     cc = t["crosscheck"]
     if "error" in cc:
         lines.append(f"  traffic cross-check: ERROR {cc['error']}")
@@ -493,6 +523,13 @@ def profile_main(argv=None) -> int:
                     help="BSP engine: profile with the bucketed "
                          "overlap-with-backward allreduce "
                          "(parallel/strategies.py; 0 = off)")
+    ap.add_argument("--strategy", default="psum",
+                    help="BSP engine: gradient exchange strategy "
+                         "(psum|hier|...; 'hier' needs --slices N)")
+    ap.add_argument("--slices", type=int, default=0,
+                    help="profile on a multislice (dcn, data) mesh with "
+                         "N slices — the flat-vs-hier comparison shape "
+                         "(BSP only; 0 = single-slice mesh)")
     args = ap.parse_args(argv)
     report = run_profile(
         model_name=args.model, engine_name=args.engine, steps=args.steps,
@@ -500,6 +537,7 @@ def profile_main(argv=None) -> int:
         avg_freq=args.avg_freq, out_dir=args.out, trace=args.trace,
         seed=args.seed, fused_update=args.fused_update,
         allreduce_buckets=args.allreduce_buckets,
+        strategy=args.strategy, slices=args.slices,
     )
     print(format_report(report))
     print(f"wrote {os.path.join(args.out, 'report.json')}")

@@ -35,6 +35,16 @@ Fault kinds (``KIND@STEP`` or ``KIND@STEP:ARG``):
                    smaller mesh — ``shrink@3:2``)
 - ``grow``         like ``shrink`` but ARG grows the world (capacity
                    returned; ``grow@3:4``)
+- ``slice_down``   whole-slice loss: :class:`TopologyChanged` before
+                   STEP with ARG slices (default 1) removed from the
+                   CURRENT mesh topology (``slice_down@3`` /
+                   ``slice_down@3:2``). Unlike ``shrink`` the arg is
+                   slice-granular — the surviving world is computed
+                   from the topology the run registered via
+                   :meth:`FaultInjector.set_topology`, so the same spec
+                   exercises reshard-to-survivors on any ``--slices N``
+                   shape (a DCN partition isolating a whole ICI domain,
+                   the failure unit real pods lose)
 
 Storage-level kinds (chaos PR) — the fault matrix used to stop at the
 process boundary; these reach into the checkpoint write path itself:
@@ -90,8 +100,9 @@ class InjectedCrash(InjectedFault):
 
 
 class TopologyChanged(InjectedFault):
-    """The ``shrink``/``grow`` faults: the visible device world changed
-    mid-run (a slice died, or capacity came back). The attempt dies like
+    """The ``shrink``/``grow``/``slice_down`` faults: the visible device
+    world changed mid-run (a slice died, or capacity came back). The
+    attempt dies like
     any infrastructure fault; under ``supervise_training(elastic=True)``
     the retry re-probes the world (honoring :meth:`FaultInjector.
     world_override` in tests), rebuilds the mesh at ``new_world``
@@ -124,7 +135,7 @@ class Preempted(RuntimeError):
 
 FAULT_KINDS = (
     "crash", "sigterm", "sigkill", "ckpt_truncate", "nan_batch",
-    "loader_stall", "shrink", "grow",
+    "loader_stall", "shrink", "grow", "slice_down",
     # storage-level kinds (chaos PR): enospc/slow_write fire INSIDE the
     # write via the checkpoint writer shim; bitrot/partial_set mutate a
     # COMMITTED file after the save lands (like ckpt_truncate)
@@ -152,6 +163,10 @@ class FaultSpec:
     arg: Optional[float] = None
     fired: bool = False
     fired_seq: int = -1
+    # slice_down resolves its survivor world from the registered
+    # topology AT FIRE TIME (the spec's arg is slices lost, not a world
+    # size) — recorded here so world_override can replay the answer
+    resolved_world: Optional[int] = None
 
 
 def parse_fault_spec(spec: Union[str, FaultSpec]) -> FaultSpec:
@@ -187,6 +202,12 @@ def parse_fault_spec(spec: Union[str, FaultSpec]) -> FaultSpec:
                 f"fault spec {spec!r}: {kind} needs an integer target "
                 f"world size >= 1 (e.g. {kind}@{step}:2)"
             )
+    if kind == "slice_down" and arg is not None and (
+            int(arg) != arg or arg < 1):
+        raise ValueError(
+            f"fault spec {spec!r}: slice_down's arg is the number of "
+            f"slices lost, an integer >= 1 (e.g. slice_down@{step}:1)"
+        )
     return FaultSpec(kind=kind, step=step, arg=arg)
 
 
@@ -212,6 +233,7 @@ class FaultInjector:
                  ledger: Optional[str] = None):
         self.specs = [parse_fault_spec(s) for s in (specs or [])]
         self._fire_seq = 0
+        self._topology: Optional[tuple] = None  # (n_slices, per_slice)
         self._ledger = ledger
         if ledger and os.path.exists(ledger):
             # arm-as-fired anything a previous incarnation already did.
@@ -226,6 +248,15 @@ class FaultInjector:
                         s.fired_seq = self._fire_seq
                         self._fire_seq += 1
                         break
+
+    def set_topology(self, n_slices: int, per_slice: int) -> None:
+        """Register the CURRENT mesh shape (``parallel.mesh.
+        slice_topology``) so slice-granular faults can resolve survivor
+        worlds. The driver calls this each attempt, after building its
+        mesh — an elastic retry re-registers the shrunk shape, so a
+        second ``slice_down`` removes a slice of the world that
+        actually survived the first."""
+        self._topology = (int(n_slices), int(per_slice))
 
     def _record_fire(self, s: FaultSpec) -> None:
         """Durably note a fired spec BEFORE its side effect (a SIGKILL
@@ -265,6 +296,26 @@ class FaultInjector:
             s = self._take(kind, first, last)
             if s is not None:
                 raise TopologyChanged(kind, s.step, int(s.arg))
+        s = self._take("slice_down", first, last)
+        if s is not None:
+            lost = 1 if s.arg is None else int(s.arg)
+            if self._topology is None or self._topology[0] <= 1:
+                raise ValueError(
+                    f"slice_down@{s.step}: no multislice topology "
+                    "registered — the run must build a --slices N mesh "
+                    "(N > 1) and call set_topology() for whole-slice "
+                    "loss to have a surviving world"
+                )
+            n_slices, per_slice = self._topology
+            survivors = (n_slices - lost) * per_slice
+            if survivors < 1:
+                raise ValueError(
+                    f"slice_down@{s.step}:{lost}: losing {lost} of "
+                    f"{n_slices} slice(s) leaves no survivors — elastic "
+                    "recovery needs at least one live slice"
+                )
+            s.resolved_world = survivors
+            raise TopologyChanged("slice_down", s.step, survivors)
         s = self._take("sigterm", first, last)
         if s is not None:
             os.kill(os.getpid(), signal.SIGTERM)
@@ -291,17 +342,31 @@ class FaultInjector:
         return x + jnp.asarray(float("nan"), x.dtype)
 
     def world_override(self) -> Optional[int]:
-        """The world size the MOST RECENTLY FIRED shrink/grow fault
-        left behind (by firing order, not command-line spec order), or
-        None when no topology fault has fired. Sticky by design: the
-        supervisor reuses ONE injector across attempts, so a shrunk
-        world stays shrunk for every subsequent elastic retry — the
-        CPU-simulation stand-in for re-probing real device liveness."""
+        """The world size the MOST RECENTLY FIRED shrink/grow/
+        slice_down fault left behind (by firing order, not command-line
+        spec order), or None when no topology fault has fired. Sticky
+        by design: the supervisor reuses ONE injector across attempts,
+        so a shrunk world stays shrunk for every subsequent elastic
+        retry — the CPU-simulation stand-in for re-probing real device
+        liveness."""
         fired = [s for s in self.specs
-                 if s.kind in ("shrink", "grow") and s.fired]
+                 if s.kind in ("shrink", "grow", "slice_down") and s.fired]
         if not fired:
             return None
-        return int(max(fired, key=lambda s: s.fired_seq).arg)
+        last = max(fired, key=lambda s: s.fired_seq)
+        if last.kind == "slice_down":
+            # resolved at fire time from the then-registered topology;
+            # a ledger-rearmed spec never fired in THIS process and
+            # carries no resolution — fall back to the next-most-recent
+            # resolved fault (the world it left is the one that ran)
+            resolved = [s for s in fired if s.kind != "slice_down"
+                        or s.resolved_world is not None]
+            if not resolved:
+                return None
+            last = max(resolved, key=lambda s: s.fired_seq)
+            if last.kind == "slice_down":
+                return int(last.resolved_world)
+        return int(last.arg)
 
     def _take_at_or_after(self, kind: str, step: int) -> Optional[FaultSpec]:
         """The unfired spec of ``kind`` due at/after ``step`` (marked
