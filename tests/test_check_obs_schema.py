@@ -99,6 +99,31 @@ def test_valid_records_pass():
         {"kind": "stress", "t": 1.0, "scenario": "serve-param-swap",
          "seed": 5, "rounds": 4, "ok": False,
          "violations": "round 1 (seed 5, switch 1e-06): deadlock"},
+        # model-drift watchdog (obs/drift.py): change-gated EWMA record,
+        # with and without a tolerance breach / calibrated fallback
+        {"kind": "drift", "rank": 0, "t": 1.0, "step": 30,
+         "tolerance": 0.25, "breached": "", "step_seconds": 1.05,
+         "peak_source": "spec", "model_err_cost": 0.04,
+         "worst_cost": "hbm"},
+        {"kind": "drift", "rank": 1, "t": 1.0, "step": 40,
+         "tolerance": 0.25, "breached": "cost,memory",
+         "peak_source": "calibrated", "model_err_cost": 0.31,
+         "model_err_traffic": 0.02, "model_err_memory": 0.4,
+         "worst_cost": "calibrated-compute", "worst_traffic": "dcn",
+         "worst_memory": "conv1"},
+        # unified run report (tools/report.py `tmpi report --json`):
+        # nested timeline/incidents are DECLARED list/dict fields
+        {"kind": "report", "verdict": "degraded", "ranks": 4,
+         "n_events": 11, "n_incidents": 1, "steps": 40,
+         "evidence": ["supervisor.jsonl:1 — retry"],
+         "timeline": [{"t": 1.0, "kind": "retry",
+                       "src": "supervisor.jsonl:1"}],
+         "incidents": [{"kind": "retry", "evidence": []}],
+         "phases": {"step": {"seconds": 48.0, "frac": 0.8}},
+         "drift": {"last": {"model_err_cost": 0.31}},
+         "fleet": {"kind_counts": {"retry": 1}}},
+        {"kind": "report", "verdict": "completed", "ranks": 0,
+         "n_events": 0, "n_incidents": 0},
     ]
     for rec in good:
         assert validate_record(rec) == [], rec
@@ -171,6 +196,19 @@ def test_valid_records_pass():
     ({"kind": "stress", "t": 1.0, "scenario": "x", "seed": 1,
       "rounds": 3, "ok": True, "violations": ["a"]},
      "is list, want str"),
+    # drift-record guard: the breached set is a comma-joined STRING
+    # (scalar record), tolerance is required, errors are numeric
+    ({"kind": "drift", "rank": 0, "t": 1.0, "step": 3,
+      "breached": ""}, "missing required field 'tolerance'"),
+    ({"kind": "drift", "rank": 0, "t": 1.0, "step": 3,
+      "tolerance": 0.25, "breached": ["cost"]}, "is list, want str"),
+    ({"kind": "drift", "rank": 0, "t": 1.0, "step": 3,
+      "tolerance": 0.25, "breached": "", "model_err_cost": "big"},
+     "is str, want"),
+    ({"kind": "report", "verdict": "completed", "ranks": 0,
+      "n_events": 0}, "missing required field 'n_incidents'"),
+    ({"kind": "report", "verdict": 1, "ranks": 0, "n_events": 0,
+      "n_incidents": 0}, "is int, want str"),
 ])
 def test_invalid_records_flagged(rec, frag):
     errs = validate_record(rec)

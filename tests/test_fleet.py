@@ -422,6 +422,34 @@ def test_frozen_rank_regression(tmp_path):
     assert v.missed == [1] and v.frozen == []
 
 
+def test_clock_skew_rank_cannot_freeze_the_fleet(tmp_path):
+    """DST/clock-skew regression (ISSUE 18 satellite): post-mortem
+    'now' comes from ONE helper, and a rank whose host clock ran hours
+    ahead (a DST jump, an unsynced node) is excluded from it — before
+    the fix its timestamps became the reference clock and every
+    healthy peer read as frozen."""
+    obs = tmp_path / "obs"
+    obs.mkdir()
+    t = 10_000.0
+    for r in range(4):
+        off = 7200.0 if r == 1 else 0.0  # rank 1's clock is 2h ahead
+        (obs / f"spans_rank{r}.jsonl").write_text(
+            "".join(_span(r, t - 60.0 + off + 2.0 * i, 0.1)
+                    for i in range(30)))
+        (obs / f"heartbeat_rank{r}.json").write_text(json.dumps(
+            {"kind": "heartbeat", "rank": r, "t": t + off, "step": 30,
+             "pid": 1 + r}))
+    v = FleetTailer(str(obs)).refresh()
+    # every rank finished step 30 within seconds of each other on its
+    # own clock: nobody is frozen, nobody missed a heartbeat
+    assert v.frozen == [] and v.missed == []
+    # the skewed-ahead rank's own heartbeat age clamps at >= 0 (never
+    # negative) in the rendered rows
+    rows = {row["rank"]: row for row in v.rows}
+    assert rows[1]["heartbeat_age_s"] == 0.0
+    assert all(row["heartbeat_age_s"] >= 0.0 for row in v.rows)
+
+
 # --------------------------------------------------------------------------
 # satellite: seeded thread-stress scenario (RACE lint's dynamic twin)
 # --------------------------------------------------------------------------

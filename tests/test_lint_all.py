@@ -5,6 +5,7 @@ merge with a train-loop host sync, a schema-drifting telemetry
 emitter, or a collective-schedule change nobody reviewed."""
 
 import json
+import os
 import time
 
 from theanompi_tpu.tools.lint import RULES, main as lint_main, run_lint
@@ -89,3 +90,29 @@ def test_lint_all_fails_on_bad_telemetry(tmp_path):
 def test_lint_all_ok_when_no_telemetry(tmp_path, capsys):
     assert main([str(tmp_path)]) == 0
     assert "no telemetry files" in capsys.readouterr().out
+
+
+def test_tmpi_report_budget_and_determinism_on_committed_dirs(capsys):
+    """ISSUE 18 satellite: `tmpi report --json` over every committed
+    experiments/profile/ dir stays under a 10 s budget and is
+    byte-deterministic across two invocations — nothing wall-clock-
+    derived may ride the body, or CI diffs start flapping."""
+    from theanompi_tpu.tools.check_obs_schema import validate_record
+    from theanompi_tpu.tools.report import report_main
+
+    root = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "experiments", "profile")
+    dirs = sorted(d for d in os.listdir(root)
+                  if os.path.isdir(os.path.join(root, d)))
+    assert dirs  # the committed snapshots must exist
+    t0 = time.monotonic()
+    for d in dirs:
+        path = os.path.join(root, d)
+        assert report_main([path, "--json"]) == 0
+        out1 = capsys.readouterr().out
+        assert report_main([path, "--json"]) == 0
+        assert capsys.readouterr().out == out1, f"{d}: nondeterministic"
+        rep = json.loads(out1)
+        assert validate_record(rep) == [], d
+    elapsed = time.monotonic() - t0
+    assert elapsed < 10.0, f"tmpi report over {dirs} took {elapsed:.1f}s"

@@ -217,3 +217,44 @@ def test_load_obs_profile_series_and_attribution_panel(tmp_path):
     assert o["prof_mfu"] == [pytest.approx(0.39), pytest.approx(0.40)]
     out = plot({"runP": p}, str(tmp_path / "attr.png"))
     assert os.path.getsize(out) > 10_000
+
+
+def _write_drift_records(run_dir, steps=(2, 4, 6), breach_at=()):
+    obs = os.path.join(run_dir, "obs")
+    os.makedirs(obs, exist_ok=True)
+    with open(os.path.join(obs, "metrics.jsonl"), "a") as f:
+        for s in steps:
+            f.write(json.dumps({
+                "kind": "drift", "rank": 0, "t": 1000.0 + s, "step": s,
+                "tolerance": 0.25,
+                "breached": "cost" if s in breach_at else "",
+                "model_err_cost": 0.3 if s in breach_at else 0.01 * s,
+                "model_err_memory": 0.02, "worst_cost": "flops",
+                "step_seconds": 0.01, "peak_source": "spec",
+            }) + "\n")
+
+
+def test_load_obs_drift_series_and_panel(tmp_path):
+    """kind=drift records (ISSUE 18 satellite) parse into the per-source
+    EWMA error series with breach steps marked; append-mode reruns keep
+    only the newest series; the drift panel row renders end to end."""
+    from theanompi_tpu.tools.plot_history import load_obs, plot
+
+    p = _write_run(str(tmp_path / "runD"), "runD")
+    _write_drift_records(str(tmp_path / "runD"), steps=(2, 4, 6),
+                         breach_at=(6,))
+    o = load_obs(p)
+    assert o["drift_step"] == [2, 4, 6]
+    assert o["drift_cost"] == [pytest.approx(0.02), pytest.approx(0.04),
+                               pytest.approx(0.3)]
+    assert o["drift_memory"] == [0.02, 0.02, 0.02]
+    assert o["drift_traffic"] == [None, None, None]  # absent source
+    assert o["drift_breach_steps"] == [6]
+    # rerun appended on top: step counter restarts, newest wins — the
+    # old run's breach marker must not survive into the new series
+    _write_drift_records(str(tmp_path / "runD"), steps=(1, 2))
+    o = load_obs(p)
+    assert o["drift_step"] == [1, 2]
+    assert o["drift_breach_steps"] == []
+    out = plot({"runD": p}, str(tmp_path / "drift.png"))
+    assert os.path.getsize(out) > 10_000

@@ -53,6 +53,27 @@ def test_gate_still_catches_a_seeded_regression(tmp_path):
     assert main([BASELINE, str(bad)]) == 1
 
 
+def test_committed_pair_gates_model_drift():
+    """ISSUE 18: the drift watchdog's EWMA model error is a gated ratio
+    invariant — the committed pair carries `drift.model_err_cost` and
+    the gate diffs it (not vacuously passing)."""
+    result = gate(_load(BASELINE), _load(CURRENT))
+    assert result["ok"], result["checks"]
+    rows = [c for c in result["checks"] if c["metric"] == "model_err_cost"]
+    assert rows and rows[0]["ok"]
+
+
+def test_gate_catches_seeded_model_drift(tmp_path):
+    """ISSUE 18 acceptance: mutating `model_err_cost` 2x on the
+    committed pair fails the gate (rc 1) — a change that doubles how
+    wrong the cost model is cannot merge, even with MFU unchanged."""
+    cur = _load(CURRENT)
+    cur["drift"]["model_err_cost"] *= 2
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(cur))
+    assert main([BASELINE, str(bad)]) == 1
+
+
 # -- r17: flat-vs-hier multislice pair (hierarchical-collectives PR) ----
 
 R17_FLAT = os.path.join(_DIR, "r17_flat", "report.json")
@@ -97,6 +118,22 @@ def test_r17_gate_catches_seeded_dcn_regression(tmp_path):
     DCN link fails the committed pair."""
     cur = _load(R17_HIER)
     cur["traffic"]["dcn_bytes_per_step"] *= 2
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(cur))
+    assert main([R17_FLAT, str(bad), "--rel-tol", str(R17_REL_TOL)]) == 1
+
+
+def test_r17_pair_gates_all_three_model_errors(tmp_path):
+    """The multislice pair carries the full drift block — cost, traffic
+    AND memory model error all gated; dropping one from the current
+    snapshot fails as vanished coverage."""
+    result = gate(_load(R17_FLAT), _load(R17_HIER), rel_tol=R17_REL_TOL)
+    assert result["ok"], result["checks"]
+    gated = {c["metric"] for c in result["checks"]}
+    assert {"model_err_cost", "model_err_traffic",
+            "model_err_memory"} <= gated
+    cur = _load(R17_HIER)
+    del cur["drift"]["model_err_memory"]
     bad = tmp_path / "bad.json"
     bad.write_text(json.dumps(cur))
     assert main([R17_FLAT, str(bad), "--rel-tol", str(R17_REL_TOL)]) == 1

@@ -50,6 +50,15 @@ invariant oracle; failing schedules are shrunk to a minimal
     tmpi chaos --seeds 25               # full matrix, all configs
     tmpi chaos --smoke --seeds 5        # tier-1 CPU smoke
     tmpi chaos --schedule 'crash@5+bitrot@3'
+
+``tmpi report`` is the unified post-mortem (tools/report.py): merge a
+run's per-rank obs streams into one causally-grouped event timeline —
+incidents cite their evidence records — plus the drift trajectory,
+per-phase wall breakdown and a completed/halted/degraded verdict::
+
+    tmpi report runs/obs                 # markdown to stdout
+    tmpi report runs/obs --out report.md
+    tmpi report runs/obs --json          # machine-readable (schema'd)
 """
 
 from __future__ import annotations
@@ -241,6 +250,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "them as <obs-dir>/anomaly_rank{r}/ with thread "
                         "stacks, span summary, optional state checkpoint "
                         "and an armed device trace")
+    p.add_argument("--drift-tolerance", type=float, default=0.25,
+                   help="model-drift watchdog (obs/drift.py): EWMA "
+                        "relative-error band the tmpi_model_err_"
+                        "{cost,traffic,memory} gauges may wander inside "
+                        "before a drift anomaly fires (flight bundle "
+                        "anomaly_rank{r}-drift/, kind=drift records in "
+                        "metrics.jsonl); compare predictions vs "
+                        "measured with `tmpi report OBS_DIR`")
     p.add_argument("--on-anomaly",
                    choices=["record", "dump", "halt", "rollback"],
                    default="dump",
@@ -418,6 +435,13 @@ def main(argv=None) -> int:
         from theanompi_tpu.tools.top import top_main
 
         return top_main(argv[1:])
+    if argv[:1] == ["report"]:
+        # unified run report (tools/report.py): merge every per-rank
+        # stream into one causally-grouped timeline + verdict —
+        # read-only like `tmpi top`; no jax, no platform setup
+        from theanompi_tpu.tools.report import report_main
+
+        return report_main(argv[1:])
     if argv[:1] == ["serve"]:
         # inference subcommand: its own parser + driver (serve/cli.py);
         # dispatched before the training parser, whose first positional
@@ -617,6 +641,7 @@ def main(argv=None) -> int:
             numerics_freq=args.numerics_freq,
             flight_window=args.flight_window,
             on_anomaly=args.on_anomaly,
+            drift_tolerance=args.drift_tolerance,
             rollback_budget=args.rollback_budget,
             rollback_skip=args.rollback_skip,
             sigterm_grace=args.sigterm_grace,

@@ -221,6 +221,10 @@ def run_training(
     numerics_freq: int = 0,
     flight_window: int = 64,
     on_anomaly: str = "dump",
+    # model-drift watchdog (obs/drift.py): EWMA band the tmpi_model_err_*
+    # gauges may wander inside before a drift anomaly fires (and the
+    # flight recorder writes its anomaly_rank{r}-drift/ bundle)
+    drift_tolerance: float = 0.25,
     # anomaly rollback (--on-anomaly rollback): on a confirmed anomaly
     # restore the last VERIFIED checkpoint and keep training — at most
     # rollback_budget times per run; on replay, skip rollback_skip data
@@ -931,6 +935,7 @@ def run_training(
         numerics_freq=nfreq,
         flight_window=flight_window,
         on_anomaly=on_anomaly,
+        drift_tolerance=drift_tolerance,
     )
     fleet_exporter = None
     if fleet_exporter_port and obs.enabled and jax.process_index() == 0:
@@ -994,6 +999,15 @@ def run_training(
                 obs.set_cost_model(engine.cost_model(state, batch))
             except Exception as e:  # noqa: BLE001
                 print(f"[obs] cost model unavailable for {rule!r}: "
+                      f"{e!r}", flush=True)
+        if hasattr(engine, "memory_model"):
+            # ... and the declared state residency (utils/flops.py
+            # MemoryModel): the predicted per-device HBM high-water the
+            # drift watchdog diffs against device.memory_stats()
+            try:
+                obs.set_memory_model(engine.memory_model(state))
+            except Exception as e:  # noqa: BLE001
+                print(f"[obs] memory model unavailable for {rule!r}: "
                       f"{e!r}", flush=True)
 
     def _flight_state_saver(dump_dir):

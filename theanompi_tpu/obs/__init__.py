@@ -94,7 +94,19 @@ On-disk layout under ``obs_dir`` (schemas:
                             compiled mismatches, and the GSPMD-inserted
                             hidden-collective bytes next to the
                             compiled/traced/declared wire totals —
-                            the sharding analyzer's lint-report line
+                            the sharding analyzer's lint-report line;
+                            the model-drift watchdog (obs/drift.py)
+                            appends change-gated kind=drift records —
+                            per-model EWMA relative error of predicted
+                            vs measured (model_err_cost / model_err_
+                            traffic / model_err_memory, matching the
+                            tmpi_model_err_* gauges perf_gate diffs),
+                            the worst-offending component per model
+                            (per-link for traffic, per-leaf-family for
+                            memory), the tolerance band, and the
+                            breached sources comma-joined — one line
+                            whenever an EWMA moves at the third
+                            decimal or the breached set changes
     chaos.jsonl             chaos campaign log (tools/chaos.py, written
                             under the campaign's --out dir): one
                             kind=chaos record per fuzzed fault
@@ -173,9 +185,18 @@ On-disk layout under ``obs_dir`` (schemas:
                             optional state/ checkpoint + postmortem/
                             trace) — written once per run at the FIRST
                             anomaly; a stall-watchdog trip writes its
-                            own anomaly_rank{r}-stall/ bundle, so a
-                            benign stall never consumes the anomaly's
-                            forensic budget
+                            own anomaly_rank{r}-stall/ bundle, and a
+                            model-drift tolerance breach (obs/drift.py)
+                            its own anomaly_rank{r}-drift/ bundle, so
+                            neither consumes the anomaly's forensic
+                            budget
+
+``tmpi report OBS_DIR`` (tools/report.py) is the read-only post-mortem
+over everything above: it merges every per-rank stream into one
+monotonic event timeline, causally groups incidents (a retry adopts the
+crash/anomaly/reshard evidence that precedes it), and renders the run
+summary + drift trajectory + final verdict — like ``tmpi top``, it
+never writes the dir it reads.
 
 Every file above is schema-linted by ``tmpi lint`` (tools/lint.py),
 whose ``--json`` report carries one SCHEMA001 finding per invalid
@@ -202,6 +223,7 @@ from theanompi_tpu.obs.comm import (  # noqa: F401
     pytree_num_elements,
     zero1_traffic,
 )
+from theanompi_tpu.obs.drift import DriftWatchdog  # noqa: F401
 from theanompi_tpu.obs.flight import FlightRecorder, sanitize_record  # noqa: F401
 from theanompi_tpu.obs.health import Heartbeat, StallWatchdog  # noqa: F401
 from theanompi_tpu.obs.metrics import (  # noqa: F401
@@ -245,6 +267,7 @@ class Observability:
         numerics_freq: int = 0,
         flight_window: int = 64,
         on_anomaly: str = "dump",
+        drift_tolerance: float = 0.25,
     ):
         if on_anomaly not in ANOMALY_POLICIES:
             raise ValueError(
@@ -272,6 +295,14 @@ class Observability:
         self._disp = None
         self._host_mark: Optional[tuple] = None  # (blocked_s, wall_t)
         self._last_attr = None
+        # model-drift watchdog (obs/drift.py): per-model EWMA relative
+        # error of predicted vs measured, refreshed at the same drain
+        # cadence as attribution; fed the memory_model() declaration via
+        # set_memory_model. _last_step gives its records a step number
+        # (note_step_seconds arrives from the dispatcher without one).
+        self.memory = None
+        self.drift = DriftWatchdog(tolerance=drift_tolerance, rank=rank)
+        self._last_step = 0
         # detection is a host-side float check per drained row — active
         # whenever sentinels are requested, even with no obs_dir (the
         # halt policy must work without telemetry output)
@@ -399,6 +430,24 @@ class Observability:
                 f"tmpi_{key}",
                 help="compiled-step cost model (utils/flops.py)",
             ).set(value)
+
+    def set_memory_model(self, mm) -> None:
+        """Record the engine's declared state residency (utils/flops.py
+        ``MemoryModel``, engine-declared via ``memory_model()``) as
+        static ``tmpi_memory_*`` gauges, and hand it to the drift
+        watchdog as the predicted HBM high-water its measured
+        counterpart (``device.memory_stats()``) is diffed against."""
+        self.memory = mm
+        if mm is None or not self.enabled:
+            return
+        self.registry.gauge(
+            "tmpi_memory_state_bytes_per_device",
+            help="declared per-device persistent state bytes "
+                 "(utils/flops.py MemoryModel)",
+        ).set(int(mm.state_bytes_per_device))
+        self.registry.gauge(
+            "tmpi_memory_n_devices", help="memory-model device count",
+        ).set(int(mm.n_devices))
 
     def set_flight_state_saver(self, saver) -> None:
         """Install the driver's ``saver(dump_dir)`` that checkpoints the
@@ -640,6 +689,7 @@ class Observability:
                 step_seconds: Optional[float] = None) -> None:
         """Per completed dispatch: advance health + comm accounting.
         ``substeps`` > 1 for fused dispatches (one call per group)."""
+        self._last_step = int(step)
         if self.heartbeat is not None:
             self.heartbeat.set_step(step)
         if self.watchdog is not None:
@@ -681,8 +731,13 @@ class Observability:
             gbps = self.traffic.achieved_gbps(per_step_seconds)
             if gbps is not None:
                 self._set_gbps_gauges(gbps, per_step_seconds)
+        # one host-frac read per drain: _live_host_frac CONSUMES the
+        # dispatcher mark, so attribution and the drift watchdog must
+        # share the same measured window
+        host_frac = self._live_host_frac()
         if self.cost is not None:
-            self._note_attribution(per_step_seconds)
+            self._note_attribution(per_step_seconds, host_frac)
+        self._note_drift(per_step_seconds, host_frac)
 
     def _live_host_frac(self) -> Optional[float]:
         """Host-blocked fraction of the wall since the previous drain
@@ -698,7 +753,8 @@ class Observability:
             return None
         return max(0.0, min(1.0, (blocked - mark[0]) / (now - mark[1])))
 
-    def _note_attribution(self, per_step_seconds: float) -> None:
+    def _note_attribution(self, per_step_seconds: float,
+                          host_frac: Optional[float]) -> None:
         """Refresh the live attribution gauges (obs/attribution.py) and
         keep the newest decomposition for the snapshot-time
         ``kind=profile`` record. Pure host-side float math per drain."""
@@ -707,7 +763,7 @@ class Observability:
         try:
             attr = attribute_step(
                 per_step_seconds, cost=self.cost, traffic=self.traffic,
-                host_frac=self._live_host_frac(),
+                host_frac=host_frac,
             )
         except Exception:  # noqa: BLE001 — gauges must never kill a drain
             return
@@ -717,6 +773,69 @@ class Observability:
                 f"tmpi_{key}",
                 help="step-time attribution (obs/attribution.py)",
             ).set(value)
+
+    def _note_drift(self, per_step_seconds: float,
+                    host_frac: Optional[float]) -> None:
+        """Feed the model-drift watchdog (obs/drift.py) one drain's
+        measurements: refresh the ``tmpi_model_err_*`` gauges, append
+        the change-gated ``kind=drift`` record (rank 0), and on a
+        tolerance breach write a ``drift`` anomaly line + flight bundle
+        (``anomaly_rank{r}-drift/``). Runs with ANY subset of the three
+        models declared — drift needs no cost model to watch traffic."""
+        if (self.cost is None and self.traffic is None
+                and self.memory is None):
+            return
+        try:
+            record, breaches = self.drift.observe(
+                per_step_seconds, step=self._last_step,
+                cost=self.cost, traffic=self.traffic, memory=self.memory,
+                host_frac=host_frac,
+            )
+            for key, value in self.drift.as_metrics().items():
+                self.registry.gauge(
+                    f"tmpi_{key}",
+                    help="EWMA |predicted-measured|/measured of the "
+                         "analytic model (obs/drift.py)",
+                ).set(value)
+        except Exception:  # noqa: BLE001 — gauges must never kill a drain
+            return
+        import json as _json
+        import time as _time
+
+        if record is not None and self._metrics_f is not None \
+                and not self._closed:
+            line = _json.dumps({**record, "t": _time.time()})
+            with self._metrics_lock:
+                if not self._closed and self._metrics_f is not None:
+                    self._metrics_f.write(line + "\n")
+                    self._metrics_f.flush()
+        if not breaches:
+            return
+        anomalies = [
+            {"metric": f"model_err_{src}", "reason": "drift",
+             "value_repr": repr(float(self.drift.ewma[src])),
+             "tolerance": self.drift.tolerance,
+             "worst": str(self.drift.worst[src] or ""),
+             "step": self._last_step}
+            for src in breaches
+        ]
+        for a in anomalies:
+            line = {"kind": "anomaly", "rank": self.rank,
+                    "t": _time.time(), "policy": "record", **a}
+            if not self._closed:
+                f = self._numerics_sink()
+                f.write(_json.dumps(line) + "\n")
+                f.flush()
+        self.registry.counter(
+            "tmpi_drift_breaches_total",
+            help="model-drift tolerance crossings (obs/drift.py)",
+        ).inc(len(anomalies))
+        if self.flight is not None:
+            # own bundle dir (anomaly_rank{r}-drift/): a drifted model
+            # is a finding, not a numerics failure — it must not spend
+            # the anomaly path's once-per-run forensic budget
+            self.flight.dump("drift", step=self._last_step,
+                             anomalies=anomalies, include_state=False)
 
     def _set_gbps_gauges(self, gbps: float,
                          step_seconds: Optional[float] = None) -> None:

@@ -33,9 +33,12 @@ single rank's stream can show. This module is the merge point:
   are written per rank but nothing ever compared them — a rank whose
   heartbeat went stale (``frozen_after`` seconds behind "now") is
   ``missed``, and stale *while the rest of the fleet advanced past it*
-  is ``frozen``. "now" is wall clock for a live tailer and the newest
-  timestamp observed anywhere in the dir for post-mortem reads, so a
-  finished healthy run does not read as universally frozen;
+  is ``frozen``. "now" comes from ONE helper (``_now``): wall clock for
+  a live tailer; for post-mortem reads, the newest timestamp observed
+  anywhere in the dir with forward clock-skew outliers excluded
+  (``AHEAD_SKEW_TOL_S`` past the cross-rank median — a rank whose host
+  clock ran ahead must not make every healthy peer read as frozen), so
+  a finished healthy run does not read as universally frozen;
 - ``kind=fleet`` JSONL records (schema: tools/check_obs_schema.py)
   appended to ``<obs_dir>/fleet.jsonl`` on change (step advanced or a
   flag set changed), plus ``tmpi_fleet_*`` gauges in a private
@@ -80,6 +83,14 @@ STRAGGLER_WINDOWS = 3
 FROZEN_AFTER_S = 30.0
 # numerics skew: |gauge| outside [median/factor, median*factor]
 SKEW_FACTOR = 10.0
+# post-mortem clock-skew guard: a rank whose host clock ran AHEAD of
+# its peers (DST shift, unsynced NTP) stamps records from the future;
+# taking a plain max over newest-timestamps would adopt that future as
+# "now" and read every healthy peer as frozen. Timestamps more than
+# this far ahead of the cross-rank median are excluded from the max —
+# comfortably above real finish-order spread (seconds to minutes),
+# comfortably below any DST/timezone jump (>= 1 h).
+AHEAD_SKEW_TOL_S = 600.0
 
 _RANK_FILE_RE = re.compile(r"_rank(\d+)\.jsonl?$")
 
@@ -532,10 +543,30 @@ class FleetTailer:
             return self._view
 
     def _now(self) -> float:
+        """THE clock staleness is judged against — one helper for both
+        the silent-rank detector and the per-rank heartbeat-age rows
+        (before this helper the two compared against different clocks).
+        Live: wall clock. Post-mortem: the newest timestamp observed in
+        the dir, with forward outliers excluded (> AHEAD_SKEW_TOL_S
+        ahead of the cross-rank median), so one rank whose host clock
+        ran ahead cannot freeze every healthy peer."""
         if self.live:
             return time.time()
         newest = [st.last_t for st in self._ranks.values() if st.last_t]
-        return max(newest) if newest else 0.0
+        if not newest:
+            return 0.0
+        med = statistics.median(newest)
+        within = [t for t in newest if t - med <= AHEAD_SKEW_TOL_S]
+        return max(within) if within else med
+
+    @staticmethod
+    def _heartbeat_age(st: "_RankState", now: float) -> Optional[float]:
+        """Seconds since ``st``'s last heartbeat against the _now()
+        clock; None when the rank never wrote one. Clamped >= 0: the
+        skewed-ahead rank itself reads fresh, never negative."""
+        if st.hb_t is None or not now:
+            return None
+        return max(0.0, now - st.hb_t)
 
     def _detect(self) -> FleetView:
         now = self._now()
@@ -566,9 +597,10 @@ class FleetTailer:
             )
             if persistent:
                 stragglers.append(st.rank)
-            # silent-rank detection: heartbeat stale vs "now"
-            stale = (st.hb_t is not None and now > 0
-                     and now - st.hb_t > self.frozen_after)
+            # silent-rank detection: heartbeat stale vs the shared
+            # _now() clock (same helper the row view renders)
+            hb_age = self._heartbeat_age(st, now)
+            stale = hb_age is not None and hb_age > self.frozen_after
             if stale:
                 missed.append(st.rank)
                 if st.step < fleet_step:
@@ -637,8 +669,7 @@ class FleetTailer:
                 "mfu": st.mfu,
                 "anomalies": st.anomalies,
                 "heartbeat_t": st.hb_t,
-                "heartbeat_age_s": (max(0.0, now - st.hb_t)
-                                    if st.hb_t is not None and now else None),
+                "heartbeat_age_s": self._heartbeat_age(st, now),
                 "pid": st.pid,
                 "slice": (st.rank * n_slices // n_ranks
                           if n_slices > 1 else 0),

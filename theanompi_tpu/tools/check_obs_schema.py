@@ -389,6 +389,55 @@ SCHEMAS: dict[str, dict[str, tuple[tuple, bool]]] = {
         "ok": ((bool,), False),
         "error": ((str,), False),
     },
+    # model-drift watchdog (obs/drift.py, written by the obs facade's
+    # drain path into metrics.jsonl): one change-gated record per EWMA
+    # movement — per-model relative error of predicted vs measured
+    # (model_err_cost: roofline wall vs measured step; model_err_traffic:
+    # priced comm seconds vs the measured remainder; model_err_memory:
+    # declared state bytes vs device.memory_stats() high-water), the
+    # worst-offending component per model (per-link for traffic,
+    # per-leaf-family for memory), the tolerance band in force, and the
+    # sources currently past it comma-joined (empty string = none).
+    # `peak_source` says whether errors are vs spec peaks or the
+    # first-drain calibration (CPU test meshes, like kind=profile).
+    "drift": {
+        "rank": ((int,), True),
+        "t": (_NUM, True),
+        "step": ((int,), True),
+        "tolerance": (_NUM, True),
+        "breached": ((str,), True),
+        "step_seconds": (_NUM, False),
+        "peak_source": ((str,), False),
+        "model_err_cost": (_NUM, False),
+        "model_err_traffic": (_NUM, False),
+        "model_err_memory": (_NUM, False),
+        "worst_cost": ((str,), False),
+        "worst_traffic": ((str,), False),
+        "worst_memory": ((str,), False),
+    },
+    # unified run report (tools/report.py, `tmpi report --json`): ONE
+    # self-contained object per invocation — the run verdict
+    # (completed/halted/degraded) with its evidence, the causally-
+    # grouped incident list (each citing the file:line evidence records
+    # it adopted), the merged monotonic event timeline, the per-phase
+    # wall breakdown (span_summary rollup) and the drift trajectory.
+    # Nested structures are DECLARED typed fields (like profile's
+    # `fractions`), so the open-union scalar rule still holds for
+    # extras. Deliberately byte-deterministic for a finished dir: no
+    # wall-clock stamps ride the body (tests diff two invocations).
+    "report": {
+        "verdict": ((str,), True),
+        "ranks": ((int,), True),
+        "n_events": ((int,), True),
+        "n_incidents": ((int,), True),
+        "steps": ((int,), False),
+        "evidence": ((list,), False),
+        "timeline": ((list,), False),
+        "incidents": ((list,), False),
+        "phases": ((dict,), False),
+        "drift": ((dict,), False),
+        "fleet": ((dict,), False),
+    },
 }
 
 # the serving metric name family (serve records may only carry these-
@@ -424,6 +473,14 @@ SERVE_METRIC_PREFIX = "tmpi_serve_"
 #   tmpi_comm_raw_dcn_bytes_per_step  gauge  cross-slice fp32 B/step
 #   tmpi_comm_ici_gbps        gauge  achieved in-slice GB/s
 #   tmpi_comm_dcn_gbps        gauge  achieved cross-slice GB/s
+# the model-drift gauge family (obs/drift.py via the obs facade's drain
+# cadence; documentation like the tmpi_mfu block — kind=drift records
+# are the enforced surface). Values are EWMA relative errors, so 0.0 is
+# a perfect model and 0.25 is the default anomaly tolerance:
+#   tmpi_model_err_cost      gauge  |roofline wall - step wall| / step
+#   tmpi_model_err_traffic   gauge  |priced comm - measured comm| / comm
+#   tmpi_model_err_memory    gauge  |declared state - HBM high-water| / HW
+#   tmpi_drift_breaches_total counter  drift anomalies raised this run
 # kind=profile fractions must sum to 1 within this absolute tolerance
 PROFILE_FRACTION_SUM_TOL = 0.02
 

@@ -24,6 +24,12 @@ moved beyond its tolerance band:
   fails exactly like an MFU drop. A 0.0 DCN baseline (single-slice
   runs) is carried and compared absolutely, so DCN bytes APPEARING
   where there were none also fails;
+- ``model_err_cost`` / ``model_err_traffic`` / ``model_err_memory`` —
+  the drift watchdog's EWMA relative error per truth source (model-
+  drift PR; a profile report's ``drift`` block or the
+  ``tmpi_model_err_*`` gauges). The models' HONESTY is a gated ratio
+  invariant like MFU: a change that doubles how wrong ``cost_model()``
+  is about the step wall fails CI even when the step got faster;
 - per-file: a profile report's attribution fractions must sum to
   1.0 +/- the fraction tolerance (the decomposition's own invariant).
 
@@ -69,7 +75,9 @@ ZERO_BASELINE_ABS_TOL = 0.02
 # the ratio invariants the gate understands, in report order
 GATE_METRICS = ("mfu", "host_blocked_frac", "compression_ratio",
                 "hbm_gbps", "preflight_peak_bytes",
-                "ici_bytes_per_step", "dcn_bytes_per_step")
+                "ici_bytes_per_step", "dcn_bytes_per_step",
+                "model_err_cost", "model_err_traffic",
+                "model_err_memory")
 
 
 def _num(v) -> Optional[float]:
@@ -132,6 +140,9 @@ def extract_invariants(obj: dict) -> dict:
         if n is None and key in ("ici_bytes_per_step", "dcn_bytes_per_step"):
             n = _num(obj.get("traffic", {}).get(key)
                      if isinstance(obj.get("traffic"), dict) else None)
+        if n is None and key.startswith("model_err_"):
+            n = _num(obj.get("drift", {}).get(key)
+                     if isinstance(obj.get("drift"), dict) else None)
         if n is not None:
             out[key] = n
     return out

@@ -34,8 +34,13 @@ the chief exporter) add a FLEET row from ``obs/fleet.jsonl``: the
 per-rank step-time spread band (min..max over ranks, median line) with
 red vlines where the persistent-straggler detector fired (left), and
 the frozen/silent-rank count (right) — append-mode rerun safe like the
-comm panel. Runs without obs/numerics/profile/fleet data plot exactly
-as before — extra rows only render when at least one run has them.
+comm panel. Runs whose drift watchdog wrote ``kind=drift`` records
+(obs/drift.py) add a DRIFT row: the EWMA relative error per truth
+source (cost/traffic/memory, log scale) with red vlines where the
+watchdog breached tolerance (left) and the cumulative breach count
+(right) — append-mode rerun safe like every other obs panel. Runs
+without obs/numerics/profile/fleet/drift data plot exactly as before —
+extra rows only render when at least one run has them.
 """
 
 from __future__ import annotations
@@ -91,7 +96,12 @@ def load_obs(jsonl_path: str) -> dict:
                  # step-time attribution (kind=profile records,
                  # obs/attribution.py): stacked fractions + MFU trend
                  "prof_step": [], "prof_fracs": [], "prof_mfu": [],
-                 "prof_mfu_calibrated": []}
+                 "prof_mfu_calibrated": [],
+                 # model-drift watchdog (kind=drift records,
+                 # obs/drift.py): EWMA relative error per truth source,
+                 # None-paired with drift_step like the comm series
+                 "drift_step": [], "drift_cost": [], "drift_traffic": [],
+                 "drift_memory": [], "drift_breach_steps": []}
     obs_dir = os.path.join(os.path.dirname(os.path.abspath(jsonl_path)), "obs")
     metrics = os.path.join(obs_dir, "metrics.jsonl")
     if os.path.exists(metrics):
@@ -130,6 +140,33 @@ def load_obs(jsonl_path: str) -> dict:
                         out["prof_mfu_calibrated"].append(
                             row.get("mfu_calibrated")
                         )
+                        continue
+                    if row.get("kind") == "drift" and "step" in row:
+                        if out["drift_step"] and (
+                            row["step"] < out["drift_step"][-1]
+                        ):
+                            # append-mode rerun: newest run's series
+                            # wins (mirrors the comm-series rule)
+                            for k in ("drift_step", "drift_cost",
+                                      "drift_traffic", "drift_memory",
+                                      "drift_breach_steps"):
+                                out[k] = []
+                        if out["drift_step"] and (
+                            row["step"] == out["drift_step"][-1]
+                        ):
+                            # change-gated re-emit at an unchanged step
+                            # (EWMA moved between drains): newest wins
+                            for k in ("drift_step", "drift_cost",
+                                      "drift_traffic", "drift_memory"):
+                                out[k].pop()
+                        out["drift_step"].append(row["step"])
+                        out["drift_cost"].append(row.get("model_err_cost"))
+                        out["drift_traffic"].append(
+                            row.get("model_err_traffic"))
+                        out["drift_memory"].append(
+                            row.get("model_err_memory"))
+                        if row.get("breached"):
+                            out["drift_breach_steps"].append(row["step"])
                         continue
                     if row.get("kind") != "metrics" or "step" not in row:
                         continue
@@ -348,11 +385,13 @@ def plot(runs: dict[str, str], out: str, show: bool = False,
     )
     has_prof = any(o["prof_step"] for o in obs.values())
     has_fleet = any(o["fleet_step"] for o in obs.values())
-    n_rows = 2 + int(has_obs) + int(has_nm) + int(has_prof) + int(has_fleet)
+    has_drift = any(o["drift_step"] for o in obs.values())
+    n_rows = (2 + int(has_obs) + int(has_nm) + int(has_prof)
+              + int(has_fleet) + int(has_drift))
     fig, axes = plt.subplots(n_rows, 2, figsize=(11, 3.5 * n_rows))
     (ax_loss, ax_val), (ax_ips, ax_lr) = axes[0], axes[1]
     ax_comm = ax_frac = ax_nm = ax_div = ax_attr = ax_mfu = None
-    ax_fleet = ax_frozen = None
+    ax_fleet = ax_frozen = ax_drift = ax_breach = None
     row = 2
     if has_obs:
         ax_comm, ax_frac = axes[row]
@@ -365,6 +404,9 @@ def plot(runs: dict[str, str], out: str, show: bool = False,
         row += 1
     if has_fleet:
         ax_fleet, ax_frozen = axes[row]
+        row += 1
+    if has_drift:
+        ax_drift, ax_breach = axes[row]
     frac_kinds: list[str] = []
     for o in obs.values():
         frac_kinds += [k for k in o["fractions"] if k not in frac_kinds]
@@ -462,6 +504,29 @@ def plot(runs: dict[str, str], out: str, show: bool = False,
         if ax_frozen is not None and o["fleet_step"]:
             ax_frozen.step(o["fleet_step"], o["fleet_frozen"],
                            where="post", label=f"{label} frozen ranks")
+        if ax_drift is not None and o["drift_step"]:
+            # one curve per truth source; zeros (a momentarily perfect
+            # model) drop rather than fight the log axis
+            for key, name, style in (("drift_cost", "cost", "-"),
+                                     ("drift_traffic", "traffic", "--"),
+                                     ("drift_memory", "memory", ":")):
+                pairs = [(s, v) for s, v in zip(o["drift_step"], o[key])
+                         if v is not None and v > 0]
+                if pairs:
+                    ax_drift.plot(*zip(*pairs), linestyle=style,
+                                  label=f"{label} {name}")
+            for j, s in enumerate(sorted(set(o["drift_breach_steps"]))):
+                ax_drift.axvline(
+                    s, color="red", alpha=0.5,
+                    label=f"{label} breach" if j == 0 else None)
+        if ax_breach is not None and o["drift_step"]:
+            bset = set(o["drift_breach_steps"])
+            cum, n = [], 0
+            for s in o["drift_step"]:
+                n += int(s in bset)
+                cum.append(n)
+            ax_breach.step(o["drift_step"], cum, where="post",
+                           label=f"{label} breaches")
         if o["anomaly_steps"]:
             # anomaly markers on both numerics panels: first marker per
             # run carries the legend entry, the rest stay unlabeled
@@ -520,6 +585,14 @@ def plot(runs: dict[str, str], out: str, show: bool = False,
                      xlabel="step")
         ax_frozen.set(title="frozen (silent) ranks", xlabel="step")
         all_axes += [ax_fleet, ax_frozen]
+    if ax_drift is not None:
+        ax_drift.set(title="model drift: EWMA relative error per truth "
+                           "source (red = tolerance breach)",
+                     xlabel="step")
+        if ax_drift.lines:
+            ax_drift.set_yscale("log")  # errors span orders of magnitude
+        ax_breach.set(title="cumulative drift breaches", xlabel="step")
+        all_axes += [ax_drift, ax_breach]
     for ax in all_axes:
         ax.grid(True, alpha=0.3)
         if ax.lines or ax.patches or ax.collections:

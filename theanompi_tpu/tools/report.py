@@ -1,0 +1,522 @@
+"""``tmpi report``: one unified post-mortem report over an obs dir.
+
+The obs dir accumulates a dozen record kinds across per-rank streams
+(metrics, numerics, supervisor, fleet, serve, spans, stall files);
+after an incident every one of them holds a piece of the story and no
+single ``grep`` shows causality. This tool tails ALL of them (through
+the same byte-offset reader the exporter uses), merges every record
+into one monotonic event timeline with file:line provenance, groups
+events causally — a ``kind=retry`` record *adopts* the anomaly /
+reshard / rollback / corrupt-scrub / stall / drift-breach records that
+preceded it (they are its cause chain), leftovers stand alone — and
+renders:
+
+- a run summary (ranks, steps, events, retries, fleet health),
+- the incident list, each incident citing its evidence records,
+- the merged event timeline (notable kinds; routine cadence records
+  are counted, not listed),
+- a per-phase wall breakdown rolled up from ``kind=span_summary``,
+- the model-drift trajectory (``kind=drift`` EWMA errors + breaches),
+- straggler/frozen verdicts from the fleet stream, annotated with the
+  step ranges they covered,
+- a final verdict — ``completed`` / ``degraded`` / ``halted`` — with
+  the evidence lines that forced it.
+
+Usage::
+
+    tmpi report OBS_DIR                  # markdown to stdout
+    tmpi report OBS_DIR --out report.md  # or report.html by extension
+    tmpi report OBS_DIR --json           # one kind=report object
+                                         # (schema: check_obs_schema)
+
+Read-only by construction, like ``tmpi top``: the tailer runs with
+``write_records=False`` and nothing here opens a file for writing
+except ``--out``. Deliberately byte-deterministic for a finished dir —
+no wall-clock stamp rides the body, so two invocations diff clean
+(tests/test_lint_all.py budgets and diffs exactly that).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import html as _html
+import json
+import os
+import sys
+from typing import Optional
+
+from theanompi_tpu.obs.fleet import FleetTailer, fleet_topology
+
+# record kinds rendered individually in the timeline; everything else
+# (per-step cadence records) is summarized as counts to keep a long
+# run's report readable
+NOTABLE_KINDS = (
+    "anomaly", "retry", "reshard", "rollback", "scrub", "stall",
+    "drift", "topology", "preflight", "reload", "shard",
+)
+# kinds a subsequent retry adopts as its cause chain (scrub only when
+# it actually found corruption; drift only when it breached tolerance)
+_ADOPTABLE = ("anomaly", "reshard", "rollback", "scrub", "stall", "drift")
+
+
+def _iter_jsonl(path: str):
+    """Yield ``(line_no, record)`` for every well-formed object line.
+
+    Torn tail lines (a rank killed mid-write) parse as garbage and are
+    skipped, same stance as the fleet tailer."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            for i, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and rec.get("kind"):
+                    yield i, rec
+    except OSError:
+        return
+
+
+def _scan_events(obs_dir: str) -> list:
+    """Every record in the dir as ``{"t","rank","kind","step","src",
+    "rec"}``, sorted into ONE monotonic timeline. ``src`` is
+    ``file:line`` — the citation format every downstream section uses.
+    Sort key includes src so equal timestamps stay deterministic."""
+    events = []
+    names = sorted(
+        n for n in os.listdir(obs_dir)
+        if n.endswith(".jsonl") and
+        os.path.isfile(os.path.join(obs_dir, n))
+    ) if os.path.isdir(obs_dir) else []
+    for name in names:
+        for line_no, rec in _iter_jsonl(os.path.join(obs_dir, name)):
+            events.append({
+                # span records carry t0, not t — fall through so span
+                # summaries land where they happened on the timeline
+                "t": float(rec.get("t") or rec.get("t0") or 0.0),
+                "rank": int(rec.get("rank") or 0),
+                "kind": str(rec.get("kind")),
+                "step": rec.get("step"),
+                "src": f"{name}:{line_no}",
+                "rec": rec,
+            })
+    # stall verdict files are single JSON objects, not JSONL streams
+    for path in sorted(glob.glob(os.path.join(obs_dir, "stall_rank*.json"))):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(rec, dict):
+            events.append({
+                "t": float(rec.get("t") or 0.0),
+                "rank": int(rec.get("rank") or 0),
+                "kind": "stall",
+                "step": rec.get("step"),
+                "src": f"{os.path.basename(path)}:1",
+                "rec": rec,
+            })
+    events.sort(key=lambda e: (e["t"], e["rank"], e["kind"], e["src"]))
+    return events
+
+
+def _describe(ev: dict) -> str:
+    """One human line per record — what a teammate would say about it."""
+    r, kind = ev["rec"], ev["kind"]
+    if kind == "retry":
+        return (f"rank {ev['rank']} retry attempt {r.get('attempt')} "
+                f"from step {r.get('step')}: {r.get('error')!r}"
+                + (f" (cause: {r['cause']})" if r.get("cause") else ""))
+    if kind == "anomaly":
+        return (f"rank {ev['rank']} anomaly {r.get('metric')} "
+                f"({r.get('reason')}) policy={r.get('policy', 'record')}")
+    if kind == "reshard":
+        return (f"reshard {r.get('from_world')}→{r.get('to_world')} ranks "
+                f"at step {r.get('step')} in {r.get('seconds'):.2f}s"
+                if isinstance(r.get("seconds"), (int, float)) else
+                f"reshard {r.get('from_world')}→{r.get('to_world')} ranks")
+    if kind == "rollback":
+        return (f"rank {ev['rank']} rollback step {r.get('step')}→"
+                f"{r.get('restore_step')} (budget left "
+                f"{r.get('budget_left')})")
+    if kind == "scrub":
+        return (f"scrub: {r.get('corrupt')}/{r.get('checked')} corrupt, "
+                f"quarantined [{r.get('quarantined')}]")
+    if kind == "stall":
+        return (f"rank {ev['rank']} STALL at step {r.get('step')}: "
+                f"{r.get('stall_s')}s past {r.get('timeout_s')}s timeout")
+    if kind == "drift":
+        errs = ", ".join(
+            f"{s}={r[f'model_err_{s}']:.3f}"
+            for s in ("cost", "traffic", "memory")
+            if isinstance(r.get(f"model_err_{s}"), (int, float)))
+        breached = r.get("breached") or ""
+        return (f"model drift [{errs}]"
+                + (f" BREACHED: {breached}" if breached else ""))
+    if kind == "topology":
+        return f"topology: {r.get('world', r.get('ranks', '?'))} ranks"
+    if kind == "preflight":
+        return f"preflight peak {r.get('peak_bytes')} bytes"
+    if kind == "reload":
+        return f"serve hot-reload step {r.get('from_step')}→{r.get('to_step')}"
+    if kind == "shard":
+        return f"sharding lint: {r.get('verdict', r.get('status', 'ran'))}"
+    return kind
+
+
+def _is_adoptable(ev: dict) -> bool:
+    k = ev["kind"]
+    if k not in _ADOPTABLE:
+        return False
+    if k == "scrub":
+        return bool(ev["rec"].get("corrupt"))
+    if k == "drift":
+        return bool(ev["rec"].get("breached"))
+    return True
+
+
+def _group_incidents(events: list) -> list:
+    """Causal grouping: walking the merged timeline in order, adoptable
+    events accumulate as pending evidence; the next ``retry`` record
+    adopts ALL of them as its cause chain (the crash/anomaly/reshard
+    that preceded a restart explains it). Pending events that no retry
+    ever claims become standalone incidents — real, just not fatal."""
+    incidents, pending = [], []
+    for ev in events:
+        if ev["kind"] == "retry":
+            incidents.append({
+                "kind": "retry",
+                "t": ev["t"],
+                "rank": ev["rank"],
+                "step": ev["rec"].get("step"),
+                "what": _describe(ev),
+                "src": ev["src"],
+                "evidence": [
+                    {"src": p["src"], "kind": p["kind"],
+                     "what": _describe(p)} for p in pending
+                ],
+            })
+            pending = []
+        elif _is_adoptable(ev):
+            pending.append(ev)
+    for ev in pending:
+        incidents.append({
+            "kind": ev["kind"],
+            "t": ev["t"],
+            "rank": ev["rank"],
+            "step": ev["rec"].get("step"),
+            "what": _describe(ev),
+            "src": ev["src"],
+            "evidence": [],
+        })
+    return incidents
+
+
+def _phase_breakdown(events: list) -> dict:
+    """Roll every rank's ``kind=span_summary`` records into one
+    per-phase wall table: total exclusive seconds per span kind across
+    the run, plus the share of summed wall they represent."""
+    totals, wall = {}, 0.0
+    for ev in events:
+        if ev["kind"] != "span_summary":
+            continue
+        r = ev["rec"]
+        wall += float(r.get("wall_s") or 0.0)
+        for k, v in (r.get("totals_s") or {}).items():
+            if isinstance(v, (int, float)):
+                totals[str(k)] = totals.get(str(k), 0.0) + float(v)
+    if not totals or wall <= 0:
+        return {}
+    phases = {k: {"seconds": round(v, 6), "frac": round(v / wall, 6)}
+              for k, v in sorted(totals.items())}
+    phases["_wall_s"] = round(wall, 6)
+    return phases
+
+
+def _drift_trajectory(events: list) -> dict:
+    """The ``kind=drift`` stream condensed: last + worst EWMA error per
+    model, and the steps where the watchdog declared a breach."""
+    rows, breaches = [], []
+    last, worst = {}, {}
+    for ev in events:
+        if ev["kind"] != "drift":
+            continue
+        r = ev["rec"]
+        row = {"step": r.get("step")}
+        for s in ("cost", "traffic", "memory"):
+            v = r.get(f"model_err_{s}")
+            if isinstance(v, (int, float)):
+                row[s] = v
+                last[f"model_err_{s}"] = v
+                # max with a self-default so an all-zero error series
+                # still lands in worst (last/worst carry the same keys)
+                worst[f"model_err_{s}"] = max(
+                    worst.get(f"model_err_{s}", v), v)
+        rows.append(row)
+        if r.get("breached"):
+            breaches.append({"step": r.get("step"), "src": ev["src"],
+                             "breached": r["breached"]})
+    if not rows:
+        return {}
+    return {"last": last, "worst": worst, "breaches": breaches,
+            "n_records": len(rows)}
+
+
+def _straggler_annotations(events: list) -> list:
+    """Fleet-stream straggler/frozen verdicts as step-range
+    annotations: "rank R flagged straggler over steps A–B", citing the
+    first fleet record that raised the flag."""
+    spans = {}  # (flag, rank) -> {first_src, lo, hi}
+    for ev in events:
+        if ev["kind"] != "fleet":
+            continue
+        r = ev["rec"]
+        step = r.get("step")
+        for flag in ("stragglers", "frozen"):
+            field = r.get(flag)
+            if not field:
+                continue
+            for tok in str(field).split(","):
+                tok = tok.strip()
+                if not tok:
+                    continue
+                key = (flag, tok)
+                if key not in spans:
+                    spans[key] = {"src": ev["src"], "lo": step, "hi": step}
+                else:
+                    spans[key]["hi"] = step
+    out = []
+    for (flag, rank), s in sorted(spans.items()):
+        out.append({
+            "flag": flag[:-1] if flag.endswith("s") else flag,
+            "rank": rank,
+            "step_lo": s["lo"], "step_hi": s["hi"], "src": s["src"],
+            "what": (f"rank {rank} flagged {flag[:-1]} over steps "
+                     f"{s['lo']}–{s['hi']} ({s['src']})"),
+        })
+    return out
+
+
+def _verdict(events: list, incidents: list, drift: dict,
+             stragglers: list) -> tuple:
+    """``(verdict, evidence_lines)``. Halted beats degraded beats
+    completed; every verdict cites the record lines that forced it. A
+    halt-policy anomaly adopted by a later retry does NOT halt the run
+    — the retry proves the supervisor recovered past it."""
+    evidence = []
+    adopted = {e["src"] for inc in incidents for e in inc["evidence"]}
+    for ev in events:
+        if ev["kind"] == "stall":
+            evidence.append(f"{ev['src']} — {_describe(ev)}")
+        elif (ev["kind"] == "anomaly"
+              and ev["rec"].get("policy") == "halt"
+              and ev["src"] not in adopted):
+            evidence.append(f"{ev['src']} — {_describe(ev)}")
+    if evidence:
+        return "halted", evidence
+    for inc in incidents:
+        evidence.append(f"{inc['src']} — {inc['what']}")
+    for ann in stragglers:
+        evidence.append(ann["what"])
+    for b in drift.get("breaches", []):
+        evidence.append(f"{b['src']} — drift breach ({b['breached']}) "
+                        f"at step {b['step']}")
+    if evidence:
+        # dedupe while keeping order (a drift breach may already be a
+        # standalone incident)
+        seen, uniq = set(), []
+        for line in evidence:
+            if line not in seen:
+                seen.add(line)
+                uniq.append(line)
+        return "degraded", uniq
+    return "completed", []
+
+
+def build_report(obs_dir: str, *, ckpt_dir: Optional[str] = None) -> dict:
+    """The full report as one JSON-safe dict (the ``--json`` body)."""
+    events = _scan_events(obs_dir)
+    incidents = _group_incidents(events)
+    phases = _phase_breakdown(events)
+    drift = _drift_trajectory(events)
+    stragglers = _straggler_annotations(events)
+    verdict, evidence = _verdict(events, incidents, drift, stragglers)
+
+    ranks = sorted({e["rank"] for e in events})
+    steps = [e["step"] for e in events if isinstance(e["step"], int)]
+    kind_counts = {}
+    for e in events:
+        kind_counts[e["kind"]] = kind_counts.get(e["kind"], 0) + 1
+    timeline = [
+        {"t": e["t"], "rank": e["rank"], "kind": e["kind"],
+         "step": e["step"], "src": e["src"], "what": _describe(e)}
+        for e in events if e["kind"] in NOTABLE_KINDS
+    ]
+
+    # one read-only post-mortem fleet pass for the live health verdict
+    # (straggler/frozen flags the per-record scan above may have missed
+    # on runs that never wrote a fleet stream)
+    fleet = {"kind_counts": kind_counts, "stragglers": stragglers}
+    try:
+        tailer = FleetTailer(
+            obs_dir, topology=fleet_topology(ckpt_dir),
+            live=False, write_records=False,
+        )
+        view = tailer.refresh()
+        if view is not None and view.rows:
+            fleet["healthy"] = bool(view.healthy)
+            fleet["unhealthy_reasons"] = view.unhealthy_reasons()
+            fleet["retries"] = int(view.retries)
+    except Exception:
+        pass  # a report over a partial dir still renders
+
+    return {
+        "kind": "report",
+        "verdict": verdict,
+        "ranks": len(ranks),
+        "n_events": len(events),
+        "n_incidents": len(incidents),
+        "steps": (max(steps) if steps else 0),
+        "evidence": evidence,
+        "timeline": timeline,
+        "incidents": incidents,
+        "phases": phases,
+        "drift": drift,
+        "fleet": fleet,
+    }
+
+
+def render_markdown(rep: dict, obs_dir: str) -> str:
+    lines = [f"# tmpi run report — {os.path.basename(os.path.abspath(obs_dir))}",
+             ""]
+    verdict = rep["verdict"].upper()
+    lines.append(f"**Verdict: {verdict}**")
+    for ev in rep["evidence"]:
+        lines.append(f"- {ev}")
+    lines += ["",
+              "## Run summary", "",
+              f"- ranks: {rep['ranks']}",
+              f"- max step: {rep['steps']}",
+              f"- events: {rep['n_events']} "
+              f"({', '.join(f'{k}×{v}' for k, v in sorted(rep['fleet']['kind_counts'].items()))})",
+              f"- incidents: {rep['n_incidents']}"]
+    if "retries" in rep["fleet"]:
+        lines.append(f"- supervisor retries: {rep['fleet']['retries']}")
+    if "healthy" in rep["fleet"]:
+        lines.append(
+            "- fleet health: "
+            + ("healthy" if rep["fleet"]["healthy"]
+               else "UNHEALTHY (" +
+               "; ".join(rep["fleet"]["unhealthy_reasons"]) + ")"))
+    lines.append("")
+
+    if rep["incidents"]:
+        lines += ["## Incidents", ""]
+        for i, inc in enumerate(rep["incidents"], 1):
+            lines.append(f"{i}. [{inc['kind']}] {inc['what']}  "
+                         f"`{inc['src']}`")
+            for e in inc["evidence"]:
+                lines.append(f"   - caused by [{e['kind']}] {e['what']}  "
+                             f"`{e['src']}`")
+        lines.append("")
+
+    if rep["fleet"]["stragglers"]:
+        lines += ["## Straggler / frozen verdicts", ""]
+        for ann in rep["fleet"]["stragglers"]:
+            lines.append(f"- {ann['what']}")
+        lines.append("")
+
+    if rep["timeline"]:
+        lines += ["## Event timeline", ""]
+        for ev in rep["timeline"]:
+            step = f" step {ev['step']}" if ev["step"] is not None else ""
+            lines.append(f"- t={ev['t']:.3f}{step} [{ev['kind']}] "
+                         f"{ev['what']}  `{ev['src']}`")
+        lines.append("")
+
+    if rep["phases"]:
+        lines += ["## Per-phase wall breakdown", "",
+                  "| phase | seconds | share |",
+                  "|---|---:|---:|"]
+        for k, v in rep["phases"].items():
+            if k.startswith("_"):
+                continue
+            lines.append(f"| {k} | {v['seconds']:.3f} | "
+                         f"{100.0 * v['frac']:.1f}% |")
+        lines.append(f"| *total wall* | {rep['phases']['_wall_s']:.3f} | |")
+        lines.append("")
+
+    if rep["drift"]:
+        d = rep["drift"]
+        lines += ["## Model drift", ""]
+        for s in ("cost", "traffic", "memory"):
+            key = f"model_err_{s}"
+            if key in d.get("last", {}):
+                lines.append(
+                    f"- {key}: last {d['last'][key]:.3f}, "
+                    f"worst {d['worst'][key]:.3f}")
+        if d.get("breaches"):
+            for b in d["breaches"]:
+                lines.append(f"- **breach** at step {b['step']}: "
+                             f"{b['breached']}  `{b['src']}`")
+        else:
+            lines.append("- no tolerance breaches")
+        lines.append("")
+
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def render_html(rep: dict, obs_dir: str) -> str:
+    """Minimal self-contained HTML: the markdown body escaped inside a
+    ``<pre>`` — survives any mail client / artifact browser."""
+    body = _html.escape(render_markdown(rep, obs_dir))
+    verdict = _html.escape(rep["verdict"])
+    return ("<!doctype html><html><head><meta charset=\"utf-8\">"
+            f"<title>tmpi report: {verdict}</title></head>"
+            f"<body><pre>{body}</pre></body></html>\n")
+
+
+def report_main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tmpi report", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument("obs_dir", help="obs directory (finished run or "
+                                    "committed profile dir)")
+    ap.add_argument("--out", default=None,
+                    help="write the report to this path; .html gets the "
+                         "HTML rendering, anything else markdown")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the kind=report JSON object to stdout "
+                         "instead of markdown")
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="checkpoint dir whose __topology__ manifest "
+                         "labels slices in the fleet verdict")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.obs_dir):
+        print(f"tmpi report: not a directory: {args.obs_dir}",
+              file=sys.stderr)
+        return 2
+
+    rep = build_report(args.obs_dir, ckpt_dir=args.ckpt_dir)
+
+    if args.json:
+        sys.stdout.write(json.dumps(rep, sort_keys=True) + "\n")
+    if args.out:
+        if args.out.endswith(".html"):
+            text = render_html(rep, args.obs_dir)
+        else:
+            text = render_markdown(rep, args.obs_dir)
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(text)
+    if not args.json and not args.out:
+        sys.stdout.write(render_markdown(rep, args.obs_dir))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(report_main())
