@@ -607,6 +607,227 @@ def bench_serve(duration_s: float = 2.0, clients: int = 8,
         }
 
 
+def bench_serve_fleet(duration_s: float = 4.0, replicas: int = 2,
+                      buckets=(1, 8, 32), waiters: int = 16,
+                      seed: int = 0) -> dict:
+    """Open-loop serving benchmark over an N-replica router (ISSUE 19,
+    the ROADMAP's load generator grown from the closed loop above):
+
+    1. **calibrate** — a short closed-loop burst measures the fleet's
+       service capacity (requests/s);
+    2. **overload probe** — Poisson arrivals at ~2.5x capacity for a
+       slice: goodput must saturate near capacity while the admission
+       path REJECTS the excess with retry-after (never queues it into
+       unbounded latency);
+    3. **measured window** — Poisson arrivals at ~0.35x capacity
+       (open loop: latency is measured from each request's SCHEDULED
+       arrival, so queueing delay counts), with a hard
+       ``kill_replica(0)`` at ~45% of the window. The survivors absorb
+       the offered load while the supervisor restarts the dead member;
+       ``recovery_ratio`` compares the SERVED FRACTION of offered
+       arrivals in the tail (last 30% of the window) to the pre-kill
+       window — the acceptance bar is >= 0.9. A fraction-of-offered
+       ratio (not a rate ratio) is deliberate: at bench-scale arrival
+       counts a rate ratio is dominated by Poisson shot noise and by
+       uniform box slowdown, neither of which is a recovery failure;
+       requests the post-kill fleet rejects, drops, or fails DO score
+       against it.
+
+    Reports p50/p99/p999 latency, ``serve_goodput_rps`` and
+    ``serve_p99_ms`` (the perf_gate metrics), and the router's own
+    failover/restart counters. CPU-friendly like every bench mode."""
+    import queue as _queue
+    import tempfile
+    import threading
+
+    import jax
+
+    from theanompi_tpu.models.cifar10 import Cifar10_model
+    from theanompi_tpu.serve.engine import Rejected, ServeEngine
+    from theanompi_tpu.serve.router import RequestDropped, Router
+    from theanompi_tpu.train import init_train_state
+    from theanompi_tpu.utils.checkpoint import save_checkpoint
+
+    model = Cifar10_model()
+    buckets = tuple(buckets)
+    with tempfile.TemporaryDirectory(prefix="tmpi_serve_fleet_") as d:
+        state = init_train_state(model, jax.random.PRNGKey(0))
+        save_checkpoint(d, state, 1, rng=jax.random.PRNGKey(1))
+        compiled = []
+
+        def member(rid):
+            eng = ServeEngine(
+                model, buckets=buckets,
+                max_queue=max(256, 8 * buckets[-1]),
+                replica_id=rid, sink_name=f"serve_r{rid}.jsonl",
+            )
+            eng.load_initial(d)
+            compiled.append(eng.warmup())
+            eng.start()
+            return eng
+
+        router = Router(member, replicas, seed=seed,
+                        health_interval=0.1, restart_base_s=0.1,
+                        restart_cap_s=1.0)
+        router.start()
+        ishape = tuple(model.recipe.input_shape)
+        rng = np.random.RandomState(seed)
+        x = rng.randn(*ishape).astype(np.float32)
+
+        # -- phase 1: closed-loop capacity calibration ------------------
+        stop = threading.Event()
+        cal_counts = [0] * 8
+
+        def cal_client(i: int) -> None:
+            while not stop.is_set():
+                router.infer(x, timeout=60.0)
+                cal_counts[i] += 1
+
+        cal_threads = [threading.Thread(target=cal_client, args=(i,),
+                                        daemon=True) for i in range(8)]
+        t0 = time.perf_counter()
+        for t in cal_threads:
+            t.start()
+        time.sleep(max(0.5, duration_s / 8))
+        stop.set()
+        for t in cal_threads:
+            t.join(timeout=60.0)
+        capacity = sum(cal_counts) / (time.perf_counter() - t0)
+        if capacity <= 0:
+            raise RuntimeError("serve fleet calibration served nothing")
+
+        def open_loop(lam: float, window: float, on_tick=None):
+            """Poisson arrivals at ``lam`` req/s for ``window`` s;
+            returns (records, elapsed). Each record: scheduled arrival,
+            terminal status, and open-loop latency (completion minus
+            SCHEDULED arrival)."""
+            arrivals = []
+            t = rng.exponential(1.0 / lam)
+            while t < window:
+                arrivals.append(t)
+                t += rng.exponential(1.0 / lam)
+            recs = [None] * len(arrivals)
+            futq: _queue.Queue = _queue.Queue()
+
+            def waiter() -> None:
+                while True:
+                    item = futq.get()
+                    if item is None:
+                        return
+                    i, sched, fut = item
+                    try:
+                        fut.result(timeout=60.0)
+                        recs[i] = ("served",
+                                   (time.perf_counter() - start) - sched)
+                    except RequestDropped:
+                        recs[i] = ("dropped", None)
+                    except Exception:  # noqa: BLE001 — terminal non-
+                        # served outcomes all score against goodput
+                        recs[i] = ("failed", None)
+
+            ws = [threading.Thread(target=waiter, daemon=True)
+                  for _ in range(waiters)]
+            for w in ws:
+                w.start()
+            start = time.perf_counter()
+            i = 0
+            while i < len(arrivals):
+                now = time.perf_counter() - start
+                if on_tick is not None:
+                    on_tick(now)
+                if arrivals[i] > now:
+                    time.sleep(min(0.002, arrivals[i] - now))
+                    continue
+                while i < len(arrivals) and arrivals[i] <= now:
+                    try:
+                        fut = router.submit(x)
+                        futq.put((i, arrivals[i], fut))
+                    except Rejected:
+                        recs[i] = ("rejected", None)
+                    i += 1
+            for _ in ws:
+                futq.put(None)
+            for w in ws:
+                w.join(timeout=120.0)
+            elapsed = time.perf_counter() - start
+            out = [(arrivals[i], *(recs[i] or ("failed", None)))
+                   for i in range(len(arrivals))]
+            return out, elapsed
+
+        # -- phase 2: overload probe (admission control, not queues,
+        # absorbs the excess) --------------------------------------------
+        over_recs, over_elapsed = open_loop(
+            2.5 * capacity, max(0.4, duration_s / 10))
+        over_served = sum(1 for _, s, _ in over_recs if s == "served")
+        over_rejected = sum(1 for _, s, _ in over_recs if s == "rejected")
+
+        # -- phase 3: measured window with a mid-run replica kill -------
+        kill_t = 0.45 * duration_s
+        killed = threading.Event()
+
+        def on_tick(now: float) -> None:
+            if replicas > 1 and now >= kill_t and not killed.is_set():
+                killed.set()
+                router.kill_replica(0)
+
+        lam = 0.35 * capacity
+        recs, elapsed = open_loop(lam, duration_s, on_tick=on_tick)
+        router.drain(timeout=30.0)
+        rstats = router.stats()
+
+        served = [(sched, lat) for sched, s, lat in recs if s == "served"]
+        if not served:
+            raise RuntimeError(
+                "serve fleet bench served zero requests — raise "
+                "--serve-duration")
+        lats = np.asarray([lat for _, lat in served])
+        n_dropped = sum(1 for _, s, _ in recs if s == "dropped")
+        n_failed = sum(1 for _, s, _ in recs if s == "failed")
+        n_rejected = sum(1 for _, s, _ in recs if s == "rejected")
+        goodput = len(served) / elapsed
+        # segment by SCHEDULED arrival; rates are informational, the
+        # recovery verdict is served-fraction-of-offered per window
+        tail_start = 0.7 * duration_s
+        pre_off = [s for sched, s, _ in recs if sched < kill_t]
+        tail_off = [s for sched, s, _ in recs if sched >= tail_start]
+        pre_rate = sum(1 for s in pre_off if s == "served") / kill_t
+        tail_rate = (sum(1 for s in tail_off if s == "served")
+                     / (duration_s - tail_start))
+        pre_frac = (sum(1 for s in pre_off if s == "served")
+                    / max(len(pre_off), 1))
+        tail_frac = (sum(1 for s in tail_off if s == "served")
+                     / max(len(tail_off), 1))
+        return {
+            "metric": f"serve_fleet_goodput_rps_{replicas}r",
+            "value": round(goodput, 1),
+            "unit": "requests/sec",
+            "vs_baseline": None,
+            "serve_goodput_rps": round(goodput, 1),
+            "serve_p50_ms": round(1000 * float(np.percentile(lats, 50)), 3),
+            "serve_p99_ms": round(1000 * float(np.percentile(lats, 99)), 3),
+            "serve_p999_ms": round(1000 * float(np.percentile(lats, 99.9)), 3),
+            "capacity_rps_est": round(capacity, 1),
+            "offered_rps": round(lam, 1),
+            "goodput_prekill_rps": round(pre_rate, 1),
+            "goodput_postkill_rps": round(tail_rate, 1),
+            "recovery_ratio": round(tail_frac / max(pre_frac, 1e-9), 4),
+            "overload_offered_rps": round(2.5 * capacity, 1),
+            "overload_goodput_rps": round(over_served / over_elapsed, 1),
+            "overload_rejected": int(over_rejected),
+            "served": len(served),
+            "rejected": int(n_rejected),
+            "dropped": int(n_dropped),
+            "failed": int(n_failed),
+            "failovers": int(rstats["tmpi_router_failovers_total"]),
+            "restarts": int(rstats["tmpi_router_restarts_total"]),
+            "replicas": replicas,
+            "buckets": ",".join(str(b) for b in buckets),
+            "compiled_programs": compiled[0] if compiled else 0,
+            "duration_s": round(elapsed, 3),
+            "device_kind": jax.devices()[0].device_kind,
+        }
+
+
 def bench_codec_sweep(engines=("bsp", "zero1", "easgd", "gosgd", "nd"),
                       codecs=("none", "bf16", "int8", "int8:ef"),
                       max_steps: int = 6) -> dict:
@@ -1218,6 +1439,12 @@ def main() -> int:
                     help="serve bench: concurrent closed-loop clients")
     ap.add_argument("--serve-buckets", default="1,8,32",
                     help="serve bench: comma-separated batch buckets")
+    ap.add_argument("--replicas", type=int, default=1, metavar="N",
+                    help="serve bench: N > 1 switches to the OPEN-LOOP "
+                         "replica-fleet benchmark (Poisson arrivals, "
+                         "p50/p99/p999, goodput under overload and "
+                         "under a mid-run replica kill with recovery "
+                         "ratio); 1 = the classic closed loop")
     ap.add_argument("--ns", default=None,
                     help="scaling mode: comma-separated device counts "
                          "(default 1,2,4,8; the verdict-3 extension runs "
@@ -1242,10 +1469,19 @@ def main() -> int:
             max_steps=args.steps or 6,
         )
     elif args.serve_bench:
-        result = bench_serve(
-            duration_s=args.serve_duration, clients=args.serve_clients,
-            buckets=tuple(int(b) for b in args.serve_buckets.split(",")),
-        )
+        if args.replicas > 1:
+            result = bench_serve_fleet(
+                duration_s=args.serve_duration, replicas=args.replicas,
+                buckets=tuple(int(b)
+                              for b in args.serve_buckets.split(",")),
+            )
+        else:
+            result = bench_serve(
+                duration_s=args.serve_duration,
+                clients=args.serve_clients,
+                buckets=tuple(int(b)
+                              for b in args.serve_buckets.split(",")),
+            )
     elif args.mode == "compute":
         result = bench_compute(steps=args.steps or 20, model_name=args.model,
                                fused_update=args.fused_update,

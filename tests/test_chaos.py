@@ -209,3 +209,98 @@ def test_slice_down_absorbed_by_elastic_reshard(tmp_path):
     assert res.final_summary and res.final_summary["steps"] == cfg.total_steps
     baseline = BaselineCache(str(tmp_path / "base"))
     assert check_invariants(cfg, schedule, res, baseline) == []
+
+
+# --------------------------------------------------------------------------
+# serving-path chaos (`tmpi chaos --serve`, ISSUE 19): the fuzzed fault
+# matrix over a replica fleet under live load, the serving invariant
+# oracle, and the seeded drop_inflight mutation self-test
+# --------------------------------------------------------------------------
+
+
+def test_generate_serve_schedule_seeded_and_constrained():
+    import random
+
+    from theanompi_tpu.tools.chaos import (
+        SERVE_MATRIX,
+        generate_serve_schedule,
+        parse_serve_spec,
+    )
+
+    a = generate_serve_schedule(random.Random(7), 2.0, 2)
+    b = generate_serve_schedule(random.Random(7), 2.0, 2)
+    assert a == b  # seeded: same seed, same schedule
+    for seed in range(50):
+        sched = generate_serve_schedule(random.Random(seed), 2.0, 2)
+        assert 1 <= len(sched) <= 2
+        for spec in sched:
+            kind, t, arg = parse_serve_spec(spec)
+            assert kind in SERVE_MATRIX
+            assert 0.0 < t <= 0.8 * 2.0  # inside the load window
+            if SERVE_MATRIX[kind].get("arg") is not None:
+                assert arg > 0
+    with pytest.raises(ValueError, match="must be KIND@T"):
+        parse_serve_spec("crash@3")  # training kinds don't parse here
+
+
+def test_serve_directed_crash_absorbed(tmp_path):
+    """Directed acceptance: a replica crash under live client load —
+    composed with the always-on hot-reload — is fully absorbed: zero
+    drops, monotone served steps, a clean drain, and a failover plus a
+    supervised restart on the router's own counters."""
+    from theanompi_tpu.tools.chaos import (
+        check_serve_invariants,
+        run_serve_schedule,
+    )
+
+    schedule = ["replica_crash@0.3"]
+    # the default 2.0 s window: long enough that the mid-window
+    # checkpoint commit reliably lands a hot-reload under this load
+    res = run_serve_schedule(schedule, str(tmp_path), replicas=2,
+                             duration=2.0, clients=3, seed=1)
+    assert check_serve_invariants(schedule, res) == []
+    assert res.router_stats["tmpi_router_dropped_total"] == 0.0
+    assert res.router_stats["tmpi_router_restarts_total"] >= 1.0
+    # hot-reload-under-load rode the schedule: the served step advanced
+    steps = [e["step"] for ledger in res.ledgers for e in ledger
+             if e["status"] == "served"]
+    assert steps and max(steps) > min(steps)
+
+
+def test_serve_mutation_drop_inflight_caught_and_shrunk(tmp_path):
+    """The serving oracle's self-test: with the seeded drop_inflight
+    mutation (the failover path drops the dying replica's in-flight
+    request instead of re-admitting it) the no_drops invariant fires,
+    and delta-debugging shrinks a 2-fault schedule to the single crash
+    that triggers it — while the same schedule unmutated is absorbed
+    (proved by test_serve_directed_crash_absorbed)."""
+    from theanompi_tpu.tools.chaos import (
+        check_serve_invariants,
+        run_serve_schedule,
+        shrink_serve_schedule,
+    )
+
+    # the stall parks in-flight work on one member (its batch sleeps
+    # 0.45 s from t=0.2 while the closed-loop clients queue behind it)
+    # and the crash at 0.4 targets the busiest healthy replica — so the
+    # victim PROVABLY holds in-flight requests at kill time and the
+    # mutation cannot dodge the oracle by scheduling luck, even on a
+    # loaded box
+    schedule = ["replica_stall@0.2:0.45", "replica_crash@0.4"]
+    res = run_serve_schedule(schedule, str(tmp_path / "bad"),
+                             replicas=2, duration=1.2, clients=3,
+                             mutate="drop_inflight", seed=1)
+    viol = check_serve_invariants(schedule, res)
+    assert "no_drops" in viol, viol
+    minimal, runs = shrink_serve_schedule(
+        schedule, str(tmp_path / "shrink"), replicas=2, duration=1.2,
+        clients=3, mutate="drop_inflight", seed=1, max_runs=6)
+    # the crash is the trigger and always survives the shrink; whether
+    # the stall is ALSO needed to reproduce depends on load timing, so
+    # the minimal schedule is the crash alone or the pair — never empty
+    # (the greedy shrinker only drops a fault after re-running the
+    # remainder and seeing the violation again, so `minimal` is a
+    # validated repro by construction)
+    assert "replica_crash@0.4" in minimal
+    assert len(minimal) <= 2
+    assert runs >= 1

@@ -46,6 +46,25 @@ def test_valid_records_pass():
                      "tmpi_serve_p99_ms": 12.5,
                      "tmpi_serve_served_total": 100.0}},
         {"kind": "serve", "t": 1.0, "params_step": -1, "metrics": {}},
+        # replica-group serving (serve/router.py, obs/router.jsonl):
+        # member records stamp replica_id; the router's own stream
+        # carries health transitions, failovers, restarts, drops, and
+        # the tmpi_router_* snapshot
+        {"kind": "serve", "t": 1.0, "params_step": 4, "replica_id": 1,
+         "metrics": {"tmpi_serve_served_total": 10.0}},
+        {"kind": "router", "t": 1.0, "event": "health", "replica_id": 0,
+         "from_state": "healthy", "to_state": "down",
+         "error": "EngineDead('replica 0 killed')"},
+        {"kind": "router", "t": 1.0, "event": "failover", "replica_id": 0,
+         "to_replica": 1, "error": "EngineDead('replica 0 killed')"},
+        {"kind": "router", "t": 1.0, "event": "restart", "replica_id": 0,
+         "from_state": "restarting", "to_state": "healthy",
+         "backoff_s": 0.21},
+        {"kind": "router", "t": 1.0, "event": "drop", "replica_id": 0,
+         "error": "RequestDropped('budget exhausted')"},
+        {"kind": "router", "t": 1.0, "event": "snapshot",
+         "metrics": {"tmpi_router_healthy": 2.0,
+                     "tmpi_router_dropped_total": 0.0}},
         # checkpoint hot-reload (serve/reload.py)
         {"kind": "reload", "t": 1.0, "from_step": 4, "to_step": 9,
          "ms": 41.2},
@@ -164,6 +183,15 @@ def test_valid_records_pass():
     # serve records carry ONLY the tmpi_serve_ name family
     ({"kind": "serve", "t": 1.0, "params_step": 1,
       "metrics": {"queue_depth": 1.0}}, "lacks the 'tmpi_serve_' prefix"),
+    ({"kind": "router", "t": 1.0}, "missing required field 'event'"),
+    ({"kind": "router", "t": 1.0, "event": "health", "replica_id": 0.5},
+     "is float, want int"),
+    # router snapshots carry ONLY the tmpi_router_ name family
+    ({"kind": "router", "t": 1.0, "event": "snapshot",
+      "metrics": {"tmpi_serve_queue_depth": 1.0}},
+     "lacks the 'tmpi_router_' prefix"),
+    ({"kind": "router", "t": 1.0, "event": "snapshot",
+      "metrics": {"tmpi_router_healthy": "two"}}, "not numeric"),
     ({"kind": "reload", "t": 1.0, "from_step": 1},
      "missing required field 'to_step'"),
     ({"kind": "reload", "t": 1.0, "from_step": 1.5, "to_step": 2},

@@ -197,3 +197,86 @@ def test_committed_profile_dirs_are_reportable():
         rep = build_report(os.path.join(root, name))
         assert rep["verdict"] == "completed"
         assert validate_record(rep) == []
+
+
+def write_serving_dir(obs, with_drop=False):
+    """A serving-fleet failure dir (ISSUE 19 satellite): a replica
+    crash (t=10) re-homes two in-flight requests (t=11, t=12), the
+    supervisor restarts the member (t=15) — plus a training-track retry
+    (t=20) that must NOT adopt the serving records."""
+    os.makedirs(obs, exist_ok=True)
+    with open(os.path.join(obs, "router.jsonl"), "w") as f:
+        f.write(json.dumps({
+            "kind": "router", "t": 10.0, "event": "health",
+            "replica_id": 0, "from_state": "healthy", "to_state": "down",
+            "error": "EngineDead('replica 0 killed')"}) + "\n")
+        f.write(json.dumps({
+            "kind": "router", "t": 11.0, "event": "failover",
+            "replica_id": 0, "to_replica": 1,
+            "error": "EngineDead('replica 0 killed')"}) + "\n")
+        f.write(json.dumps({
+            "kind": "router", "t": 12.0, "event": "failover",
+            "replica_id": 0, "to_replica": 1,
+            "error": "EngineDead('replica 0 killed')"}) + "\n")
+        if with_drop:
+            f.write(json.dumps({
+                "kind": "router", "t": 13.0, "event": "drop",
+                "replica_id": 0,
+                "error": "RequestDropped('budget exhausted')"}) + "\n")
+        f.write(json.dumps({
+            "kind": "router", "t": 15.0, "event": "restart",
+            "replica_id": 0, "from_state": "restarting",
+            "to_state": "healthy", "backoff_s": 0.31}) + "\n")
+    with open(os.path.join(obs, "supervisor.jsonl"), "w") as f:
+        f.write(json.dumps({
+            "kind": "retry", "rank": 0, "t": 20.0, "attempt": 1,
+            "step": 8, "error": "InjectedCrash('boom')",
+            "backoff_s": 0.5, "cause": "crash"}) + "\n")
+
+
+def test_replica_restart_adopts_serving_chain_not_training(tmp_path):
+    """ISSUE 19 satellite: serving incidents ride the causal timeline
+    on their OWN track — the replica restart adopts the crash and both
+    failovers with exact record citations, the later training retry
+    adopts none of them, and a replica lost with zero drops reads
+    DEGRADED (traffic absorbed), never halted."""
+    obs = str(tmp_path / "obs")
+    write_serving_dir(obs)
+    rep = build_report(obs)
+
+    assert rep["verdict"] == "degraded"
+    restarts = [i for i in rep["incidents"]
+                if i["kind"] == "replica_restart"]
+    assert len(restarts) == 1
+    inc = restarts[0]
+    assert inc["src"] == "router.jsonl:4"
+    assert "traffic absorbed by survivors" in inc["what"]
+    assert [e["src"] for e in inc["evidence"]] == [
+        "router.jsonl:1", "router.jsonl:2", "router.jsonl:3"]
+    assert [e["kind"] for e in inc["evidence"]] == ["router"] * 3
+    # the training retry stands alone: no serving record crossed tracks
+    retries = [i for i in rep["incidents"] if i["kind"] == "retry"]
+    assert len(retries) == 1 and retries[0]["evidence"] == []
+    # markdown carries the serving story verbatim
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert report_main([obs]) == 0
+    md = buf.getvalue()
+    assert "Verdict: DEGRADED" in md
+    assert "traffic absorbed by survivors" in md
+    assert "re-admitted from replica 0 to replica 1" in md
+
+
+def test_router_drop_forces_halted_verdict(tmp_path):
+    """ANY dropped request is a halt-class violation of the serving
+    contract — even though the fleet restarted and kept serving, the
+    request is gone, so the verdict is halted and cites the drop."""
+    obs = str(tmp_path / "obs")
+    write_serving_dir(obs, with_drop=True)
+    rep = build_report(obs)
+    assert rep["verdict"] == "halted"
+    assert any("router.jsonl:4" in ev and "DROPPED" in ev
+               for ev in rep["evidence"])

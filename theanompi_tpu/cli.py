@@ -13,9 +13,13 @@ Usage::
     tmpi BSP 8 my_model.py MyModel --strategy asa16 --epochs 5
 
 ``tmpi serve`` is the inference subcommand (serve/cli.py): serve a
-training run's checkpoints with dynamic micro-batching and hot-reload::
+training run's checkpoints with dynamic micro-batching and hot-reload;
+``--replicas N`` runs a replica-group fleet behind the same endpoint
+(serve/router.py: health-checked least-loaded routing, bounded
+failover, supervised restarts)::
 
     tmpi serve --ckpt-dir runs/ck --model cifar10 --watch --port 8300
+    tmpi serve --ckpt-dir runs/ck --model cifar10 --replicas 3 --watch
 
 ``tmpi lint`` runs every repo lint plus the SPMD safety analyzer
 (tools/lint.py): collective-signature verification against goldens,
@@ -50,6 +54,10 @@ invariant oracle; failing schedules are shrunk to a minimal
     tmpi chaos --seeds 25               # full matrix, all configs
     tmpi chaos --smoke --seeds 5        # tier-1 CPU smoke
     tmpi chaos --schedule 'crash@5+bitrot@3'
+    tmpi chaos --serve --seeds 10       # serving-path campaign: fuzzed
+                                        # replica crash/stall/corrupt-
+                                        # reload faults against a live
+                                        # router fleet under load
 
 ``tmpi report`` is the unified post-mortem (tools/report.py): merge a
 run's per-rank obs streams into one causally-grouped event timeline —

@@ -180,6 +180,30 @@ On-disk layout under ``obs_dir`` (schemas:
                             batch fill, request totals) + one
                             kind=reload record per checkpoint
                             hot-reload the engine applied
+    serve_r{N}.jsonl        per-replica member telemetry when ``tmpi
+                            serve --replicas N`` runs a fleet
+                            (serve/router.py): the same kind=serve
+                            records as serve.jsonl, each stamped with
+                            its ``replica_id`` — one file per member,
+                            restarted members append to their
+                            predecessor's file
+    router.jsonl            replica-group router stream
+                            (serve/router.py): kind=router health
+                            transitions (healthy→down→restarting→
+                            healthy), failover records (the in-flight
+                            request's from/to replica), restart /
+                            restart_failed records with the
+                            decorrelated-jitter backoff drawn, drop
+                            records (terminal failover failures — the
+                            chaos oracle's zero-drop invariant watches
+                            these), the drain-time kind=router
+                            snapshot carrying the tmpi_router_* gauge
+                            family, and one kind=reload record per
+                            CENTRAL hot-reload fanned out to the
+                            fleet; ``tmpi report`` adopts these into
+                            its causal timeline (a replica restart
+                            adopts the crash/failover chain that
+                            preceded it)
     anomaly_rank{r}/        flight-recorder triage bundle (ring.jsonl,
                             report.json, stacks.txt, span_summary.json,
                             optional state/ checkpoint + postmortem/

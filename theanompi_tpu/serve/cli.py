@@ -13,6 +13,14 @@ then the process exits — the serving twin of the trainer's
 ``--sigterm-grace``. ``--selftest N`` skips the HTTP server and drives
 N closed-loop local requests instead (smoke/CI path; prints the
 ``serve`` stats line and exits).
+
+``--replicas N`` (N > 1) fronts an N-member replica group through
+serve/router.py instead of one engine: health-checked least-loaded
+routing with bounded failover, a supervisor restarting crashed members
+with jitter backoff, central hot-reload under ``--watch``, and
+``kind=router`` records in ``<obs-dir>/router.jsonl`` (members write
+``serve_r<id>.jsonl``). The final stdout line is then a schema-valid
+``router`` snapshot record rather than a ``serve`` one.
 """
 
 from __future__ import annotations
@@ -61,6 +69,13 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8300,
                    help="HTTP port (serve/frontend.py)")
+    p.add_argument("--replicas", type=int, default=1, metavar="N",
+                   help="replica-group serving (serve/router.py): N "
+                        "engines behind one endpoint with health-checked "
+                        "least-loaded routing, bounded failover, and a "
+                        "supervisor that restarts crashed members; "
+                        "checkpoint hot-reload becomes central (one load, "
+                        "fleet-wide swap). 1 = the classic single engine")
     p.add_argument("--selftest", type=int, default=0, metavar="N",
                    help="no HTTP: run N closed-loop local requests, print "
                         "stats JSON, exit (smoke path)")
@@ -112,21 +127,57 @@ def serve_main(argv=None) -> int:
 
     model = _resolve_serve_model(args.model, args.recipe_arg)
     buckets = tuple(int(b) for b in args.buckets.split(","))
-    engine = ServeEngine(
-        model,
-        buckets=buckets,
-        max_queue=args.max_queue,
-        default_deadline_ms=args.deadline_ms or None,
-        obs_dir=args.obs_dir,
-    )
-    step = engine.load_initial(args.ckpt_dir)
-    compiled = engine.warmup()
-    print(f"[serve] serving {model.name} step {step}; "
-          f"{compiled} programs AOT-warmed for buckets {buckets}",
-          flush=True)
-    engine.start()
+    replicas = max(1, int(args.replicas))
+    if replicas == 1:
+        engine = ServeEngine(
+            model,
+            buckets=buckets,
+            max_queue=args.max_queue,
+            default_deadline_ms=args.deadline_ms or None,
+            obs_dir=args.obs_dir,
+        )
+        step = engine.load_initial(args.ckpt_dir)
+        compiled = engine.warmup()
+        print(f"[serve] serving {model.name} step {step}; "
+              f"{compiled} programs AOT-warmed for buckets {buckets}",
+              flush=True)
+        engine.start()
+        final_record = engine.serve_record
+    else:
+        from theanompi_tpu.serve.router import Router
+
+        def _member(rid):
+            # the replica factory: the supervisor reuses it to restart
+            # crashed members from the newest verified checkpoint
+            eng = ServeEngine(
+                model,
+                buckets=buckets,
+                max_queue=args.max_queue,
+                default_deadline_ms=args.deadline_ms or None,
+                obs_dir=args.obs_dir,
+                replica_id=rid,
+                sink_name=f"serve_r{rid}.jsonl",
+            )
+            eng.load_initial(args.ckpt_dir)
+            eng.warmup()
+            eng.start()
+            return eng
+
+        engine = Router(
+            _member, replicas,
+            obs_dir=args.obs_dir,
+            default_deadline_ms=args.deadline_ms or None,
+        )
+        engine.start()
+        print(f"[serve] {replicas}-replica fleet serving {model.name} "
+              f"step {engine.params_step}; buckets {buckets} AOT-warmed "
+              "per member", flush=True)
+        final_record = engine.router_record
     reloader = None
     if args.watch:
+        # fronting a Router this is CENTRAL hot-reload: one checkpoint
+        # load, one set_params fan-out, every replica swaps to the
+        # same step (the Router duck-types the reloader's engine)
         reloader = CheckpointReloader(
             engine, args.ckpt_dir, interval=args.poll_interval
         )
@@ -149,8 +200,9 @@ def serve_main(argv=None) -> int:
             for _ in range(args.selftest):
                 engine.infer(rng.randn(*shape))
             _shutdown()
-            # LAST stdout line = one schema-valid serve stats record
-            print(json.dumps(engine.serve_record()))
+            # LAST stdout line = one schema-valid stats record
+            # (kind=serve, or kind=router for a replica fleet)
+            print(json.dumps(final_record()))
             return 0
 
         from theanompi_tpu.serve.frontend import serve_http
@@ -185,7 +237,7 @@ def serve_main(argv=None) -> int:
         finally:
             httpd.server_close()
         _shutdown()
-        print(json.dumps(engine.serve_record()), flush=True)
+        print(json.dumps(final_record()), flush=True)
         return 0
     finally:
         _shutdown()

@@ -100,6 +100,14 @@ class DeadlineExceeded(RuntimeError):
     batch slot someone else's deadline needed)."""
 
 
+class EngineDead(RuntimeError):
+    """The engine hard-died (:meth:`ServeEngine.abort` — a crashed
+    replica, or chaos's ``replica_crash``): queued AND in-flight
+    requests are rejected with this error, which a fronting router
+    (serve/router.py) treats as "re-admit on a healthy replica", never
+    as a client-visible failure."""
+
+
 class ServedParams(NamedTuple):
     """One coherent serving triple, swapped by atomic reference."""
 
@@ -120,10 +128,13 @@ class ServeFuture:
     """Minimal completion handle (threading.Event + slots — no
     concurrent.futures machinery on the hot path)."""
 
-    __slots__ = ("_event", "_value", "_error", "t_submit")
+    __slots__ = ("_event", "_lock", "_value", "_error", "t_submit")
 
     def __init__(self):
         self._event = threading.Event()
+        # settlement can come from the batcher thread OR a router
+        # failover/abort path on another thread; first writer wins
+        self._lock = threading.Lock()
         self._value: Optional[ServeResult] = None
         self._error: Optional[BaseException] = None
         self.t_submit = time.monotonic()
@@ -140,12 +151,16 @@ class ServeFuture:
 
     # -- engine side --------------------------------------------------------
     def _resolve(self, value: ServeResult) -> None:
-        self._value = value
-        self._event.set()
+        with self._lock:
+            if not self._event.is_set():
+                self._value = value
+                self._event.set()
 
     def _reject(self, error: BaseException) -> None:
-        self._error = error
-        self._event.set()
+        with self._lock:
+            if not self._event.is_set():
+                self._error = error
+                self._event.set()
 
 
 class _Request:
@@ -171,6 +186,12 @@ class ServeEngine:
     own; None = requests wait indefinitely.
     ``record_every``: write a ``serve`` JSONL record every N
     micro-batches (obs_dir only); one final record lands at drain.
+    ``replica_id``: set by the router (serve/router.py) when this
+    engine is one member of a replica group — rides every ``serve``
+    record so a fleet's obs streams attribute to the member.
+    ``sink_name``: the JSONL file under ``obs_dir`` (replica members
+    write ``serve_r<id>.jsonl`` so N members never interleave one
+    file).
     """
 
     def __init__(
@@ -183,6 +204,8 @@ class ServeEngine:
         obs_dir: Optional[str] = None,
         registry=None,
         record_every: int = 50,
+        replica_id: Optional[int] = None,
+        sink_name: str = "serve.jsonl",
     ):
         from theanompi_tpu.models.zoo import infer_fn
         from theanompi_tpu.obs.metrics import MetricsRegistry
@@ -199,6 +222,8 @@ class ServeEngine:
         self.default_deadline_ms = default_deadline_ms
         self.obs_dir = obs_dir
         self.record_every = max(1, int(record_every))
+        self.replica_id = None if replica_id is None else int(replica_id)
+        self.sink_name = str(sink_name)
 
         ishape = tuple(model.recipe.input_shape)
         self._ishape = ishape
@@ -235,6 +260,7 @@ class ServeEngine:
         self._q: collections.deque[_Request] = collections.deque()
         self._cond = threading.Condition()
         self._draining = False
+        self._abort_error: Optional[BaseException] = None
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._batch_s_ewma: Optional[float] = None
@@ -367,11 +393,14 @@ class ServeEngine:
         return self._trace_count
 
     def start(self) -> None:
-        if self._thread is not None:
-            raise RuntimeError("engine already started")
-        self._thread = threading.Thread(
-            target=self._loop, name="tmpi-serve-batcher", daemon=True
-        )
+        # under the engine lock: the router's supervisor starts
+        # restarted members from its own thread
+        with self._cond:
+            if self._thread is not None:
+                raise RuntimeError("engine already started")
+            self._thread = threading.Thread(
+                target=self._loop, name="tmpi-serve-batcher", daemon=True
+            )
         self._thread.start()
 
     def drain(self, timeout: Optional[float] = None) -> bool:
@@ -407,7 +436,7 @@ class ServeEngine:
                     if self._serve_f is None:
                         os.makedirs(self.obs_dir, exist_ok=True)
                         self._serve_f = open(
-                            os.path.join(self.obs_dir, "serve.jsonl"), "a"
+                            os.path.join(self.obs_dir, self.sink_name), "a"
                         )
                     self._serve_f.write(json.dumps(rec) + "\n")
                     self._sink_retired = True
@@ -417,9 +446,50 @@ class ServeEngine:
 
     close = drain
 
+    def abort(self, error: Optional[BaseException] = None) -> None:
+        """Hard death (the crash analogue of :meth:`drain`): stop
+        admitting, reject every QUEUED request with ``error``
+        (default :class:`EngineDead`), and poison the in-flight batch
+        so its futures reject too — nothing resolves after an abort.
+        A fronting router re-admits the rejected requests on healthy
+        replicas; a bare engine surfaces them as failures. Idempotent.
+        """
+        err = error if error is not None else EngineDead("engine aborted")
+        with self._cond:
+            if self._abort_error is None:
+                self._abort_error = err
+            self._draining = True
+            doomed = list(self._q)
+            self._q.clear()
+            self._g_queue.set(0.0)
+            self._cond.notify_all()
+        for r in doomed:
+            r.future._reject(err)
+        if doomed:
+            self._c_requests.inc(len(doomed), status="failed")
+
     @property
     def draining(self) -> bool:
         return self._draining
+
+    @property
+    def alive(self) -> bool:
+        """Health the router polls: a started, un-aborted, un-draining
+        engine whose batcher thread is running."""
+        t = self._thread
+        return (t is not None and t.is_alive()
+                and self._abort_error is None and not self._draining)
+
+    @property
+    def queue_depth(self) -> int:
+        """Requests waiting for a batch slot (the router's load signal)."""
+        return len(self._q)
+
+    @property
+    def batch_s_ewma(self) -> Optional[float]:
+        """EWMA seconds per micro-batch (None before the first batch) —
+        the other half of the router's least-loaded score."""
+        return self._batch_s_ewma
 
     # -- request path -------------------------------------------------------
     def submit(self, x, deadline_ms: Optional[float] = None) -> ServeFuture:
@@ -485,18 +555,24 @@ class ServeEngine:
             except BaseException as e:  # noqa: BLE001 — requests must
                 # never hang on an engine bug: fail THIS batch's futures
                 # and keep serving (a poisoned input must not take the
-                # engine down with it)
+                # engine down with it). An abort poisons the batch on
+                # purpose — those count as failed, not rejected
                 failed = 0
                 for r in reqs:
                     if not r.future.done():
                         r.future._reject(e)
                         failed += 1
                 if failed:
-                    self._c_requests.inc(failed, status="rejected")
+                    status = ("failed" if e is self._abort_error
+                              else "rejected")
+                    self._c_requests.inc(failed, status=status)
 
     def _serve_batch(self, reqs: list) -> None:
         import jax.numpy as jnp
 
+        err = self._abort_error
+        if err is not None:  # the replica died under this batch
+            raise err
         now = time.monotonic()
         live = []
         for r in reqs:
@@ -520,6 +596,9 @@ class ServeEngine:
             self._fwd(served.params, served.model_state, jnp.asarray(batch))
         )
         t_done = time.monotonic()
+        err = self._abort_error
+        if err is not None:  # abort landed mid-forward: nothing
+            raise err        # resolves after a death
         for i, r in enumerate(live):
             r.future._resolve(ServeResult(logits[i], served.step))
             self._h_latency.observe(t_done - r.future.t_submit)
@@ -555,6 +634,7 @@ class ServeEngine:
             "tmpi_serve_served_total": self._c_requests.value(status="served"),
             "tmpi_serve_expired_total": self._c_requests.value(status="expired"),
             "tmpi_serve_rejected_total": self._c_requests.value(status="rejected"),
+            "tmpi_serve_failed_total": self._c_requests.value(status="failed"),
             "tmpi_serve_reloads_total": self._c_reloads.value(),
             "tmpi_serve_reload_failures_total":
                 self._c_reloads.value(status="failed"),
@@ -572,9 +652,12 @@ class ServeEngine:
         """The one constructor of a ``kind=serve`` record (schema:
         tools/check_obs_schema.py) — used for the periodic/drain-time
         obs lines AND the CLI's final stdout line, so the two can never
-        drift apart on shape."""
-        return {"kind": "serve", "t": time.time(),
-                "params_step": self.params_step, "metrics": self.stats()}
+        drift apart on shape. Replica members stamp ``replica_id``."""
+        rec = {"kind": "serve", "t": time.time(),
+               "params_step": self.params_step, "metrics": self.stats()}
+        if self.replica_id is not None:
+            rec["replica_id"] = self.replica_id
+        return rec
 
     def _write_serve_record(self) -> None:
         self._write_record(self.serve_record())
@@ -588,7 +671,7 @@ class ServeEngine:
             if self._serve_f is None:
                 os.makedirs(self.obs_dir, exist_ok=True)
                 self._serve_f = open(
-                    os.path.join(self.obs_dir, "serve.jsonl"), "a"
+                    os.path.join(self.obs_dir, self.sink_name), "a"
                 )
             self._serve_f.write(json.dumps(rec) + "\n")
             self._serve_f.flush()

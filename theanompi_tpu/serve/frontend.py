@@ -7,6 +7,15 @@ is batched on the engine's single batcher thread, which is exactly the
 dynamic micro-batching story: N concurrent HTTP clients coalesce into
 bucket-shaped forwards.
 
+The same handler fronts a replica-group :class:`~theanompi_tpu.serve.
+router.Router` (``tmpi serve --replicas N``): the router duck-types
+``submit``/``params_step``/``draining``/``registry``, failover happens
+inside ``fut.result()`` on the handler thread, and a fleet-level 503's
+``Retry-After`` comes from the ROUTER's surviving-capacity EWMA
+(``RouterOverloaded.retry_after_ms`` — total backlog over the healthy
+replicas' aggregate service rate), never a single engine's view. The
+single-engine path is byte-identical to the pre-router behavior.
+
 Routes::
 
     POST /infer    {"input": <nested list, recipe.input_shape>,
@@ -17,9 +26,12 @@ Routes::
     GET /healthz -> 200 {"params_step", "queue_depth", "draining"} —
                    the load-balancer probe (draining -> 503 so a
                    SIGTERM'd replica falls out of rotation while it
-                   finishes its backlog)
+                   finishes its backlog). Fronting a router, the body
+                   also carries {"replicas", "healthy", "states"} and
+                   503 means ZERO healthy replicas (one dead member of
+                   a degraded-but-serving fleet keeps the probe green)
     GET /metrics -> Prometheus text of the engine registry
-                   (tmpi_serve_* families)
+                   (tmpi_serve_* families; tmpi_router_* for a router)
 """
 
 from __future__ import annotations
@@ -32,11 +44,12 @@ import numpy as np
 from theanompi_tpu.serve.engine import (
     DeadlineExceeded,
     Rejected,
-    ServeEngine,
 )
 
 
-def make_handler(engine: ServeEngine):
+def make_handler(engine):
+    """Build the handler class over one serve target — a bare
+    :class:`ServeEngine` or a replica-group ``Router`` (duck-typed)."""
     class Handler(BaseHTTPRequestHandler):
         # request logging off the hot path: per-request stderr lines at
         # serving rates are their own denial of service
@@ -55,6 +68,11 @@ def make_handler(engine: ServeEngine):
 
         def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
             if self.path == "/healthz":
+                hz = getattr(engine, "healthz", None)
+                if hz is not None:  # a Router: fleet-level probe
+                    ok, body = hz()
+                    self._reply(200 if ok else 503, body)
+                    return
                 body = {
                     "params_step": engine.params_step,
                     "queue_depth": int(engine.stats()["tmpi_serve_queue_depth"]),
@@ -112,7 +130,7 @@ def make_handler(engine: ServeEngine):
     return Handler
 
 
-def serve_http(engine: ServeEngine, host: str = "127.0.0.1",
+def serve_http(engine, host: str = "127.0.0.1",
                port: int = 8300) -> ThreadingHTTPServer:
     """Bind and return the server (caller runs ``serve_forever`` — the
     CLI does it on the main thread so SIGTERM lands there)."""

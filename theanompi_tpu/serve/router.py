@@ -1,0 +1,897 @@
+"""Replica-group serving: health-checked least-loaded routing with
+bounded failover, plus the supervisor that restarts dead replicas.
+
+PR 5's engine is one process, one replica — a death drops everything it
+holds. This module turns N :class:`~theanompi_tpu.serve.engine.
+ServeEngine` replicas into one serving fleet behind one endpoint:
+
+- **Least-loaded routing.** :meth:`Router.submit` scores every healthy
+  replica by ``(queue_depth + 1) x EWMA batch seconds`` — the expected
+  wait a new request would see — and admits to the cheapest. A replica
+  that rejects (its own bounded-queue admission control) falls to the
+  next candidate; only when EVERY healthy replica rejects does the
+  router itself raise :class:`RouterOverloaded`, whose
+  ``retry_after_ms`` comes from the fleet's SURVIVING-capacity EWMA
+  (total backlog / aggregate service rate), not any single engine's
+  view — graceful degradation means overload semantics engage exactly
+  when the surviving capacity is truly exceeded.
+
+- **Bounded per-request failover.** A request in flight on a dying
+  replica (its engine rejects the future with
+  :class:`~theanompi_tpu.serve.engine.EngineDead` or any other
+  engine-side error) is RE-ADMITTED to a healthy replica within its
+  original deadline — never silently dropped. Failover is bounded
+  (``max_failovers``) and deadline-honoring: a deadline that expires
+  mid-failover surfaces as ``DeadlineExceeded`` exactly like one that
+  expires in a queue. Every terminal drop is counted
+  (``tmpi_router_requests_total{status=dropped}``) and recorded — the
+  chaos oracle (tools/chaos.py ``--serve``) asserts the counter stays
+  at zero while surviving capacity suffices.
+
+- **Served-step monotonicity by construction.** The router keeps a
+  fleet-wide step floor, ratcheted under a lock on every result. A
+  result served from params OLDER than the floor (one replica lagging
+  the central hot-reload by a batch) is not returned — the request is
+  re-admitted until a current replica serves it. Clients can never
+  observe the served step move backward across failover or reload.
+
+- **Supervisor.** A single ``tmpi-router-supervisor`` thread health-
+  checks replicas (an aborted/dead batcher demotes the replica out of
+  rotation) and restarts down replicas through the replica factory
+  with the PR-4 decorrelated-jitter backoff
+  (``min(cap, U(base, 3*prev))``, seeded RNG) while survivors absorb
+  the traffic.
+
+The Router duck-types enough of the engine surface that the existing
+pieces compose unchanged: ``serve/reload.py``'s
+:class:`CheckpointReloader` points at the Router and hot-reload becomes
+CENTRAL (one load, one ``set_params`` fan-out, every replica swaps to
+the same step), and ``serve/frontend.py`` fronts a Router exactly like
+an engine (``submit``/``params_step``/``draining``/``registry``).
+
+Telemetry: ``tmpi_router_*`` metrics in the router's registry and
+``kind=router`` JSONL records (events ``health`` / ``failover`` /
+``restart`` / ``restart_failed`` / ``drop`` / ``snapshot``) in
+``<obs_dir>/router.jsonl`` — schema in tools/check_obs_schema.py.
+Replica members write their own ``serve_r<id>.jsonl``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+from theanompi_tpu.serve.engine import (
+    DeadlineExceeded,
+    EngineDead,
+    EngineDraining,
+    Rejected,
+    ServeEngine,
+)
+
+# a replica with no batch timing yet is assumed this fast (seconds per
+# micro-batch) for scoring — matches the engine's own overload fallback
+_DEFAULT_BATCH_S = 0.05
+# sleep between re-admission attempts when no replica is healthy yet
+# (the supervisor is restarting one); deadline-bounded overall
+_REROUTE_WAIT_S = 0.02
+# a result older than the fleet's step floor is retried at most this
+# many times (the central reload fan-out window is sub-millisecond;
+# this bound exists so a wedged fleet cannot spin forever)
+_MAX_STALE_RETRIES = 8
+
+
+class RouterOverloaded(Rejected):
+    """Every healthy replica rejected admission: the FLEET is out of
+    capacity. ``retry_after_ms`` is the aggregate estimate — total
+    backlog over the surviving replicas' combined service rate."""
+
+    def __init__(self, healthy: int, depth: int, retry_after_ms: float):
+        self.retry_after_ms = float(retry_after_ms)
+        super().__init__(
+            f"all {healthy} healthy replicas overloaded ({depth} "
+            f"waiting fleet-wide); retry in ~{retry_after_ms:.0f} ms"
+        )
+
+
+class RouterUnavailable(Rejected):
+    """Zero healthy replicas right now (all crashed, supervisor mid-
+    restart). ``retry_after_ms`` estimates the restart backoff."""
+
+    def __init__(self, retry_after_ms: float):
+        self.retry_after_ms = float(retry_after_ms)
+        super().__init__(
+            "no healthy replica available; retry in "
+            f"~{retry_after_ms:.0f} ms"
+        )
+
+
+class RequestDropped(RuntimeError):
+    """Terminal failover failure: the request exhausted its failover
+    budget (or the drop_inflight mutation fired). The router counts
+    every one — the chaos oracle's zero-drop invariant watches it."""
+
+
+class RouterFuture:
+    """Completion handle for a routed request. ``result()`` runs the
+    failover loop in the WAITING thread: it blocks on the current
+    replica's future and, when that replica dies under the request,
+    asks the router to re-admit it on a healthy one — bounded by the
+    failover budget and the request's original deadline."""
+
+    __slots__ = ("_router", "_x", "_deadline", "_rep", "_fut",
+                 "_failovers", "_stales", "t_submit")
+
+    def __init__(self, router: "Router", x, deadline_ms: Optional[float]):
+        self._router = router
+        self._x = x
+        self._deadline = (
+            time.monotonic() + float(deadline_ms) / 1000.0
+            if deadline_ms else None
+        )
+        self._rep = None
+        self._fut = None
+        self._failovers = 0
+        self._stales = 0
+        self.t_submit = time.monotonic()
+
+    def remaining_ms(self) -> Optional[float]:
+        """Milliseconds left on the ORIGINAL deadline (None = none)."""
+        if self._deadline is None:
+            return None
+        return 1000.0 * (self._deadline - time.monotonic())
+
+    def done(self) -> bool:
+        f = self._fut
+        return f is not None and f.done()
+
+    def result(self, timeout: Optional[float] = None):
+        t_end = None if timeout is None else time.monotonic() + timeout
+        while True:
+            budget = None if t_end is None else t_end - time.monotonic()
+            try:
+                res = self._fut.result(budget)
+            except TimeoutError:
+                raise
+            except DeadlineExceeded:
+                self._router._count_expired()
+                raise
+            except BaseException as e:  # noqa: BLE001 — every engine-
+                # side failure (EngineDead, a post-admission drain, a
+                # poisoned batch) is a failover candidate: another
+                # replica may still serve this request in time
+                self._router._failover(self, e)
+                continue
+            if self._router._settle(res):
+                return res
+            # stale params: this replica lagged the central reload
+            self._stales += 1
+            if self._stales > _MAX_STALE_RETRIES:
+                # wedged fleet — surface the stale result rather than
+                # spin; counted so the oracle can see it ever happened
+                self._router._count_stale_served()
+                return res
+            self._router._reroute_stale(self)
+
+
+class Replica:
+    """One fleet member: an engine slot plus its health state machine
+    (``new -> healthy <-> down -> restarting -> healthy``). All state
+    transitions are serialized by the replica's own lock; the Router
+    writes the ``kind=router`` health records around them."""
+
+    def __init__(self, replica_id: int,
+                 factory: Callable[[int], ServeEngine]):
+        self.replica_id = int(replica_id)
+        self._factory = factory
+        self._lock = threading.Lock()
+        self._engine: Optional[ServeEngine] = None
+        self._state = "new"
+        self._last_error: Optional[str] = None
+        self._restarts = 0
+        self._next_restart_t: Optional[float] = None
+        self._backoff_s: Optional[float] = None
+
+    # -- views (racy reads are fine: every write is serialized) -------------
+    @property
+    def engine(self) -> Optional[ServeEngine]:
+        return self._engine
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    @property
+    def restarts(self) -> int:
+        return self._restarts
+
+    @property
+    def healthy(self) -> bool:
+        eng = self._engine
+        return self._state == "healthy" and eng is not None and eng.alive
+
+    @property
+    def next_restart_t(self) -> Optional[float]:
+        return self._next_restart_t
+
+    @property
+    def backoff_s(self) -> Optional[float]:
+        return self._backoff_s
+
+    # -- transitions --------------------------------------------------------
+    def start(self) -> ServeEngine:
+        """Build this member's engine through the factory (started,
+        warmed, params set — the factory contract) and enter rotation."""
+        eng = self._factory(self.replica_id)
+        with self._lock:
+            self._engine = eng
+            self._state = "healthy"
+        return eng
+
+    def mark_down(self, error: str) -> bool:
+        """healthy/new -> down; returns whether THIS call made the
+        transition (the caller writes the health record exactly once)."""
+        with self._lock:
+            if self._state in ("down", "restarting"):
+                return False
+            self._state = "down"
+            self._last_error = str(error)[:300]
+            self._next_restart_t = None
+            return True
+
+    def schedule_restart(self, at_t: float, backoff_s: float) -> None:
+        with self._lock:
+            self._next_restart_t = float(at_t)
+            self._backoff_s = float(backoff_s)
+
+    def begin_restart(self) -> bool:
+        with self._lock:
+            if self._state != "down":
+                return False
+            self._state = "restarting"
+            return True
+
+    def adopt(self, engine: ServeEngine) -> None:
+        """Restart succeeded: publish the fresh engine and re-enter
+        rotation; the jitter backoff resets on success."""
+        with self._lock:
+            self._engine = engine
+            self._state = "healthy"
+            self._restarts += 1
+            self._next_restart_t = None
+            self._backoff_s = None
+
+    def restart_failed(self, error: str) -> None:
+        with self._lock:
+            self._state = "down"
+            self._last_error = str(error)[:300]
+            self._next_restart_t = None  # supervisor re-draws backoff
+
+    def kill(self, error: Optional[BaseException] = None) -> None:
+        """Chaos hook: hard-abort the engine (queued AND in-flight
+        requests reject with :class:`EngineDead` and fail over)."""
+        eng = self._engine
+        if eng is not None:
+            eng.abort(error or EngineDead(
+                f"replica {self.replica_id} killed"))
+
+
+class Router:
+    """N-replica serving fleet behind one submit(): health-checked
+    least-loaded routing, bounded failover, supervised restarts.
+
+    ``factory(replica_id) -> ServeEngine`` must return a STARTED,
+    warmed engine with params set (each member owns its registry and
+    writes ``serve_r<id>.jsonl``); the supervisor uses the same factory
+    to restart crashed members. Lifecycle: construct -> ``start()`` ->
+    ``submit``/``infer`` ... -> ``drain()``.
+
+    ``mutate="drop_inflight"`` plants the seeded bug the chaos
+    mutation self-test must catch: the failover path DROPS a request
+    held by a dying replica instead of re-admitting it.
+    """
+
+    def __init__(
+        self,
+        factory: Callable[[int], ServeEngine],
+        n_replicas: int,
+        *,
+        obs_dir: Optional[str] = None,
+        registry=None,
+        default_deadline_ms: Optional[float] = None,
+        max_failovers: int = 4,
+        health_interval: float = 0.25,
+        restart_base_s: float = 0.2,
+        restart_cap_s: float = 2.0,
+        seed: int = 0,
+        mutate: Optional[str] = None,
+    ):
+        from theanompi_tpu.obs.metrics import MetricsRegistry
+
+        if int(n_replicas) < 1:
+            raise ValueError("n_replicas must be >= 1")
+        self._factory = factory
+        self._replicas = tuple(
+            Replica(i, factory) for i in range(int(n_replicas))
+        )
+        self.obs_dir = obs_dir
+        self.default_deadline_ms = default_deadline_ms
+        self.max_failovers = int(max_failovers)
+        self.health_interval = float(health_interval)
+        self.restart_base_s = float(restart_base_s)
+        self.restart_cap_s = float(restart_cap_s)
+        self.mutate = mutate
+        # seeded: restart backoff jitter is reproducible per chaos seed
+        self._rng = random.Random(seed)
+
+        self._lock = threading.Lock()
+        self._step_floor = -1
+        self._capacity_rps = 0.0
+        self._draining = False
+        self._stop = threading.Event()
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._sink_lock = threading.Lock()
+        self._sink_f = None
+        self._sink_retired = False
+
+        self.registry = registry or MetricsRegistry()
+        self._c_requests = self.registry.counter(
+            "tmpi_router_requests_total",
+            help="routed requests by outcome (status=served|dropped|"
+                 "rejected|expired|stale_retry|stale_served)",
+        )
+        self._c_failovers = self.registry.counter(
+            "tmpi_router_failovers_total",
+            help="in-flight requests re-admitted off a dying replica",
+        )
+        self._c_restarts = self.registry.counter(
+            "tmpi_router_restarts_total",
+            help="supervisor replica restarts (status=failed for "
+                 "factory failures)",
+        )
+        self._c_reloads = self.registry.counter(
+            "tmpi_router_reloads_total",
+            help="central hot-reloads fanned out to the fleet",
+        )
+        self._g_healthy = self.registry.gauge(
+            "tmpi_router_healthy", help="replicas currently in rotation"
+        )
+        self._g_replicas = self.registry.gauge(
+            "tmpi_router_replicas", help="fleet size"
+        )
+        self._g_queue = self.registry.gauge(
+            "tmpi_router_queue_depth", help="fleet-wide queued requests"
+        )
+        self._g_capacity = self.registry.gauge(
+            "tmpi_router_capacity_rps",
+            help="surviving-capacity EWMA (requests/s the healthy "
+                 "replicas can serve)",
+        )
+        self._g_floor = self.registry.gauge(
+            "tmpi_router_step_floor",
+            help="fleet-wide served-step floor (monotone ratchet)",
+        )
+        self._g_replicas.set(float(len(self._replicas)))
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self, supervise: bool = True) -> None:
+        """Build every replica through the factory, then start the
+        supervisor thread (health checks + jitter-backoff restarts)."""
+        for rep in self._replicas:
+            rep.start()
+            self._write_record(self._event(
+                "health", rep, from_state="new", to_state="healthy"))
+        self._g_healthy.set(float(self.healthy_count))
+        if supervise:
+            self._thread = threading.Thread(
+                target=self._supervise_loop, name="tmpi-router-supervisor",
+                daemon=True,
+            )
+            self._thread.start()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful fleet shutdown: stop admission, stop the
+        supervisor, drain every live replica, flush the final
+        ``snapshot`` record. Idempotent."""
+        with self._lock:
+            self._draining = True
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=10.0)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        drained = True
+        for rep in self._replicas:
+            eng = rep.engine
+            if eng is None:
+                continue
+            left = (None if deadline is None
+                    else max(0.0, deadline - time.monotonic()))
+            try:
+                drained = eng.drain(timeout=left) and drained
+            except Exception:  # noqa: BLE001 — a dead member must not
+                # block the survivors' drain
+                drained = False
+        with self._sink_lock:
+            first = not self._stopped.is_set()
+            self._stopped.set()
+        if first and self.obs_dir is not None:
+            rec = self.router_record()
+            with self._sink_lock:
+                if not self._sink_retired:
+                    if self._sink_f is None:
+                        os.makedirs(self.obs_dir, exist_ok=True)
+                        self._sink_f = open(
+                            os.path.join(self.obs_dir, "router.jsonl"), "a"
+                        )
+                    self._sink_f.write(json.dumps(rec) + "\n")
+                    self._sink_retired = True
+                    self._sink_f.close()
+                    self._sink_f = None
+        return drained
+
+    close = drain
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def replicas(self) -> tuple:
+        return self._replicas
+
+    @property
+    def healthy_count(self) -> int:
+        return sum(1 for rep in self._replicas if rep.healthy)
+
+    @property
+    def model(self):
+        """The served model (any live member's — they are identical);
+        the central reloader builds its load template from this."""
+        for rep in self._replicas:
+            eng = rep.engine
+            if eng is not None:
+                return eng.model
+        raise RuntimeError("no replica has an engine yet (start() first)")
+
+    # -- reloader adapter (CheckpointReloader duck-type) --------------------
+    @property
+    def params_step(self) -> int:
+        """MIN served step over healthy replicas: the reloader polls
+        for anything newer than the laggiest member, so a member that
+        missed a swap catches up on the next poll."""
+        steps = [rep.engine.params_step for rep in self._replicas
+                 if rep.healthy and rep.engine is not None]
+        if not steps:
+            return self._step_floor
+        return min(steps)
+
+    def set_params(self, params, model_state, step: int) -> bool:
+        """Central hot-reload fan-out: one loaded checkpoint, every
+        live replica swaps (each refuses backward steps on its own).
+        Returns True when at least one member swapped."""
+        any_swapped = False
+        for rep in self._replicas:
+            eng = rep.engine
+            if eng is None:
+                continue
+            try:
+                any_swapped = (
+                    eng.set_params(params, model_state, step) or any_swapped
+                )
+            except Exception:  # noqa: BLE001 — a dying member must not
+                # fail the fleet's reload; it restarts from the newest
+                # checkpoint anyway
+                continue
+        return any_swapped
+
+    def note_reload(self, from_step: int, to_step: int, ms: float) -> None:
+        self._c_reloads.inc()
+        self._write_record({
+            "kind": "reload", "t": time.time(),
+            "from_step": int(from_step), "to_step": int(to_step),
+            "ms": round(float(ms), 3),
+        })
+
+    def note_reload_failed(self, from_step: int, error: str) -> None:
+        self._c_reloads.inc(status="failed")
+        self._write_record({
+            "kind": "reload", "t": time.time(),
+            "from_step": int(from_step), "to_step": -1,
+            "ok": False, "error": str(error)[:500],
+        })
+
+    # -- request path -------------------------------------------------------
+    def submit(self, x, deadline_ms: Optional[float] = None) -> RouterFuture:
+        """Admit one request to the least-loaded healthy replica.
+        Raises :class:`RouterOverloaded` (every healthy replica's own
+        admission control rejected) or :class:`RouterUnavailable`
+        (zero healthy replicas) synchronously; engine-side failures
+        after admission fail over inside ``result()``."""
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        if self._draining:
+            self._c_requests.inc(status="rejected")
+            raise EngineDraining()
+        fut = RouterFuture(self, x, deadline_ms)
+        self._admit(fut, deadline_ms, exclude=None)
+        return fut
+
+    def infer(self, x, deadline_ms: Optional[float] = None,
+              timeout: Optional[float] = 30.0):
+        """Blocking convenience: submit + failover-aware wait."""
+        return self.submit(x, deadline_ms=deadline_ms).result(timeout)
+
+    def _admit(self, fut: RouterFuture, deadline_ms: Optional[float],
+               exclude: Optional[Replica]) -> None:
+        """One admission pass over the healthy replicas, least-loaded
+        first. Raises RouterOverloaded / RouterUnavailable when no
+        member admits (ValueError from a shape mismatch propagates —
+        that is a caller bug, not a capacity problem)."""
+        tried = set()
+        while True:
+            rep = self._pick(tried, prefer_not=exclude)
+            if rep is None:
+                break
+            eng = rep.engine
+            if eng is None:
+                tried.add(rep.replica_id)
+                continue
+            try:
+                sfut = eng.submit(fut._x, deadline_ms=deadline_ms)
+            except Rejected:
+                tried.add(rep.replica_id)
+                continue
+            except RuntimeError:
+                # engine died between pick and submit — not a reject
+                tried.add(rep.replica_id)
+                continue
+            fut._rep, fut._fut = rep, sfut
+            return
+        healthy = self.healthy_count
+        self._c_requests.inc(status="rejected")
+        if healthy == 0:
+            raise RouterUnavailable(
+                retry_after_ms=1000.0 * max(self.restart_base_s, 0.05))
+        raise RouterOverloaded(
+            healthy, self.total_queue_depth,
+            retry_after_ms=self.retry_after_ms())
+
+    def _pick(self, tried: set, prefer_not: Optional[Replica]) -> \
+            Optional[Replica]:
+        """Least-loaded healthy replica not yet tried; the replica the
+        request just died on is only chosen when it is the sole
+        survivor (it may have restarted already)."""
+        best = None
+        best_score = None
+        for pass_excluding_prev in (True, False):
+            for rep in self._replicas:
+                if rep.replica_id in tried or not rep.healthy:
+                    continue
+                if pass_excluding_prev and rep is prefer_not:
+                    continue
+                eng = rep.engine
+                if eng is None:
+                    continue
+                ewma = eng.batch_s_ewma or _DEFAULT_BATCH_S
+                score = (eng.queue_depth + 1) * ewma
+                if best_score is None or score < best_score:
+                    best, best_score = rep, score
+            if best is not None:
+                return best
+        return None
+
+    # -- failover (runs on the waiting request's thread) --------------------
+    def _failover(self, fut: RouterFuture, error: BaseException) -> None:
+        """The dying replica rejected an in-flight request: demote the
+        replica, then re-admit the request on a healthy one within its
+        original deadline. Raises when the request is terminally lost
+        (budget exhausted / deadline passed / mutation)."""
+        rep = fut._rep
+        if rep is not None and rep.mark_down(repr(error)):
+            self._write_record(self._event(
+                "health", rep, from_state="healthy", to_state="down",
+                error=repr(error)))
+            self._g_healthy.set(float(self.healthy_count))
+        if self.mutate == "drop_inflight":
+            # the planted bug the chaos mutation self-test must catch:
+            # the in-flight request is dropped instead of re-admitted
+            self._drop(fut, rep, error)
+        fut._failovers += 1
+        if fut._failovers > self.max_failovers:
+            self._drop(fut, rep, error)
+        remaining = fut.remaining_ms()
+        if remaining is not None and remaining <= 0.0:
+            self._count_expired()
+            raise DeadlineExceeded(
+                "deadline expired during failover "
+                f"(after {fut._failovers} attempts)") from error
+        # re-admit, waiting out a no-healthy-replica window (the
+        # supervisor is restarting) up to the deadline; deadline-less
+        # requests get a bounded wait instead of spinning forever on a
+        # fleet whose restarts keep failing
+        waited = 0.0
+        max_wait_s = 4.0 * max(self.restart_cap_s, self.restart_base_s)
+        while True:
+            try:
+                self._admit(fut, fut.remaining_ms(), exclude=rep)
+            except Rejected as rej:
+                remaining = fut.remaining_ms()
+                if remaining is not None and remaining <= 0.0:
+                    self._count_expired()
+                    raise DeadlineExceeded(
+                        "deadline expired during failover "
+                        f"(after {fut._failovers} attempts)") from error
+                if remaining is None and (
+                        self._draining or waited >= max_wait_s):
+                    self._drop(fut, rep, rej)
+                time.sleep(_REROUTE_WAIT_S)
+                waited += _REROUTE_WAIT_S
+                continue
+            break
+        self._c_failovers.inc()
+        self._write_record(self._event(
+            "failover", rep if rep is not None else fut._rep,
+            to_replica=fut._rep.replica_id, error=repr(error)))
+
+    def _drop(self, fut: RouterFuture, rep: Optional[Replica],
+              error: BaseException) -> None:
+        self._c_requests.inc(status="dropped")
+        self._write_record(self._event("drop", rep, error=repr(error)))
+        raise RequestDropped(
+            f"request dropped after {fut._failovers} failovers: "
+            f"{error!r}") from error
+
+    def _reroute_stale(self, fut: RouterFuture) -> None:
+        """The result came from params older than the fleet floor (a
+        member lagging the central reload by one batch): re-admit,
+        preferring a different replica — by the time the new submit
+        batches, the swap fan-out has landed."""
+        self._c_requests.inc(status="stale_retry")
+        time.sleep(_REROUTE_WAIT_S / 4.0)
+        waited = 0.0
+        while True:
+            try:
+                self._admit(fut, fut.remaining_ms(), exclude=fut._rep)
+            except Rejected:
+                remaining = fut.remaining_ms()
+                if remaining is not None and remaining <= 0.0:
+                    self._count_expired()
+                    raise DeadlineExceeded(
+                        "deadline expired while retrying a stale-params "
+                        "result")
+                if remaining is None and (
+                        self._draining or waited >= 2.0):
+                    raise  # surface the fleet-level reject as-is
+                time.sleep(_REROUTE_WAIT_S)
+                waited += _REROUTE_WAIT_S
+                continue
+            break
+
+    # -- result settlement --------------------------------------------------
+    def _settle(self, res) -> bool:
+        """Ratchet the fleet step floor; False = the result is from
+        params older than what the fleet already served (stale)."""
+        with self._lock:
+            if res.step < self._step_floor:
+                return False
+            if res.step > self._step_floor:
+                self._step_floor = res.step
+                self._g_floor.set(float(res.step))
+        self._c_requests.inc(status="served")
+        return True
+
+    def _count_expired(self) -> None:
+        self._c_requests.inc(status="expired")
+
+    def _count_stale_served(self) -> None:
+        self._c_requests.inc(status="stale_served")
+
+    # -- supervisor ---------------------------------------------------------
+    def _supervise_loop(self) -> None:
+        while not self._stop.wait(self.health_interval):
+            try:
+                self._health_pass(time.monotonic())
+            except Exception as e:  # noqa: BLE001 — the supervisor
+                # must outlive any single bad pass
+                print(f"[serve.router] health pass failed ({e!r}); "
+                      "retrying", flush=True)
+
+    def _health_pass(self, now: float) -> None:
+        """One supervisor tick: demote dead members, restart due ones
+        (decorrelated-jitter backoff), refresh the capacity EWMA."""
+        healthy = 0
+        queue_depth = 0
+        rate = 0.0
+        for rep in self._replicas:
+            eng = rep.engine
+            if rep.state == "healthy" and (eng is None or not eng.alive):
+                if rep.mark_down("engine not alive (health check)"):
+                    self._write_record(self._event(
+                        "health", rep, from_state="healthy",
+                        to_state="down",
+                        error="engine not alive (health check)"))
+            if rep.state == "down":
+                nxt = rep.next_restart_t
+                if nxt is None:
+                    prev = rep.backoff_s or self.restart_base_s
+                    backoff = min(
+                        self.restart_cap_s,
+                        self._rng.uniform(self.restart_base_s, 3.0 * prev),
+                    )
+                    rep.schedule_restart(now + backoff, backoff)
+                elif now >= nxt:
+                    self._restart(rep)
+            if rep.healthy:
+                eng = rep.engine
+                healthy += 1
+                queue_depth += eng.queue_depth
+                ewma = eng.batch_s_ewma or _DEFAULT_BATCH_S
+                rate += eng.buckets[-1] / max(ewma, 1e-4)
+        with self._lock:
+            prev = self._capacity_rps
+            self._capacity_rps = (
+                rate if prev == 0.0 else 0.7 * prev + 0.3 * rate
+            )
+        self._g_healthy.set(float(healthy))
+        self._g_queue.set(float(queue_depth))
+        self._g_capacity.set(self._capacity_rps)
+
+    def _restart(self, rep: Replica) -> None:
+        backoff = rep.backoff_s
+        if not rep.begin_restart():
+            return
+        self._write_record(self._event(
+            "health", rep, from_state="down", to_state="restarting"))
+        try:
+            eng = self._factory(rep.replica_id)
+        except Exception as e:  # noqa: BLE001 — a failed restart re-
+            # enters backoff with the jitter grown from the last draw
+            self._c_restarts.inc(status="failed")
+            rep.restart_failed(repr(e))
+            self._write_record(self._event(
+                "restart_failed", rep, error=repr(e),
+                backoff_s=backoff))
+            return
+        rep.adopt(eng)
+        self._c_restarts.inc()
+        self._g_healthy.set(float(self.healthy_count))
+        self._write_record(self._event(
+            "restart", rep, from_state="restarting", to_state="healthy",
+            backoff_s=backoff))
+
+    # -- chaos hooks --------------------------------------------------------
+    def kill_replica(self, replica_id: int,
+                     error: Optional[BaseException] = None) -> None:
+        """Hard-kill one member (chaos ``replica_crash``): demote it
+        out of rotation FIRST (no new admissions), then abort its
+        engine so queued and in-flight requests fail over."""
+        rep = self._replicas[int(replica_id)]
+        if rep.mark_down("killed (chaos replica_crash)"):
+            self._write_record(self._event(
+                "health", rep, from_state="healthy", to_state="down",
+                error="killed (chaos replica_crash)"))
+            self._g_healthy.set(float(self.healthy_count))
+        rep.kill(error)
+
+    # -- telemetry ----------------------------------------------------------
+    @property
+    def total_queue_depth(self) -> int:
+        total = 0
+        for rep in self._replicas:
+            eng = rep.engine
+            if rep.healthy and eng is not None:
+                total += eng.queue_depth
+        return total
+
+    def surviving_capacity_rps(self) -> float:
+        """The router's surviving-capacity EWMA (requests/s across the
+        healthy replicas) — the ``Retry-After`` source once replicas
+        exist. Falls back to an instantaneous estimate before the
+        supervisor's first pass."""
+        cap = self._capacity_rps
+        if cap > 0.0:
+            return cap
+        rate = 0.0
+        for rep in self._replicas:
+            eng = rep.engine
+            if rep.healthy and eng is not None:
+                ewma = eng.batch_s_ewma or _DEFAULT_BATCH_S
+                rate += eng.buckets[-1] / max(ewma, 1e-4)
+        return rate
+
+    def retry_after_ms(self) -> float:
+        """Aggregate backlog over aggregate service rate: when the
+        FLEET rejects, this is how long until capacity frees up."""
+        rate = max(self.surviving_capacity_rps(), 1e-3)
+        return 1000.0 * (self.total_queue_depth + 1) / rate
+
+    def stats(self) -> dict:
+        """Flat ``tmpi_router_``-prefixed numeric snapshot (the
+        ``kind=router`` snapshot record's metrics map — prefix enforced
+        by the schema checker)."""
+        return {
+            "tmpi_router_replicas": float(len(self._replicas)),
+            "tmpi_router_healthy": float(self.healthy_count),
+            "tmpi_router_queue_depth": float(self.total_queue_depth),
+            "tmpi_router_capacity_rps": float(self.surviving_capacity_rps()),
+            "tmpi_router_step_floor": float(self._step_floor),
+            "tmpi_router_served_total":
+                self._c_requests.value(status="served"),
+            "tmpi_router_dropped_total":
+                self._c_requests.value(status="dropped"),
+            "tmpi_router_rejected_total":
+                self._c_requests.value(status="rejected"),
+            "tmpi_router_expired_total":
+                self._c_requests.value(status="expired"),
+            "tmpi_router_stale_retries_total":
+                self._c_requests.value(status="stale_retry"),
+            "tmpi_router_stale_served_total":
+                self._c_requests.value(status="stale_served"),
+            "tmpi_router_failovers_total": self._c_failovers.value(),
+            "tmpi_router_restarts_total": self._c_restarts.value(),
+            "tmpi_router_restart_failures_total":
+                self._c_restarts.value(status="failed"),
+            "tmpi_router_reloads_total": self._c_reloads.value(),
+        }
+
+    def router_record(self) -> dict:
+        """The ``kind=router`` snapshot record (schema:
+        tools/check_obs_schema.py)."""
+        return {"kind": "router", "t": time.time(), "event": "snapshot",
+                "metrics": self.stats()}
+
+    def healthz(self) -> tuple:
+        """(ok, body) for the HTTP front's ``/healthz``: the fleet is
+        routable while it is not draining and >=1 member is healthy."""
+        body = {
+            "params_step": self.params_step,
+            "queue_depth": self.total_queue_depth,
+            "draining": self.draining,
+            "replicas": len(self._replicas),
+            "healthy": self.healthy_count,
+            "states": {str(rep.replica_id): rep.state
+                       for rep in self._replicas},
+        }
+        ok = not self.draining and self.healthy_count > 0
+        return ok, body
+
+    def _event(self, event: str, rep: Optional[Replica],
+               from_state: Optional[str] = None,
+               to_state: Optional[str] = None,
+               to_replica: Optional[int] = None,
+               error: Optional[str] = None,
+               backoff_s: Optional[float] = None) -> dict:
+        rec = {"kind": "router", "t": time.time(), "event": event}
+        if rep is not None:
+            rec["replica_id"] = rep.replica_id
+        if from_state is not None:
+            rec["from_state"] = from_state
+        if to_state is not None:
+            rec["to_state"] = to_state
+        if to_replica is not None:
+            rec["to_replica"] = int(to_replica)
+        if error is not None:
+            rec["error"] = str(error)[:300]
+        if backoff_s is not None:
+            rec["backoff_s"] = round(float(backoff_s), 4)
+        return rec
+
+    def _write_record(self, rec: dict) -> None:
+        if self.obs_dir is None:
+            return
+        with self._sink_lock:
+            if self._sink_retired:
+                return
+            if self._sink_f is None:
+                os.makedirs(self.obs_dir, exist_ok=True)
+                self._sink_f = open(
+                    os.path.join(self.obs_dir, "router.jsonl"), "a"
+                )
+            self._sink_f.write(json.dumps(rec) + "\n")
+            self._sink_f.flush()

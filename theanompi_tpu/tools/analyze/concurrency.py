@@ -96,6 +96,7 @@ CONCURRENCY_FILES = tuple(
         ("serve", "reload.py"),
         ("serve", "frontend.py"),
         ("serve", "cli.py"),
+        ("serve", "router.py"),
         ("utils", "checkpoint.py"),
         ("utils", "dispatch.py"),
         ("data", "loader.py"),
@@ -221,6 +222,8 @@ class ClassInfo:
     callback_ctx: dict = field(default_factory=dict)
     # param name -> attr name it is stored into (across methods)
     param_stores: dict = field(default_factory=dict)
+    # attr name -> set of class names it may hold (ctor-typed stores)
+    attr_types: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -490,6 +493,28 @@ class _Model:
                     types[sub.targets[0].id] = cname
         return types
 
+    def _ctor_types_multi(self, scope: ast.AST) -> dict:
+        """Like :meth:`_ctor_types` but keeping EVERY constructor type a
+        local may hold (name -> set of class names): the serve CLI binds
+        ``engine`` to a ``ServeEngine`` on one branch and a ``Router``
+        on the other, and a duck-typed consumer must see both."""
+        cache = getattr(self, "_ctor_multi_cache", None)
+        if cache is None:
+            cache = self._ctor_multi_cache = {}
+        hit = cache.get(id(scope))
+        if hit is not None:
+            return hit
+        types: dict = {}
+        cache[id(scope)] = types
+        for sub in ast.walk(scope):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 and \
+                    isinstance(sub.targets[0], ast.Name) and \
+                    isinstance(sub.value, ast.Call):
+                cname = _term(sub.value.func)
+                if cname in self.classes:
+                    types.setdefault(sub.targets[0].id, set()).add(cname)
+        return types
+
     def _resolve_method(self, recv: ast.expr, mname: str,
                         types: dict) -> list:
         """FuncInfos a call ``recv.mname(...)`` may dispatch to."""
@@ -583,7 +608,34 @@ class _Model:
                         f.value.id == "self":
                     pass
                 else:
-                    out.extend(self._resolve_method(f.value, f.attr, types))
+                    # attr-typed receiver first: ``self.engine.set_params``
+                    # where __init__ stored a ctor-typed arg into
+                    # ``self.engine`` dispatches to every candidate class
+                    # (the unique-name fallback goes dark the moment two
+                    # classes share the method name — Router/ServeEngine)
+                    recv = _self_attr(f.value)
+                    hits = []
+                    if recv is not None and fi.cls is not None:
+                        for cname in fi.cls.attr_types.get(recv, ()):
+                            m2 = self.classes[cname].methods.get(f.attr)
+                            if m2 is not None:
+                                hits.append(m2)
+                    elif isinstance(f.value, ast.Name):
+                        # ctor-typed param of an enclosing scope (the
+                        # handler closure's ``engine.submit``)
+                        ptypes = getattr(self, "param_types", {})
+                        for scope in chain:
+                            for cname in ptypes.get(
+                                    (scope, f.value.id), ()):
+                                m2 = self.classes[cname].methods.get(
+                                    f.attr)
+                                if m2 is not None:
+                                    hits.append(m2)
+                    if hits:
+                        out.extend(hits)
+                    else:
+                        out.extend(
+                            self._resolve_method(f.value, f.attr, types))
         return out
 
     def _param_stores(self) -> None:
@@ -610,6 +662,74 @@ class _Model:
                                         n.id in params:
                                     ci.param_stores.setdefault(
                                         (m.name, n.id), set()).add(attr)
+
+    def _attr_ctor_types(self, sites: list) -> None:
+        """Type class attributes from constructor-typed stores: direct
+        ``self.x = ClassName(...)`` assignments in the class body, plus
+        call-site args bound into attrs whose local binding is
+        ctor-typed (``CheckpointReloader(engine, ...)`` with ``engine``
+        assigned from ``ServeEngine(...)`` on one branch and
+        ``Router(...)`` on the other types ``self.engine`` as BOTH —
+        context propagation must reach every runtime dispatch target)."""
+        for ci in self.classes.values():
+            for m in ci.methods.values():
+                for sub in ast.walk(m.node):
+                    if isinstance(sub, ast.Assign) and \
+                            len(sub.targets) == 1:
+                        attr = _self_attr(sub.targets[0])
+                        if attr is not None and \
+                                isinstance(sub.value, ast.Call):
+                            cname = _term(sub.value.func)
+                            if cname in self.classes:
+                                ci.attr_types.setdefault(
+                                    attr, set()).add(cname)
+        for ci_target, attr, val, scope, _path in sites:
+            if isinstance(val, ast.Name) and scope is not None:
+                for s in self._scope_chain(scope):
+                    multi = self._ctor_types_multi(s)
+                    for cname in multi.get(val.id, ()):
+                        ci_target.attr_types.setdefault(
+                            attr, set()).add(cname)
+        # module-function params: ``make_handler(engine)`` with a
+        # ctor-typed argument types the param inside the callee (and
+        # its closures — the HTTP handler's ``engine.submit``)
+        self.param_types = {}  # (fn node, param name) -> set of classes
+        bindings = []  # (callee node, param name, arg name, scope chain)
+        for _path, tree in self.trees.items():
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) or \
+                        not isinstance(node.func, ast.Name) or \
+                        node.func.id not in self.module_funcs:
+                    continue
+                callee = self.module_funcs[node.func.id]
+                scope = self._enclosing_func(node)
+                if scope is None:
+                    continue
+                sig = callee.node.args
+                pos = [a.arg for a in sig.args]
+                bound = list(zip(pos, node.args))
+                bound += [(kw.arg, kw.value) for kw in node.keywords
+                          if kw.arg is not None]
+                for pname, aval in bound:
+                    if isinstance(aval, ast.Name):
+                        bindings.append((callee.node, pname, aval.id,
+                                         self._scope_chain(scope)))
+        # fixpoint: a typed param flows through further call sites
+        # (serve_http(engine) -> make_handler(engine) -> Handler)
+        for _ in range(4):
+            changed = False
+            for callee_node, pname, aname, chain in bindings:
+                cands: set = set()
+                for s in chain:
+                    cands |= self._ctor_types_multi(s).get(aname, set())
+                    cands |= self.param_types.get((s, aname), set())
+                cur = self.param_types.setdefault(
+                    (callee_node, pname), set())
+                if not cands <= cur:
+                    cur |= cands
+                    changed = True
+            if not changed:
+                break
 
     def _registration_sites(self) -> list:
         """Every call that may store a callable into a class attribute:
@@ -667,6 +787,7 @@ class _Model:
             if not fi.name.startswith("_") and fi.name != "__init__":
                 fi.contexts.add(_CALLER)
         sites = self._registration_sites()
+        self._attr_ctor_types(sites)  # before any _callees memoization
         for _ in range(12):
             changed = False
             for fi in list(self.funcs):

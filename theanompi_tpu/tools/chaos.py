@@ -51,6 +51,22 @@ re-feeds one already-consumed batch on mid-epoch resume,
 ``TMPI_CHAOS_MUTATE``) — the campaign MUST catch and shrink it; that is
 the proof the oracle is alive, the same way ``--inject-fault`` is the
 proof the recovery paths are.
+
+``--serve`` points the same machinery at the SERVING path instead of
+training: seeded schedules over :data:`SERVE_MATRIX`
+(``replica_crash@t`` / ``replica_stall@t:s`` / ``reload_corrupt@t`` /
+``slow_replica@t:s``, t in seconds into the load window) fire at an
+N-replica group (serve/router.py) under closed-loop client load, always
+composed with a mid-window checkpoint hot-reload. The serving oracle
+(:data:`SERVE_INVARIANTS`): zero dropped/failed requests while the
+surviving capacity suffices, per-client served step monotone across
+failover and reload, deadline semantics honored, schema-clean obs. The
+same greedy shrink applies, and ``--mutate drop_inflight`` arms the
+seeded router bug (an in-flight request on a dying replica is dropped
+instead of re-admitted) the campaign must catch and shrink::
+
+    tmpi chaos --serve --seeds 10
+    tmpi chaos --serve --schedule replica_crash@0.4 --mutate drop_inflight
 """
 
 from __future__ import annotations
@@ -61,6 +77,7 @@ import os
 import random
 import subprocess
 import sys
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -674,6 +691,447 @@ def repro_line(schedule: list[str]) -> str:
 
 
 # ---------------------------------------------------------------------------
+# the serving campaign (`tmpi chaos --serve`)
+# ---------------------------------------------------------------------------
+
+# serving fault kinds: spec is KIND@T[:ARG] with T seconds into the
+# load window (floats, unlike the training matrix's step numbers).
+#   replica_crash   hard-kill one healthy member (router.kill_replica:
+#                   queued AND in-flight requests must fail over)
+#   replica_stall   freeze one member's batcher for ARG seconds, once —
+#                   the router's least-loaded scoring must steer around
+#                   the growing queue, not blackhole behind it
+#   reload_corrupt  commit a NEWER checkpoint then bit-rot it: the
+#                   central reloader's verified keep-chain walk must
+#                   skip it and keep serving the previous step
+#   slow_replica    ARG seconds of extra latency per batch for the rest
+#                   of the run (a degraded-not-dead member: EWMA-based
+#                   routing shifts load, health checks keep it green)
+SERVE_MATRIX: dict[str, dict] = {
+    "replica_crash": {},
+    "replica_stall": {"arg": 0.3},
+    "reload_corrupt": {},
+    "slow_replica": {"arg": 0.05},
+}
+
+SERVE_INVARIANTS = (
+    "no_drops",        # zero dropped/failed requests while the
+                       # surviving capacity sufficed (every request
+                       # terminally served/expired/rejected-with-
+                       # retry — never silently lost)
+    "step_monotone",   # per-client served params_step never moves
+                       # backward across failover or hot-reload
+    "deadline",        # DeadlineExceeded only after the deadline
+                       # actually passed; no zombie expiries
+    "completed",       # clients all ran, traffic was served, the
+                       # router drained cleanly
+    "schema",          # every obs JSONL line validates (router.jsonl,
+                       # serve_r<id>.jsonl included)
+)
+
+
+def parse_serve_spec(spec: str) -> tuple:
+    """``KIND@T[:ARG]`` -> (kind, t_seconds, arg)."""
+    kind, sep, rest = spec.partition("@")
+    if not sep or kind not in SERVE_MATRIX:
+        raise ValueError(
+            f"serve fault spec {spec!r} must be KIND@T with kind in "
+            f"{sorted(SERVE_MATRIX)}"
+        )
+    t_s, sep2, arg_s = rest.partition(":")
+    arg = float(arg_s) if sep2 else SERVE_MATRIX[kind].get("arg")
+    return kind, float(t_s), arg
+
+
+def generate_serve_schedule(rng: random.Random, duration: float,
+                            max_faults: int) -> list[str]:
+    """One fuzzed serving schedule: 1..max_faults specs inside the load
+    window, with the training generator's composition pressure (~0.4
+    probability a fault lands on/next to the previous one's time — a
+    crash DURING a stall, a second crash inside the first restart's
+    backoff window)."""
+    n = rng.randint(1, max_faults)
+    schedule: list[str] = []
+    prev_t: Optional[float] = None
+    for _ in range(n):
+        kind = rng.choice(sorted(SERVE_MATRIX))
+        if prev_t is not None and rng.random() < 0.4:
+            t = min(0.8 * duration, prev_t + rng.choice((0.0, 0.1)))
+        else:
+            t = rng.uniform(0.15 * duration, 0.7 * duration)
+        t = round(t, 2)
+        prev_t = t
+        arg = SERVE_MATRIX[kind].get("arg")
+        schedule.append(f"{kind}@{t}" + (f":{arg}" if arg is not None
+                                         else ""))
+    return schedule
+
+
+@dataclass
+class ServeRunResult:
+    """Everything the serving oracle needs from one schedule's run."""
+
+    ledgers: list = field(default_factory=list)  # per-client entry dicts
+    router_stats: dict = field(default_factory=dict)
+    drained: bool = False
+    error: Optional[str] = None
+    obs_dir: str = ""
+
+
+def _serve_model():
+    from theanompi_tpu.models.mlp import MLP
+
+    return MLP(MLP.default_recipe().replace(
+        input_shape=(8, 8, 3), batch_size=8))
+
+
+def _degrade_engine(eng, seconds: float, once: bool) -> None:
+    """Wrap one engine's batch path with injected latency — the
+    chaos-side stand-in for a GC pause / noisy neighbor (`once`) or a
+    persistently slow host (not `once`)."""
+    orig = eng._serve_batch
+
+    def stalled(reqs):
+        if once:
+            eng._serve_batch = orig
+        time.sleep(seconds)
+        orig(reqs)
+
+    eng._serve_batch = stalled
+
+
+def run_serve_schedule(schedule: list[str], workdir: str, *,
+                       replicas: int = 2, duration: float = 2.0,
+                       clients: int = 4, mutate: Optional[str] = None,
+                       seed: int = 0) -> ServeRunResult:
+    """Run one serving schedule in-process: an N-replica Router under
+    closed-loop client load, the fault controller firing the schedule
+    at its T marks, and ALWAYS a good checkpoint committed mid-window
+    (hot-reload under load rides every schedule)."""
+    import jax
+
+    from theanompi_tpu.serve.engine import (
+        DeadlineExceeded, Rejected, ServeEngine,
+    )
+    from theanompi_tpu.serve.reload import CheckpointReloader
+    from theanompi_tpu.serve.router import RequestDropped, Router
+    from theanompi_tpu.train import init_train_state
+    from theanompi_tpu.utils.checkpoint import save_checkpoint
+    from theanompi_tpu.utils.faults import FaultInjector
+
+    os.makedirs(workdir, exist_ok=True)
+    res = ServeRunResult(obs_dir=os.path.join(workdir, "obs"))
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    os.makedirs(ckpt_dir, exist_ok=True)
+
+    model = _serve_model()
+    state = init_train_state(model, jax.random.PRNGKey(0))
+    ckpt_step = [1]
+
+    def _commit(corrupt: bool = False) -> None:
+        # step-dependent params so every swap is visible in served steps
+        ckpt_step[0] += 1
+        step = ckpt_step[0]
+        bumped = state._replace(params=jax.tree_util.tree_map(
+            lambda p: p + 0.01 * step, state.params))
+        save_checkpoint(ckpt_dir, bumped, step,
+                        rng=jax.random.PRNGKey(step), keep=10)
+        if corrupt:
+            FaultInjector.bitrot_newest(ckpt_dir)
+
+    save_checkpoint(ckpt_dir, state, 1, rng=jax.random.PRNGKey(1), keep=10)
+
+    def _member(rid):
+        eng = ServeEngine(
+            model, buckets=(1, 4), max_queue=256, obs_dir=res.obs_dir,
+            replica_id=rid, sink_name=f"serve_r{rid}.jsonl",
+        )
+        eng.load_initial(ckpt_dir)
+        eng.warmup()
+        eng.start()
+        return eng
+
+    router = Router(
+        _member, replicas, obs_dir=res.obs_dir, health_interval=0.05,
+        restart_base_s=0.05, restart_cap_s=0.4, seed=seed, mutate=mutate,
+    )
+    router.start()
+    reloader = CheckpointReloader(router, ckpt_dir, interval=0.1)
+
+    stop = threading.Event()
+    ledgers: list[list] = [[] for _ in range(clients)]
+
+    def _client(idx: int) -> None:
+        r = np.random.RandomState(1000 + idx)
+        shape = tuple(model.recipe.input_shape)
+        x = r.randn(*shape).astype(np.float32)
+        i = 0
+        while not stop.is_set():
+            # every 4th request carries a (generous) deadline so the
+            # deadline invariant exercises the expiry path under faults
+            deadline = 2000.0 if i % 4 == 0 else None
+            entry: dict = {"deadline_ms": deadline}
+            t0 = time.perf_counter()
+            try:
+                out = router.infer(x, deadline_ms=deadline, timeout=30.0)
+                entry.update(status="served", step=int(out.step))
+            except DeadlineExceeded:
+                entry["status"] = "expired"
+            except RequestDropped as e:
+                entry.update(status="dropped", error=repr(e))
+            except Rejected as e:
+                entry.update(status="rejected",
+                             error=type(e).__name__)
+            except Exception as e:  # noqa: BLE001 — oracle evidence
+                entry.update(status="failed", error=repr(e))
+            entry["ms"] = round(1000.0 * (time.perf_counter() - t0), 3)
+            ledgers[idx].append(entry)
+            i += 1
+            if entry["status"] == "rejected":
+                time.sleep(0.01)  # honor retry-after in spirit
+
+    def _fire(kind: str, arg: Optional[float]) -> None:
+        if kind == "replica_crash":
+            # kill the BUSIEST healthy member (deepest queue, ties to
+            # the lowest id): the harshest realistic crash — it is the
+            # replica actually holding in-flight work, so the failover
+            # re-admission path is exercised every time instead of by
+            # scheduling luck
+            healthy = [rep for rep in router._replicas
+                       if rep.state == "healthy" and rep.engine is not None]
+            if healthy:
+                victim = max(healthy,
+                             key=lambda rep: (rep.engine.queue_depth,
+                                              -rep.replica_id))
+                router.kill_replica(victim.replica_id)
+        elif kind in ("replica_stall", "slow_replica"):
+            rep = next((rep for rep in router._replicas
+                        if rep.state == "healthy"
+                        and rep.engine is not None), None)
+            if rep is not None:
+                _degrade_engine(rep.engine,
+                                arg or SERVE_MATRIX[kind]["arg"],
+                                once=(kind == "replica_stall"))
+        elif kind == "reload_corrupt":
+            _commit(corrupt=True)
+            reloader.poll_once()  # force the load attempt NOW (it is
+            # absorbed — serving keeps the current params); waiting on
+            # the background poller leaves the exercise to timing luck
+        elif kind == "good_reload":
+            _commit(corrupt=False)
+            # land the swap at the event mark: this IS the
+            # reload-under-load composition, deterministically timed —
+            # the background poller still runs for extra churn, but on
+            # a loaded box its first poll can start after the window
+            reloader.poll_once()
+
+    events = [parse_serve_spec(s) for s in schedule]
+    # hot-reload-under-load rides EVERY schedule: a good checkpoint
+    # lands mid-window, so faults compose with a live swap
+    events.append(("good_reload", round(duration * 0.5, 2), None))
+    events.sort(key=lambda e: e[1])
+
+    def _controller() -> None:
+        t_start = time.perf_counter()
+        for kind, t, arg in events:
+            wait = t - (time.perf_counter() - t_start)
+            if wait > 0 and stop.wait(wait):
+                return
+            try:
+                _fire(kind, arg)
+            except Exception as e:  # noqa: BLE001 — runner bug, not
+                # a finding: surface it as a run error
+                res.error = f"fault controller: {e!r}"
+                return
+
+    threads = [threading.Thread(target=_client, args=(i,), daemon=True)
+               for i in range(clients)]
+    ctrl = threading.Thread(target=_controller, daemon=True)
+    threads.append(ctrl)
+    try:
+        reloader.start()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        # the window closes `duration` after start OR 0.3 s after the
+        # LAST scheduled event fired, whichever is later: on a loaded
+        # box the controller's event marks slip, and closing on wall
+        # time alone can cut the window before the composed
+        # reload-under-load ever gets a post-swap request
+        ctrl.join(timeout=2.0 * duration + 30.0)
+        time.sleep(max(duration - (time.perf_counter() - t0), 0.3))
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=60.0)
+        if any(t.is_alive() for t in threads):
+            res.error = res.error or "client/controller thread hung"
+        reloader.stop()
+        res.drained = router.drain(timeout=30.0)
+    res.router_stats = router.stats()
+    res.ledgers = ledgers
+    return res
+
+
+def check_serve_invariants(schedule: list[str],
+                           res: ServeRunResult) -> list[str]:
+    """The serving oracle: names of every violated invariant (empty =
+    the schedule was absorbed). See :data:`SERVE_INVARIANTS`."""
+    viol: list[str] = []
+    entries = [e for ledger in res.ledgers for e in ledger]
+    served = [e for e in entries if e["status"] == "served"]
+
+    if (res.error is not None or not res.drained or not served
+            or any(not ledger for ledger in res.ledgers)):
+        viol.append("completed")
+
+    # zero silent loss while capacity sufficed: the schedules this
+    # campaign generates always leave the supervisor able to restore
+    # capacity (factory restarts succeed), so ANY dropped/failed
+    # request is a violation — counted both from the client ledgers
+    # and the router's own counter (they must agree in kind)
+    dropped = res.router_stats.get("tmpi_router_dropped_total", 0.0)
+    if dropped > 0 or any(e["status"] in ("dropped", "failed")
+                          for e in entries):
+        viol.append("no_drops")
+
+    for ledger in res.ledgers:
+        steps = [e["step"] for e in ledger if e["status"] == "served"]
+        if any(b < a for a, b in zip(steps, steps[1:])):
+            viol.append("step_monotone")
+            break
+
+    for e in entries:
+        d = e.get("deadline_ms")
+        if e["status"] == "expired" and (d is None or e["ms"] < d - 50.0):
+            viol.append("deadline")  # expired before its deadline
+            break
+        if e["status"] == "served" and d is not None and e["ms"] > d + 1500.0:
+            viol.append("deadline")  # served long past its deadline
+            break
+
+    viol.extend(_schema_violations(res.obs_dir))
+    return viol
+
+
+def shrink_serve_schedule(schedule: list[str], workdir: str, *,
+                          replicas: int, duration: float, clients: int,
+                          mutate: Optional[str], seed: int,
+                          max_runs: int = 16) -> tuple[list[str], int]:
+    """Greedy delta-debugging over a failing serving schedule — same
+    fixed-point loop as the training shrink."""
+    current = list(schedule)
+    runs = 0
+    changed = True
+    while changed and len(current) > 1 and runs < max_runs:
+        changed = False
+        for i in range(len(current)):
+            cand = current[:i] + current[i + 1:]
+            wd = os.path.join(workdir, f"shrink{runs}")
+            runs += 1
+            r = run_serve_schedule(cand, wd, replicas=replicas,
+                                   duration=duration, clients=clients,
+                                   mutate=mutate, seed=seed)
+            if check_serve_invariants(cand, r):
+                current = cand
+                changed = True
+                break
+            if runs >= max_runs:
+                break
+    return current, runs
+
+
+def run_serve_campaign(args: argparse.Namespace) -> dict:
+    out_dir = os.path.abspath(args.out)
+    os.makedirs(out_dir, exist_ok=True)
+    chaos_log = os.path.join(out_dir, "chaos.jsonl")
+    config_name = f"serve_{args.replicas}r"
+
+    if args.schedule:
+        for s in args.schedule.split("+"):
+            parse_serve_spec(s)  # fail fast on a bad directed spec
+        plans = [(args.seed, args.schedule.split("+"))]
+    else:
+        plans = []
+        for i in range(args.seeds):
+            seed = args.seed + i
+            rng = random.Random(seed * 100003 + 29)
+            plans.append((seed, generate_serve_schedule(
+                rng, args.serve_duration, args.max_faults)))
+
+    t_start = time.perf_counter()
+    # no parity baseline on the serving path; the bucket stays for the
+    # summary line's shared format
+    timings = {"baseline": 0.0, "runs": 0.0, "shrink": 0.0}
+    results = []
+    n_bad = 0
+    with open(chaos_log, "a") as log_f:
+        for seed, schedule in plans:
+            wd = os.path.join(out_dir, f"serve_seed{seed}")
+            t0 = time.perf_counter()
+            res = run_serve_schedule(
+                schedule, wd, replicas=args.replicas,
+                duration=args.serve_duration, clients=args.serve_clients,
+                mutate=args.mutate, seed=seed)
+            viol = check_serve_invariants(schedule, res)
+            timings["runs"] += time.perf_counter() - t0
+            rec = {
+                "kind": "chaos", "t": time.time(), "seed": int(seed),
+                "config": config_name, "schedule": "+".join(schedule),
+                "ok": not viol, "violations": ",".join(viol),
+                "runs": 1,
+                "seconds": round(time.perf_counter() - t0, 3),
+            }
+            if viol:
+                n_bad += 1
+                t0 = time.perf_counter()
+                minimal, shrink_runs = shrink_serve_schedule(
+                    schedule, wd, replicas=args.replicas,
+                    duration=args.serve_duration,
+                    clients=args.serve_clients, mutate=args.mutate,
+                    seed=seed)
+                timings["shrink"] += time.perf_counter() - t0
+                rec["shrunk_schedule"] = "+".join(minimal)
+                rec["repro"] = (f"--serve --schedule "
+                                f"{'+'.join(minimal)}")
+                rec["runs"] = rec["runs"] + shrink_runs
+                print(f"[chaos] serve seed {seed} VIOLATED {viol} by "
+                      f"{'+'.join(schedule)}; minimal repro: "
+                      f"{rec['repro']}", flush=True)
+                if res.error:
+                    print(f"[chaos]   run error: {res.error[:400]}",
+                          flush=True)
+            else:
+                n_served = sum(
+                    1 for ledger in res.ledgers for e in ledger
+                    if e["status"] == "served")
+                print(f"[chaos] serve seed {seed} ok: "
+                      f"{'+'.join(schedule)} absorbed "
+                      f"({n_served} served, "
+                      f"{int(res.router_stats.get('tmpi_router_failovers_total', 0))}"
+                      f" failovers)", flush=True)
+            log_f.write(json.dumps(rec) + "\n")
+            log_f.flush()
+            results.append(rec)
+
+    timings["total"] = time.perf_counter() - t_start
+    report = {
+        "schedules": len(results),
+        "ok": len(results) - n_bad,
+        "violated": n_bad,
+        "kinds": sorted(SERVE_MATRIX),
+        "configs": [config_name],
+        "mutate": args.mutate,
+        "results": results,
+        "timings_s": {k: round(v, 3) for k, v in timings.items()},
+        "out": out_dir,
+    }
+    with open(os.path.join(out_dir, "report.json"), "w") as f:
+        json.dump(report, f, indent=2)
+    return report
+
+
+# ---------------------------------------------------------------------------
 # campaign driver
 # ---------------------------------------------------------------------------
 
@@ -805,11 +1263,25 @@ def chaos_main(argv: Optional[list] = None) -> int:
                          "(bsp_none,bsp_int8ef,zero1_none,zero1_int8ef)")
     ap.add_argument("--max-faults", type=int, default=3,
                     help="max faults per fuzzed schedule")
-    ap.add_argument("--mutate", choices=["refeed"], default=None,
+    ap.add_argument("--mutate", choices=["refeed", "drop_inflight"],
+                    default=None,
                     help="arm a deliberately seeded recovery bug "
                          "(oracle self-test): 'refeed' re-feeds one "
-                         "consumed batch on mid-epoch resume — the "
-                         "campaign must catch and shrink it")
+                         "consumed batch on mid-epoch resume; "
+                         "'drop_inflight' (--serve only) drops an "
+                         "in-flight request on replica death instead "
+                         "of re-admitting it — the campaign must "
+                         "catch and shrink it")
+    ap.add_argument("--serve", action="store_true",
+                    help="chaos the SERVING path instead of training: "
+                         "fuzzed SERVE_MATRIX schedules against an "
+                         "N-replica router under client load")
+    ap.add_argument("--replicas", type=int, default=2, metavar="N",
+                    help="--serve: replica-group size")
+    ap.add_argument("--serve-duration", type=float, default=2.0,
+                    help="--serve: load-window seconds per schedule")
+    ap.add_argument("--serve-clients", type=int, default=4,
+                    help="--serve: closed-loop client threads")
     ap.add_argument("--out", default="chaos_out",
                     help="campaign output dir (chaos.jsonl, report.json, "
                          "per-seed work dirs)")
@@ -819,12 +1291,20 @@ def chaos_main(argv: Optional[list] = None) -> int:
                     help="print the full JSON report to stdout")
     args = ap.parse_args(argv)
 
+    if args.mutate == "drop_inflight" and not args.serve:
+        raise SystemExit("--mutate drop_inflight needs --serve (it is "
+                         "a router bug, not a training one)")
+    if args.mutate == "refeed" and args.serve:
+        raise SystemExit("--mutate refeed is a training-resume bug; "
+                         "--serve wants drop_inflight")
+
     from theanompi_tpu.tools.lint import _ensure_virtual_devices
 
     _ensure_virtual_devices()
 
     try:
-        report = run_campaign(args)
+        report = run_serve_campaign(args) if args.serve \
+            else run_campaign(args)
     except SystemExit:
         raise
     except Exception as e:  # noqa: BLE001 — rc 2 = runner bug, not a finding

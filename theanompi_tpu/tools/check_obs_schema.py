@@ -373,6 +373,35 @@ SCHEMAS: dict[str, dict[str, tuple[tuple, bool]]] = {
         "t": (_NUM, True),
         "params_step": ((int,), True),
         "metrics": ((dict,), True),
+        # replica-group members (`tmpi serve --replicas N`) stamp which
+        # member wrote the record (serve_r<id>.jsonl); absent on the
+        # classic single-engine path (byte-compatible)
+        "replica_id": ((int,), False),
+    },
+    # replica-group router (serve/router.py): one record per routing
+    # event in <obs_dir>/router.jsonl. `event` says which: "health"
+    # (replica state transition, from_state/to_state), "failover" (an
+    # in-flight request re-admitted off a dying replica, to_replica),
+    # "restart" (supervisor revived a member, backoff_s is the
+    # decorrelated-jitter delay it waited), "drop" (failover budget or
+    # capacity exhausted — the oracle's zero-drop invariant greps
+    # these), "reload"/"reload_failed" (central hot-reload fan-out),
+    # and "snapshot" (drain-time stats; `metrics` keys carry the
+    # tmpi_router_ prefix, ENFORCED below like serve's).
+    "router": {
+        "t": (_NUM, True),
+        "event": ((str,), True),
+        "replica_id": ((int,), False),
+        "from_state": ((str,), False),
+        "to_state": ((str,), False),
+        "to_replica": ((int,), False),
+        "backoff_s": (_NUM, False),
+        "from_step": ((int,), False),
+        "to_step": ((int,), False),
+        "ms": (_NUM, False),
+        "ok": ((bool,), False),
+        "error": ((str,), False),
+        "metrics": ((dict,), False),
     },
     # one record per checkpoint hot-reload applied by the serving
     # engine (serve/reload.py): the step served before, the verified
@@ -451,6 +480,27 @@ SCHEMAS: dict[str, dict[str, tuple[tuple, bool]]] = {
 #   tmpi_serve_batches_total     counter    by bucket=N
 #   tmpi_serve_reloads_total     counter    hot-reloads applied
 SERVE_METRIC_PREFIX = "tmpi_serve_"
+
+# the router metric name family (serve/router.py; kind=router snapshot
+# records may only carry these-prefixed keys — enforced below, same
+# deal as SERVE_METRIC_PREFIX). Counters are fleet totals; gauges are
+# refreshed by the supervisor's health pass:
+#   tmpi_router_requests_total  counter  by status=served|dropped|
+#                                        rejected|expired|stale_retry|
+#                                        stale_served
+#   tmpi_router_failovers_total counter  in-flight re-admissions that
+#                                        landed on a healthy replica
+#   tmpi_router_restarts_total  counter  supervisor revivals (+ by
+#                                        status=failed for factory
+#                                        errors, retried with backoff)
+#   tmpi_router_reloads_total   counter  central hot-reload fan-outs
+#   tmpi_router_healthy         gauge    replicas in rotation
+#   tmpi_router_replicas        gauge    configured group size
+#   tmpi_router_queue_depth     gauge    fleet backlog (sum of members)
+#   tmpi_router_capacity_rps    gauge    surviving-capacity EWMA (the
+#                                        503 Retry-After denominator)
+#   tmpi_router_step_floor      gauge    served-step monotone floor
+ROUTER_METRIC_PREFIX = "tmpi_router_"
 
 # the step-attribution gauge family (obs/attribution.py; set live at
 # every dispatcher drain sync, documented here next to its record kind —
@@ -560,6 +610,14 @@ def validate_record(obj: Any) -> list[str]:
                     errs.append(
                         f"serve.metrics key {k!r} lacks the "
                         f"{SERVE_METRIC_PREFIX!r} prefix"
+                    )
+        elif kind == "router" and isinstance(obj.get("metrics"), dict):
+            errs += _check_numeric_map(obj["metrics"], "metrics")
+            for k in obj["metrics"]:
+                if isinstance(k, str) and not k.startswith(ROUTER_METRIC_PREFIX):
+                    errs.append(
+                        f"router.metrics key {k!r} lacks the "
+                        f"{ROUTER_METRIC_PREFIX!r} prefix"
                     )
         elif kind == "profile":
             errs += _check_numeric_map(obj["fractions"], "fractions")

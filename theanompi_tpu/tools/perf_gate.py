@@ -77,7 +77,10 @@ GATE_METRICS = ("mfu", "host_blocked_frac", "compression_ratio",
                 "hbm_gbps", "preflight_peak_bytes",
                 "ici_bytes_per_step", "dcn_bytes_per_step",
                 "model_err_cost", "model_err_traffic",
-                "model_err_memory")
+                "model_err_memory",
+                # serving-fleet invariants (bench.py --serve-bench
+                # --replicas N; committed baseline under experiments/)
+                "serve_p99_ms", "serve_goodput_rps")
 
 
 def _num(v) -> Optional[float]:

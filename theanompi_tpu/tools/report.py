@@ -53,7 +53,7 @@ from theanompi_tpu.obs.fleet import FleetTailer, fleet_topology
 # run's report readable
 NOTABLE_KINDS = (
     "anomaly", "retry", "reshard", "rollback", "scrub", "stall",
-    "drift", "topology", "preflight", "reload", "shard",
+    "drift", "topology", "preflight", "reload", "shard", "router",
 )
 # kinds a subsequent retry adopts as its cause chain (scrub only when
 # it actually found corruption; drift only when it breached tolerance)
@@ -163,6 +163,25 @@ def _describe(ev: dict) -> str:
         return f"preflight peak {r.get('peak_bytes')} bytes"
     if kind == "reload":
         return f"serve hot-reload step {r.get('from_step')}→{r.get('to_step')}"
+    if kind == "router":
+        name, rid = r.get("event"), r.get("replica_id")
+        if name == "health":
+            msg = (f"replica {rid} {r.get('from_state')}→"
+                   f"{r.get('to_state')}")
+            return msg + (f": {r['error']}" if r.get("error") else "")
+        if name == "failover":
+            return (f"failover: in-flight request re-admitted from "
+                    f"replica {rid} to replica {r.get('to_replica')}")
+        if name == "restart":
+            return (f"replica {rid} restarted after "
+                    f"{r.get('backoff_s')}s backoff (replica lost, "
+                    "traffic absorbed by survivors)")
+        if name == "restart_failed":
+            return f"replica {rid} restart FAILED: {r.get('error')}"
+        if name == "drop":
+            return (f"request DROPPED on replica {rid}: "
+                    f"{r.get('error')}")
+        return f"router {name}"
     if kind == "shard":
         return f"sharding lint: {r.get('verdict', r.get('status', 'ran'))}"
     return kind
@@ -179,13 +198,30 @@ def _is_adoptable(ev: dict) -> bool:
     return True
 
 
+def _is_router_adoptable(ev: dict) -> bool:
+    """Serving events a later replica RESTART adopts as its cause
+    chain: the health transition that took the member down, the
+    failovers that re-homed its in-flight requests, any dropped
+    request, and failed restart attempts along the way."""
+    if ev["kind"] != "router":
+        return False
+    name = ev["rec"].get("event")
+    if name == "health":
+        return ev["rec"].get("to_state") == "down"
+    return name in ("failover", "drop", "restart_failed")
+
+
 def _group_incidents(events: list) -> list:
     """Causal grouping: walking the merged timeline in order, adoptable
     events accumulate as pending evidence; the next ``retry`` record
     adopts ALL of them as its cause chain (the crash/anomaly/reshard
-    that preceded a restart explains it). Pending events that no retry
-    ever claims become standalone incidents — real, just not fatal."""
-    incidents, pending = [], []
+    that preceded a restart explains it). Serving events group the same
+    way on their own track: a router ``restart`` adopts the crash /
+    failover / drop records that preceded it (training evidence never
+    crosses into a serving incident or vice versa). Pending events that
+    no adopter ever claims become standalone incidents — real, just
+    not fatal."""
+    incidents, pending, pending_serve = [], [], []
     for ev in events:
         if ev["kind"] == "retry":
             incidents.append({
@@ -201,9 +237,28 @@ def _group_incidents(events: list) -> list:
                 ],
             })
             pending = []
+        elif (ev["kind"] == "router"
+              and ev["rec"].get("event") == "restart"):
+            incidents.append({
+                "kind": "replica_restart",
+                "t": ev["t"],
+                "rank": ev["rank"],
+                "step": ev["rec"].get("step"),
+                "what": _describe(ev),
+                "src": ev["src"],
+                "evidence": [
+                    {"src": p["src"], "kind": p["kind"],
+                     "what": _describe(p)} for p in pending_serve
+                ],
+            })
+            pending_serve = []
         elif _is_adoptable(ev):
             pending.append(ev)
-    for ev in pending:
+        elif _is_router_adoptable(ev):
+            pending_serve.append(ev)
+    leftovers = sorted(pending + pending_serve,
+                       key=lambda e: (e["t"], e["rank"], e["src"]))
+    for ev in leftovers:
         incidents.append({
             "kind": ev["kind"],
             "t": ev["t"],
@@ -306,7 +361,11 @@ def _verdict(events: list, incidents: list, drift: dict,
     """``(verdict, evidence_lines)``. Halted beats degraded beats
     completed; every verdict cites the record lines that forced it. A
     halt-policy anomaly adopted by a later retry does NOT halt the run
-    — the retry proves the supervisor recovered past it."""
+    — the retry proves the supervisor recovered past it. On the
+    serving side the line runs between "degraded (replica lost,
+    traffic absorbed)" — crash/failover/restart records with zero
+    drops — and "halted": ANY dropped request is a broken serving
+    contract, even though the fleet kept running."""
     evidence = []
     adopted = {e["src"] for inc in incidents for e in inc["evidence"]}
     for ev in events:
@@ -315,6 +374,12 @@ def _verdict(events: list, incidents: list, drift: dict,
         elif (ev["kind"] == "anomaly"
               and ev["rec"].get("policy") == "halt"
               and ev["src"] not in adopted):
+            evidence.append(f"{ev['src']} — {_describe(ev)}")
+        elif (ev["kind"] == "router"
+              and ev["rec"].get("event") == "drop"):
+            # a dropped request is a halt-class violation whether or
+            # not a restart later adopted it as evidence: the request
+            # is gone either way
             evidence.append(f"{ev['src']} — {_describe(ev)}")
     if evidence:
         return "halted", evidence
