@@ -25,6 +25,12 @@ line is ONE JSON object {"metric", "value", "unit", "vs_baseline", ...}):
                                    #   dynamic micro-batching inference
                                    #   engine (serve/) — sustained req/s,
                                    #   p50/p99 latency, batch-fill
+  python bench.py --decode-bench   # LM token serving: open-loop Poisson
+                                   #   prompts over the continuous-
+                                   #   batching decode engine
+                                   #   (serve/decode/) — tokens/sec,
+                                   #   p50/p99 TTFT, TPOT, and the
+                                   #   continuous-vs-static ratio
   python bench.py --bucket-sweep   # bucketed-allreduce sweep (bucket
                                    #   size x engine variant); compute
                                    #   mode also takes --fused-update /
@@ -828,6 +834,162 @@ def bench_serve_fleet(duration_s: float = 4.0, replicas: int = 2,
         }
 
 
+def bench_decode(duration_s: float = 3.0, seed: int = 0,
+                 prefill_buckets=(4, 8), page_size: int = 4,
+                 max_seqs: int = 4, max_new_tokens: int = 12,
+                 rate_rps: float = 100.0) -> dict:
+    """LM token-serving benchmark over the continuous-batching decode
+    engine (serve/decode/, ISSUE 20): one mixed workload — prompt
+    lengths uniform over ``1..max(prefill_buckets)+1`` (every prefill
+    bucket plus the prefill-free single-token path), output budgets
+    uniform over ``1..max_new_tokens`` — measured two ways:
+
+    1. **saturating burst** — all requests offered back-to-back, run
+       once through a ``mode="continuous"`` engine and once through a
+       ``mode="static"`` engine (admit only into an empty batch, run it
+       to completion — the classic static-batching strawman). Sustained
+       tokens/sec each; ``continuous_vs_static`` is the ratio the
+       acceptance bar wants > 1: with mixed budgets the static batch
+       convoys on its longest member while continuous refills freed
+       slots every iteration.
+    2. **open-loop Poisson window** — arrivals at a FIXED ``rate_rps``
+       against a fresh continuous engine; latency is engine-measured
+       submit->first-token, so queueing delay counts. Reports
+       ``decode_p50_ttft_ms``/``decode_p99_ttft_ms`` (the perf_gate
+       invariant) and TPOT. The rate is fixed rather than derived from
+       the burst measurement on purpose: a derived rate couples the
+       TTFT operating point to burst wall-clock jitter and the p99
+       stops being gate-stable (re-baseline with ``--decode-rate``
+       when the host class changes, like every experiments/ snapshot).
+
+    Runs on JAX_PLATFORMS=cpu over a real checkpoint round-trip
+    (save -> verified load -> AOT warmup -> serve) like every serve
+    bench; the tiny-LM geometry keeps the three engines' compile cost
+    (len(prefill_buckets)+1 programs each) in CI range."""
+    import tempfile
+
+    import jax
+
+    from theanompi_tpu.models.zoo import zoo_entry
+    from theanompi_tpu.serve.decode import DecodeEngine
+    from theanompi_tpu.train import init_train_state
+    from theanompi_tpu.utils.checkpoint import save_checkpoint
+
+    buckets = tuple(prefill_buckets)
+    cls, _ = zoo_entry("transformer_lm")
+    model = cls(cls.default_recipe().replace(
+        input_shape=(64,), num_classes=64, d_model=32, n_heads=2,
+        n_layers=2, d_ff=64, attn="ring", batch_size=max_seqs,
+    ))
+    rng = np.random.RandomState(seed)
+    vocab = int(model.recipe.num_classes)
+    top = buckets[-1] + 1
+
+    def make_workload(n: int):
+        """(prompt, budget) pairs — same RNG stream per phase seed."""
+        r = np.random.RandomState(seed + n)
+        return [
+            (r.randint(0, vocab, size=r.randint(1, top + 1),
+                       dtype=np.int32),
+             int(r.randint(1, max_new_tokens + 1)))
+            for _ in range(n)
+        ]
+
+    with tempfile.TemporaryDirectory(prefix="tmpi_decode_bench_") as d:
+        state = init_train_state(model, jax.random.PRNGKey(0))
+        save_checkpoint(d, state, 1, rng=jax.random.PRNGKey(1))
+        compiled = []
+
+        def make_engine(mode: str) -> DecodeEngine:
+            eng = DecodeEngine(
+                model, prefill_buckets=buckets, page_size=page_size,
+                kv_pages=4 * max_seqs * ((top + max_new_tokens)
+                                         // page_size + 1),
+                max_seqs=max_seqs, max_new_tokens=max_new_tokens,
+                max_queue=4096, mode=mode, seed=seed,
+            )
+            eng.load_initial(d)
+            compiled.append(eng.warmup())
+            eng.start()
+            return eng
+
+        def burst(mode: str, work):
+            """Offer the whole workload at once; sustained tokens/s
+            plus the engine's iteration count (DETERMINISTIC for a
+            fixed workload — the structural continuous-vs-static gap
+            survives wall-clock jitter)."""
+            eng = make_engine(mode)
+            t0 = time.perf_counter()
+            futs = [eng.submit(p, max_new_tokens=b) for p, b in work]
+            toks = sum(len(f.result(timeout=600.0).tokens) for f in futs)
+            tps = toks / (time.perf_counter() - t0)
+            iters = eng.stats()["tmpi_decode_iterations_total"]
+            eng.drain(timeout=30.0)
+            if toks != sum(b for _, b in work):
+                raise RuntimeError(
+                    f"decode burst ({mode}) lost tokens: got {toks}")
+            return tps, int(iters)
+
+        n_burst = 40 * max_seqs
+        work = make_workload(n_burst)
+        cont_tps, cont_iters = burst("continuous", work)
+        static_tps, static_iters = burst("static", work)
+
+        # open-loop TTFT window at the fixed offered rate (~0.25x this
+        # host class's continuous capacity at the defaults): loaded
+        # enough that batching engages, light enough that p99 measures
+        # the engine's iteration time rather than saturation queueing
+        lam = max(1.0, float(rate_rps))
+        arrivals, t = [], rng.exponential(1.0 / lam)
+        while t < duration_s:
+            arrivals.append(t)
+            t += rng.exponential(1.0 / lam)
+        if not arrivals:
+            raise RuntimeError(
+                "decode bench scheduled zero arrivals — raise "
+                "--serve-duration")
+        poisson_work = make_workload(len(arrivals))
+        eng = make_engine("continuous")
+        futs = []
+        start = time.perf_counter()
+        for sched, (p, b) in zip(arrivals, poisson_work):
+            lag = sched - (time.perf_counter() - start)
+            if lag > 0:
+                time.sleep(lag)
+            futs.append(eng.submit(p, max_new_tokens=b))
+        for f in futs:
+            f.result(timeout=600.0)
+        elapsed = time.perf_counter() - start
+        eng.drain(timeout=30.0)
+        stats = eng.stats()
+
+        return {
+            "metric": "decode_tokens_per_sec",
+            "value": round(cont_tps, 1),
+            "unit": "tokens/sec",
+            "vs_baseline": None,  # no token serving existed before
+            "decode_tokens_per_sec": round(cont_tps, 1),
+            "decode_p50_ttft_ms": stats.get("tmpi_decode_ttft_p50_ms"),
+            "decode_p99_ttft_ms": stats.get("tmpi_decode_ttft_p99_ms"),
+            "decode_tpot_ms": stats.get("tmpi_decode_tpot_ms"),
+            "static_tokens_per_sec": round(static_tps, 1),
+            "continuous_vs_static": round(cont_tps / static_tps, 4),
+            # deterministic companions to the wall-clock ratio: decode
+            # iterations each mode needed for the SAME workload
+            "continuous_iterations": cont_iters,
+            "static_iterations": static_iters,
+            "offered_rps": round(lam, 2),
+            "poisson_requests": len(arrivals),
+            "burst_requests": n_burst,
+            "max_seqs": max_seqs,
+            "max_new_tokens": max_new_tokens,
+            "prefill_buckets": ",".join(str(b) for b in buckets),
+            "compiled_programs": compiled[0] if compiled else 0,
+            "duration_s": round(elapsed, 3),
+            "device_kind": jax.devices()[0].device_kind,
+        }
+
+
 def bench_codec_sweep(engines=("bsp", "zero1", "easgd", "gosgd", "nd"),
                       codecs=("none", "bf16", "int8", "int8:ef"),
                       max_steps: int = 6) -> dict:
@@ -1433,6 +1595,20 @@ def main() -> int:
                          "sustained req/s + p50/p99 latency + batch-"
                          "fill over a real checkpoint round-trip "
                          "(overrides --mode)")
+    ap.add_argument("--decode-bench", action="store_true",
+                    help="LM token-serving benchmark over the "
+                         "continuous-batching decode engine "
+                         "(serve/decode/): sustained tokens/sec and "
+                         "continuous-vs-static ratio under a "
+                         "saturating mixed-length burst, plus p50/p99 "
+                         "TTFT and TPOT under open-loop Poisson "
+                         "arrivals (overrides --mode; baseline under "
+                         "experiments/decode_bench/)")
+    ap.add_argument("--decode-rate", type=float, default=100.0,
+                    help="decode bench: fixed open-loop Poisson offered "
+                         "rate (requests/sec) for the TTFT window; "
+                         "re-baseline with a rate ~0.25x the host's "
+                         "burst capacity when the CI host class changes")
     ap.add_argument("--serve-duration", type=float, default=2.0,
                     help="serve bench: closed-loop load window seconds")
     ap.add_argument("--serve-clients", type=int, default=8,
@@ -1468,6 +1644,9 @@ def main() -> int:
             bucket_mbs=tuple(float(b) for b in args.bucket_sizes.split(",")),
             max_steps=args.steps or 6,
         )
+    elif args.decode_bench:
+        result = bench_decode(duration_s=args.serve_duration,
+                              rate_rps=args.decode_rate)
     elif args.serve_bench:
         if args.replicas > 1:
             result = bench_serve_fleet(

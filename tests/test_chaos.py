@@ -304,3 +304,71 @@ def test_serve_mutation_drop_inflight_caught_and_shrunk(tmp_path):
     assert "replica_crash@0.4" in minimal
     assert len(minimal) <= 2
     assert runs >= 1
+
+
+# --------------------------------------------------------------------------
+# decode-fleet chaos (`tmpi chaos --serve --decode`, ISSUE 20): the
+# DECODE_MATRIX generator, the directed kv_exhaust + long_prompt_burst
+# composition over continuous-batching engines, and the kv_conserved
+# oracle's self-test
+# --------------------------------------------------------------------------
+
+
+def test_generate_decode_schedule_uses_decode_matrix():
+    import random
+
+    from theanompi_tpu.tools.chaos import (
+        DECODE_MATRIX,
+        generate_serve_schedule,
+        parse_serve_spec,
+    )
+
+    a = generate_serve_schedule(random.Random(7), 2.0, 2, DECODE_MATRIX)
+    assert a == generate_serve_schedule(random.Random(7), 2.0, 2,
+                                        DECODE_MATRIX)
+    drawn: set = set()
+    for seed in range(50):
+        for spec in generate_serve_schedule(random.Random(seed), 2.0, 2,
+                                            DECODE_MATRIX):
+            kind, t, arg = parse_serve_spec(spec, DECODE_MATRIX)
+            assert kind in DECODE_MATRIX
+            assert 0.0 < t <= 0.8 * 2.0
+            drawn.add(kind)
+    # 50 seeds reliably draw the decode-only kinds at least once
+    assert {"kv_exhaust", "long_prompt_burst"} <= drawn
+    # default hold rides the matrix: kv_exhaust grabs pages for 0.5 s
+    assert parse_serve_spec("kv_exhaust@0.4", DECODE_MATRIX)[2] == 0.5
+    # decode-only kinds don't parse against the eval-serving matrix...
+    with pytest.raises(ValueError, match="must be KIND@T"):
+        parse_serve_spec("kv_exhaust@0.4")
+    # ...and slow_replica is deliberately absent from the decode one
+    with pytest.raises(ValueError, match="must be KIND@T"):
+        parse_serve_spec("slow_replica@0.4:0.05", DECODE_MATRIX)
+
+
+def test_decode_directed_kv_exhaust_and_burst_absorbed(tmp_path):
+    """Directed acceptance for the decode fleet: KV-page exhaustion on
+    one member composed with a worst-case long-prompt burst and the
+    always-on hot-reload-mid-generation — absorbed with zero drops,
+    generated tokens still flowing, and every member's KV free-list
+    conserved after drain. Flipping the conservation bit proves the
+    kv_conserved oracle actually fires (self-test)."""
+    from theanompi_tpu.tools.chaos import (
+        check_serve_invariants,
+        run_serve_schedule,
+    )
+
+    schedule = ["kv_exhaust@0.3:0.4", "long_prompt_burst@0.5"]
+    res = run_serve_schedule(schedule, str(tmp_path), replicas=2,
+                             duration=1.5, clients=3, seed=1,
+                             decode=True)
+    assert check_serve_invariants(schedule, res) == []
+    assert res.kv_conserved is True
+    assert res.router_stats["tmpi_router_dropped_total"] == 0.0
+    served = [e for ledger in res.ledgers for e in ledger
+              if e["status"] == "served"]
+    assert served
+    # a leaked KV page (pages_out != pages_in after drain) must be a
+    # violation, not a shrug — the page-table equivalent of no_drops
+    res.kv_conserved = False
+    assert "kv_conserved" in check_serve_invariants(schedule, res)

@@ -6,9 +6,11 @@ dispatch pipeline removed."""
 import pytest
 
 from theanompi_tpu.tools.check_hot_loop import (
+    DECODE_PATH,
     PROFILE_PATH,
     SERVE_PATH,
     WORKER_PATH,
+    check_decode_source,
     check_profile_source,
     check_serve_source,
     check_source,
@@ -138,6 +140,8 @@ def test_default_cli_covers_worker_and_serve(capsys):
     out = capsys.readouterr().out
     assert "worker.py" in out and "engine.py" in out
     assert "profile.py" in out  # ISSUE 12 satellite: HOT003 coverage
+    # ISSUE 20 satellite: HOT004 covers the decode engine by default
+    assert "decode" in out
 
 
 # --------------------------------------------------------------------------
@@ -219,3 +223,70 @@ def test_profile_anchor_guard():
     with pytest.raises(ValueError, match="warm-step loops"):
         check_profile_source(
             "def run_profile():\n    def one_step():\n        pass\n")
+
+
+# --------------------------------------------------------------------------
+# continuous-batching decode hot loop (HOT004, ISSUE 20 satellite) —
+# ONE host drain per iteration: _iteration's top-level np.asarray on
+# the fused next-token vector. Mutation-tested like the others.
+# --------------------------------------------------------------------------
+
+_DECODE_BAD = '''
+class Engine:
+    def _loop(self):
+        while True:
+            self._cond.wait(0.05)
+            depth = float(self._q_depth)  # sync on the batcher thread
+            self._iteration()
+
+    def _iteration(self):
+        import numpy as np
+        for seq in admitted:
+            toks = np.asarray(seq.prompt)  # per-sequence prefill fetch
+        nxt = self._decode(params)
+        next_np = np.asarray(nxt)  # sanctioned: the ONE drain
+        for slot in self._running:
+            t = next_np[slot].item()  # per-sequence token fetch
+'''
+
+_DECODE_CLEAN = '''
+class Engine:
+    def _loop(self):
+        while True:
+            self._cond.wait(0.05)
+            self._iteration()
+
+    def _iteration(self):
+        import numpy as np
+        import jax.numpy as jnp
+        for seq in admitted:
+            self._prefill(jnp.asarray(seq.toks))  # device-side: fine
+        nxt = self._decode(params)
+        next_np = np.asarray(nxt)  # the ONE drain per iteration
+        for slot in self._running:
+            self._harvest(next_np[slot])  # host-side slice of the drain
+'''
+
+
+def test_live_decode_source_is_clean():
+    with open(DECODE_PATH) as f:
+        assert check_decode_source(f.read()) == []
+
+
+def test_decode_per_sequence_sync_detected():
+    errs = check_decode_source(_DECODE_BAD)
+    assert len(errs) == 3
+    assert any("dispatch loop" in e and "float(" in e for e in errs)
+    assert any("per-sequence loop" in e and "np.asarray(" in e
+               for e in errs)
+    assert any(".item(" in e for e in errs)
+
+
+def test_decode_single_drain_is_sanctioned():
+    assert check_decode_source(_DECODE_CLEAN) == []
+
+
+def test_decode_anchor_guard():
+    with pytest.raises(ValueError, match="anchors"):
+        check_decode_source(
+            "class Engine:\n    def _loop(self):\n        pass\n")

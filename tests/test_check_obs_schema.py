@@ -65,6 +65,16 @@ def test_valid_records_pass():
         {"kind": "router", "t": 1.0, "event": "snapshot",
          "metrics": {"tmpi_router_healthy": 2.0,
                      "tmpi_router_dropped_total": 0.0}},
+        # continuous-batching decode telemetry (serve/decode/engine.py,
+        # obs/decode.jsonl; decode_r<id>.jsonl for fleet members)
+        {"kind": "decode", "t": 1.0, "params_step": 7,
+         "metrics": {"tmpi_decode_queue_depth": 0.0,
+                     "tmpi_decode_tokens_per_sec": 812.4,
+                     "tmpi_decode_ttft_p99_ms": 4.9,
+                     "tmpi_decode_kv_pages_used": 12.0}},
+        {"kind": "decode", "t": 1.0, "params_step": -1, "metrics": {}},
+        {"kind": "decode", "t": 1.0, "params_step": 7, "replica_id": 1,
+         "metrics": {"tmpi_decode_served_total": 10.0}},
         # checkpoint hot-reload (serve/reload.py)
         {"kind": "reload", "t": 1.0, "from_step": 4, "to_step": 9,
          "ms": 41.2},
@@ -183,6 +193,15 @@ def test_valid_records_pass():
     # serve records carry ONLY the tmpi_serve_ name family
     ({"kind": "serve", "t": 1.0, "params_step": 1,
       "metrics": {"queue_depth": 1.0}}, "lacks the 'tmpi_serve_' prefix"),
+    ({"kind": "decode", "t": 1.0, "metrics": {}},
+     "missing required field 'params_step'"),
+    ({"kind": "decode", "t": 1.0, "params_step": 1,
+      "metrics": {"tmpi_decode_tpot_ms": "fast"}}, "not numeric"),
+    # decode records carry ONLY the tmpi_decode_ name family — a
+    # tmpi_serve_ key in a decode record is cross-engine bleed
+    ({"kind": "decode", "t": 1.0, "params_step": 1,
+      "metrics": {"tmpi_serve_queue_depth": 1.0}},
+     "lacks the 'tmpi_decode_' prefix"),
     ({"kind": "router", "t": 1.0}, "missing required field 'event'"),
     ({"kind": "router", "t": 1.0, "event": "health", "replica_id": 0.5},
      "is float, want int"),

@@ -69,6 +69,9 @@ class TransformerLMModel(Model):
     name = "transformer_lm"
     is_lm = True
     is_moe = False
+    # serve/decode contract: the incremental prefill/decode surface
+    # below exists (DecodeEngine checks this flag at construction)
+    supports_decode = True
 
     def __init__(self, recipe: LMRecipe | None = None):
         self.recipe = recipe or self.default_recipe()
@@ -124,6 +127,31 @@ class TransformerLMModel(Model):
         preds = jnp.argmax(logits[:, :-1].astype(jnp.float32), axis=-1)
         err = jnp.mean((preds != labels[:, 1:]).astype(jnp.float32))
         return {"error": err}
+
+    # -- incremental decode surface (serve/decode DecodeEngine) ---------
+    # Decode-mode apply, split at the prefill/decode program boundary the
+    # paged KV-cache needs (one compiled program per prompt bucket, ONE
+    # single-token program for every decode iteration). Both delegate to
+    # the functional arch so the MoE subclass inherits them unchanged
+    # (its arch binds the dense top-1 Switch FFN).
+
+    def decode_prefill(self, params, tokens, pages, k_pool, v_pool, *,
+                       page_size: int):
+        """Cache one padded prompt's K/V pages; see
+        ``transformer.paged_prefill``."""
+        return self.arch.prefill_cache(
+            params, tokens, pages, k_pool, v_pool, page_size=page_size
+        )
+
+    def decode_step(self, params, k_pool, v_pool, page_tables, seq_lens,
+                    last_tokens, active, temperature, key, *,
+                    page_size: int):
+        """One continuous-batching decode iteration; see
+        ``transformer.paged_decode_step``."""
+        return self.arch.decode_step(
+            params, k_pool, v_pool, page_tables, seq_lens, last_tokens,
+            active, temperature, key, page_size=page_size
+        )
 
 
 class MoELMModel(TransformerLMModel):

@@ -33,6 +33,8 @@ from theanompi_tpu.models.transformer import (
     build_spec_step,
     cast_block_params,
     next_token_loss,
+    paged_decode_step,
+    paged_prefill,
     pick_nll,
     sync_grads_by_spec,
     validate_tp_divisibility,
@@ -204,6 +206,52 @@ class MoETransformerLM(NamedTuple):
             "head": P(None, tp_axis) if tp_axis else P(),
             "blocks": [blk] * self.n_layers,
         }
+
+    # -- paged-KV incremental decode (serve/decode subsystem) ------------
+
+    def prefill_cache(self, params, tokens, pages, k_pool, v_pool, *,
+                      page_size: int):
+        """:func:`~theanompi_tpu.models.transformer.paged_prefill` with
+        the dense top-1 Switch FFN (:func:`moe_decode_ffn`)."""
+        return paged_prefill(
+            self, params, tokens, pages, k_pool, v_pool, page_size,
+            ffn=moe_decode_ffn,
+        )
+
+    def decode_step(self, params, k_pool, v_pool, page_tables, seq_lens,
+                    last_tokens, active, temperature, key, *,
+                    page_size: int):
+        """:func:`~theanompi_tpu.models.transformer.paged_decode_step`
+        with the dense top-1 Switch FFN (:func:`moe_decode_ffn`)."""
+        return paged_decode_step(
+            self, params, k_pool, v_pool, page_tables, seq_lens,
+            last_tokens, active, temperature, key, page_size,
+            ffn=moe_decode_ffn,
+        )
+
+
+def moe_decode_ffn(blk, hin):
+    """Dense top-1 Switch FFN for incremental decode: each token runs
+    ONLY its argmax expert (weights gathered per token), scaled by the
+    router probability — ``switch_moe``'s route-and-combine without the
+    all-to-all dispatch or the capacity grid. At decode there is no
+    capacity pressure (a handful of tokens per iteration), so this
+    matches the training forward whenever the token would not have been
+    capacity-dropped there; capacity drops are a TRAINING throughput
+    knob, not a serving semantic. ``blk`` arrives via
+    ``cast_block_params`` (the gate stays fp32). Accepts ``[..., d]``.
+    """
+    shape = hin.shape
+    h2 = hin.reshape(-1, shape[-1])                 # [N, d]
+    gl = h2.astype(jnp.float32) @ blk["gate"]       # router logits, fp32
+    probs = jax.nn.softmax(gl, axis=-1)
+    eidx = jnp.argmax(gl, axis=-1)                  # [N]
+    w_in = blk["expert_in"][eidx]                   # [N, d, h]
+    w_out = blk["expert_out"][eidx]                 # [N, h, d]
+    h = jax.nn.gelu(jnp.einsum("nd,ndh->nh", h2, w_in))
+    y = jnp.einsum("nh,nhd->nd", h, w_out)
+    scale = jnp.take_along_axis(probs, eidx[:, None], axis=-1)
+    return (y.astype(jnp.float32) * scale).astype(hin.dtype).reshape(shape)
 
 
 def ep_spec_setup(

@@ -30,6 +30,7 @@ contract is classifier-shaped); the point is the PARALLELISM patterns.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Any, NamedTuple, Optional
 
 import jax
@@ -389,6 +390,143 @@ class TransformerLM(NamedTuple):
             "head": P(None, tp_axis),              # vocab columns
             "blocks": [blk] * self.n_layers,
         }
+
+    # -- paged-KV incremental decode (serve/decode subsystem) ------------
+
+    def prefill_cache(self, params, tokens, pages, k_pool, v_pool, *,
+                      page_size: int):
+        """See :func:`paged_prefill` — dense-FFN binding."""
+        return paged_prefill(
+            self, params, tokens, pages, k_pool, v_pool, page_size
+        )
+
+    def decode_step(self, params, k_pool, v_pool, page_tables, seq_lens,
+                    last_tokens, active, temperature, key, *,
+                    page_size: int):
+        """See :func:`paged_decode_step` — dense-FFN binding."""
+        return paged_decode_step(
+            self, params, k_pool, v_pool, page_tables, seq_lens,
+            last_tokens, active, temperature, key, page_size
+        )
+
+
+# -- paged-KV incremental decode ----------------------------------------
+#
+# The serving-side counterpart of the training forward above
+# (serve/decode: continuous batching over a paged KV-cache). Two
+# programs, compiled ONCE each for fixed shapes:
+#   * paged_prefill — one padded prompt per call, one static bucket
+#     length per compiled program; writes per-layer K/V pages.
+#   * paged_decode_step — ONE token per active batch slot, every slot
+#     every iteration; reads the cache through per-slot page tables,
+#     writes the current position's K/V, samples the next token.
+# Both take an ``ffn(blk, hin) -> delta`` hook so the MoE LM
+# (models/moe.py) reuses the attention/cache plumbing unchanged.
+
+
+def dense_ffn(blk, hin):
+    """The dense block's FFN residual delta (shared with the training
+    forward's MLP; ``blk`` arrives already cast)."""
+    return jax.nn.gelu(hin @ blk["mlp_in"]) @ blk["mlp_out"]
+
+
+def paged_prefill(arch, params, tokens, pages, k_pool, v_pool,
+                  page_size: int, ffn=dense_ffn):
+    """Cache one prompt's per-layer K/V into the paged pools.
+
+    ``tokens`` is ONE padded prompt ``[T_b] int32`` (``T_b`` a static
+    bucket length, a multiple of ``page_size``), ``pages
+    [T_b/page_size] int32`` routes each page-worth of positions to its
+    physical page (the scratch index for the padding tail), and the
+    pools are ``[L, n_pages+1, page_size, H, hd]``. Runs the full
+    causal forward minus the vocabulary head, so every position below
+    the true prompt length produces K/V bit-identical to the training
+    forward — causality means the padding tail cannot contaminate them,
+    and its garbage K/V land on read-masked offsets or the scratch
+    page. Returns ``(k_pool, v_pool)`` updated.
+    """
+    T = tokens.shape[0]
+    x = (params["tok_emb"][tokens] + params["pos_emb"][:T]).astype(arch.dtype)
+    x = x[None]  # [1, T, d]
+    for li, blk in enumerate(params["blocks"]):
+        blk = cast_block_params(blk, arch.dtype)
+        hin = _rms(x, blk["ln1"])
+        qkv = jnp.einsum("btd,dchk->btchk", hin, blk["qkv"])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [1, T, H, hd]
+        kp = k[0].reshape(-1, page_size, k.shape[2], k.shape[3])
+        vp = v[0].reshape(-1, page_size, v.shape[2], v.shape[3])
+        k_pool = k_pool.at[li, pages].set(kp.astype(k_pool.dtype))
+        v_pool = v_pool.at[li, pages].set(vp.astype(v_pool.dtype))
+        att = full_attention_reference(q, k, v, causal=True)
+        x = x + jnp.einsum("bthk,hkd->btd", att, blk["proj"])
+        x = x + ffn(blk, _rms(x, blk["ln2"]))
+    return k_pool, v_pool
+
+
+def paged_decode_step(arch, params, k_pool, v_pool, page_tables, seq_lens,
+                      last_tokens, active, temperature, key,
+                      page_size: int, ffn=dense_ffn):
+    """One continuous-batching decode iteration over ALL batch slots.
+
+    Per slot ``s``: embed ``last_tokens[s]`` at position ``seq_lens[s]``,
+    write its K/V at (page ``page_tables[s, pos//page_size]``, offset
+    ``pos % page_size``) — inactive slots write to the scratch page —
+    then attend over cached positions ``0..seq_lens[s]`` inclusive
+    (gathered through the slot's page table, fp32 softmax, same
+    ``1/sqrt(hd)`` scale as :func:`full_attention_reference`), and
+    sample: greedy argmax where ``temperature[s] == 0``, else
+    categorical on ``logits/temperature`` under ``key``. All shapes are
+    static in ``(S, M)`` so ONE compiled program serves every iteration.
+
+    Returns ``(next_tokens [S] int32, logits [S, V] fp32, k_pool,
+    v_pool)``.
+    """
+    S, M = page_tables.shape
+    scratch = k_pool.shape[1] - 1
+    pos = jnp.clip(seq_lens, 0, params["pos_emb"].shape[0] - 1)
+    x = (params["tok_emb"][last_tokens] + params["pos_emb"][pos]).astype(
+        arch.dtype
+    )
+    pidx = jnp.clip(seq_lens // page_size, 0, M - 1)
+    write_page = jnp.where(
+        active, page_tables[jnp.arange(S), pidx], scratch
+    )
+    write_off = seq_lens % page_size
+    for li, blk in enumerate(params["blocks"]):
+        blk = cast_block_params(blk, arch.dtype)
+        hin = _rms(x, blk["ln1"])
+        qkv = jnp.einsum("sd,dchk->schk", hin, blk["qkv"])
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [S, H, hd]
+        k_pool = k_pool.at[li, write_page, write_off].set(
+            k.astype(k_pool.dtype)
+        )
+        v_pool = v_pool.at[li, write_page, write_off].set(
+            v.astype(v_pool.dtype)
+        )
+        k_ctx = k_pool[li][page_tables].reshape(
+            S, M * page_size, k.shape[1], k.shape[2]
+        )
+        v_ctx = v_pool[li][page_tables].reshape(
+            S, M * page_size, v.shape[1], v.shape[2]
+        )
+        sc = 1.0 / math.sqrt(q.shape[-1])
+        s_ = jnp.einsum(
+            "shd,sthd->sht", q.astype(jnp.float32), k_ctx.astype(jnp.float32)
+        ) * sc
+        valid = jnp.arange(M * page_size)[None, :] <= seq_lens[:, None]
+        s_ = jnp.where(valid[:, None, :], s_, -1e30)
+        p = jax.nn.softmax(s_, axis=-1)
+        att = jnp.einsum(
+            "sht,sthd->shd", p, v_ctx.astype(jnp.float32)
+        ).astype(x.dtype)
+        x = x + jnp.einsum("shk,hkd->sd", att, blk["proj"])
+        x = x + ffn(blk, _rms(x, blk["ln2"]))
+    logits = (x @ params["head"].astype(arch.dtype)).astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    safe_t = jnp.maximum(temperature, 1e-6)[:, None]
+    sampled = jax.random.categorical(key, logits / safe_t).astype(jnp.int32)
+    next_tokens = jnp.where(temperature > 0, sampled, greedy)
+    return next_tokens, logits, k_pool, v_pool
 
 
 def _vocab_sharded_nll(logits: jax.Array, targets: jax.Array, tp_axis: str):

@@ -205,6 +205,28 @@ class ShardingRecipe:
             return jax.device_put(tree)
         return jax.device_put(tree, NamedSharding(self.mesh, PartitionSpec()))
 
+    def place_params(self, params):
+        """Place the SERVED params tree per this recipe's ``params``
+        role. The replicated serve recipe degenerates to
+        :meth:`place_replicated`; the tensor-serve recipe commits each
+        leaf to its Megatron spec's NamedSharding — the one sanctioned
+        path for sharded-param serving (engines still never touch
+        PartitionSpec)."""
+        spec_tree = self.roles.get("params", PartitionSpec())
+        if _is_spec(spec_tree):
+            return self.place_replicated(params)
+        if self.mesh.devices.size == 1:
+            # degenerate 1-device tensor mesh: every spec shards over an
+            # extent-1 axis — plain device_put, same array, faster path
+            return jax.device_put(params)
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        specs = treedef.flatten_up_to(spec_tree)
+        placed = [
+            jax.device_put(leaf, NamedSharding(self.mesh, spec))
+            for leaf, spec in zip(leaves, specs)
+        ]
+        return jax.tree_util.tree_unflatten(treedef, placed)
+
     # -- constructors (one per rule family) -----------------------------
     @classmethod
     def bsp(cls, mesh: Mesh, axes, ef_sharded: bool) -> "ShardingRecipe":
@@ -311,6 +333,40 @@ class ShardingRecipe:
         return cls(
             rule="serve", mesh=mesh, axes=tuple(mesh.axis_names),
             roles=dict(params=PartitionSpec(),
+                       model_state=PartitionSpec(),
+                       opt_state=PartitionSpec(), step=PartitionSpec(),
+                       ef=()),
+            batch_spec=PartitionSpec(),
+        )
+
+    @classmethod
+    def serve_tensor(cls, model, mesh: Optional[Mesh] = None,
+                     tp_axis: Optional[str] = None) -> "ShardingRecipe":
+        """Tensor-sharded serving (``tmpi serve --decode --shard
+        tensor``): the model arch's Megatron param specs
+        (``tp_param_specs`` — qkv/head column-sharded, proj/mlp_out
+        row-sharded, embeddings and norms replicated) over a 1-axis
+        serving mesh spanning every local device. On one device this
+        degenerates to the replicated serve recipe (every spec shards
+        an extent-1 axis), so the SAME CLI flags run on a CPU dev box
+        and a multi-chip serving host. ``model`` is a zoo model whose
+        ``arch`` exposes ``tp_param_specs`` (the LM stack)."""
+        arch = getattr(model, "arch", model)
+        specs_fn = getattr(arch, "tp_param_specs", None)
+        if specs_fn is None:
+            raise ValueError(
+                f"{type(model).__name__} has no tp_param_specs — tensor-"
+                "sharded serving needs the LM stack's Megatron spec "
+                "table (use --shard none for replicated serving)"
+            )
+        if mesh is None:
+            from theanompi_tpu.models.transformer import MODEL_AXIS
+
+            mesh = Mesh(np.array(jax.devices()), (MODEL_AXIS,))
+        axis = tp_axis if tp_axis is not None else mesh.axis_names[0]
+        return cls(
+            rule="serve_tensor", mesh=mesh, axes=tuple(mesh.axis_names),
+            roles=dict(params=specs_fn(axis),
                        model_state=PartitionSpec(),
                        opt_state=PartitionSpec(), step=PartitionSpec(),
                        ef=()),

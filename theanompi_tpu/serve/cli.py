@@ -7,20 +7,41 @@ micro-batching and (``--watch``) checkpoint hot-reload::
                --buckets 1,8,32,128 --max-queue 256 --deadline-ms 250 \\
                --obs-dir runs/obs --port 8300
 
+Two engine kinds share this command:
+
+- **Eval-forward** (default): one logits row per request
+  (serve/engine.py). ``--buckets`` are its BATCH buckets — requests
+  pad UP to the smallest fitting batch size, one compiled program per
+  bucket. This flag applies to the eval engine ONLY.
+- **LM decode** (``--decode``): continuous-batching generation over a
+  paged KV-cache (serve/decode/) — requests are 1-D token prompts,
+  responses are generated continuations. Its compiled-program knobs
+  are ``--prefill-buckets`` (prompt-length buckets, page-size
+  multiples) and ``--kv-pages`` (total KV pool pages) — NOT
+  ``--buckets``. ``--shard tensor`` serves Megatron tensor-sharded
+  params placed by ``ShardingRecipe.serve_tensor`` (degenerates to
+  replicated on one device)::
+
+      tmpi serve --decode --shard tensor --ckpt-dir runs/ck \\
+                 --model runs/lm.py:TransformerLMModel \\
+                 --prefill-buckets 16,64 --kv-pages 256
+
 SIGTERM drains gracefully: admission stops (healthz flips 503, so a
-load balancer rotates the replica out), the queued backlog is served,
-then the process exits — the serving twin of the trainer's
-``--sigterm-grace``. ``--selftest N`` skips the HTTP server and drives
-N closed-loop local requests instead (smoke/CI path; prints the
-``serve`` stats line and exits).
+load balancer rotates the replica out), the queued backlog is served —
+for decode, every admitted generation runs to completion — then the
+process exits. ``--selftest N`` skips the HTTP server and drives N
+closed-loop local requests instead (smoke/CI path; prints the final
+stats line and exits).
 
 ``--replicas N`` (N > 1) fronts an N-member replica group through
-serve/router.py instead of one engine: health-checked least-loaded
-routing with bounded failover, a supervisor restarting crashed members
-with jitter backoff, central hot-reload under ``--watch``, and
-``kind=router`` records in ``<obs-dir>/router.jsonl`` (members write
-``serve_r<id>.jsonl``). The final stdout line is then a schema-valid
-``router`` snapshot record rather than a ``serve`` one.
+serve/router.py instead of one engine — for BOTH engine kinds (the
+decode engine exposes the same submit/drain/set_params surface, so the
+router is unchanged): health-checked least-loaded routing with bounded
+failover, a supervisor restarting crashed members with jitter backoff,
+central hot-reload under ``--watch``, and ``kind=router`` records in
+``<obs-dir>/router.jsonl`` (members write ``serve_r<id>.jsonl`` /
+``decode_r<id>.jsonl``). The final stdout line is then a schema-valid
+``router`` snapshot record rather than a ``serve``/``decode`` one.
 """
 
 from __future__ import annotations
@@ -49,9 +70,41 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="recipe override (repeatable, JSON values) — must "
                         "mirror the overrides the training run used")
     p.add_argument("--buckets", default="1,8,32,128",
-                   help="comma-separated batch buckets; requests pad UP to "
-                        "the smallest fitting bucket, one compiled program "
-                        "per bucket, all AOT-warmed at startup")
+                   help="EVAL-FORWARD engine only: comma-separated batch "
+                        "buckets; requests pad UP to the smallest fitting "
+                        "bucket, one compiled program per bucket, all "
+                        "AOT-warmed at startup (the decode engine's "
+                        "program knobs are --prefill-buckets/--kv-pages)")
+    p.add_argument("--decode", action="store_true",
+                   help="LM decode serving (serve/decode/): requests are "
+                        "1-D token prompts, responses generated "
+                        "continuations via continuous batching over a "
+                        "paged KV-cache; needs a model with the "
+                        "incremental decode surface (transformer_lm zoo "
+                        "family)")
+    p.add_argument("--prefill-buckets", default="16,64",
+                   help="DECODE engine only: comma-separated prompt-length "
+                        "buckets (page-size multiples); one compiled "
+                        "prefill program per bucket + ONE decode program, "
+                        "all AOT-warmed")
+    p.add_argument("--kv-pages", type=int, default=256,
+                   help="DECODE engine only: total pages in the "
+                        "preallocated KV pool (admission reserves "
+                        "worst-case pages per generation)")
+    p.add_argument("--page-size", type=int, default=16,
+                   help="DECODE engine only: positions per KV page")
+    p.add_argument("--max-seqs", type=int, default=8,
+                   help="DECODE engine only: decode batch width "
+                        "(concurrent generations)")
+    p.add_argument("--max-new-tokens", type=int, default=32,
+                   help="DECODE engine only: default per-request output "
+                        "budget")
+    p.add_argument("--shard", choices=("none", "tensor"), default="none",
+                   help="DECODE engine only: 'tensor' serves Megatron "
+                        "tensor-sharded params over all local devices "
+                        "(ShardingRecipe.serve_tensor; checkpoints load "
+                        "through load_resharded onto the serving mesh); "
+                        "'none' = replicated single-device serving")
     p.add_argument("--max-queue", type=int, default=256,
                    help="admission bound: a full queue rejects with "
                         "retry-after instead of growing latency unbounded")
@@ -128,36 +181,71 @@ def serve_main(argv=None) -> int:
     model = _resolve_serve_model(args.model, args.recipe_arg)
     buckets = tuple(int(b) for b in args.buckets.split(","))
     replicas = max(1, int(args.replicas))
-    if replicas == 1:
-        engine = ServeEngine(
-            model,
-            buckets=buckets,
-            max_queue=args.max_queue,
-            default_deadline_ms=args.deadline_ms or None,
-            obs_dir=args.obs_dir,
-        )
-        step = engine.load_initial(args.ckpt_dir)
-        compiled = engine.warmup()
-        print(f"[serve] serving {model.name} step {step}; "
-              f"{compiled} programs AOT-warmed for buckets {buckets}",
-              flush=True)
-        engine.start()
-        final_record = engine.serve_record
-    else:
-        from theanompi_tpu.serve.router import Router
 
-        def _member(rid):
-            # the replica factory: the supervisor reuses it to restart
-            # crashed members from the newest verified checkpoint
-            eng = ServeEngine(
+    if args.decode:
+        from theanompi_tpu.serve.decode import DecodeEngine
+
+        prefill_buckets = tuple(
+            int(b) for b in args.prefill_buckets.split(","))
+        sharding = None
+        if args.shard == "tensor":
+            # specs are born in parallel/recipe.py (source guard:
+            # serve/* never constructs a PartitionSpec)
+            from theanompi_tpu.parallel.recipe import ShardingRecipe
+
+            sharding = ShardingRecipe.serve_tensor(model)
+
+        def _make(rid=None):
+            return DecodeEngine(
+                model,
+                prefill_buckets=prefill_buckets,
+                kv_pages=args.kv_pages,
+                page_size=args.page_size,
+                max_seqs=args.max_seqs,
+                max_new_tokens=args.max_new_tokens,
+                max_queue=args.max_queue,
+                default_deadline_ms=args.deadline_ms or None,
+                obs_dir=args.obs_dir,
+                replica_id=rid,
+                sink_name=("decode.jsonl" if rid is None
+                           else f"decode_r{rid}.jsonl"),
+                sharding=sharding,
+            )
+
+        engine_kind, program_note = "decode", (
+            f"prefill buckets {prefill_buckets} + 1 decode program")
+    else:
+        def _make(rid=None):
+            return ServeEngine(
                 model,
                 buckets=buckets,
                 max_queue=args.max_queue,
                 default_deadline_ms=args.deadline_ms or None,
                 obs_dir=args.obs_dir,
                 replica_id=rid,
-                sink_name=f"serve_r{rid}.jsonl",
+                sink_name=("serve.jsonl" if rid is None
+                           else f"serve_r{rid}.jsonl"),
             )
+
+        engine_kind, program_note = "serve", f"buckets {buckets}"
+
+    if replicas == 1:
+        engine = _make()
+        step = engine.load_initial(args.ckpt_dir)
+        compiled = engine.warmup()
+        print(f"[serve] {engine_kind} engine: {model.name} step {step}; "
+              f"{compiled} programs AOT-warmed ({program_note})",
+              flush=True)
+        engine.start()
+        final_record = (engine.decode_record if args.decode
+                        else engine.serve_record)
+    else:
+        from theanompi_tpu.serve.router import Router
+
+        def _member(rid):
+            # the replica factory: the supervisor reuses it to restart
+            # crashed members from the newest verified checkpoint
+            eng = _make(rid)
             eng.load_initial(args.ckpt_dir)
             eng.warmup()
             eng.start()
@@ -169,9 +257,9 @@ def serve_main(argv=None) -> int:
             default_deadline_ms=args.deadline_ms or None,
         )
         engine.start()
-        print(f"[serve] {replicas}-replica fleet serving {model.name} "
-              f"step {engine.params_step}; buckets {buckets} AOT-warmed "
-              "per member", flush=True)
+        print(f"[serve] {replicas}-replica {engine_kind} fleet serving "
+              f"{model.name} step {engine.params_step}; {program_note} "
+              "AOT-warmed per member", flush=True)
         final_record = engine.router_record
     reloader = None
     if args.watch:
@@ -196,12 +284,21 @@ def serve_main(argv=None) -> int:
             import numpy as np
 
             rng = np.random.RandomState(0)
-            shape = tuple(model.recipe.input_shape)
-            for _ in range(args.selftest):
-                engine.infer(rng.randn(*shape))
+            if args.decode:
+                # decode selftest: mixed-length int32 prompts exercise
+                # every prefill bucket plus the shared decode program
+                vocab = int(model.recipe.num_classes)
+                top = max(int(b) for b in args.prefill_buckets.split(",")) + 1
+                for i in range(args.selftest):
+                    n = 1 + (i * 3) % top
+                    engine.infer(rng.randint(0, vocab, size=n, dtype=np.int32))
+            else:
+                shape = tuple(model.recipe.input_shape)
+                for _ in range(args.selftest):
+                    engine.infer(rng.randn(*shape))
             _shutdown()
             # LAST stdout line = one schema-valid stats record
-            # (kind=serve, or kind=router for a replica fleet)
+            # (kind=serve/decode, or kind=router for a replica fleet)
             print(json.dumps(final_record()))
             return 0
 

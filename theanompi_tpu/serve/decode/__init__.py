@@ -1,0 +1,29 @@
+"""Continuous-batching LM decode serving (ISSUE 20 tentpole).
+
+Layers, host-side up:
+
+- ``kvcache``   — paged KV pool: one fixed-shape device allocation,
+                  host free-list + per-sequence page tables.
+- ``scheduler`` — iteration-level admission/eviction over the cache,
+                  bucketed prefill planning.
+- ``engine``    — :class:`DecodeEngine`: the threaded decode loop with
+                  the same submit/drain/set_params surface as the
+                  eval-forward ``ServeEngine``, so ``serve/router.py``
+                  fronts decode replicas unchanged.
+"""
+
+from theanompi_tpu.serve.decode.engine import (  # noqa: F401
+    DEFAULT_PREFILL_BUCKETS,
+    DecodeEngine,
+    DecodeResult,
+)
+from theanompi_tpu.serve.decode.kvcache import (  # noqa: F401
+    FreeList,
+    KVExhausted,
+    PagedKVCache,
+    pages_needed,
+)
+from theanompi_tpu.serve.decode.scheduler import (  # noqa: F401
+    DecodeScheduler,
+    DecodeSequence,
+)

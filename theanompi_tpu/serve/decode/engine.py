@@ -1,0 +1,790 @@
+"""Continuous-batching LM decode engine over a paged KV-cache.
+
+The decode sibling of :class:`theanompi_tpu.serve.engine.ServeEngine`
+(one-shot eval forwards): same queue/admission/drain/hot-reload
+lifecycle and the same ``submit``/``drain``/``set_params``/
+``params_step`` surface — so :class:`theanompi_tpu.serve.router.Router`
+fronts N decode replicas UNCHANGED — but each request is a *generation*
+(a prompt plus up to ``max_new_tokens`` sampled continuations), not a
+single forward. Three rules carry over from the eval engine, reshaped
+for autoregression:
+
+1. **Fixed shapes, bounded programs.** The KV pool is ONE preallocated
+   device array per layer (``serve/decode/kvcache.py``); page tables
+   and per-slot operand vectors have fixed ``[max_seqs]`` shapes, so
+   the single-token decode step compiles exactly ONCE no matter how
+   sequences come and go. Prompt prefill pads into a small set of
+   length buckets (page-size multiples), one compiled program each,
+   AOT-warmed in :meth:`warmup`. Total programs:
+   ``len(prefill_buckets) + 1`` — proven by the trace counter
+   (``compile_count``), same idiom as the eval engine.
+
+2. **Iteration-level scheduling.** Between decode steps the scheduler
+   (``serve/decode/scheduler.py``) admits waiting prompts into free
+   batch slots (reserving worst-case pages so a running sequence can
+   never die of page exhaustion) and evicts finished/deadline-passed
+   ones — sequences join and leave a RUNNING batch, no static-batch
+   barrier. The prompt's first ``L-1`` tokens prefill the cache; its
+   LAST token rides the decode step, so every emitted token exits
+   through the one decode program and each iteration has exactly ONE
+   host drain point (the ``np.asarray`` on the next-token vector —
+   ``tools/check_hot_loop.py`` HOT004 guards this).
+
+3. **Swap params between iterations.** Hot reload publishes a new
+   :class:`~theanompi_tpu.serve.engine.ServedParams` by atomic
+   reference swap; the decode loop reads the reference ONCE per
+   iteration, so a mid-generation reload changes the params a sequence
+   decodes with between tokens but never mid-step, the served step
+   only moves forward, and zero in-flight generations drop
+   (tests/test_decode_engine.py hammers this, chaos's decode
+   schedules hammer it harder).
+
+Telemetry is ``tmpi_decode_*``-prefixed (schema: ``kind=decode`` in
+tools/check_obs_schema.py): TTFT/TPOT histograms, tokens/sec,
+kv page occupancy, batch occupancy, eviction/expiry counters, plus
+periodic ``decode`` JSONL records in ``<obs_dir>/decode.jsonl``.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from theanompi_tpu.serve.decode.kvcache import PagedKVCache, pages_needed
+from theanompi_tpu.serve.decode.scheduler import DecodeScheduler, DecodeSequence
+from theanompi_tpu.serve.engine import (
+    LATENCY_BUCKETS,
+    DeadlineExceeded,
+    EngineDead,
+    EngineDraining,
+    EngineOverloaded,
+    Rejected,
+    ServedParams,
+    ServeFuture,
+)
+
+__all__ = [
+    "DecodeEngine",
+    "DecodeResult",
+    "DEFAULT_PREFILL_BUCKETS",
+    "DeadlineExceeded",
+    "EngineDead",
+    "EngineDraining",
+    "EngineOverloaded",
+    "Rejected",
+]
+
+DEFAULT_PREFILL_BUCKETS = (16, 64)
+
+# TPOT (time-per-output-token) lives well below request latency — extend
+# the serve band downward into the sub-millisecond range
+TPOT_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0,
+)
+
+
+class DecodeResult(NamedTuple):
+    """Per-request result: the generated token ids and the checkpoint
+    step of the params that produced the LAST token (a mid-generation
+    hot reload legitimately splits a sequence across steps; the final
+    step is what monotonicity tests assert on)."""
+
+    tokens: np.ndarray
+    step: int
+
+
+class DecodeEngine:
+    """Continuous-batching generation engine over one LM.
+
+    ``model`` is a constructed zoo model with ``supports_decode`` (the
+    incremental ``decode_prefill``/``decode_step`` surface —
+    models/lm.py). Requests are 1-D int32 token prompts of any length
+    up to ``max(prefill_buckets) + 1``; results are
+    :class:`DecodeResult`. Lifecycle mirrors the eval engine:
+    construct → ``load_initial`` → ``warmup`` → ``start`` →
+    ``submit``/``generate`` ... → ``drain``.
+
+    ``kv_pages`` fixed device pages of ``page_size`` positions bound
+    total cache capacity; ``max_seqs`` bounds the decode batch width.
+    ``mode="static"`` disables iteration-level admission (a batch runs
+    to completion before the next forms) — the strawman the decode
+    bench's continuous-vs-static ratio measures against.
+    ``temperature`` is the default sampling temperature (0 = greedy);
+    sampling draws from a PRNG stream keyed by ``seed`` and the
+    iteration counter INSIDE the jitted step, so replays are
+    deterministic and the key never retraces the program.
+    """
+
+    def __init__(
+        self,
+        model,
+        *,
+        prefill_buckets: Sequence[int] = DEFAULT_PREFILL_BUCKETS,
+        kv_pages: int = 64,
+        page_size: int = 16,
+        max_seqs: int = 8,
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        mode: str = "continuous",
+        max_queue: int = 256,
+        default_deadline_ms: Optional[float] = None,
+        obs_dir: Optional[str] = None,
+        registry=None,
+        record_every: int = 50,
+        replica_id: Optional[int] = None,
+        sink_name: str = "decode.jsonl",
+        seed: int = 0,
+        sharding=None,
+    ):
+        from theanompi_tpu.obs.metrics import MetricsRegistry
+
+        if not getattr(model, "supports_decode", False):
+            raise ValueError(
+                f"{type(model).__name__} does not support incremental "
+                "decode (no decode_prefill/decode_step surface — see "
+                "models/lm.py); serve it with the eval-forward "
+                "ServeEngine instead"
+            )
+        self.model = model
+        arch = model.arch
+        self.page_size = int(page_size)
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.default_temperature = float(temperature)
+        self.max_queue = int(max_queue)
+        if self.max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+        self.default_deadline_ms = default_deadline_ms
+        self.obs_dir = obs_dir
+        self.record_every = max(1, int(record_every))
+        self.replica_id = None if replica_id is None else int(replica_id)
+        self.sink_name = str(sink_name)
+        self._seed = int(seed)
+
+        buckets = tuple(sorted(int(b) for b in prefill_buckets))
+        # longest generation the pool must hold: the largest admissible
+        # prompt plus the full output budget, capped by the model's
+        # position table
+        self.max_context = min(
+            int(arch.max_len), buckets[-1] + 1 + self.max_new_tokens
+        )
+        max_pages_per_seq = pages_needed(self.max_context, self.page_size)
+        if kv_pages < max_pages_per_seq:
+            raise ValueError(
+                f"kv_pages={kv_pages} cannot hold even one worst-case "
+                f"sequence ({max_pages_per_seq} pages for "
+                f"{self.max_context} positions at page_size "
+                f"{self.page_size})"
+            )
+        self._cache = PagedKVCache(
+            n_layers=arch.n_layers,
+            n_heads=arch.n_heads,
+            head_dim=arch.d_model // arch.n_heads,
+            page_size=self.page_size,
+            n_pages=int(kv_pages),
+            max_seqs=int(max_seqs),
+            max_pages_per_seq=max_pages_per_seq,
+        )
+        self._sched = DecodeScheduler(
+            self._cache, prefill_buckets=buckets, mode=mode
+        )
+        # the router reads eng.buckets[-1] for its backlog math; for a
+        # decode member that's the prefill bucket set
+        self.buckets = self._sched.buckets
+
+        # two jitted programs (+1 shape per prefill bucket), both routed
+        # through the host-side trace counter — ``compile_count`` proves
+        # the "len(prefill_buckets) + 1 programs" bound under any
+        # request mix (tests/test_decode_engine.py)
+        import jax
+
+        self._trace_count = 0
+        seed_const = self._seed
+
+        def _counted_prefill(params, tokens, pages, k_pool, v_pool):
+            self._trace_count += 1  # trace-time only, never per call
+            return model.decode_prefill(
+                params, tokens, pages, k_pool, v_pool,
+                page_size=self.page_size,
+            )
+
+        def _counted_decode(params, k_pool, v_pool, tables, seq_lens,
+                            last, active, temp, it):
+            self._trace_count += 1  # trace-time only, never per call
+            # the sampling key is derived INSIDE the program from the
+            # traced iteration counter — deterministic replay, no
+            # per-iteration retrace, no host-side key threading
+            key = jax.random.fold_in(jax.random.PRNGKey(seed_const), it)
+            return model.decode_step(
+                params, k_pool, v_pool, tables, seq_lens, last, active,
+                temp, key, page_size=self.page_size,
+            )
+
+        self._prefill = jax.jit(_counted_prefill)
+        self._decode = jax.jit(_counted_decode)
+
+        from theanompi_tpu.parallel.recipe import ShardingRecipe
+
+        # declared serving placement (SHARD004's comparison target);
+        # ``tmpi serve --decode --shard tensor`` passes the tensor-serve
+        # recipe here instead of the replicated default
+        self.sharding = sharding if sharding is not None else ShardingRecipe.serve()
+
+        self._served: Optional[ServedParams] = None
+        self._swap_lock = threading.Lock()
+        self._q: collections.deque[DecodeSequence] = collections.deque()
+        self._cond = threading.Condition()
+        self._draining = False
+        self._abort_error: Optional[BaseException] = None
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._batch_s_ewma: Optional[float] = None
+        self._iterations = 0
+        self._tokens_total = 0
+        self._t_started: Optional[float] = None
+        self._sink_f = None
+        self._sink_lock = threading.Lock()
+        self._sink_retired = False
+
+        self.registry = registry or MetricsRegistry()
+        self._h_ttft = self.registry.histogram(
+            "tmpi_decode_ttft_seconds",
+            help="time to first generated token, submit -> token",
+            buckets=LATENCY_BUCKETS,
+        )
+        self._h_tpot = self.registry.histogram(
+            "tmpi_decode_tpot_seconds",
+            help="per-output-token latency after the first token",
+            buckets=TPOT_BUCKETS,
+        )
+        self._g_queue = self.registry.gauge(
+            "tmpi_decode_queue_depth",
+            help="generations waiting for a batch slot",
+        )
+        self._g_occupancy = self.registry.gauge(
+            "tmpi_decode_batch_occupancy",
+            help="running sequences / max_seqs of the last iteration",
+        )
+        self._g_pages_used = self.registry.gauge(
+            "tmpi_decode_kv_pages_used", help="KV pool pages reserved"
+        )
+        self._g_pages_free = self.registry.gauge(
+            "tmpi_decode_kv_pages_free", help="KV pool pages on the free-list"
+        )
+        self._g_step = self.registry.gauge(
+            "tmpi_decode_params_step", help="checkpoint step currently served"
+        )
+        self._c_requests = self.registry.counter(
+            "tmpi_decode_requests_total",
+            help="generations by outcome "
+                 "(status=served|expired|evicted|rejected|failed)",
+        )
+        self._c_tokens = self.registry.counter(
+            "tmpi_decode_tokens_total", help="tokens generated"
+        )
+        self._c_prefills = self.registry.counter(
+            "tmpi_decode_prefills_total",
+            help="prompt prefills by length bucket (bucket=N)",
+        )
+        self._c_evicted = self.registry.counter(
+            "tmpi_decode_evicted_total",
+            help="running sequences evicted (deadline) — typed, not a drop",
+        )
+        self._c_preempted = self.registry.counter(
+            "tmpi_decode_preempted_total",
+            help="running sequences preempted for capacity (admission "
+                 "reserves worst-case pages, so this stays 0 — the "
+                 "counter exists so a future best-effort-admission mode "
+                 "cannot hide preemptions)",
+        )
+        self._c_reloads = self.registry.counter(
+            "tmpi_decode_reloads_total",
+            help="checkpoint hot-reloads applied (serve/reload.py)",
+        )
+
+    # -- params (surface shared with ServeEngine; router/reloader use it) ---
+    @property
+    def params_step(self) -> int:
+        """Checkpoint step currently served (-1 before load_initial)."""
+        served = self._served
+        return served.step if served is not None else -1
+
+    def load_initial(self, ckpt_dir: str) -> int:
+        """Load the newest VERIFIED checkpoint from a training run's
+        keep-chain and serve it (same discovery/reshard path as the
+        eval engine: serve/reload.py::load_for_serving)."""
+        from theanompi_tpu.serve.reload import load_for_serving
+        from theanompi_tpu.utils.checkpoint import latest_checkpoint
+
+        path = latest_checkpoint(ckpt_dir, verify=True)
+        if path is None:
+            raise FileNotFoundError(
+                f"no verified checkpoint under {ckpt_dir!r} to serve"
+            )
+        params, model_state, step = load_for_serving(
+            path, self.model, target_mesh=self.sharding.mesh
+        )
+        self.set_params(params, model_state, step)
+        return step
+
+    def set_params(self, params, model_state, step: int) -> bool:
+        """Atomically publish a serving triple; refuses to move the
+        served step backward. Same discipline as the eval engine: the
+        device placement runs OUTSIDE the swap lock, the step check
+        re-runs under it. A generation in flight simply decodes its
+        next token with the new params — the KV cache entries written
+        under the old params remain valid context (same architecture,
+        different weights: exactly the semantics of serving the newer
+        checkpoint)."""
+        step = int(step)
+        current = self._served
+        if current is not None and step <= current.step:
+            return False
+        place = getattr(self.sharding, "place_params", None)
+        params = place(params) if place else self.sharding.place_replicated(params)
+        model_state = self.sharding.place_replicated(model_state)
+        with self._swap_lock:
+            current = self._served
+            if current is not None and step <= current.step:
+                return False
+            self._served = ServedParams(params, model_state, step)
+            self._g_step.set(step)
+        return True
+
+    def note_reload(self, from_step: int, to_step: int, ms: float) -> None:
+        """Reloader hook: count the swap + write a ``reload`` record."""
+        self._c_reloads.inc()
+        self._write_record({
+            "kind": "reload", "t": time.time(),
+            "from_step": int(from_step), "to_step": int(to_step),
+            "ms": round(float(ms), 3),
+        })
+
+    def note_reload_failed(self, from_step: int, error: str) -> None:
+        """Reloader hook for a verified-then-unloadable checkpoint (the
+        TOCTOU race) — counted and recorded, serving never blinks."""
+        self._c_reloads.inc(status="failed")
+        self._write_record({
+            "kind": "reload", "t": time.time(),
+            "from_step": int(from_step), "to_step": -1,
+            "ok": False, "error": str(error)[:500],
+        })
+
+    # -- lifecycle ----------------------------------------------------------
+    def warmup(self) -> int:
+        """AOT-compile every program before the first request: one
+        prefill per bucket (pages all-scratch — the warmup K/V land on
+        the write-discard page) and the single decode step (all slots
+        inactive). Returns the compile count, ==
+        ``len(prefill_buckets) + 1`` on a fresh engine."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._served is None:
+            raise RuntimeError("warmup needs params (load_initial first)")
+        served = self._served
+        c = self._cache
+        for b in self.buckets:
+            toks = jnp.zeros((b,), jnp.int32)
+            pages = jnp.full((b // self.page_size,), c.scratch, jnp.int32)
+            out = self._prefill(served.params, toks, pages, c.k_pool, c.v_pool)
+            jax.block_until_ready(out)  # compile now, discard scratch writes
+        S = c.max_seqs
+        nxt, _lg, _k, _v = self._decode(
+            served.params, c.k_pool, c.v_pool,
+            jnp.asarray(c.page_tables), jnp.zeros((S,), jnp.int32),
+            jnp.zeros((S,), jnp.int32), jnp.zeros((S,), bool),
+            jnp.zeros((S,), jnp.float32), np.int32(0),
+        )
+        np.asarray(nxt)
+        return self.compile_count
+
+    @property
+    def compile_count(self) -> int:
+        """Programs compiled so far (trace count across both jits)."""
+        return self._trace_count
+
+    def start(self) -> None:
+        with self._cond:
+            if self._thread is not None:
+                raise RuntimeError("engine already started")
+            self._t_started = time.monotonic()
+            self._thread = threading.Thread(
+                target=self._loop, name="tmpi-decode-batcher", daemon=True
+            )
+        self._thread.start()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful shutdown: reject new admissions, run every queued
+        AND running generation to completion (zero drops — the fleet
+        invariant), stop the loop, flush the final ``decode`` record.
+        Idempotent."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        drained = True
+        if self._thread is not None:
+            self._thread.join(
+                None if deadline is None
+                else max(0.0, deadline - time.monotonic())
+            )
+            drained = not self._thread.is_alive()
+        with self._sink_lock:
+            first = not self._stopped.is_set()
+            self._stopped.set()
+        if first and self.obs_dir is not None:
+            rec = self.decode_record()
+            with self._sink_lock:
+                if not self._sink_retired:
+                    if self._sink_f is None:
+                        os.makedirs(self.obs_dir, exist_ok=True)
+                        self._sink_f = open(
+                            os.path.join(self.obs_dir, self.sink_name), "a"
+                        )
+                    self._sink_f.write(json.dumps(rec) + "\n")
+                    self._sink_retired = True
+                    self._sink_f.close()
+                    self._sink_f = None
+        return drained
+
+    close = drain
+
+    def abort(self, error: Optional[BaseException] = None) -> None:
+        """Hard death: stop admitting, reject every queued generation,
+        poison the in-flight iteration so running generations reject
+        too (the loop's failure path releases their KV pages — the
+        free-list stays conserved even through a crash). A fronting
+        router re-admits the rejected prompts on healthy replicas."""
+        err = error if error is not None else EngineDead("engine aborted")
+        with self._cond:
+            if self._abort_error is None:
+                self._abort_error = err
+            self._draining = True
+            doomed = list(self._q)
+            self._q.clear()
+            self._g_queue.set(0.0)
+            self._cond.notify_all()
+        for seq in doomed:
+            seq.future._reject(err)
+        if doomed:
+            self._c_requests.inc(len(doomed), status="failed")
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def alive(self) -> bool:
+        t = self._thread
+        return (t is not None and t.is_alive()
+                and self._abort_error is None and not self._draining)
+
+    @property
+    def queue_depth(self) -> int:
+        """Generations waiting for a batch slot (the router's load
+        signal): the submit queue plus the scheduler's waiting line."""
+        return len(self._q) + self._sched.n_waiting
+
+    @property
+    def batch_s_ewma(self) -> Optional[float]:
+        """EWMA seconds per decode iteration (prefills included)."""
+        return self._batch_s_ewma
+
+    # -- request path -------------------------------------------------------
+    def submit(self, x, deadline_ms: Optional[float] = None,
+               max_new_tokens: Optional[int] = None,
+               temperature: Optional[float] = None) -> ServeFuture:
+        """Enqueue one prompt (1-D int token ids); returns a future
+        resolving to :class:`DecodeResult`. Admission control mirrors
+        the eval engine: :class:`EngineOverloaded` /
+        :class:`EngineDraining` raise synchronously, deadline expiry
+        and eviction surface from ``future.result()`` as
+        :class:`DeadlineExceeded`."""
+        prompt = np.asarray(x, np.int32)
+        if prompt.ndim != 1 or prompt.size == 0:
+            raise ValueError(
+                f"prompt must be a non-empty 1-D token row, got shape "
+                f"{prompt.shape}"
+            )
+        if prompt.size > self._sched.max_prompt_len:
+            raise ValueError(
+                f"prompt of {prompt.size} tokens exceeds the largest "
+                f"prefill bucket + 1 ({self._sched.max_prompt_len})"
+            )
+        n_new = self.max_new_tokens if max_new_tokens is None \
+            else int(max_new_tokens)
+        n_new = min(n_new, self.max_context - int(prompt.size))
+        if n_new < 1:
+            raise ValueError(
+                f"prompt of {prompt.size} tokens leaves no room to "
+                f"generate within max_context {self.max_context}"
+            )
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        deadline = (
+            time.monotonic() + float(deadline_ms) / 1000.0
+            if deadline_ms else None
+        )
+        fut = ServeFuture()
+        seq = DecodeSequence(
+            prompt,
+            max_new_tokens=n_new,
+            temperature=(self.default_temperature if temperature is None
+                         else float(temperature)),
+            deadline=deadline,
+            future=fut,
+            t_submit=fut.t_submit,
+        )
+        with self._cond:
+            if self._draining:
+                self._c_requests.inc(status="rejected")
+                raise EngineDraining()
+            depth = len(self._q) + self._sched.n_waiting
+            if depth >= self.max_queue:
+                self._c_requests.inc(status="rejected")
+                batch_s = self._batch_s_ewma or 0.05
+                # a waiting generation needs ~max_new_tokens iterations
+                # once admitted; estimate the backlog in batch rounds
+                rounds = -(-depth // self._cache.max_seqs)
+                raise EngineOverloaded(
+                    depth,
+                    retry_after_ms=1000.0 * batch_s
+                    * self.max_new_tokens * rounds,
+                )
+            self._q.append(seq)
+            self._g_queue.set(len(self._q) + self._sched.n_waiting)
+            self._cond.notify()
+        return fut
+
+    def generate(self, x, deadline_ms: Optional[float] = None,
+                 max_new_tokens: Optional[int] = None,
+                 temperature: Optional[float] = None,
+                 timeout: Optional[float] = 60.0) -> DecodeResult:
+        """Blocking convenience: submit + wait."""
+        return self.submit(
+            x, deadline_ms=deadline_ms, max_new_tokens=max_new_tokens,
+            temperature=temperature,
+        ).result(timeout)
+
+    def infer(self, x, deadline_ms: Optional[float] = None,
+              timeout: Optional[float] = 60.0) -> DecodeResult:
+        """ServeEngine-signature blocking call (the CLI selftest and
+        frontend duck-type this surface)."""
+        return self.generate(x, deadline_ms=deadline_ms, timeout=timeout)
+
+    # -- decode loop --------------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while (not self._q and not self._sched.has_work()
+                       and not self._draining):
+                    self._cond.wait(0.05)
+                if (self._draining and not self._q
+                        and not self._sched.has_work()):
+                    return
+                while self._q:
+                    self._sched.add(self._q.popleft())
+                self._g_queue.set(self._sched.n_waiting)
+            try:
+                self._iteration()
+            except BaseException as e:  # noqa: BLE001 — generations must
+                # never hang on an engine bug: fail everything this loop
+                # owns (releasing its KV pages) and keep the thread
+                # alive. An abort poisons the iteration on purpose —
+                # those count as failed, not rejected
+                self._fail_all(e)
+
+    def _iteration(self) -> None:
+        """One continuous-batching iteration: admit, prefill admitted
+        prompts, run the single decode step, harvest tokens. Exactly
+        ONE host drain point (the np.asarray on the next-token vector)
+        — tools/check_hot_loop.py HOT004 walks this function."""
+        import jax.numpy as jnp
+
+        err = self._abort_error
+        if err is not None:  # the replica died under this iteration
+            raise err
+        now = time.monotonic()
+        served = self._served  # ONE read: the swap point for hot reload
+        admitted, expired = self._sched.admit(now)
+        for seq in expired:
+            seq.future._reject(DeadlineExceeded(
+                "deadline passed before a decode slot opened"
+            ))
+            self._c_requests.inc(status="expired")
+        t0 = time.monotonic()
+        c = self._cache
+        for seq in admitted:
+            pf = self._sched.prefill_args(seq)
+            if pf is None:
+                continue  # 1-token prompt: the decode step handles it
+            bucket, toks, pages = pf
+            c.k_pool, c.v_pool = self._prefill(
+                served.params, jnp.asarray(toks), jnp.asarray(pages),
+                c.k_pool, c.v_pool,
+            )
+            self._c_prefills.inc(bucket=bucket)
+        if not self._sched.running:
+            return
+        tables, seq_lens, last, active, temp = self._sched.step_arrays()
+        nxt, _logits, c.k_pool, c.v_pool = self._decode(
+            served.params, c.k_pool, c.v_pool,
+            jnp.asarray(tables), jnp.asarray(seq_lens), jnp.asarray(last),
+            jnp.asarray(active), jnp.asarray(temp),
+            np.int32(self._iterations),
+        )
+        next_np = np.asarray(nxt)  # the ONE host drain per iteration
+        t_done = time.monotonic()
+        err = self._abort_error
+        if err is not None:  # abort landed mid-step: nothing resolves
+            raise err        # after a death
+        self._harvest(next_np, served.step, t_done, t0)
+
+    def _harvest(self, next_np: np.ndarray, step: int, t_done: float,
+                 t0: float) -> None:
+        """Post-step bookkeeping: append tokens, resolve finished
+        generations, evict deadline-passed ones (typed — never a
+        silent drop), update telemetry."""
+        n_live = 0
+        for slot, seq in list(self._sched.running.items()):
+            tok = int(next_np[slot])
+            seq.generated.append(tok)
+            n_live += 1
+            if seq.t_first_token is None:
+                seq.t_first_token = t_done
+                if seq.t_submit is not None:
+                    self._h_ttft.observe(t_done - seq.t_submit)
+            if seq.done:
+                self._sched.remove(slot, "finished")
+                n = len(seq.generated)
+                if n > 1 and seq.t_first_token is not None:
+                    self._h_tpot.observe(
+                        (t_done - seq.t_first_token) / (n - 1)
+                    )
+                seq.future._resolve(DecodeResult(
+                    np.asarray(seq.generated, np.int32), step
+                ))
+                self._c_requests.inc(status="served")
+        self._tokens_total += n_live
+        self._c_tokens.inc(n_live)
+        for slot in self._sched.running_deadline_victims(t_done):
+            seq = self._sched.remove(slot, "evicted")
+            seq.future._reject(DeadlineExceeded(
+                f"deadline passed after {len(seq.generated)} of "
+                f"{seq.max_new_tokens} tokens — evicted"
+            ))
+            self._c_evicted.inc()
+            self._c_requests.inc(status="evicted")
+        self._g_occupancy.set(self._sched.occupancy)
+        self._g_pages_used.set(self._cache.pages_used)
+        self._g_pages_free.set(self._cache.pages_free)
+        batch_s = t_done - t0
+        self._batch_s_ewma = (
+            batch_s if self._batch_s_ewma is None
+            else 0.8 * self._batch_s_ewma + 0.2 * batch_s
+        )
+        self._iterations += 1
+        if self._iterations % self.record_every == 0:
+            self._write_record(self.decode_record())
+
+    def _fail_all(self, e: BaseException) -> None:
+        """Failure path for a poisoned iteration: reject every
+        generation the loop owns, RELEASING their KV pages so the
+        free-list stays conserved (the chaos oracle checks) and the
+        engine can keep serving if the error was input-local."""
+        failed = 0
+        for slot in list(self._sched.running):
+            seq = self._sched.remove(slot, "evicted")
+            if not seq.future.done():
+                seq.future._reject(e)
+                failed += 1
+        while self._sched.waiting:
+            seq = self._sched.waiting.popleft()
+            if not seq.future.done():
+                seq.future._reject(e)
+                failed += 1
+        if failed:
+            status = "failed" if e is self._abort_error else "rejected"
+            self._c_requests.inc(failed, status=status)
+
+    # -- stats / telemetry --------------------------------------------------
+    def tokens_per_sec(self) -> Optional[float]:
+        if self._t_started is None or not self._tokens_total:
+            return None
+        dt = time.monotonic() - self._t_started
+        return self._tokens_total / dt if dt > 0 else None
+
+    def ttft_ms(self, q: float) -> Optional[float]:
+        s = self._h_ttft.quantile(q)
+        return None if s is None else 1000.0 * s
+
+    def stats(self) -> dict:
+        """Flat numeric snapshot (the ``decode`` record's metrics map;
+        every key ``tmpi_decode_``-prefixed — enforced by the schema
+        checker)."""
+        fl = self._cache.free_list
+        out = {
+            "tmpi_decode_queue_depth": float(self.queue_depth),
+            "tmpi_decode_running": float(self._sched.n_running),
+            "tmpi_decode_batch_occupancy": self._sched.occupancy,
+            "tmpi_decode_kv_pages_used": float(self._cache.pages_used),
+            "tmpi_decode_kv_pages_free": float(self._cache.pages_free),
+            "tmpi_decode_kv_pages_out_total": float(fl.pages_out_total),
+            "tmpi_decode_kv_pages_in_total": float(fl.pages_in_total),
+            "tmpi_decode_iterations_total": float(self._iterations),
+            "tmpi_decode_tokens_total": float(self._tokens_total),
+            "tmpi_decode_served_total": self._c_requests.value(status="served"),
+            "tmpi_decode_expired_total": self._c_requests.value(status="expired"),
+            "tmpi_decode_evicted_total": self._c_evicted.value(),
+            "tmpi_decode_preempted_total": self._c_preempted.value(),
+            "tmpi_decode_rejected_total":
+                self._c_requests.value(status="rejected"),
+            "tmpi_decode_failed_total": self._c_requests.value(status="failed"),
+            "tmpi_decode_reloads_total": self._c_reloads.value(),
+            "tmpi_decode_reload_failures_total":
+                self._c_reloads.value(status="failed"),
+        }
+        tps = self.tokens_per_sec()
+        if tps is not None:
+            out["tmpi_decode_tokens_per_sec"] = tps
+        for name, q in (("p50", 0.5), ("p99", 0.99)):
+            ms = self.ttft_ms(q)
+            if ms is not None:
+                out[f"tmpi_decode_ttft_{name}_ms"] = ms
+        tpot = self._h_tpot.quantile(0.5)
+        if tpot is not None:
+            out["tmpi_decode_tpot_ms"] = 1000.0 * tpot
+        return out
+
+    def decode_record(self) -> dict:
+        """The one constructor of a ``kind=decode`` record (schema:
+        tools/check_obs_schema.py). Replica members stamp
+        ``replica_id``."""
+        rec = {"kind": "decode", "t": time.time(),
+               "params_step": self.params_step, "metrics": self.stats()}
+        if self.replica_id is not None:
+            rec["replica_id"] = self.replica_id
+        return rec
+
+    def _write_record(self, rec: dict) -> None:
+        if self.obs_dir is None:
+            return
+        with self._sink_lock:
+            if self._sink_retired:
+                return
+            if self._sink_f is None:
+                os.makedirs(self.obs_dir, exist_ok=True)
+                self._sink_f = open(
+                    os.path.join(self.obs_dir, self.sink_name), "a"
+                )
+            self._sink_f.write(json.dumps(rec) + "\n")
+            self._sink_f.flush()

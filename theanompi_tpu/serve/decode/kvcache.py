@@ -1,0 +1,203 @@
+"""Paged KV-cache for continuous-batching LM decode.
+
+ONE preallocated device pool per pool-kind (K and V), shaped
+
+    [n_layers, n_pages + 1, page_size, n_heads, head_dim]
+
+so the compiled decode/prefill programs see a FIXED shape forever: pages
+are handed out and returned by a host-side free-list, and the programs
+receive gather/scatter *indices* (per-sequence page tables) instead of
+resized buffers. Index ``n_pages`` is the SCRATCH page — never owned by
+any sequence; inactive batch slots and the padding tail of a prefill
+scatter are routed there, so every write in the jitted step is
+unconditional (no dynamic shapes, no host-side branching) and the
+garbage lands somewhere no read ever looks (reads are masked by
+``seq_lens``).
+
+Admission is worst-case: a sequence reserves
+``pages_needed(prompt_len + max_new_tokens)`` pages up front, so a
+running sequence can NEVER hit an out-of-pages fault mid-generation —
+exhaustion is an admission-time signal (:class:`KVExhausted`), which the
+scheduler turns into queueing, not corruption. Eviction (finish,
+deadline, abort) returns the pages; the free-list keeps conservation
+counters (``pages_out_total``/``pages_in_total``) so the chaos oracle
+can assert pages_out == pages_in after drain.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+
+class KVExhausted(RuntimeError):
+    """Admission could not reserve the sequence's worst-case pages."""
+
+
+def pages_needed(total_len: int, page_size: int) -> int:
+    """Pages covering ``total_len`` cache positions (ceil division)."""
+    if total_len <= 0:
+        return 0
+    return -(-int(total_len) // int(page_size))
+
+
+class FreeList:
+    """Host-side page allocator over physical pages ``0..n_pages-1``.
+
+    Not thread-safe by itself — the scheduler serializes access (one
+    decode loop owns it). Double frees and foreign pages raise: a page
+    accounting bug must surface as an exception, not as two sequences
+    silently sharing a page.
+    """
+
+    def __init__(self, n_pages: int):
+        if n_pages <= 0:
+            raise ValueError(f"n_pages must be positive, got {n_pages}")
+        self.n_pages = int(n_pages)
+        # pop() from the tail hands out ascending page ids — makes unit
+        # tests deterministic and keeps early pages hot
+        self._free: List[int] = list(range(self.n_pages - 1, -1, -1))
+        self._out: set = set()
+        self.pages_out_total = 0
+        self.pages_in_total = 0
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_used(self) -> int:
+        return self.n_pages - len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        """Reserve ``n`` pages or raise :class:`KVExhausted` (atomic:
+        either all ``n`` come out or none do)."""
+        if n < 0:
+            raise ValueError(f"cannot alloc {n} pages")
+        if n > len(self._free):
+            raise KVExhausted(
+                f"need {n} KV pages, only {len(self._free)} free of "
+                f"{self.n_pages}"
+            )
+        pages = [self._free.pop() for _ in range(n)]
+        self._out.update(pages)
+        self.pages_out_total += n
+        return pages
+
+    def free(self, pages: Sequence[int]) -> None:
+        for pg in pages:
+            pg = int(pg)
+            if pg not in self._out:
+                raise ValueError(
+                    f"page {pg} returned but not outstanding "
+                    "(double free, or a page this list never issued)"
+                )
+            self._out.discard(pg)
+            self._free.append(pg)
+            self.pages_in_total += 1
+
+    def conserved(self) -> bool:
+        """True iff every page ever issued came back — the chaos
+        oracle's KV-conservation invariant after drain."""
+        return (
+            not self._out
+            and len(self._free) == self.n_pages
+            and self.pages_in_total == self.pages_out_total
+        )
+
+
+class PagedKVCache:
+    """Pools + per-slot page tables + free-list for up to ``max_seqs``
+    concurrent sequences.
+
+    The pools are jax arrays threaded FUNCTIONALLY through the jitted
+    programs (each step returns updated pools; the cache just holds the
+    latest reference) — nothing here ever resizes device memory. The
+    page tables are a host ``int32 [max_seqs, max_pages_per_seq]``
+    array, scratch-filled for unowned entries, handed to the decode
+    step as a plain input every iteration (a few hundred bytes of H2D).
+    """
+
+    def __init__(
+        self,
+        *,
+        n_layers: int,
+        n_heads: int,
+        head_dim: int,
+        page_size: int,
+        n_pages: int,
+        max_seqs: int,
+        max_pages_per_seq: int,
+        dtype=None,
+    ):
+        import jax.numpy as jnp  # deferred: FreeList stays importable sans jax
+
+        if page_size <= 0:
+            raise ValueError(f"page_size must be positive, got {page_size}")
+        if max_pages_per_seq <= 0 or max_pages_per_seq > n_pages:
+            raise ValueError(
+                f"max_pages_per_seq={max_pages_per_seq} must be in "
+                f"1..n_pages ({n_pages})"
+            )
+        self.page_size = int(page_size)
+        self.n_pages = int(n_pages)
+        self.scratch = self.n_pages  # the sacrificial page index
+        self.max_seqs = int(max_seqs)
+        self.max_pages_per_seq = int(max_pages_per_seq)
+        shape = (n_layers, self.n_pages + 1, self.page_size, n_heads, head_dim)
+        dt = dtype if dtype is not None else jnp.float32
+        self.k_pool = jnp.zeros(shape, dt)
+        self.v_pool = jnp.zeros(shape, dt)
+        self.free_list = FreeList(self.n_pages)
+        self.page_tables = np.full(
+            (self.max_seqs, self.max_pages_per_seq), self.scratch, np.int32
+        )
+        self._slot_pages: dict = {}
+
+    @property
+    def max_context(self) -> int:
+        """Longest sequence (prompt + generated) a slot can hold."""
+        return self.max_pages_per_seq * self.page_size
+
+    def reserve(self, slot: int, total_len: int) -> List[int]:
+        """Reserve worst-case pages for a sequence of ``total_len``
+        positions into ``slot``. Raises :class:`KVExhausted` when the
+        free-list cannot cover it; raises ValueError for a slot already
+        holding pages (the scheduler must release first)."""
+        if slot in self._slot_pages:
+            raise ValueError(f"slot {slot} already holds pages")
+        need = pages_needed(total_len, self.page_size)
+        if need > self.max_pages_per_seq:
+            raise KVExhausted(
+                f"sequence needs {need} pages "
+                f"({total_len} positions / page_size {self.page_size}) "
+                f"but a slot holds at most {self.max_pages_per_seq}"
+            )
+        pages = self.free_list.alloc(need)
+        self.page_tables[slot, :] = self.scratch
+        self.page_tables[slot, :need] = pages
+        self._slot_pages[slot] = pages
+        return pages
+
+    def release(self, slot: int) -> int:
+        """Return ``slot``'s pages to the free-list (idempotent for a
+        slot holding none). Returns how many pages came back."""
+        pages = self._slot_pages.pop(slot, None)
+        self.page_tables[slot, :] = self.scratch
+        if not pages:
+            return 0
+        self.free_list.free(pages)
+        return len(pages)
+
+    def release_all(self) -> int:
+        """Drain-time sweep: return every outstanding slot's pages."""
+        return sum(self.release(s) for s in list(self._slot_pages))
+
+    @property
+    def pages_used(self) -> int:
+        return self.free_list.n_used
+
+    @property
+    def pages_free(self) -> int:
+        return self.free_list.n_free

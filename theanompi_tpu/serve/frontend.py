@@ -21,6 +21,10 @@ Routes::
     POST /infer    {"input": <nested list, recipe.input_shape>,
                     "deadline_ms": <optional>}
                 -> 200 {"logits": [...], "step": N}
+                   (fronting a decode engine — ``tmpi serve --decode``
+                   — "input" is a 1-D token prompt and the response is
+                   {"tokens": [...], "step": N}: the generated
+                   continuation instead of a logits row)
                    503 + Retry-After on overload/draining
                    504 on deadline expiry
     GET /healthz -> 200 {"params_step", "queue_depth", "draining"} —
@@ -75,7 +79,10 @@ def make_handler(engine):
                     return
                 body = {
                     "params_step": engine.params_step,
-                    "queue_depth": int(engine.stats()["tmpi_serve_queue_depth"]),
+                    # the shared-surface property, NOT a stats() key —
+                    # ServeEngine prefixes tmpi_serve_, DecodeEngine
+                    # tmpi_decode_; only queue_depth is common
+                    "queue_depth": int(engine.queue_depth),
                     "draining": engine.draining,
                 }
                 self._reply(503 if engine.draining else 200, body)
@@ -122,10 +129,18 @@ def make_handler(engine):
                 # not a reset socket
                 self._reply(500, {"error": f"inference failed: {e!r}"})
             else:
-                self._reply(200, {
-                    "logits": np.asarray(res.logits, np.float64).tolist(),
-                    "step": res.step,
-                })
+                if hasattr(res, "tokens"):
+                    # decode engine (serve/decode): the result is the
+                    # generated continuation, not a logits row
+                    self._reply(200, {
+                        "tokens": np.asarray(res.tokens, np.int64).tolist(),
+                        "step": res.step,
+                    })
+                else:
+                    self._reply(200, {
+                        "logits": np.asarray(res.logits, np.float64).tolist(),
+                        "step": res.step,
+                    })
 
     return Handler
 

@@ -42,13 +42,37 @@ def serving_state_template(model):
     )
 
 
-def load_for_serving(path: str, model):
+def load_for_serving(path: str, model, target_mesh=None):
     """Restore ``(params, model_state, step)`` from a training
     checkpoint — the optimizer state and rng are loaded (the file's
-    structure demands it) and dropped (serving needs neither)."""
-    from theanompi_tpu.utils.checkpoint import checkpoint_step, load_checkpoint
+    structure demands it) and dropped (serving needs neither).
 
-    state, _rng = load_checkpoint(path, serving_state_template(model))
+    The load goes through :func:`~theanompi_tpu.utils.checkpoint.
+    load_resharded` against the SERVING mesh (``ShardingRecipe.serve()``
+    by default; ``tmpi serve --shard tensor`` passes its tensor-serve
+    mesh), which is the real train->serve handoff: a checkpoint stamped
+    on a pod's training mesh loads onto a 1-chip serving mesh (or a
+    tensor-sharded serving mesh) by reading each leaf's GLOBAL bounds
+    under the stamped ``__topology__`` manifest. A pre-manifest
+    checkpoint whose leaves match falls back to the plain structural
+    load — same-mesh serving stays bit-identical
+    (tests/test_serve_reload.py::test_load_for_serving_cross_topology).
+    """
+    from theanompi_tpu.utils.checkpoint import checkpoint_step, load_resharded
+
+    if target_mesh is None:
+        from theanompi_tpu.parallel.recipe import ShardingRecipe
+
+        target_mesh = ShardingRecipe.serve().mesh
+    state, _rng, info = load_resharded(
+        path, serving_state_template(model), target_mesh
+    )
+    if info.get("resharded"):
+        print(
+            f"[serve.reload] resharded {path!r} from a "
+            f"{info.get('from_world')}-device training mesh onto the "
+            f"{info.get('to_world')}-device serving mesh", flush=True,
+        )
     return state.params, state.model_state, checkpoint_step(path)
 
 

@@ -473,9 +473,39 @@ class _Model:
         return out
 
     # -- context propagation ------------------------------------------------
+    def _factory_types(self, scope: ast.AST, fname: str) -> set:
+        """Class names a LOCAL factory function named ``fname`` (defined
+        anywhere inside ``scope``) may return via a direct
+        ``return ClassName(...)``. The serve CLI builds its engine
+        through per-branch ``_make`` factories (ServeEngine on one
+        branch, DecodeEngine on the other), so ``engine = _make()``
+        must ctor-type the local with EVERY branch's return type or the
+        reloader/router consumers lose their dispatch targets."""
+        cache = getattr(self, "_factory_cache", None)
+        if cache is None:
+            cache = self._factory_cache = {}
+        key = (id(scope), fname)
+        hit = cache.get(key)
+        if hit is not None:
+            return hit
+        out: set = set()
+        cache[key] = out
+        for sub in ast.walk(scope):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and sub.name == fname:
+                for ret in ast.walk(sub):
+                    if isinstance(ret, ast.Return) and \
+                            isinstance(ret.value, ast.Call):
+                        cname = _term(ret.value.func)
+                        if cname in self.classes:
+                            out.add(cname)
+        return out
+
     def _ctor_types(self, scope: ast.AST) -> dict:
         """Local name -> class name for ``x = ClassName(...)`` bindings
-        in ``scope`` (constructor-typed locals). Memoized per scope."""
+        in ``scope`` (constructor-typed locals, including calls to a
+        local factory with exactly ONE return type — ambiguous
+        factories stay multi-only). Memoized per scope."""
         cache = getattr(self, "_ctor_cache", None)
         if cache is None:
             cache = self._ctor_cache = {}
@@ -491,6 +521,10 @@ class _Model:
                 cname = _term(sub.value.func)
                 if cname in self.classes:
                     types[sub.targets[0].id] = cname
+                elif cname is not None:
+                    facs = self._factory_types(scope, cname)
+                    if len(facs) == 1:
+                        types[sub.targets[0].id] = next(iter(facs))
         return types
 
     def _ctor_types_multi(self, scope: ast.AST) -> dict:
@@ -513,6 +547,11 @@ class _Model:
                 cname = _term(sub.value.func)
                 if cname in self.classes:
                     types.setdefault(sub.targets[0].id, set()).add(cname)
+                elif cname is not None:
+                    facs = self._factory_types(scope, cname)
+                    if facs:
+                        types.setdefault(sub.targets[0].id,
+                                         set()).update(facs)
         return types
 
     def _resolve_method(self, recv: ast.expr, mname: str,

@@ -67,6 +67,22 @@ instead of re-admitted) the campaign must catch and shrink::
 
     tmpi chaos --serve --seeds 10
     tmpi chaos --serve --schedule replica_crash@0.4 --mutate drop_inflight
+
+``--serve --decode`` points the serving campaign at a fleet of
+continuous-batching DECODE engines (serve/decode/) instead of
+eval-forward engines: clients stream mixed-length token prompts, and
+the schedule draws from :data:`DECODE_MATRIX` — the shared kinds plus
+``kv_exhaust@t:s`` (grab nearly every free KV page from inside a
+member's decode loop and hold it for s seconds: admission must queue
+on the free-list, never corrupt or crash) and ``long_prompt_burst@t``
+(a concurrent burst of worst-case prompts with maximum output budgets
+slamming the largest prefill bucket and the page reservation path).
+The oracle gains ``kv_conserved``: after drain every member's KV
+free-list must hold pages_out == pages_in with zero outstanding — a
+leaked page is a silent capacity loss that compounds across requests::
+
+    tmpi chaos --serve --decode --seeds 10
+    tmpi chaos --serve --decode --schedule kv_exhaust@0.4:0.5
 """
 
 from __future__ import annotations
@@ -714,6 +730,29 @@ SERVE_MATRIX: dict[str, dict] = {
     "slow_replica": {"arg": 0.05},
 }
 
+# the decode fleet's matrix (``--serve --decode``): the engine-agnostic
+# kinds, plus
+#   kv_exhaust       from inside one member's decode loop, alloc all
+#                    but one free KV page and hold them ARG seconds —
+#                    admission must back up on the free-list (FIFO
+#                    queueing, typed KVExhausted internally) and
+#                    resume when the pages return; never a crash, a
+#                    drop, or a corrupted page table
+#   long_prompt_burst  a concurrent burst of worst-case-length prompts
+#                    with maximum output budgets — slams the largest
+#                    prefill bucket, the worst-case page reservation,
+#                    and slot contention all at once
+# (slow_replica is omitted: per-batch latency injection wraps the
+# eval engine's _serve_batch; the decode equivalent of a persistently
+# slow member is kv_exhaust's page pressure)
+DECODE_MATRIX: dict[str, dict] = {
+    "replica_crash": {},
+    "replica_stall": {"arg": 0.3},
+    "reload_corrupt": {},
+    "kv_exhaust": {"arg": 0.5},
+    "long_prompt_burst": {},
+}
+
 SERVE_INVARIANTS = (
     "no_drops",        # zero dropped/failed requests while the
                        # surviving capacity sufficed (every request
@@ -727,41 +766,48 @@ SERVE_INVARIANTS = (
                        # router drained cleanly
     "schema",          # every obs JSONL line validates (router.jsonl,
                        # serve_r<id>.jsonl included)
+    "kv_conserved",    # decode fleets only: after drain, every
+                       # member's KV free-list is whole (pages_out ==
+                       # pages_in, zero outstanding) — a leaked page
+                       # is silent capacity loss
 )
 
 
-def parse_serve_spec(spec: str) -> tuple:
+def parse_serve_spec(spec: str, matrix: Optional[dict] = None) -> tuple:
     """``KIND@T[:ARG]`` -> (kind, t_seconds, arg)."""
+    matrix = SERVE_MATRIX if matrix is None else matrix
     kind, sep, rest = spec.partition("@")
-    if not sep or kind not in SERVE_MATRIX:
+    if not sep or kind not in matrix:
         raise ValueError(
             f"serve fault spec {spec!r} must be KIND@T with kind in "
-            f"{sorted(SERVE_MATRIX)}"
+            f"{sorted(matrix)}"
         )
     t_s, sep2, arg_s = rest.partition(":")
-    arg = float(arg_s) if sep2 else SERVE_MATRIX[kind].get("arg")
+    arg = float(arg_s) if sep2 else matrix[kind].get("arg")
     return kind, float(t_s), arg
 
 
 def generate_serve_schedule(rng: random.Random, duration: float,
-                            max_faults: int) -> list[str]:
+                            max_faults: int,
+                            matrix: Optional[dict] = None) -> list[str]:
     """One fuzzed serving schedule: 1..max_faults specs inside the load
     window, with the training generator's composition pressure (~0.4
     probability a fault lands on/next to the previous one's time — a
     crash DURING a stall, a second crash inside the first restart's
     backoff window)."""
+    matrix = SERVE_MATRIX if matrix is None else matrix
     n = rng.randint(1, max_faults)
     schedule: list[str] = []
     prev_t: Optional[float] = None
     for _ in range(n):
-        kind = rng.choice(sorted(SERVE_MATRIX))
+        kind = rng.choice(sorted(matrix))
         if prev_t is not None and rng.random() < 0.4:
             t = min(0.8 * duration, prev_t + rng.choice((0.0, 0.1)))
         else:
             t = rng.uniform(0.15 * duration, 0.7 * duration)
         t = round(t, 2)
         prev_t = t
-        arg = SERVE_MATRIX[kind].get("arg")
+        arg = matrix[kind].get("arg")
         schedule.append(f"{kind}@{t}" + (f":{arg}" if arg is not None
                                          else ""))
     return schedule
@@ -776,6 +822,9 @@ class ServeRunResult:
     drained: bool = False
     error: Optional[str] = None
     obs_dir: str = ""
+    # decode fleets only: every member's KV free-list whole after
+    # drain (None = not a decode run, invariant not applicable)
+    kv_conserved: Optional[bool] = None
 
 
 def _serve_model():
@@ -785,29 +834,82 @@ def _serve_model():
         input_shape=(8, 8, 3), batch_size=8))
 
 
+def _decode_model():
+    from theanompi_tpu.models.zoo import zoo_entry
+
+    cls, _ = zoo_entry("transformer_lm")
+    return cls(cls.default_recipe().replace(
+        input_shape=(64,), num_classes=32, d_model=32, n_heads=2,
+        n_layers=2, d_ff=64, attn="ring", batch_size=4))
+
+
 def _degrade_engine(eng, seconds: float, once: bool) -> None:
     """Wrap one engine's batch path with injected latency — the
     chaos-side stand-in for a GC pause / noisy neighbor (`once`) or a
-    persistently slow host (not `once`)."""
-    orig = eng._serve_batch
+    persistently slow host (not `once`). The eval engine's unit of
+    work is ``_serve_batch``; the decode engine's is ``_iteration``."""
+    if hasattr(eng, "_serve_batch"):
+        orig = eng._serve_batch
 
-    def stalled(reqs):
-        if once:
-            eng._serve_batch = orig
-        time.sleep(seconds)
-        orig(reqs)
+        def stalled(reqs):
+            if once:
+                eng._serve_batch = orig
+            time.sleep(seconds)
+            orig(reqs)
 
-    eng._serve_batch = stalled
+        eng._serve_batch = stalled
+    else:
+        orig = eng._iteration
+
+        def stalled_iter():
+            if once:
+                eng._iteration = orig
+            time.sleep(seconds)
+            orig()
+
+        eng._iteration = stalled_iter
+
+
+def _exhaust_engine(eng, hold_s: float, held: list) -> None:
+    """kv_exhaust: from INSIDE the decode loop (the free-list is
+    single-owner — foreign-thread allocs would race admission), grab
+    all but one free KV page on the next iteration and hold them for
+    ``hold_s`` seconds. Admission must back up on the free-list and
+    resume when the pages return. ``held`` collects the grab so the
+    runner can return pages that are still out when the window closes
+    (after drain, once the batcher thread is gone)."""
+    orig = eng._iteration
+    grab: dict = {"fl": eng._cache.free_list, "pages": None, "t0": None}
+    held.append(grab)
+
+    def exhausted_iter():
+        fl = grab["fl"]
+        now = time.perf_counter()
+        if grab["pages"] is None:
+            n = max(0, fl.n_free - 1)
+            grab["pages"] = fl.alloc(n) if n else []
+            grab["t0"] = now
+        elif grab["pages"] and now - grab["t0"] >= hold_s:
+            fl.free(grab["pages"])
+            grab["pages"] = []
+            eng._iteration = orig
+        orig()
+
+    eng._iteration = exhausted_iter
 
 
 def run_serve_schedule(schedule: list[str], workdir: str, *,
                        replicas: int = 2, duration: float = 2.0,
                        clients: int = 4, mutate: Optional[str] = None,
-                       seed: int = 0) -> ServeRunResult:
+                       seed: int = 0,
+                       decode: bool = False) -> ServeRunResult:
     """Run one serving schedule in-process: an N-replica Router under
     closed-loop client load, the fault controller firing the schedule
     at its T marks, and ALWAYS a good checkpoint committed mid-window
-    (hot-reload under load rides every schedule)."""
+    (hot-reload under load rides every schedule). ``decode=True``
+    swaps the fleet members for continuous-batching decode engines
+    (clients stream mixed-length token prompts; the Router is
+    UNCHANGED — that composition is the point)."""
     import jax
 
     from theanompi_tpu.serve.engine import (
@@ -824,7 +926,7 @@ def run_serve_schedule(schedule: list[str], workdir: str, *,
     ckpt_dir = os.path.join(workdir, "ckpt")
     os.makedirs(ckpt_dir, exist_ok=True)
 
-    model = _serve_model()
+    model = _decode_model() if decode else _serve_model()
     state = init_train_state(model, jax.random.PRNGKey(0))
     ckpt_step = [1]
 
@@ -842,10 +944,20 @@ def run_serve_schedule(schedule: list[str], workdir: str, *,
     save_checkpoint(ckpt_dir, state, 1, rng=jax.random.PRNGKey(1), keep=10)
 
     def _member(rid):
-        eng = ServeEngine(
-            model, buckets=(1, 4), max_queue=256, obs_dir=res.obs_dir,
-            replica_id=rid, sink_name=f"serve_r{rid}.jsonl",
-        )
+        if decode:
+            from theanompi_tpu.serve.decode import DecodeEngine
+
+            eng = DecodeEngine(
+                model, prefill_buckets=(4, 8), page_size=4,
+                kv_pages=48, max_seqs=4, max_new_tokens=6,
+                max_queue=256, obs_dir=res.obs_dir,
+                replica_id=rid, sink_name=f"decode_r{rid}.jsonl",
+            )
+        else:
+            eng = ServeEngine(
+                model, buckets=(1, 4), max_queue=256, obs_dir=res.obs_dir,
+                replica_id=rid, sink_name=f"serve_r{rid}.jsonl",
+            )
         eng.load_initial(ckpt_dir)
         eng.warmup()
         eng.start()
@@ -861,12 +973,20 @@ def run_serve_schedule(schedule: list[str], workdir: str, *,
     stop = threading.Event()
     ledgers: list[list] = [[] for _ in range(clients)]
 
+    vocab = int(getattr(model.recipe, "num_classes", 0) or 0)
+
     def _client(idx: int) -> None:
         r = np.random.RandomState(1000 + idx)
-        shape = tuple(model.recipe.input_shape)
-        x = r.randn(*shape).astype(np.float32)
+        if not decode:
+            shape = tuple(model.recipe.input_shape)
+            x = r.randn(*shape).astype(np.float32)
         i = 0
         while not stop.is_set():
+            if decode:
+                # mixed-length token prompts spanning every prefill
+                # bucket plus the prefill-free single-token path
+                x = r.randint(0, vocab, size=r.randint(1, 10),
+                              dtype=np.int32)
             # every 4th request carries a (generous) deadline so the
             # deadline invariant exercises the expiry path under faults
             deadline = 2000.0 if i % 4 == 0 else None
@@ -912,6 +1032,41 @@ def run_serve_schedule(schedule: list[str], workdir: str, *,
                 _degrade_engine(rep.engine,
                                 arg or SERVE_MATRIX[kind]["arg"],
                                 once=(kind == "replica_stall"))
+        elif kind == "kv_exhaust":
+            rep = next((rep for rep in router._replicas
+                        if rep.state == "healthy"
+                        and rep.engine is not None), None)
+            if rep is not None:
+                _exhaust_engine(rep.engine,
+                                arg or DECODE_MATRIX[kind]["arg"], held)
+        elif kind == "long_prompt_burst":
+            # worst-case prompts (largest bucket + 1) with maximum
+            # output budgets, submitted concurrently through the
+            # router; outcomes land in their own ledger so the oracle
+            # scores them like any client's
+            top = 9  # the decode members' largest prefill bucket + 1
+            prompts = [burst_rng.randint(0, max(vocab, 2), size=top,
+                                         dtype=np.int32)
+                       for _ in range(2 * replicas + 2)]
+
+            def _burst_wait(p):
+                entry: dict = {"deadline_ms": None}
+                t0 = time.perf_counter()
+                try:
+                    out = router.infer(p, timeout=30.0)
+                    entry.update(status="served", step=int(out.step))
+                except RequestDropped as e:
+                    entry.update(status="dropped", error=repr(e))
+                except Rejected as e:
+                    entry.update(status="rejected", error=type(e).__name__)
+                except Exception as e:  # noqa: BLE001 — oracle evidence
+                    entry.update(status="failed", error=repr(e))
+                entry["ms"] = round(1000.0 * (time.perf_counter() - t0), 3)
+                burst_ledger.append(entry)
+
+            for p in prompts:
+                threading.Thread(target=_burst_wait, args=(p,),
+                                 daemon=True).start()
         elif kind == "reload_corrupt":
             _commit(corrupt=True)
             reloader.poll_once()  # force the load attempt NOW (it is
@@ -925,11 +1080,17 @@ def run_serve_schedule(schedule: list[str], workdir: str, *,
             # a loaded box its first poll can start after the window
             reloader.poll_once()
 
-    events = [parse_serve_spec(s) for s in schedule]
+    events = [parse_serve_spec(s, DECODE_MATRIX if decode else None)
+              for s in schedule]
     # hot-reload-under-load rides EVERY schedule: a good checkpoint
-    # lands mid-window, so faults compose with a live swap
+    # lands mid-window, so faults compose with a live swap (for a
+    # decode fleet this IS hot-reload mid-generation: in-flight
+    # sequences keep generating across the fleet-wide param swap)
     events.append(("good_reload", round(duration * 0.5, 2), None))
     events.sort(key=lambda e: e[1])
+    held: list = []            # kv_exhaust grabs (returned post-drain)
+    burst_ledger: list = []    # long_prompt_burst outcomes
+    burst_rng = np.random.RandomState(seed * 7 + 3)
 
     def _controller() -> None:
         t_start = time.perf_counter()
@@ -968,8 +1129,22 @@ def run_serve_schedule(schedule: list[str], workdir: str, *,
             res.error = res.error or "client/controller thread hung"
         reloader.stop()
         res.drained = router.drain(timeout=30.0)
+    if decode:
+        # return any kv_exhaust pages still out when the window closed
+        # (safe now: drain stopped the batcher threads that own the
+        # free-lists), then assert conservation over every member that
+        # is still attached — crashed members were failed-over and
+        # their replacement engines are the ones in rotation
+        for grab in held:
+            if grab["pages"]:
+                grab["fl"].free(grab["pages"])
+                grab["pages"] = []
+        res.kv_conserved = all(
+            rep.engine._cache.free_list.conserved()
+            for rep in router._replicas if rep.engine is not None
+        )
     res.router_stats = router.stats()
-    res.ledgers = ledgers
+    res.ledgers = ledgers + ([burst_ledger] if burst_ledger else [])
     return res
 
 
@@ -1010,6 +1185,9 @@ def check_serve_invariants(schedule: list[str],
             viol.append("deadline")  # served long past its deadline
             break
 
+    if res.kv_conserved is False:  # decode fleets only (None = N/A)
+        viol.append("kv_conserved")
+
     viol.extend(_schema_violations(res.obs_dir))
     return viol
 
@@ -1017,7 +1195,8 @@ def check_serve_invariants(schedule: list[str],
 def shrink_serve_schedule(schedule: list[str], workdir: str, *,
                           replicas: int, duration: float, clients: int,
                           mutate: Optional[str], seed: int,
-                          max_runs: int = 16) -> tuple[list[str], int]:
+                          max_runs: int = 16,
+                          decode: bool = False) -> tuple[list[str], int]:
     """Greedy delta-debugging over a failing serving schedule — same
     fixed-point loop as the training shrink."""
     current = list(schedule)
@@ -1031,7 +1210,8 @@ def shrink_serve_schedule(schedule: list[str], workdir: str, *,
             runs += 1
             r = run_serve_schedule(cand, wd, replicas=replicas,
                                    duration=duration, clients=clients,
-                                   mutate=mutate, seed=seed)
+                                   mutate=mutate, seed=seed,
+                                   decode=decode)
             if check_serve_invariants(cand, r):
                 current = cand
                 changed = True
@@ -1045,11 +1225,14 @@ def run_serve_campaign(args: argparse.Namespace) -> dict:
     out_dir = os.path.abspath(args.out)
     os.makedirs(out_dir, exist_ok=True)
     chaos_log = os.path.join(out_dir, "chaos.jsonl")
-    config_name = f"serve_{args.replicas}r"
+    decode = bool(getattr(args, "decode", False))
+    matrix = DECODE_MATRIX if decode else SERVE_MATRIX
+    kind_name = "decode" if decode else "serve"
+    config_name = f"{kind_name}_{args.replicas}r"
 
     if args.schedule:
         for s in args.schedule.split("+"):
-            parse_serve_spec(s)  # fail fast on a bad directed spec
+            parse_serve_spec(s, matrix)  # fail fast on a bad spec
         plans = [(args.seed, args.schedule.split("+"))]
     else:
         plans = []
@@ -1057,7 +1240,7 @@ def run_serve_campaign(args: argparse.Namespace) -> dict:
             seed = args.seed + i
             rng = random.Random(seed * 100003 + 29)
             plans.append((seed, generate_serve_schedule(
-                rng, args.serve_duration, args.max_faults)))
+                rng, args.serve_duration, args.max_faults, matrix)))
 
     t_start = time.perf_counter()
     # no parity baseline on the serving path; the bucket stays for the
@@ -1067,12 +1250,12 @@ def run_serve_campaign(args: argparse.Namespace) -> dict:
     n_bad = 0
     with open(chaos_log, "a") as log_f:
         for seed, schedule in plans:
-            wd = os.path.join(out_dir, f"serve_seed{seed}")
+            wd = os.path.join(out_dir, f"{kind_name}_seed{seed}")
             t0 = time.perf_counter()
             res = run_serve_schedule(
                 schedule, wd, replicas=args.replicas,
                 duration=args.serve_duration, clients=args.serve_clients,
-                mutate=args.mutate, seed=seed)
+                mutate=args.mutate, seed=seed, decode=decode)
             viol = check_serve_invariants(schedule, res)
             timings["runs"] += time.perf_counter() - t0
             rec = {
@@ -1089,14 +1272,14 @@ def run_serve_campaign(args: argparse.Namespace) -> dict:
                     schedule, wd, replicas=args.replicas,
                     duration=args.serve_duration,
                     clients=args.serve_clients, mutate=args.mutate,
-                    seed=seed)
+                    seed=seed, decode=decode)
                 timings["shrink"] += time.perf_counter() - t0
                 rec["shrunk_schedule"] = "+".join(minimal)
-                rec["repro"] = (f"--serve --schedule "
-                                f"{'+'.join(minimal)}")
+                rec["repro"] = (f"--serve {'--decode ' if decode else ''}"
+                                f"--schedule {'+'.join(minimal)}")
                 rec["runs"] = rec["runs"] + shrink_runs
-                print(f"[chaos] serve seed {seed} VIOLATED {viol} by "
-                      f"{'+'.join(schedule)}; minimal repro: "
+                print(f"[chaos] {kind_name} seed {seed} VIOLATED {viol} "
+                      f"by {'+'.join(schedule)}; minimal repro: "
                       f"{rec['repro']}", flush=True)
                 if res.error:
                     print(f"[chaos]   run error: {res.error[:400]}",
@@ -1105,7 +1288,7 @@ def run_serve_campaign(args: argparse.Namespace) -> dict:
                 n_served = sum(
                     1 for ledger in res.ledgers for e in ledger
                     if e["status"] == "served")
-                print(f"[chaos] serve seed {seed} ok: "
+                print(f"[chaos] {kind_name} seed {seed} ok: "
                       f"{'+'.join(schedule)} absorbed "
                       f"({n_served} served, "
                       f"{int(res.router_stats.get('tmpi_router_failovers_total', 0))}"
@@ -1119,7 +1302,7 @@ def run_serve_campaign(args: argparse.Namespace) -> dict:
         "schedules": len(results),
         "ok": len(results) - n_bad,
         "violated": n_bad,
-        "kinds": sorted(SERVE_MATRIX),
+        "kinds": sorted(matrix),
         "configs": [config_name],
         "mutate": args.mutate,
         "results": results,
@@ -1276,6 +1459,12 @@ def chaos_main(argv: Optional[list] = None) -> int:
                     help="chaos the SERVING path instead of training: "
                          "fuzzed SERVE_MATRIX schedules against an "
                          "N-replica router under client load")
+    ap.add_argument("--decode", action="store_true",
+                    help="with --serve: fleet of continuous-batching "
+                         "decode engines; schedules draw from "
+                         "DECODE_MATRIX (adds kv_exhaust/"
+                         "long_prompt_burst) and the oracle adds "
+                         "kv_conserved")
     ap.add_argument("--replicas", type=int, default=2, metavar="N",
                     help="--serve: replica-group size")
     ap.add_argument("--serve-duration", type=float, default=2.0,
@@ -1291,6 +1480,9 @@ def chaos_main(argv: Optional[list] = None) -> int:
                     help="print the full JSON report to stdout")
     args = ap.parse_args(argv)
 
+    if args.decode and not args.serve:
+        raise SystemExit("--decode modifies the serving campaign; "
+                         "pass --serve --decode")
     if args.mutate == "drop_inflight" and not args.serve:
         raise SystemExit("--mutate drop_inflight needs --serve (it is "
                          "a router bug, not a training one)")

@@ -26,6 +26,17 @@ dequeue loop (``_loop``) and inside any per-request ``for`` loop of
 ``_serve_batch`` — the "fetch each request's logits separately" patch
 that would turn one device round trip per batch into one per request.
 
+**Decode hot loop** (ISSUE 20 satellite, rule HOT004): the continuous-
+batching decode engine (``serve/decode/engine.py``) has a stricter
+contract than the eval engine — exactly ONE host drain per iteration,
+the top-level ``np.asarray`` on the fused next-token vector in
+``DecodeEngine._iteration``. ``check_decode_source`` flags host-
+materializing calls anywhere in the batcher's dispatch loop (``_loop``)
+and inside any per-sequence ``for`` loop of ``_iteration`` — the
+"fetch each sequence's token separately" patch that would turn one
+device round trip per iteration into one per RUNNING SEQUENCE (and
+with it the whole point of batching the decode step).
+
 **Profiler warm-step path** (ISSUE 12 satellite): ``tmpi profile``
 (tools/profile.py) measures by blocking, but only at its sanctioned
 points — the ``one_step`` closure's ``block_until_ready`` reads. Rule
@@ -37,7 +48,7 @@ profiler times.
 Usage::
 
     python -m theanompi_tpu.tools.check_hot_loop            # worker + serve
-                                                            # + profile
+                                                            # + decode + profile
     python -m theanompi_tpu.tools.check_hot_loop path.py    # train-loop lint
                                                             # on that file
 
@@ -72,11 +83,18 @@ SERVE_PATH = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "serve", "engine.py",
 )
+DECODE_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "serve", "decode", "engine.py",
+)
 PROFILE_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "profile.py",
 )
 # the serve micro-batch hot path: the dequeue loop and the batch server
 _SERVE_FUNCS = ("_loop", "_serve_batch")
+# the decode hot path (HOT004): the batcher's dispatch loop and the
+# continuous-batching iteration it drives
+_DECODE_FUNCS = ("_loop", "_iteration")
 # `tmpi profile` hot path anchors (tools/profile.py): the per-step
 # closure holding the SANCTIONED blocked reads, and the warm/measure
 # loops that drive it
@@ -164,6 +182,20 @@ def _serve_funcs(tree: ast.Module) -> list:
     return fns
 
 
+def _outermost_for_nodes(fn: ast.FunctionDef):
+    """AST nodes inside ``fn``'s outermost ``for`` loops only — a
+    nested loop's subtree is already covered by its ancestor's walk
+    (double-reporting would inflate the violation count), and calls at
+    the function's top level are the sanctioned once-per-batch /
+    once-per-iteration sync points."""
+    fors = [n for n in ast.walk(fn) if isinstance(n, ast.For)]
+    inner = {id(sub) for loop in fors
+             for sub in ast.walk(loop) if sub is not loop
+             and isinstance(sub, ast.For)}
+    return (n for loop in fors if id(loop) not in inner
+            for n in ast.walk(loop))
+
+
 def check_serve_source(source: str) -> list:
     """Violation strings for the serve micro-batch hot path (empty =
     clean). ``_loop`` must never materialize host values (it holds the
@@ -172,18 +204,8 @@ def check_serve_source(source: str) -> list:
     fetch) but never inside a per-request ``for`` loop."""
     errs = []
     for fn in _serve_funcs(ast.parse(source)):
-        if fn.name == "_loop":
-            nodes = ast.walk(fn)
-        else:
-            # outermost For loops only: a nested loop's subtree is
-            # already covered by its ancestor's walk (double-reporting
-            # would inflate the violation count)
-            fors = [n for n in ast.walk(fn) if isinstance(n, ast.For)]
-            inner = {id(sub) for loop in fors
-                     for sub in ast.walk(loop) if sub is not loop
-                     and isinstance(sub, ast.For)}
-            nodes = (n for loop in fors if id(loop) not in inner
-                     for n in ast.walk(loop))
+        nodes = (ast.walk(fn) if fn.name == "_loop"
+                 else _outermost_for_nodes(fn))
         for node in nodes:
             if not isinstance(node, ast.Call):
                 continue
@@ -197,6 +219,49 @@ def check_serve_source(source: str) -> list:
                     "(one materialization per micro-batch, at "
                     "_serve_batch top level, is the sanctioned sync "
                     "point)"
+                )
+    return errs
+
+
+def check_decode_source(source: str) -> list:
+    """Violation strings for the continuous-batching decode hot path
+    (``serve/decode/engine.py``; empty = clean) — rule HOT004. The
+    contract: exactly ONE host drain per decode iteration, the
+    top-level ``np.asarray`` on the fused next-token vector in
+    ``_iteration``. ``_loop`` (the batcher thread: it holds the engine
+    condvar and gates every sequence's next token) must never
+    materialize host values; inside ``_iteration`` no per-sequence
+    ``for`` loop may — per-sequence fetches multiply the round trip by
+    the running-batch size. Anchor-guarded: renaming ``_loop`` /
+    ``_iteration`` fails loudly instead of silently passing."""
+    tree = ast.parse(source)
+    fns = [node for node in ast.walk(tree)
+           if isinstance(node, ast.FunctionDef)
+           and node.name in _DECODE_FUNCS]
+    if len(fns) < len(_DECODE_FUNCS):
+        found = {f.name for f in fns}
+        raise ValueError(
+            f"decode hot-path anchors "
+            f"{sorted(set(_DECODE_FUNCS) - found)} not found — the "
+            "decode iteration moved; update tools/check_hot_loop.py"
+        )
+    errs = []
+    for fn in fns:
+        nodes = (ast.walk(fn) if fn.name == "_loop"
+                 else _outermost_for_nodes(fn))
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            tok = _forbidden_call(node)
+            if tok is not None:
+                where = ("the decode dispatch loop"
+                         if fn.name == "_loop"
+                         else "a per-sequence loop of _iteration")
+                errs.append(
+                    f"line {node.lineno}: forbidden host sync {tok!r} "
+                    f"inside {where}: {ast.unparse(node)} (the ONE "
+                    "sanctioned drain is _iteration's top-level "
+                    "np.asarray on the fused next-token vector)"
                 )
     return errs
 
@@ -297,6 +362,7 @@ def main(argv: Optional[list] = None) -> int:
     rc = 0
     for path, checker in ((WORKER_PATH, check_source),
                           (SERVE_PATH, check_serve_source),
+                          (DECODE_PATH, check_decode_source),
                           (PROFILE_PATH, check_profile_source)):
         with open(path) as f:
             errs = checker(f.read())

@@ -4,7 +4,9 @@ Every machine-readable line this framework emits — Recorder history
 (``<run>.jsonl``), span traces (``obs/spans_rank*.jsonl``), metric
 snapshots (``obs/metrics.jsonl``, bench.py's snapshot line), heartbeat
 and stall reports, the serving engine's ``serve``/``reload`` records
-(``obs/serve.jsonl``) — must match ONE of the record kinds below, keyed
+(``obs/serve.jsonl``), the continuous-batching decode engine's
+``decode`` records (``obs/decode.jsonl``, ``tmpi_decode_*`` metric
+family) — must match ONE of the record kinds below, keyed
 by the ``kind`` field. Downstream parsing (bench.py drivers, BENCH_*.json
 diffing, tools/plot_history.py) reads these streams; without an
 enforced schema they drift silently and the first symptom is a broken
@@ -378,6 +380,22 @@ SCHEMAS: dict[str, dict[str, tuple[tuple, bool]]] = {
         # classic single-engine path (byte-compatible)
         "replica_id": ((int,), False),
     },
+    # continuous-batching decode engine (serve/decode/engine.py):
+    # periodic + drain-time stats records in <obs_dir>/decode.jsonl
+    # (decode_r<id>.jsonl for replica-fleet members). Same shape as
+    # kind=serve — `params_step` is the served checkpoint step, and
+    # `metrics` is a flat numeric map — but the keys carry the
+    # tmpi_decode_ prefix (TTFT p50/p99 ms, TPOT ms, tokens/sec, KV
+    # page occupancy and free-list conservation totals, per-status
+    # request totals) — ENFORCED below so token-serving telemetry
+    # stays greppable under its own name family, distinct from the
+    # eval-forward engine's.
+    "decode": {
+        "t": (_NUM, True),
+        "params_step": ((int,), True),
+        "metrics": ((dict,), True),
+        "replica_id": ((int,), False),
+    },
     # replica-group router (serve/router.py): one record per routing
     # event in <obs_dir>/router.jsonl. `event` says which: "health"
     # (replica state transition, from_state/to_state), "failover" (an
@@ -480,6 +498,21 @@ SCHEMAS: dict[str, dict[str, tuple[tuple, bool]]] = {
 #   tmpi_serve_batches_total     counter    by bucket=N
 #   tmpi_serve_reloads_total     counter    hot-reloads applied
 SERVE_METRIC_PREFIX = "tmpi_serve_"
+
+# the decode metric name family (kind=decode records may only carry
+# these-prefixed keys — enforced below, same deal as serve's):
+#   tmpi_decode_ttft_seconds    histogram  submit -> first token
+#   tmpi_decode_tpot_seconds    histogram  per-token decode interval
+#   tmpi_decode_queue_depth     gauge      prompts waiting for a slot
+#   tmpi_decode_batch_occupancy gauge      running seqs / max_seqs
+#   tmpi_decode_kv_pages_used   gauge      KV pool pages outstanding
+#   tmpi_decode_kv_pages_free   gauge      KV pool pages in free list
+#   tmpi_decode_requests_total  counter    by status=served|expired|
+#                                          evicted|rejected|failed
+#   tmpi_decode_tokens_total    counter    tokens sampled and returned
+#   tmpi_decode_prefills_total  counter    by bucket=N
+#   tmpi_decode_reloads_total   counter    hot-reloads applied
+DECODE_METRIC_PREFIX = "tmpi_decode_"
 
 # the router metric name family (serve/router.py; kind=router snapshot
 # records may only carry these-prefixed keys — enforced below, same
@@ -610,6 +643,14 @@ def validate_record(obj: Any) -> list[str]:
                     errs.append(
                         f"serve.metrics key {k!r} lacks the "
                         f"{SERVE_METRIC_PREFIX!r} prefix"
+                    )
+        elif kind == "decode":
+            errs += _check_numeric_map(obj["metrics"], "metrics")
+            for k in obj["metrics"]:
+                if isinstance(k, str) and not k.startswith(DECODE_METRIC_PREFIX):
+                    errs.append(
+                        f"decode.metrics key {k!r} lacks the "
+                        f"{DECODE_METRIC_PREFIX!r} prefix"
                     )
         elif kind == "router" and isinstance(obj.get("metrics"), dict):
             errs += _check_numeric_map(obj["metrics"], "metrics")

@@ -30,6 +30,14 @@ moved beyond its tolerance band:
   ``tmpi_model_err_*`` gauges). The models' HONESTY is a gated ratio
   invariant like MFU: a change that doubles how wrong ``cost_model()``
   is about the step wall fails CI even when the step got faster;
+- ``serve_p99_ms`` / ``serve_goodput_rps`` — the replica-fleet serving
+  invariants (``bench.py --serve-bench --replicas N`` against the
+  committed ``experiments/serve_bench/baseline.json``);
+- ``decode_tokens_per_sec`` / ``decode_p99_ttft_ms`` — the LM
+  continuous-batching decode invariants (``bench.py --decode-bench``
+  against ``experiments/decode_bench/baseline.json``): a decode-path
+  change that halves token throughput or triples submit->first-token
+  latency fails exactly like an MFU drop;
 - per-file: a profile report's attribution fractions must sum to
   1.0 +/- the fraction tolerance (the decomposition's own invariant).
 
@@ -80,7 +88,10 @@ GATE_METRICS = ("mfu", "host_blocked_frac", "compression_ratio",
                 "model_err_memory",
                 # serving-fleet invariants (bench.py --serve-bench
                 # --replicas N; committed baseline under experiments/)
-                "serve_p99_ms", "serve_goodput_rps")
+                "serve_p99_ms", "serve_goodput_rps",
+                # LM decode invariants (bench.py --decode-bench;
+                # committed baseline under experiments/decode_bench/)
+                "decode_tokens_per_sec", "decode_p99_ttft_ms")
 
 
 def _num(v) -> Optional[float]:
